@@ -1,0 +1,31 @@
+"""Test harness: emulate an 8-device mesh on CPU.
+
+SURVEY.md §4: the reference tests multi-worker behavior with local threads
+(``local[2]`` / ``local-cluster``); the direct analogue here is
+``--xla_force_host_platform_device_count=8`` on the CPU backend.  Must run
+before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the remote-TPU ("axon") PJRT
+# plugin and force-sets jax_platforms="axon,cpu" via jax.config, trampling
+# the JAX_PLATFORMS env var — re-assert CPU so tests never dial the tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,161 @@
+"""GradientDescent semantics: convergence, loss history, sampling, reg.
+
+Mirrors the reference's GradientDescentSuite strategy (SURVEY.md §4):
+synthetic data from known weights, assert loss decreases and weights approach
+truth; regParam changes solutions; convergence tolerance exits early.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import LeastSquaresGradient, LogisticGradient
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import (
+    GradientDescent,
+    run_mini_batch_sgd,
+)
+from tpu_sgd.utils.mlutils import linear_data, logistic_data
+
+
+def test_linear_recovers_truth():
+    X, y, w_true = linear_data(2000, 10, eps=0.01, seed=0)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(200)
+        .set_convergence_tol(0.0)
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(10, np.float32))
+    assert hist[-1] < hist[0]
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.05)
+
+
+def test_loss_history_decreases_and_matches_contract():
+    X, y, _ = linear_data(500, 5, eps=0.0, seed=1)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.2)
+        .set_num_iterations(50)
+        .set_convergence_tol(0.0)
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(5, np.float32))
+    assert len(hist) == 50
+    # first recorded loss is the loss at the INITIAL weights (before update)
+    expect0 = 0.5 * np.mean((X @ np.zeros(5) - y) ** 2)
+    np.testing.assert_allclose(hist[0], expect0, rtol=1e-4)
+    assert hist[-1] < 1e-2 * hist[0]
+
+
+def test_convergence_tol_early_exit():
+    X, y, _ = linear_data(500, 5, eps=0.0, seed=2)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(500)
+        .set_convergence_tol(1e-3)
+    )
+    _, hist = opt.optimize_with_history((X, y), np.zeros(5, np.float32))
+    assert len(hist) < 500  # exited early
+
+
+def test_reg_param_changes_solution():
+    X, y, _ = logistic_data(1000, 8, seed=3)
+    common = dict(step_size=1.0, num_iterations=60, mini_batch_fraction=1.0,
+                  convergence_tol=0.0)
+    w_low, _ = run_mini_batch_sgd(
+        (X, y), LogisticGradient(), SquaredL2Updater(),
+        reg_param=0.0, initial_weights=np.zeros(8, np.float32), **common)
+    w_high, _ = run_mini_batch_sgd(
+        (X, y), LogisticGradient(), SquaredL2Updater(),
+        reg_param=1.0, initial_weights=np.zeros(8, np.float32), **common)
+    assert np.linalg.norm(np.asarray(w_high)) < np.linalg.norm(np.asarray(w_low))
+
+
+def test_mini_batch_fraction_path_converges():
+    X, y, w_true = linear_data(4000, 6, eps=0.01, seed=4)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(300)
+        .set_mini_batch_fraction(0.1)
+        .set_convergence_tol(0.0)
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+
+
+def test_sampling_is_deterministic_in_seed():
+    X, y, _ = linear_data(1000, 4, seed=5)
+    def go(seed):
+        return np.asarray(
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_num_iterations(20)
+            .set_mini_batch_fraction(0.3)
+            .set_seed(seed)
+            .optimize((X, y), np.zeros(4, np.float32))
+        )
+    a, b, c = go(42), go(42), go(7)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_empty_input_returns_initial_weights():
+    opt = GradientDescent()
+    w0 = np.ones(3, np.float32)
+    w, hist = opt.optimize_with_history(
+        (np.zeros((0, 3), np.float32), np.zeros((0,), np.float32)), w0
+    )
+    np.testing.assert_array_equal(np.asarray(w), w0)
+    assert len(hist) == 0
+
+
+def test_tiny_fraction_warns():
+    X, y, _ = linear_data(10, 2, seed=6)
+    opt = GradientDescent().set_mini_batch_fraction(0.01).set_num_iterations(3)
+    with pytest.warns(RuntimeWarning):
+        opt.optimize((X, y), np.zeros(2, np.float32))
+
+
+def test_integer_features_are_cast():
+    X = np.asarray([[0, 1], [1, 0], [1, 1], [0, 0]] * 50, np.int64)
+    y = (X[:, 0] + 2 * X[:, 1]).astype(np.int64)
+    w = (
+        GradientDescent()
+        .set_step_size(0.5)
+        .set_num_iterations(500)
+        .set_convergence_tol(0.0)
+        .optimize((X, y), np.zeros(2, np.float32))
+    )
+    np.testing.assert_allclose(np.asarray(w), [1.0, 2.0], atol=0.15)
+
+
+def test_repeat_optimize_hits_compile_cache():
+    import time
+
+    X, y, _ = linear_data(256, 4, seed=8)
+    opt = GradientDescent().set_num_iterations(20).set_convergence_tol(0.0)
+    w0 = np.zeros(4, np.float32)
+    opt.optimize((X, y), w0)  # compile
+    t0 = time.perf_counter()
+    for _ in range(5):
+        opt.optimize((X, y), w0)
+    per_call = (time.perf_counter() - t0) / 5
+    assert per_call < 0.05, f"repeat optimize too slow ({per_call:.3f}s) — retracing?"
+
+
+def test_run_mini_batch_sgd_signature_parity():
+    X, y, _ = linear_data(200, 3, seed=7)
+    w, hist = run_mini_batch_sgd(
+        data=(X, y),
+        gradient=LeastSquaresGradient(),
+        updater=SimpleUpdater(),
+        step_size=0.5,
+        num_iterations=30,
+        reg_param=0.0,
+        mini_batch_fraction=1.0,
+        initial_weights=np.zeros(3, np.float32),
+        convergence_tol=0.0,
+    )
+    assert len(hist) == 30
+    assert hist[-1] < hist[0]

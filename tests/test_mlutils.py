@@ -1,0 +1,108 @@
+"""I/O and linalg utilities (SURVEY.md §3.4, §2 #10/#12)."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.linalg import BLAS, DenseVector, SparseVector, Vectors
+from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
+from tpu_sgd.utils.mlutils import (
+    append_bias,
+    load_libsvm_file,
+    save_as_libsvm_file,
+)
+
+
+LIBSVM_TEXT = """\
+1 1:1.5 3:2.0
+0 2:-0.5
+1 1:0.25 2:1.0 3:-1.0
+"""
+
+
+def test_libsvm_load_dense(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text(LIBSVM_TEXT)
+    X, y = load_libsvm_file(str(p))
+    assert X.shape == (3, 3)
+    np.testing.assert_allclose(y, [1, 0, 1])
+    np.testing.assert_allclose(X[0], [1.5, 0.0, 2.0])
+    np.testing.assert_allclose(X[1], [0.0, -0.5, 0.0])
+
+
+def test_libsvm_one_based_indexing(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text("1 1:7.0\n")
+    X, y = load_libsvm_file(str(p))
+    assert X[0, 0] == 7.0  # index 1 on disk -> column 0
+
+
+def test_libsvm_num_features_override(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text("0 2:1.0\n")
+    X, _ = load_libsvm_file(str(p), num_features=10)
+    assert X.shape == (1, 10)
+
+
+def test_libsvm_sparse_csr(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text(LIBSVM_TEXT)
+    (vals, cols, indptr), y, d = load_libsvm_file(str(p), dense=False)
+    assert d == 3
+    assert indptr.tolist() == [0, 2, 3, 6]
+    # row 2 reconstruction
+    row2 = np.zeros(3)
+    row2[cols[indptr[2]:indptr[3]]] = vals[indptr[2]:indptr[3]]
+    np.testing.assert_allclose(row2, [0.25, 1.0, -1.0])
+
+
+def test_libsvm_roundtrip(tmp_path):
+    X = np.asarray([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]], np.float32)
+    y = np.asarray([1.0, 0.0], np.float32)
+    p = tmp_path / "rt.txt"
+    save_as_libsvm_file(str(p), X, y)
+    X2, y2 = load_libsvm_file(str(p), num_features=3)
+    np.testing.assert_allclose(X2, X)
+    np.testing.assert_allclose(y2, y)
+
+
+def test_append_bias():
+    X = np.zeros((4, 2), np.float32)
+    Xb = append_bias(X)
+    assert Xb.shape == (4, 3)
+    np.testing.assert_allclose(Xb[:, -1], 1.0)  # bias is the LAST column
+
+
+def test_labeled_point_parse():
+    lp = LabeledPoint.parse("(1.0,[2.5,3.5])")
+    assert lp.label == 1.0
+    np.testing.assert_allclose(lp.features, [2.5, 3.5])
+    lp2 = LabeledPoint.parse("0 1.0 2.0 3.0")
+    assert lp2.label == 0.0 and lp2.features.shape == (3,)
+
+
+def test_to_arrays():
+    X, y = to_arrays([LabeledPoint(1.0, np.asarray([1.0, 2.0]))])
+    assert X.shape == (1, 2) and y.tolist() == [1.0]
+
+
+class TestLinalg:
+    def test_dense_sparse_equality(self):
+        d = Vectors.dense(1.0, 0.0, 2.0)
+        s = Vectors.sparse(3, [0, 2], [1.0, 2.0])
+        assert d == s and s == d
+
+    def test_dot(self):
+        d = Vectors.dense([1.0, 2.0, 3.0])
+        s = Vectors.sparse(3, [1], [4.0])
+        assert d.dot(s) == 8.0
+        assert BLAS.dot(d, s) == 8.0
+
+    def test_axpy_scal(self):
+        acc = np.zeros(3, np.float32)
+        BLAS.axpy(2.0, Vectors.dense([1.0, 1.0, 1.0]), acc)
+        np.testing.assert_allclose(acc, [2, 2, 2])
+        BLAS.scal(0.5, acc)
+        np.testing.assert_allclose(acc, [1, 1, 1])
+
+    def test_zeros(self):
+        assert Vectors.zeros(4).size == 4

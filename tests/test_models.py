@@ -1,0 +1,151 @@
+"""Model-level integration tests (SURVEY.md §4: LinearRegressionSuite,
+LogisticRegressionSuite, SVMSuite analogues): train on synthetic data, assert
+accuracy; with/without intercept; save/load round-trip; validators."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import (
+    LabeledPoint,
+    LassoWithSGD,
+    LinearRegressionModel,
+    LinearRegressionWithSGD,
+    LogisticRegressionModel,
+    LogisticRegressionWithSGD,
+    RidgeRegressionWithSGD,
+    SVMModel,
+    SVMWithSGD,
+)
+from tpu_sgd.ops.updaters import L1Updater
+from tpu_sgd.utils.mlutils import linear_data, logistic_data, svm_data
+
+
+def test_linear_regression_config1():
+    """Config 1 (BASELINE.json:7) at test scale: dense synthetic least squares."""
+    X, y, w_true = linear_data(5000, 20, eps=0.1, seed=0)
+    model = LinearRegressionWithSGD.train((X, y), num_iterations=200, step_size=0.5)
+    pred = np.asarray(model.predict(X))
+    mse = np.mean((pred - y) ** 2)
+    assert mse < 0.05  # noise floor is eps^2 = 0.01
+    np.testing.assert_allclose(np.asarray(model.weights), w_true, atol=0.05)
+
+
+def test_linear_regression_with_intercept():
+    X, y, w_true = linear_data(5000, 8, intercept=2.5, eps=0.05, seed=1)
+    model = LinearRegressionWithSGD.train(
+        (X, y), num_iterations=300, step_size=0.5, intercept=True
+    )
+    assert abs(model.intercept - 2.5) < 0.1
+    np.testing.assert_allclose(np.asarray(model.weights), w_true, atol=0.1)
+
+
+def test_predict_single_vector():
+    X, y, _ = linear_data(500, 4, seed=2)
+    model = LinearRegressionWithSGD.train((X, y), num_iterations=100, step_size=0.5)
+    single = float(model.predict(X[0]))
+    batch = np.asarray(model.predict(X[:1]))[0]
+    assert abs(single - batch) < 1e-6
+
+
+def test_labeled_point_input():
+    X, y, _ = linear_data(300, 3, seed=3)
+    pts = [LabeledPoint(float(y[i]), X[i]) for i in range(len(y))]
+    model = LinearRegressionWithSGD.train(pts, num_iterations=100, step_size=0.5)
+    assert model.weights.shape == (3,)
+
+
+def test_logistic_regression_accuracy():
+    X, y, w_true = logistic_data(4000, 10, seed=4)
+    model = LogisticRegressionWithSGD.train((X, y), num_iterations=100, reg_param=0.0)
+    acc = np.mean(np.asarray(model.predict(X)) == y)
+    bayes = np.mean((X @ w_true > 0).astype(np.float32) == y)  # optimal classifier
+    assert acc > bayes - 0.02
+
+
+def test_logistic_threshold_and_clear():
+    X, y, _ = logistic_data(1000, 5, seed=5)
+    model = LogisticRegressionWithSGD.train((X, y), num_iterations=50)
+    raw = np.asarray(model.clear_threshold().predict(X))
+    assert raw.min() >= 0.0 and raw.max() <= 1.0  # sigmoid scores
+    model.set_threshold(0.5)
+    lab = np.asarray(model.predict(X))
+    assert set(np.unique(lab)) <= {0.0, 1.0}
+
+
+def test_label_validator_rejects_bad_labels():
+    X = np.random.default_rng(6).normal(size=(10, 3)).astype(np.float32)
+    y = np.asarray([0, 1, 2, 0, 1, 0, 1, 0, 1, 0], np.float32)
+    with pytest.raises(ValueError, match="0 or 1"):
+        LogisticRegressionWithSGD.train((X, y), num_iterations=5)
+
+
+def test_svm_accuracy_and_l1(tmp_path):
+    """Config 3 shape (BASELINE.json:9): hinge + L1Updater."""
+    X, y, _ = svm_data(4000, 10, noise=0.05, seed=7)
+    model = SVMWithSGD.train(
+        (X, y), num_iterations=100, reg_param=0.01, updater=L1Updater()
+    )
+    acc = np.mean(np.asarray(model.predict(X)) == y)
+    assert acc > 0.9
+    raw = np.asarray(model.clear_threshold().predict(X))
+    assert raw.min() < 0 < raw.max()  # raw margins after clear_threshold
+
+
+def test_lasso_sparsity_vs_ridge():
+    r = np.random.default_rng(8)
+    w_true = np.zeros(20, np.float32)
+    w_true[:3] = [2.0, -1.5, 1.0]  # only 3 informative features
+    X, y, _ = linear_data(3000, 20, weights=w_true, eps=0.05, seed=8)
+    lasso = LassoWithSGD.train((X, y), num_iterations=200, reg_param=0.5,
+                               step_size=0.5)
+    ridge = RidgeRegressionWithSGD.train((X, y), num_iterations=200, reg_param=0.5,
+                                         step_size=0.5)
+    wl = np.asarray(lasso.weights)
+    wr = np.asarray(ridge.weights)
+    assert (np.abs(wl) < 1e-3).sum() > (np.abs(wr) < 1e-3).sum()
+    assert (np.abs(wl[3:]) < 0.05).all()  # uninformative features killed
+
+
+def test_save_load_roundtrip(tmp_path):
+    X, y, _ = linear_data(500, 6, seed=9)
+    model = LinearRegressionWithSGD.train((X, y), num_iterations=50, step_size=0.5,
+                                          intercept=True)
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(np.asarray(loaded.weights),
+                               np.asarray(model.weights))
+    assert loaded.intercept == model.intercept
+    np.testing.assert_allclose(np.asarray(loaded.predict(X)),
+                               np.asarray(model.predict(X)))
+
+
+def test_save_load_threshold_state(tmp_path):
+    X, y, _ = logistic_data(300, 4, seed=10)
+    model = LogisticRegressionWithSGD.train((X, y), num_iterations=20)
+    model.clear_threshold()
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = LogisticRegressionModel.load(path)
+    assert loaded.threshold is None  # cleared state survives
+
+
+def test_load_wrong_class_rejected(tmp_path):
+    X, y, _ = logistic_data(300, 4, seed=11)
+    model = LogisticRegressionWithSGD.train((X, y), num_iterations=10)
+    path = str(tmp_path / "m")
+    model.save(path)
+    with pytest.raises(ValueError, match="expected"):
+        SVMModel.load(path)
+
+
+def test_warm_start_initial_weights():
+    X, y, w_true = linear_data(2000, 6, eps=0.01, seed=12)
+    m1 = LinearRegressionWithSGD.train((X, y), num_iterations=50, step_size=0.5)
+    m2 = LinearRegressionWithSGD.train(
+        (X, y), num_iterations=50, step_size=0.5,
+        initial_weights=np.asarray(m1.weights),
+    )
+    e1 = np.linalg.norm(np.asarray(m1.weights) - w_true)
+    e2 = np.linalg.norm(np.asarray(m2.weights) - w_true)
+    assert e2 <= e1 + 1e-4

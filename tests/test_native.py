@@ -1,0 +1,58 @@
+"""Native C++ LIBSVM parser: build + parity with the Python parser."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_sgd.utils.mlutils import _parse_libsvm_python
+
+TEXT = "1 1:1.5 3:2.0\n0 2:-0.5  # comment\n\n1 1:0.25 2:1.0 3:-1.0\n"
+
+
+@pytest.fixture(scope="module")
+def native():
+    from tpu_sgd.utils.native import _LIB_PATH, parse_libsvm
+
+    if not os.path.exists(_LIB_PATH):
+        from tpu_sgd.utils.native.build import build
+
+        try:
+            build(verbose=False)
+        except Exception as e:  # pragma: no cover - toolchain missing
+            pytest.skip(f"cannot build native parser: {e}")
+    return parse_libsvm
+
+
+def test_native_matches_python(native, tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text(TEXT)
+    ln, rn, cn, vn, mn = native(str(p))
+    lp, rp, cp, vp, mp = _parse_libsvm_python(str(p))
+    np.testing.assert_array_equal(ln, lp)
+    np.testing.assert_array_equal(rn, rp)
+    np.testing.assert_array_equal(cn, cp)
+    np.testing.assert_allclose(vn, vp)
+    assert mn == mp
+
+
+def test_native_rejects_zero_index(native, tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("1 0:5.0\n")
+    with pytest.raises(IOError):
+        native(str(p))
+
+
+def test_native_large_random_roundtrip(native, tmp_path):
+    r = np.random.default_rng(0)
+    n, d = 200, 40
+    X = (r.random((n, d)) * (r.random((n, d)) < 0.1)).astype(np.float32)
+    X[:, -1] = 1.0  # keep max index stable
+    y = (r.random(n) < 0.5).astype(np.float32)
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    p = tmp_path / "big.txt"
+    save_as_libsvm_file(str(p), X, y)
+    X2, y2 = load_libsvm_file(str(p))
+    np.testing.assert_allclose(X2, X, rtol=1e-4)
+    np.testing.assert_array_equal(y2, y)

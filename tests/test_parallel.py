@@ -1,0 +1,107 @@
+"""Data-parallel correctness: sharded step vs single-device step.
+
+SURVEY.md §4 mapping: property-test parity between the single-device step and
+the sharded step on the 8-device CPU mesh (the analogue of Spark's
+local-cluster tests); config-4 shape at small scale.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import LeastSquaresGradient, LogisticGradient
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+from tpu_sgd.parallel.data_parallel import dp_optimize, pad_to_multiple, shard_dataset
+from tpu_sgd.parallel.mesh import data_mesh, make_mesh
+from tpu_sgd.utils.mlutils import linear_data, logistic_data
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return data_mesh()
+
+
+def test_pad_to_multiple():
+    X = np.ones((10, 3), np.float32)
+    y = np.ones((10,), np.float32)
+    Xp, yp, valid = pad_to_multiple(X, y, 8)
+    assert Xp.shape == (16, 3) and yp.shape == (16,)
+    assert valid.sum() == 10 and valid[:10].all() and not valid[10:].any()
+
+
+def test_full_batch_parity_with_single_device(mesh):
+    """frac=1.0: the DP result equals the single-device result (the psum is
+    just a re-association of the same sum)."""
+    X, y, _ = linear_data(1024, 12, seed=0)
+    w0 = np.zeros(12, np.float32)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.3)
+        .set_num_iterations(40)
+        .set_convergence_tol(0.0)
+    )
+    w_single, h_single = opt.optimize_with_history((X, y), w0)
+    w_dp, h_dp, n_rec = dp_optimize(
+        LeastSquaresGradient(), SimpleUpdater(), opt.config, mesh, w0, X, y
+    )
+    assert int(n_rec) == 40
+    np.testing.assert_allclose(np.asarray(w_dp), np.asarray(w_single), rtol=2e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_dp)[:40], h_single, rtol=2e-4, atol=1e-5)
+
+
+def test_uneven_shards_padded_parity(mesh):
+    """n not divisible by 8: padded rows must contribute nothing."""
+    X, y, _ = linear_data(1003, 5, seed=1)
+    w0 = np.zeros(5, np.float32)
+    cfg = SGDConfig(step_size=0.3, num_iterations=25, convergence_tol=0.0)
+    opt = GradientDescent(LeastSquaresGradient(), SimpleUpdater(), cfg)
+    w_single, h_single = opt.optimize_with_history((X, y), w0)
+    w_dp, h_dp, n_rec = dp_optimize(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, mesh, w0, X, y
+    )
+    np.testing.assert_allclose(np.asarray(w_dp), np.asarray(w_single), rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_minibatch_dp_converges(mesh):
+    """Config-4 shape at small scale: frac=0.1, 8-way DP all-reduce."""
+    X, y, w_true = linear_data(8000, 10, eps=0.01, seed=2)
+    cfg = SGDConfig(step_size=0.5, num_iterations=300, mini_batch_fraction=0.1,
+                    convergence_tol=0.0)
+    w_dp, h_dp, n_rec = dp_optimize(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, mesh, np.zeros(10, np.float32),
+        X, y
+    )
+    np.testing.assert_allclose(np.asarray(w_dp), w_true, atol=0.1)
+    h = np.asarray(h_dp)[: int(n_rec)]
+    assert h[-1] < 0.1 * h[0]
+
+
+def test_optimizer_set_mesh_integration(mesh):
+    X, y, _ = logistic_data(2048, 6, seed=3)
+    opt = (
+        GradientDescent(LogisticGradient(), SquaredL2Updater())
+        .set_reg_param(0.01)
+        .set_num_iterations(50)
+        .set_convergence_tol(0.0)
+        .set_mesh(mesh)
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    assert hist[-1] < hist[0]
+
+
+def test_2d_mesh_constructs():
+    m = make_mesh(n_data=4, n_model=2)
+    assert m.shape["data"] == 4 and m.shape["model"] == 2
+
+
+def test_shard_dataset_places_rows(mesh):
+    X, y, _ = linear_data(64, 4, seed=4)
+    Xd, yd, valid = shard_dataset(mesh, X, y)
+    assert valid is None
+    assert len(Xd.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(Xd), X)

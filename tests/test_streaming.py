@@ -1,0 +1,100 @@
+"""Streaming SGD tests (SURVEY.md §4: StreamingLinearRegressionSuite
+analogue): deterministic micro-batch generator, weights move toward truth,
+prediction error falls."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models.streaming import (
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+from tpu_sgd.utils.mlutils import linear_data, logistic_data
+
+
+def micro_batches(n_batches, n, d, w_true, eps=0.05, seed=0):
+    """Deterministic generator — the analogue of ManualClock queued batches."""
+    for i in range(n_batches):
+        X, y, _ = linear_data(n, d, weights=w_true, eps=eps, seed=seed + i)
+        yield X, y
+
+
+def test_streaming_linear_converges_to_truth():
+    d = 8
+    w_true = np.linspace(-1, 1, d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=20)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    errs = []
+    for X, y in micro_batches(10, 500, d, w_true):
+        alg.train_on_batch(X, y)
+        errs.append(np.linalg.norm(np.asarray(alg.latest_model().weights) - w_true))
+    assert errs[-1] < 0.1
+    assert errs[-1] < errs[0]
+
+
+def test_streaming_prediction_error_falls():
+    d = 6
+    w_true = np.ones(d, np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=20)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    Xt, yt, _ = linear_data(500, d, weights=w_true, eps=0.01, seed=99)
+    alg.train_on_batch(*next(micro_batches(1, 500, d, w_true, seed=1)))
+    early = np.mean((np.asarray(alg.latest_model().predict(Xt)) - yt) ** 2)
+    alg.train_on(micro_batches(8, 500, d, w_true, seed=2))
+    late = np.mean((np.asarray(alg.latest_model().predict(Xt)) - yt) ** 2)
+    assert late < early
+
+
+def test_streaming_train_on_full_stream():
+    d = 4
+    w_true = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=25)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    model = alg.train_on(micro_batches(12, 400, d, w_true))
+    np.testing.assert_allclose(np.asarray(model.weights), w_true, atol=0.15)
+
+
+def test_predict_on_uses_latest_model():
+    d = 3
+    alg = StreamingLinearRegressionWithSGD()
+    alg.set_initial_weights(np.ones(d, np.float32))
+    stream = [np.eye(d, dtype=np.float32)]
+    (pred,) = list(alg.predict_on(iter(stream)))
+    np.testing.assert_allclose(pred, np.ones(d), rtol=1e-5)
+
+
+def test_predict_on_values_keys_preserved():
+    d = 2
+    alg = StreamingLinearRegressionWithSGD()
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    out = list(alg.predict_on_values([("a", np.ones((1, d), np.float32))]))
+    assert out[0][0] == "a"
+
+
+def test_uninitialized_model_raises():
+    alg = StreamingLinearRegressionWithSGD()
+    with pytest.raises(RuntimeError, match="initialized"):
+        alg.latest_model()
+
+
+def test_empty_batch_skipped():
+    d = 3
+    alg = StreamingLinearRegressionWithSGD()
+    alg.set_initial_weights(np.ones(d, np.float32))
+    before = np.asarray(alg.latest_model().weights).copy()
+    alg.train_on_batch(np.zeros((0, d), np.float32), np.zeros((0,), np.float32))
+    np.testing.assert_array_equal(np.asarray(alg.latest_model().weights), before)
+
+
+def test_streaming_logistic():
+    d = 5
+    w_true = np.asarray([1.0, -1.0, 2.0, -2.0, 0.5], np.float32)
+    alg = StreamingLogisticRegressionWithSGD(step_size=0.5, num_iterations=20)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    for i in range(8):
+        X, y, _ = logistic_data(600, d, weights=w_true, seed=i)
+        alg.train_on_batch(X, y)
+    Xt, yt, _ = logistic_data(1000, d, weights=w_true, seed=100)
+    acc = np.mean(np.asarray(alg.latest_model().predict(Xt)) == yt)
+    bayes = np.mean((Xt @ w_true > 0).astype(np.float32) == yt)
+    assert acc > bayes - 0.03

@@ -1,0 +1,66 @@
+"""Updater semantics vs hand-computed closed forms (SURVEY.md §4, §7)."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.ops.updaters import L1Updater, SimpleUpdater, SquaredL2Updater
+
+
+W = np.asarray([0.5, -0.3, 0.0, 2.0], np.float32)
+G = np.asarray([0.1, -0.2, 0.3, -0.4], np.float32)
+
+
+def test_simple_updater_step_decay():
+    u = SimpleUpdater()
+    for t in (1, 4, 9):
+        w, reg = u.compute(W, G, 1.0, t, 0.5)
+        np.testing.assert_allclose(w, W - G / np.sqrt(t), rtol=1e-6)
+        assert float(reg) == 0.0
+
+
+def test_l2_updater_closed_form():
+    u = SquaredL2Updater()
+    step, t, reg_param = 0.7, 4, 0.3
+    eta = step / np.sqrt(t)
+    expect = W * (1 - eta * reg_param) - eta * G
+    w, reg = u.compute(W, G, step, t, reg_param)
+    np.testing.assert_allclose(w, expect, rtol=1e-6)
+    np.testing.assert_allclose(reg, 0.5 * reg_param * (expect**2).sum(), rtol=1e-6)
+
+
+def test_l1_updater_soft_threshold():
+    u = L1Updater()
+    step, t, reg_param = 1.0, 1, 0.25
+    eta = step / np.sqrt(t)
+    raw = W - eta * G
+    shrink = reg_param * eta
+    expect = np.sign(raw) * np.maximum(np.abs(raw) - shrink, 0.0)
+    w, reg = u.compute(W, G, step, t, reg_param)
+    np.testing.assert_allclose(w, expect, rtol=1e-6)
+    np.testing.assert_allclose(reg, reg_param * np.abs(expect).sum(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_l1_prox_property(seed):
+    """Soft-thresholding: |w'| <= |raw step| and small raw values are zeroed."""
+    r = np.random.default_rng(seed)
+    w0 = r.normal(size=(20,)).astype(np.float32)
+    g = r.normal(size=(20,)).astype(np.float32)
+    step, t, reg_param = 0.5, 3, 0.4
+    eta = step / np.sqrt(t)
+    raw = w0 - eta * g
+    w, _ = L1Updater().compute(w0, g, step, t, reg_param)
+    w = np.asarray(w)
+    assert np.all(np.abs(w) <= np.abs(raw) + 1e-6)
+    assert np.all(w[np.abs(raw) <= reg_param * eta] == 0.0)
+    moved = np.abs(raw) > reg_param * eta
+    np.testing.assert_allclose(
+        np.abs(w[moved]), np.abs(raw[moved]) - reg_param * eta, rtol=1e-4, atol=1e-6
+    )
+
+
+def test_zero_gradient_probe_reg_val():
+    """The optimizer initializes regVal via a zero-gradient probe update."""
+    w, reg = SquaredL2Updater().compute(W, np.zeros_like(W), 0.0, 1, 0.3)
+    np.testing.assert_allclose(w, W, rtol=1e-6)
+    np.testing.assert_allclose(reg, 0.5 * 0.3 * (W**2).sum(), rtol=1e-6)

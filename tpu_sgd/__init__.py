@@ -1,0 +1,30 @@
+"""tpu_sgd: a TPU-native framework with the capabilities of
+``Patrickgsheng/spark-parallelized-sgd`` (Spark-MLlib-style parallelized
+mini-batch SGD for generalized linear models).
+
+The reference's capability contract is preserved — the
+Optimizer × Gradient × Updater plugin boundary, the model families
+(Linear/Lasso/Ridge regression, logistic regression, linear SVM, streaming
+variants), seeded mini-batch sampling, loss history, convergence tolerance —
+re-designed TPU-first: fused XLA matvec gradient steps, a whole-run
+``lax.while_loop`` driver, and ``shard_map`` + ``lax.psum`` data parallelism
+over ICI.  See SURVEY.md for the reference analysis this build follows.
+"""
+
+from tpu_sgd.config import MeshConfig, SGDConfig
+from tpu_sgd.linalg import BLAS, DenseVector, SparseVector, Vectors
+from tpu_sgd.models import *  # noqa: F401,F403
+from tpu_sgd.models import __all__ as _models_all
+from tpu_sgd.ops import *  # noqa: F401,F403
+from tpu_sgd.ops import __all__ as _ops_all
+from tpu_sgd.optimize import GradientDescent, Optimizer, run_mini_batch_sgd
+from tpu_sgd.parallel import data_mesh, make_mesh
+
+__version__ = "0.1.0"
+
+__all__ = (
+    ["SGDConfig", "MeshConfig", "Vectors", "DenseVector", "SparseVector", "BLAS"]
+    + list(_models_all)
+    + list(_ops_all)
+    + ["GradientDescent", "Optimizer", "run_mini_batch_sgd", "data_mesh", "make_mesh"]
+)

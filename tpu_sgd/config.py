@@ -1,0 +1,62 @@
+"""Configuration for the TPU-native SGD framework.
+
+Mirrors the reference's two-tier config system (SURVEY.md §5.6): Spark exposes
+builder-style setters on the optimizer/algorithm (``setStepSize``,
+``setNumIterations``, ``setRegParam``, ``setMiniBatchFraction``,
+``setConvergenceTol``) with defaults step=1.0, iters=100, frac=1.0, reg=0.0,
+convTol=0.001.  Here the same knobs live in a frozen dataclass; the fluent
+setters on :class:`~tpu_sgd.optimize.gradient_descent.GradientDescent` return
+updated copies of it.
+
+Reference parity: [U] mllib/optimization/GradientDescent.scala (defaults set in
+the class constructor; see SURVEY.md §2 #2, §5.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDConfig:
+    """Hyper-parameters of mini-batch SGD, with the reference's defaults.
+
+    Attributes:
+      step_size: initial step size; decays as ``step_size / sqrt(iter)``
+        inside the updaters (parity with Spark's ``Updater.compute``).
+      num_iterations: number of outer SGD iterations.
+      reg_param: regularization strength handed to the updater.
+      mini_batch_fraction: Bernoulli sampling fraction per iteration
+        (parity with ``data.sample(false, frac, 42 + i)``).
+      convergence_tol: early-exit tolerance on the relative weight delta,
+        ``||w_new - w_old|| < tol * max(||w_new||, 1)``.
+      seed: base RNG seed; iteration ``i`` folds in ``seed + i`` (the
+        distributional analogue of Spark's per-iteration seed ``42 + i``).
+    """
+
+    step_size: float = 1.0
+    num_iterations: int = 100
+    reg_param: float = 0.0
+    mini_batch_fraction: float = 1.0
+    convergence_tol: float = 0.001
+    seed: int = 42
+
+    def replace(self, **kwargs) -> "SGDConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the device mesh the optimizer runs over.
+
+    The reference's only parallelism axis is data parallelism (SURVEY.md §2
+    parallelism ledger); ``model`` is the optional feature-sharding hook for
+    very wide weight vectors (SURVEY.md §2 ledger, TP row).
+    """
+
+    data: int = 1
+    model: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model

@@ -1,0 +1,118 @@
+"""Vector types and BLAS-level ops.
+
+Reference parity: [U] mllib/linalg/{Vectors,BLAS}.scala (SURVEY.md §2 #10-#11).
+The reference's linalg layer is dense/sparse vector records dispatching to
+netlib-java BLAS (its one native component).  On TPU the "native BLAS" role is
+played by XLA itself — every ``jnp`` matvec hits the MXU — so this module is
+deliberately thin: vector record types for loaders and API parity, plus
+``dot``/``axpy``/``scal`` shims that work on either record type or raw
+arrays.  The hot path never goes through per-example BLAS calls (that is the
+whole point of the redesign, SURVEY.md §2 native-component ledger); these
+exist for parity, tests, and host-side glue.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+
+class DenseVector:
+    __slots__ = ("values",)
+
+    def __init__(self, values):
+        self.values = np.asarray(values, np.float32)
+
+    @property
+    def size(self) -> int:
+        return self.values.shape[0]
+
+    def to_array(self) -> np.ndarray:
+        return self.values
+
+    def dot(self, other) -> float:
+        return float(self.values @ _values_of(other, self.size))
+
+    def __repr__(self):
+        return f"DenseVector({self.values.tolist()})"
+
+    def __eq__(self, other):
+        return isinstance(other, (DenseVector, SparseVector)) and np.array_equal(
+            self.to_array(), _values_of(other, self.size)
+        )
+
+
+class SparseVector:
+    __slots__ = ("size", "indices", "values")
+
+    def __init__(self, size: int, indices: Sequence[int], values: Sequence[float]):
+        self.size = int(size)
+        self.indices = np.asarray(indices, np.int64)
+        self.values = np.asarray(values, np.float32)
+        if self.indices.shape != self.values.shape:
+            raise ValueError("indices and values must have the same length")
+
+    def to_array(self) -> np.ndarray:
+        out = np.zeros((self.size,), np.float32)
+        out[self.indices] = self.values
+        return out
+
+    def dot(self, other) -> float:
+        return float(self.to_array() @ _values_of(other, self.size))
+
+    def __repr__(self):
+        return f"SparseVector({self.size}, {self.indices.tolist()}, {self.values.tolist()})"
+
+    def __eq__(self, other):
+        return isinstance(other, (DenseVector, SparseVector)) and np.array_equal(
+            self.to_array(), _values_of(other, self.size)
+        )
+
+
+Vector = Union[DenseVector, SparseVector, np.ndarray]
+
+
+def _values_of(v: Vector, size: int) -> np.ndarray:
+    if isinstance(v, (DenseVector, SparseVector)):
+        return v.to_array()
+    return np.asarray(v, np.float32)
+
+
+class Vectors:
+    """Factory namespace, parity with the reference's ``Vectors`` object."""
+
+    @staticmethod
+    def dense(*values) -> DenseVector:
+        if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+            return DenseVector(values[0])
+        return DenseVector(values)
+
+    @staticmethod
+    def sparse(size: int, indices, values) -> SparseVector:
+        return SparseVector(size, indices, values)
+
+    @staticmethod
+    def zeros(size: int) -> DenseVector:
+        return DenseVector(np.zeros((size,), np.float32))
+
+
+class BLAS:
+    """Level-1 shims (host-side; device code uses jnp/MXU directly)."""
+
+    @staticmethod
+    def dot(x: Vector, y: Vector) -> float:
+        xv = _values_of(x, getattr(x, "size", None) or len(x))
+        return float(xv @ _values_of(y, xv.shape[0]))
+
+    @staticmethod
+    def axpy(a: float, x: Vector, y: np.ndarray) -> np.ndarray:
+        """y += a * x in place on a numpy accumulator; returns y."""
+        xv = _values_of(x, y.shape[0])
+        y += a * xv
+        return y
+
+    @staticmethod
+    def scal(a: float, x: np.ndarray) -> np.ndarray:
+        x *= a
+        return x

@@ -1,0 +1,41 @@
+from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
+from tpu_sgd.models.glm import GeneralizedLinearAlgorithm, GeneralizedLinearModel
+from tpu_sgd.models.regression import (
+    LassoModel,
+    LassoWithSGD,
+    LinearRegressionModel,
+    LinearRegressionWithSGD,
+    RidgeRegressionModel,
+    RidgeRegressionWithSGD,
+)
+from tpu_sgd.models.classification import (
+    LogisticRegressionModel,
+    LogisticRegressionWithSGD,
+    SVMModel,
+    SVMWithSGD,
+)
+from tpu_sgd.models.streaming import (
+    StreamingLinearAlgorithm,
+    StreamingLinearRegressionWithSGD,
+    StreamingLogisticRegressionWithSGD,
+)
+
+__all__ = [
+    "LabeledPoint",
+    "to_arrays",
+    "GeneralizedLinearAlgorithm",
+    "GeneralizedLinearModel",
+    "LinearRegressionModel",
+    "LinearRegressionWithSGD",
+    "LassoModel",
+    "LassoWithSGD",
+    "RidgeRegressionModel",
+    "RidgeRegressionWithSGD",
+    "LogisticRegressionModel",
+    "LogisticRegressionWithSGD",
+    "SVMModel",
+    "SVMWithSGD",
+    "StreamingLinearAlgorithm",
+    "StreamingLinearRegressionWithSGD",
+    "StreamingLogisticRegressionWithSGD",
+]

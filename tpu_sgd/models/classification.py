@@ -1,0 +1,149 @@
+"""Classification model families: logistic regression and linear SVM.
+
+Reference parity: [U] mllib/classification/{LogisticRegression,SVM}.scala
+(SURVEY.md §2 #7-#8).  Reference defaults mirrored: both use step=1.0,
+iters=100, reg=0.01, frac=1.0 and the squared-L2 updater; config 3
+(BASELINE.json:9) swaps the SVM's updater for L1 via
+``svm.optimizer.set_updater(L1Updater())``.  Prediction thresholds are
+mutable and clearable exactly like the reference (``clear_threshold`` makes
+``predict`` return raw scores).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.models.glm import GeneralizedLinearAlgorithm, GeneralizedLinearModel
+from tpu_sgd.ops.gradients import HingeGradient, LogisticGradient
+from tpu_sgd.ops.updaters import SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+
+class _ThresholdedModel(GeneralizedLinearModel):
+    _default_threshold = 0.5
+
+    def __init__(self, weights, intercept: float = 0.0):
+        super().__init__(weights, intercept)
+        self.threshold = self._default_threshold
+
+    def set_threshold(self, t: float):
+        self.threshold = float(t)
+        return self
+
+    def clear_threshold(self):
+        """After this, ``predict`` returns raw scores (reference parity)."""
+        self.threshold = None
+        return self
+
+    def score(self, margin):
+        raise NotImplementedError
+
+    def predict_point(self, margin):
+        s = self.score(margin)
+        if self.threshold is None:
+            return s
+        return (s > self.threshold).astype(jnp.float32)
+
+
+class LogisticRegressionModel(_ThresholdedModel):
+    """Sigmoid score thresholded at 0.5 by default."""
+
+    def score(self, margin):
+        return jax.nn.sigmoid(margin)
+
+
+class SVMModel(_ThresholdedModel):
+    """Raw margin thresholded at 0.0 by default."""
+
+    _default_threshold = 0.0
+
+    def score(self, margin):
+        return margin
+
+
+def _save(model, path):
+    from tpu_sgd.utils.persistence import save_glm_model
+
+    save_glm_model(path, model)
+
+
+def _load(cls, path):
+    from tpu_sgd.utils.persistence import load_glm_model
+
+    return load_glm_model(path, cls)
+
+
+LogisticRegressionModel.save = _save
+LogisticRegressionModel.load = classmethod(_load)
+SVMModel.save = _save
+SVMModel.load = classmethod(_load)
+
+
+class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
+    _gradient_cls = None
+    _model_cls = None
+
+    def __init__(
+        self,
+        step_size: float = 1.0,
+        num_iterations: int = 100,
+        reg_param: float = 0.01,
+        mini_batch_fraction: float = 1.0,
+    ):
+        super().__init__()
+        self.optimizer = (
+            GradientDescent(self._gradient_cls(), SquaredL2Updater())
+            .set_step_size(step_size)
+            .set_num_iterations(num_iterations)
+            .set_reg_param(reg_param)
+            .set_mini_batch_fraction(mini_batch_fraction)
+        )
+
+    def validators(self, X, y):
+        """Binary label validator ([U] DataValidators.binaryLabelValidator)."""
+        bad = np.logical_and(y != 0.0, y != 1.0)
+        if bad.any():
+            raise ValueError(
+                "Classification labels should be 0 or 1; found "
+                f"{np.unique(np.asarray(y)[bad])[:5]}"
+            )
+
+    def create_model(self, weights, intercept):
+        return self._model_cls(weights, intercept)
+
+    @classmethod
+    def train(
+        cls,
+        data,
+        num_iterations: int = 100,
+        step_size: float = 1.0,
+        reg_param: float = 0.01,
+        mini_batch_fraction: float = 1.0,
+        initial_weights=None,
+        intercept: bool = False,
+        updater=None,
+        mesh=None,
+    ):
+        alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
+        alg.set_intercept(intercept)
+        if updater is not None:
+            alg.optimizer.set_updater(updater)
+        if mesh is not None:
+            alg.optimizer.set_mesh(mesh)
+        return alg.run(data, initial_weights)
+
+
+class LogisticRegressionWithSGD(_BinaryClassifierWithSGD):
+    """Binary logistic regression via SGD (config 2, BASELINE.json:8)."""
+
+    _gradient_cls = LogisticGradient
+    _model_cls = LogisticRegressionModel
+
+
+class SVMWithSGD(_BinaryClassifierWithSGD):
+    """Linear SVM via hinge-loss SGD (config 3, BASELINE.json:9)."""
+
+    _gradient_cls = HingeGradient
+    _model_cls = SVMModel

@@ -1,0 +1,137 @@
+"""Generalized linear model harness.
+
+Reference parity: [U] mllib/regression/GeneralizedLinearAlgorithm.scala
+(SURVEY.md §2 #5, §1 L5).  Owns exactly what the reference's harness owns:
+input validation, feature-count discovery, intercept handling (bias appended
+as the LAST column, parity with ``MLUtils.appendBias``), calling
+``optimizer.optimize``, splitting the intercept back out, and
+``create_model``.  Models own prediction; training always flows through
+``run``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
+from tpu_sgd.optimize.optimizer import Optimizer
+
+DatasetLike = Union[Tuple, Iterable[LabeledPoint]]
+
+
+def _as_arrays(data: DatasetLike) -> Tuple[np.ndarray, np.ndarray]:
+    if isinstance(data, tuple) and len(data) == 2:
+        X, y = data
+        return np.asarray(X), np.asarray(y)
+    return to_arrays(data)
+
+
+class GeneralizedLinearModel:
+    """Weights + intercept + prediction rule (abstract ``predict_point``)."""
+
+    def __init__(self, weights, intercept: float = 0.0):
+        self.weights = jnp.asarray(weights)
+        self.intercept = float(intercept)
+
+    def _margin(self, X):
+        X = jnp.asarray(X)
+        return X @ self.weights + self.intercept
+
+    def predict_margin(self, X):
+        """Raw margin(s) ``x.w + b`` for a single vector or a batch."""
+        return self._margin(jnp.atleast_2d(jnp.asarray(X)))
+
+    def predict_point(self, margin):
+        raise NotImplementedError
+
+    def predict(self, X):
+        """Predict for one feature vector or a batch (parity with the
+        reference's ``predict(Vector)`` / ``predict(RDD[Vector])``)."""
+        X = jnp.asarray(X)
+        single = X.ndim == 1
+        out = self.predict_point(self._margin(jnp.atleast_2d(X)))
+        return out[0] if single else out
+
+    def __repr__(self):
+        return (
+            f"{type(self).__name__}(numFeatures={self.weights.shape[-1]}, "
+            f"intercept={self.intercept})"
+        )
+
+
+class GeneralizedLinearAlgorithm:
+    """Shared training harness; subclasses provide optimizer + create_model."""
+
+    #: subclasses set an Optimizer instance
+    optimizer: Optimizer = None
+
+    def __init__(self):
+        self.add_intercept = False
+        self.validate_data = True
+        self.num_features = -1
+
+    # -- fluent config, parity with the reference's setters ----------------
+    def set_intercept(self, flag: bool):
+        self.add_intercept = bool(flag)
+        return self
+
+    def set_validate_data(self, flag: bool):
+        self.validate_data = bool(flag)
+        return self
+
+    def set_num_features(self, n: int):
+        self.num_features = int(n)
+        return self
+
+    # -- hooks -------------------------------------------------------------
+    def create_model(self, weights, intercept) -> GeneralizedLinearModel:
+        raise NotImplementedError
+
+    def validators(self, X: np.ndarray, y: np.ndarray) -> None:
+        """Input validation hook; classifier subclasses check label sets."""
+
+    # -- training ----------------------------------------------------------
+    def run(
+        self,
+        data: DatasetLike,
+        initial_weights=None,
+        initial_intercept: float = 0.0,
+    ) -> GeneralizedLinearModel:
+        X, y = _as_arrays(data)
+        if X.shape[0] == 0:
+            raise ValueError("empty input")
+        if self.num_features < 0:
+            self.num_features = X.shape[1]
+        if self.validate_data:
+            self.validators(X, y)
+        if initial_weights is None:
+            initial_weights = np.zeros((self._weight_dim(),), np.float32)
+        w0 = np.asarray(initial_weights, np.float32)
+        if self.add_intercept:
+            from tpu_sgd.utils.mlutils import append_bias
+
+            # Bias appended as the LAST column ([U] MLUtils.appendBias;
+            # SURVEY.md §3.1 intercept prepend/split).
+            Xb = append_bias(X)
+            w0 = np.concatenate([w0, np.asarray([initial_intercept], np.float32)])
+            weights = self.optimizer.optimize((Xb, y), w0)
+            intercept = float(weights[-1])
+            weights = weights[:-1]
+        else:
+            weights = self.optimizer.optimize((X, y), w0)
+            intercept = 0.0
+        return self.create_model(weights, intercept)
+
+    def _weight_dim(self) -> int:
+        return self.num_features
+
+    def run_warm(self, data: DatasetLike, model: Optional[GeneralizedLinearModel]):
+        """Warm-started run used by the streaming mode (SURVEY.md §3.3):
+        re-run the batch optimizer seeded with the latest weights AND
+        intercept (improves on the reference, which re-seeds the intercept)."""
+        if model is None:
+            return self.run(data)
+        return self.run(data, model.weights, model.intercept)

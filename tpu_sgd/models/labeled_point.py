@@ -1,0 +1,44 @@
+"""The dataset element type.
+
+Reference parity: [U] mllib/regression/LabeledPoint.scala (SURVEY.md §2 #9):
+``(label: Double, features: Vector)``.  The TPU-native dataset is columnar
+``(X, y)`` arrays (SoA, MXU-friendly), but the record type is kept for API
+parity and for row-wise loaders; ``to_arrays`` converts a collection of
+points into the columnar form the optimizer consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Tuple
+
+import numpy as np
+
+
+class LabeledPoint(NamedTuple):
+    label: float
+    features: np.ndarray
+
+    @staticmethod
+    def parse(s: str) -> "LabeledPoint":
+        """Parse "(label,[f0,f1,...])" or "label f0 f1 ..." forms."""
+        s = s.strip()
+        if s.startswith("("):
+            label_str, feat_str = s[1:-1].split(",", 1)
+            feats = feat_str.strip().lstrip("[").rstrip("]")
+            return LabeledPoint(
+                float(label_str), np.fromstring(feats, sep=",", dtype=np.float32)
+            )
+        parts = s.split()
+        return LabeledPoint(
+            float(parts[0]), np.asarray([float(p) for p in parts[1:]], np.float32)
+        )
+
+
+def to_arrays(points: Iterable[LabeledPoint]) -> Tuple[np.ndarray, np.ndarray]:
+    """Collection of LabeledPoints -> columnar ``(X, y)`` float32 arrays."""
+    pts = list(points)
+    if not pts:
+        return np.zeros((0, 0), np.float32), np.zeros((0,), np.float32)
+    X = np.stack([np.asarray(p.features, np.float32) for p in pts])
+    y = np.asarray([p.label for p in pts], np.float32)
+    return X, y

@@ -1,0 +1,107 @@
+"""Regression model families: Linear, Lasso, Ridge — all SGD-trained.
+
+Reference parity: [U] mllib/regression/{LinearRegression,Lasso,
+RidgeRegression}.scala (SURVEY.md §2 #6).  Each family is the GLM harness plus
+a (Gradient, Updater) pair and the reference's defaults: step=1.0, iters=100,
+frac=1.0; reg=0.0 for plain linear, 0.01 for Lasso/Ridge.
+"""
+
+from __future__ import annotations
+
+from tpu_sgd.models.glm import GeneralizedLinearAlgorithm, GeneralizedLinearModel
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.updaters import L1Updater, SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+
+class LinearRegressionModel(GeneralizedLinearModel):
+    """Prediction is the raw margin ``x.w + b``."""
+
+    def predict_point(self, margin):
+        return margin
+
+    def save(self, path):
+        from tpu_sgd.utils.persistence import save_glm_model
+
+        save_glm_model(path, self)
+
+    @classmethod
+    def load(cls, path):
+        from tpu_sgd.utils.persistence import load_glm_model
+
+        return load_glm_model(path, cls)
+
+
+class LassoModel(LinearRegressionModel):
+    pass
+
+
+class RidgeRegressionModel(LinearRegressionModel):
+    pass
+
+
+class _RegressionWithSGD(GeneralizedLinearAlgorithm):
+    _gradient_cls = LeastSquaresGradient
+    _updater_cls = SimpleUpdater
+    _model_cls = LinearRegressionModel
+    _default_reg = 0.0
+
+    def __init__(
+        self,
+        step_size: float = 1.0,
+        num_iterations: int = 100,
+        reg_param: float = None,
+        mini_batch_fraction: float = 1.0,
+    ):
+        super().__init__()
+        if reg_param is None:
+            reg_param = self._default_reg
+        self.optimizer = (
+            GradientDescent(self._gradient_cls(), self._updater_cls())
+            .set_step_size(step_size)
+            .set_num_iterations(num_iterations)
+            .set_reg_param(reg_param)
+            .set_mini_batch_fraction(mini_batch_fraction)
+        )
+
+    def create_model(self, weights, intercept):
+        return self._model_cls(weights, intercept)
+
+    @classmethod
+    def train(
+        cls,
+        data,
+        num_iterations: int = 100,
+        step_size: float = 1.0,
+        reg_param: float = None,
+        mini_batch_fraction: float = 1.0,
+        initial_weights=None,
+        intercept: bool = False,
+        mesh=None,
+    ):
+        """Static train() parity with the reference's object methods."""
+        alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
+        alg.set_intercept(intercept)
+        if mesh is not None:
+            alg.optimizer.set_mesh(mesh)
+        return alg.run(data, initial_weights)
+
+
+class LinearRegressionWithSGD(_RegressionWithSGD):
+    """Least squares, no regularization (config 1, BASELINE.json:7)."""
+
+
+class LassoWithSGD(_RegressionWithSGD):
+    """Least squares + L1 prox updater."""
+
+    _updater_cls = L1Updater
+    _model_cls = LassoModel
+    _default_reg = 0.01
+
+
+class RidgeRegressionWithSGD(_RegressionWithSGD):
+    """Least squares + squared-L2 updater."""
+
+    _updater_cls = SquaredL2Updater
+    _model_cls = RidgeRegressionModel
+    _default_reg = 0.01

@@ -1,0 +1,105 @@
+"""Streaming (online) SGD over micro-batches.
+
+Reference parity: [U] mllib/regression/StreamingLinearRegressionWithSGD.scala
+and StreamingLinearAlgorithm.scala (SURVEY.md §2 #15, §3.3), plus
+[U] mllib/classification/StreamingLogisticRegressionWithSGD.scala.  The
+reference implements online learning by re-running the batch optimizer per
+micro-batch, warm-started with the latest weights — there is no separate
+online-SGD code path.  The TPU build reuses the batch step the same way
+(config 5, BASELINE.json:11): a "DStream" is any iterator of ``(X, y)``
+micro-batches, and ``train_on`` folds the model through it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from tpu_sgd.models.classification import LogisticRegressionWithSGD
+from tpu_sgd.models.glm import GeneralizedLinearAlgorithm, GeneralizedLinearModel
+from tpu_sgd.models.regression import LinearRegressionWithSGD
+
+Batch = Tuple[np.ndarray, np.ndarray]
+
+
+class StreamingLinearAlgorithm:
+    """Fold a GLM through a stream of micro-batches with warm restarts."""
+
+    def __init__(self, algorithm: GeneralizedLinearAlgorithm):
+        self.algorithm = algorithm
+        self.model: Optional[GeneralizedLinearModel] = None
+        self._batch_count = 0
+
+    def latest_model(self) -> GeneralizedLinearModel:
+        if self.model is None:
+            raise RuntimeError(
+                "Model must be initialized (set_initial_weights) or trained "
+                "before use"
+            )
+        return self.model
+
+    def set_initial_weights(self, weights, intercept: float = 0.0):
+        self.model = self.algorithm.create_model(
+            np.asarray(weights, np.float32), intercept
+        )
+        return self
+
+    def train_on_batch(self, X, y) -> GeneralizedLinearModel:
+        """One micro-batch update (the body of the reference's foreachRDD)."""
+        X = np.asarray(X)
+        if X.shape[0] == 0:  # reference skips empty RDDs
+            return self.model
+        self.model = self.algorithm.run_warm((X, np.asarray(y)), self.model)
+        self._batch_count += 1
+        return self.model
+
+    def train_on(self, stream: Iterable[Batch]) -> GeneralizedLinearModel:
+        """Consume an entire stream (parity with ``trainOn(DStream)``)."""
+        for X, y in stream:
+            self.train_on_batch(X, y)
+        return self.model
+
+    def predict_on(self, stream: Iterable[np.ndarray]) -> Iterator[np.ndarray]:
+        """Lazily map prediction over a stream of feature batches, using the
+        model snapshot current at consumption time (parity with
+        ``predictOn``)."""
+        for X in stream:
+            yield np.asarray(self.latest_model().predict(X))
+
+    def predict_on_values(
+        self, stream: Iterable[Tuple[object, np.ndarray]]
+    ) -> Iterator[Tuple[object, np.ndarray]]:
+        """Keyed variant (parity with ``predictOnValues``)."""
+        for key, X in stream:
+            yield key, np.asarray(self.latest_model().predict(X))
+
+
+class StreamingLinearRegressionWithSGD(StreamingLinearAlgorithm):
+    def __init__(
+        self,
+        step_size: float = 0.1,
+        num_iterations: int = 50,
+        mini_batch_fraction: float = 1.0,
+        reg_param: float = 0.0,
+    ):
+        super().__init__(
+            LinearRegressionWithSGD(
+                step_size, num_iterations, reg_param, mini_batch_fraction
+            )
+        )
+
+
+class StreamingLogisticRegressionWithSGD(StreamingLinearAlgorithm):
+    def __init__(
+        self,
+        step_size: float = 0.1,
+        num_iterations: int = 50,
+        mini_batch_fraction: float = 1.0,
+        reg_param: float = 0.0,
+    ):
+        super().__init__(
+            LogisticRegressionWithSGD(
+                step_size, num_iterations, reg_param, mini_batch_fraction
+            )
+        )

@@ -1,0 +1,25 @@
+from tpu_sgd.ops.gradients import (
+    Gradient,
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+    MultinomialLogisticGradient,
+)
+from tpu_sgd.ops.updaters import (
+    L1Updater,
+    SimpleUpdater,
+    SquaredL2Updater,
+    Updater,
+)
+
+__all__ = [
+    "Gradient",
+    "LeastSquaresGradient",
+    "LogisticGradient",
+    "HingeGradient",
+    "MultinomialLogisticGradient",
+    "Updater",
+    "SimpleUpdater",
+    "L1Updater",
+    "SquaredL2Updater",
+]

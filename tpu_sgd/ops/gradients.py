@@ -1,0 +1,182 @@
+"""Loss gradients for generalized linear models, batched TPU-first.
+
+Reference parity: [U] mllib/optimization/Gradient.scala (SURVEY.md §2 #3).
+Spark's ``Gradient.compute(data, label, weights) -> (gradient, loss)`` is a
+per-example scalar loop over BLAS ``dot``/``axpy`` calls.  On TPU the idiomatic
+form is one fused batched matvec pipeline (SURVEY.md §2 native-component
+ledger): every linear-model gradient factors as
+
+    margins   = X @ w                      # MXU matvec / matmul
+    coeff, l  = pointwise(margins, y)      # VPU elementwise
+    grad_sum  = X.T @ (coeff * mask)       # MXU matvec
+    loss_sum  = sum(l * mask)
+
+so each Gradient subclass only supplies the ``pointwise`` rule and the whole
+mini-batch runs in two MXU passes that XLA fuses with the elementwise ops.
+The per-example ``compute`` method is kept for contract parity and testing.
+
+Closed forms mirrored exactly from the reference semantics (SURVEY.md §3.2):
+  * LeastSquaresGradient:  diff = x.w - y;  loss = diff^2 / 2;  grad = diff * x
+  * LogisticGradient (binary): margin = -x.w;
+        multiplier = 1/(1+exp(margin)) - y;  grad = multiplier * x
+        loss = log1p(exp(margin))            if y > 0
+               log1p(exp(margin)) - margin   otherwise
+  * HingeGradient: s = 2y - 1 in {-1, +1};  if 1 - s*(x.w) > 0:
+        grad = -s * x, loss = 1 - s*(x.w);  else 0, 0
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class Gradient:
+    """Loss-specific plugin: the ``Gradient`` axis of the optimizer boundary.
+
+    Subclasses implement :meth:`pointwise`; everything else (single-example
+    ``compute``, batched ``batch_sums``) derives from it.
+    """
+
+    def pointwise(self, margin: Array, label: Array) -> Tuple[Array, Array]:
+        """Elementwise rule: ``(dloss/dmargin, loss)`` given ``margin = x.w``."""
+        raise NotImplementedError
+
+    def weight_dim(self, num_features: int) -> int:
+        """Length of the flat weight vector for ``num_features`` inputs."""
+        return num_features
+
+    def compute(self, data: Array, label: Array, weights: Array) -> Tuple[Array, Array]:
+        """Single-example ``(gradient, loss)`` — Spark contract parity."""
+        margin = jnp.dot(data, weights)
+        coeff, loss = self.pointwise(margin, label)
+        return coeff * data, loss
+
+    def batch_sums(
+        self,
+        X: Array,
+        y: Array,
+        weights: Array,
+        mask: Optional[Array] = None,
+    ) -> Tuple[Array, Array, Array]:
+        """Fused mini-batch ``(grad_sum, loss_sum, count)``.
+
+        This is the XLA-compiled replacement for the reference's executor-side
+        per-example seqOp loop (SURVEY.md §3.1 inner hot loop): the whole
+        shard's contribution in two matvecs.  ``mask`` implements Bernoulli
+        mini-batch sampling; sums are *unnormalized* so they can be combined
+        across shards with ``lax.psum`` before dividing by the realized
+        mini-batch count (parity with ``treeAggregate`` + ``/ miniBatchSize``).
+        """
+        margins = X @ weights
+        coeff, losses = self.pointwise(margins, y)
+        if mask is not None:
+            m = mask.astype(margins.dtype)
+            coeff = coeff * m
+            losses = losses * m
+            count = jnp.sum(m)
+        else:
+            count = jnp.asarray(X.shape[0], margins.dtype)
+        grad_sum = coeff @ X  # == X.T @ coeff, row-major friendly
+        loss_sum = jnp.sum(losses)
+        return grad_sum, loss_sum, count
+
+
+class LeastSquaresGradient(Gradient):
+    """Squared loss for linear regression: ``L = (x.w - y)^2 / 2``."""
+
+    def pointwise(self, margin: Array, label: Array) -> Tuple[Array, Array]:
+        diff = margin - label
+        return diff, 0.5 * diff * diff
+
+
+class LogisticGradient(Gradient):
+    """Binary log-loss with labels in {0, 1}, numerically stable.
+
+    Matches the reference's formulation via ``margin = -x.w`` with
+    ``log1p(exp(margin))`` saturation guard (SURVEY.md §3.2).  The stable
+    rewrite used here is ``softplus(margin) = max(margin, 0) + log1p(exp(-|margin|))``.
+    """
+
+    def pointwise(self, margin: Array, label: Array) -> Tuple[Array, Array]:
+        neg_margin = -margin  # the reference's "margin" is -x.w
+        multiplier = jax.nn.sigmoid(margin) - label  # 1/(1+exp(-x.w)) - y
+        softplus = jnp.maximum(neg_margin, 0.0) + jnp.log1p(
+            jnp.exp(-jnp.abs(neg_margin))
+        )
+        loss = jnp.where(label > 0, softplus, softplus - neg_margin)
+        return multiplier, loss
+
+
+class HingeGradient(Gradient):
+    """Hinge loss for linear SVM with labels in {0, 1} mapped to {-1, +1}."""
+
+    def pointwise(self, margin: Array, label: Array) -> Tuple[Array, Array]:
+        scaled = 2.0 * label - 1.0
+        slack = 1.0 - scaled * margin
+        active = slack > 0
+        coeff = jnp.where(active, -scaled, 0.0)
+        loss = jnp.where(active, slack, 0.0)
+        return coeff, loss
+
+
+class MultinomialLogisticGradient:
+    """K-class logistic gradient over a ``(K-1, D)`` weight matrix.
+
+    Parity with the reference's multinomial branch of ``LogisticGradient``
+    ([U] mllib/optimization/Gradient.scala, SURVEY.md §2 #3, "binary +
+    multinomial"): the pivot class is class 0, weights hold K-1 rows, and the
+    loss is the negative log-likelihood of the softmax with an implicit zero
+    logit for the pivot.  Kept as a separate class because its weight pytree is
+    a matrix, not a vector; the GLM harness reshapes accordingly.
+    """
+
+    def __init__(self, num_classes: int):
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+
+    def weight_dim(self, num_features: int) -> int:
+        return (self.num_classes - 1) * num_features
+
+    def batch_sums(
+        self,
+        X: Array,
+        y: Array,
+        weights: Array,
+        mask: Optional[Array] = None,
+    ) -> Tuple[Array, Array, Array]:
+        K = self.num_classes
+        W = weights.reshape(K - 1, X.shape[-1])
+        margins = X @ W.T  # (n, K-1)
+        logits = jnp.concatenate(
+            [jnp.zeros((X.shape[0], 1), margins.dtype), margins], axis=-1
+        )  # (n, K) with pivot logit 0
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        y_int = y.astype(jnp.int32)
+        losses = -jnp.take_along_axis(log_probs, y_int[:, None], axis=-1)[:, 0]
+        probs = jnp.exp(log_probs)[:, 1:]  # (n, K-1)
+        onehot = jax.nn.one_hot(y_int - 1, K - 1, dtype=margins.dtype)
+        coeff = probs - onehot  # (n, K-1)
+        if mask is not None:
+            m = mask.astype(margins.dtype)
+            coeff = coeff * m[:, None]
+            losses = losses * m
+            count = jnp.sum(m)
+        else:
+            count = jnp.asarray(X.shape[0], margins.dtype)
+        grad_sum = (coeff.T @ X).reshape(-1)  # flattened (K-1)*D
+        return grad_sum, jnp.sum(losses), count
+
+    def predict_class(self, X: Array, weights: Array) -> Array:
+        K = self.num_classes
+        W = weights.reshape(K - 1, X.shape[-1])
+        margins = X @ W.T
+        logits = jnp.concatenate(
+            [jnp.zeros((X.shape[0], 1), margins.dtype), margins], axis=-1
+        )
+        return jnp.argmax(logits, axis=-1).astype(jnp.float32)

@@ -1,0 +1,15 @@
+from tpu_sgd.optimize.optimizer import Optimizer
+from tpu_sgd.optimize.gradient_descent import (
+    GradientDescent,
+    make_run,
+    make_step,
+    run_mini_batch_sgd,
+)
+
+__all__ = [
+    "Optimizer",
+    "GradientDescent",
+    "make_run",
+    "make_step",
+    "run_mini_batch_sgd",
+]

@@ -1,0 +1,11 @@
+from tpu_sgd.parallel.mesh import DATA_AXIS, MODEL_AXIS, data_mesh, make_mesh
+from tpu_sgd.parallel.data_parallel import dp_optimize, shard_dataset
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_mesh",
+    "make_mesh",
+    "dp_optimize",
+    "shard_dataset",
+]

@@ -1,0 +1,110 @@
+"""Data-parallel SGD: shard the example axis, psum the gradient sums.
+
+This is the TPU-native replacement for the reference's entire L1-L2 stack
+(SURVEY.md §3.5): where Spark runs ``sample().treeAggregate(depth=2)`` through
+shuffle files, task serialization and a driver hop every iteration, here the
+batch lives sharded across cores, the weights live replicated, and
+``lax.psum`` combines per-shard ``(grad_sum, loss_sum, count)`` in hardware
+over ICI.  Broadcast of updated weights is free: the all-reduced update is
+applied identically on every core (deterministic replication replaces
+TorrentBroadcast, SURVEY.md §5.8).
+
+Uneven example counts are handled by zero-padding each shard and carrying a
+``valid`` row mask folded into the mini-batch mask — the analogue of Spark's
+arbitrary-size partitions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.updaters import Updater
+from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+Array = jax.Array
+
+
+def pad_to_multiple(
+    X: np.ndarray, y: np.ndarray, n_shards: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Zero-pad rows so ``n`` divides evenly; returns (X, y, valid mask)."""
+    n = X.shape[0]
+    rem = (-n) % n_shards
+    valid = np.ones((n + rem,), dtype=bool)
+    if rem:
+        X = np.concatenate([X, np.zeros((rem,) + X.shape[1:], X.dtype)], axis=0)
+        y = np.concatenate([y, np.zeros((rem,), y.dtype)], axis=0)
+        valid[n:] = False
+    return X, y, valid
+
+
+def shard_dataset(mesh: Mesh, X, y) -> Tuple[Array, Array, Optional[Array]]:
+    """Place ``(X, y)`` sharded over the 'data' axis of ``mesh``.
+
+    Returns device arrays plus a ``valid`` mask (None when no padding was
+    needed).  This is the one host->device transfer of the whole run — the
+    analogue of the reference's initial ``RDD.cache()`` materialization.
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    Xh = np.asarray(X)
+    yh = np.asarray(y)
+    n = Xh.shape[0]
+    Xh, yh, validh = pad_to_multiple(Xh, yh, n_shards)
+    row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    Xd = jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS, None)))
+    yd = jax.device_put(yh, row_sharding)
+    if n == Xh.shape[0]:
+        return Xd, yd, None
+    vd = jax.device_put(validh, row_sharding)
+    return Xd, yd, vd
+
+
+def dp_run_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    mesh: Mesh,
+    with_valid: bool,
+):
+    """Build the jitted shard_map'ed full-loop runner.
+
+    The inner body is *the same* ``make_run`` used single-device, with
+    ``axis_name='data'`` turning its combines into ICI all-reduces — one
+    compiled XLA program for the entire optimization across all cores.
+    """
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    run = make_run(gradient, updater, config, axis_name=DATA_AXIS)
+    if with_valid:
+        body = lambda w, X, y, v: run(w, X, y, v)
+        in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS))
+    else:
+        body = lambda w, X, y: run(w, X, y, None)
+        in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS))
+    out_specs = (P(), P(), P())
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
+def dp_optimize(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    mesh: Mesh,
+    initial_weights,
+    X,
+    y,
+):
+    """Shard, run, return ``(weights, loss_history, n_recorded)``."""
+    Xd, yd, valid = shard_dataset(mesh, X, y)
+    w0 = jnp.asarray(initial_weights)
+    fn = dp_run_fn(gradient, updater, config, mesh, valid is not None)
+    if valid is not None:
+        return fn(w0, Xd, yd, valid)
+    return fn(w0, Xd, yd)

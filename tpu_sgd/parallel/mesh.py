@@ -1,0 +1,61 @@
+"""Device-mesh construction helpers.
+
+The reference's distribution substrate is Spark executors + Netty RPC
+(SURVEY.md §1 L1-L2); the TPU-native substrate is a ``jax.sharding.Mesh``
+whose collectives ride ICI within a slice and DCN across hosts
+(SURVEY.md §5.8).  The canonical mesh for this framework is 1-D over the
+example axis (``'data'``), with an optional second ``'model'`` axis for
+feature sharding of very wide weight vectors (SURVEY.md §2 parallelism
+ledger).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh; defaults to all devices on 'data'."""
+    if devices is None:
+        devices = jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_model
+    n = n_data * n_model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:n]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all devices on the 'data' axis."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def shard_map_fn(mesh, fn, in_specs, out_specs, check_vma=False):
+    """Version-tolerant shard_map wrapper (jax.shard_map vs experimental)."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)

@@ -1,0 +1,20 @@
+from tpu_sgd.utils.mlutils import (
+    append_bias,
+    linear_data,
+    load_libsvm_file,
+    logistic_data,
+    save_as_libsvm_file,
+    svm_data,
+)
+from tpu_sgd.utils.persistence import load_glm_model, save_glm_model
+
+__all__ = [
+    "append_bias",
+    "load_libsvm_file",
+    "save_as_libsvm_file",
+    "linear_data",
+    "logistic_data",
+    "svm_data",
+    "save_glm_model",
+    "load_glm_model",
+]

@@ -1,0 +1,173 @@
+"""Data loading / generation utilities.
+
+Reference parity: [U] mllib/util/MLUtils.scala (SURVEY.md §2 #12, §3.4):
+``loadLibSVMFile`` parses 1-based-indexed sparse text into labeled points;
+``saveAsLibSVMFile`` writes it back; ``appendBias`` appends a 1.0 feature.
+Also mirrors the reference's synthetic data generators
+([U] mllib/util/{Linear,LogisticRegression,SVM}DataGenerator.scala), which
+the reference's test suites and the benchmark configs rely on.
+
+A native C++ fast path for the LIBSVM parser (the analogue of the
+reference's executor-side parsing throughput) lives in
+``tpu_sgd/utils/native``; this module transparently uses it when built.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_sgd.models.labeled_point import LabeledPoint
+
+
+def append_bias(X: np.ndarray) -> np.ndarray:
+    """Append a 1.0 bias column (parity with ``MLUtils.appendBias``)."""
+    X = np.asarray(X)
+    return np.concatenate([X, np.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+
+def _parse_libsvm_python(path: str):
+    labels, rows, cols, vals = [], [], [], []
+    max_idx = 0
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            r = len(labels) - 1
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                j = int(idx) - 1  # 1-based on disk
+                if j < 0:
+                    raise ValueError(f"invalid 0 index in libsvm file {path}")
+                rows.append(r)
+                cols.append(j)
+                vals.append(float(val))
+                max_idx = max(max_idx, j + 1)
+    return (
+        np.asarray(labels, np.float32),
+        np.asarray(rows, np.int64),
+        np.asarray(cols, np.int64),
+        np.asarray(vals, np.float32),
+        max_idx,
+    )
+
+
+def load_libsvm_file(
+    path: str,
+    num_features: Optional[int] = None,
+    dense: bool = True,
+    dtype=np.float32,
+):
+    """Load a LIBSVM-format file into ``(X, y)``.
+
+    ``num_features`` discovery scans for the max index, exactly like the
+    reference's one extra reduce job (SURVEY.md §3.4).  ``dense=True``
+    densifies (the TPU-resident layout; config 3's "sparse->densified",
+    BASELINE.json:9); ``dense=False`` returns a scipy-free CSR triple
+    ``((data, indices, indptr), y, num_features)``.
+    """
+    try:
+        from tpu_sgd.utils.native import parse_libsvm as _native
+
+        labels, rows, cols, vals, max_idx = _native(path)
+    except Exception:
+        labels, rows, cols, vals, max_idx = _parse_libsvm_python(path)
+    d = num_features if num_features is not None else max_idx
+    n = labels.shape[0]
+    if dense:
+        X = np.zeros((n, d), dtype)
+        X[rows, cols] = vals
+        return X, labels
+    # CSR without scipy
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    indptr = np.zeros((n + 1,), np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr)
+    return (vals.astype(dtype), cols, indptr), labels, d
+
+
+def save_as_libsvm_file(path: str, X: np.ndarray, y: np.ndarray) -> None:
+    """Write ``(X, y)`` in 1-based LIBSVM text (parity with
+    ``MLUtils.saveAsLibSVMFile``); zero entries are dropped."""
+    X = np.asarray(X)
+    y = np.asarray(y)
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            nz = np.nonzero(X[i])[0]
+            feats = " ".join(f"{j + 1}:{X[i, j]:.6g}" for j in nz)
+            f.write(f"{y[i]:.6g} {feats}\n")
+
+
+# ---------------------------------------------------------------------------
+# Synthetic data generators (reference: mllib/util/*DataGenerator.scala)
+# ---------------------------------------------------------------------------
+
+def linear_data(
+    n: int,
+    d: int,
+    intercept: float = 0.0,
+    weights: Optional[np.ndarray] = None,
+    eps: float = 0.1,
+    seed: int = 42,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """y = X.w + b + N(0, eps); returns (X, y, true_weights)."""
+    rng = np.random.default_rng(seed)
+    w = (
+        np.asarray(weights, dtype)
+        if weights is not None
+        else rng.uniform(-1.0, 1.0, size=(d,)).astype(dtype)
+    )
+    X = rng.normal(size=(n, d)).astype(dtype)
+    y = (X @ w + intercept + eps * rng.normal(size=(n,))).astype(dtype)
+    return X, y, w
+
+
+def logistic_data(
+    n: int,
+    d: int,
+    weights: Optional[np.ndarray] = None,
+    intercept: float = 0.0,
+    seed: int = 42,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Labels in {0,1} drawn from sigmoid(X.w + b); returns (X, y, w)."""
+    rng = np.random.default_rng(seed)
+    w = (
+        np.asarray(weights, dtype)
+        if weights is not None
+        else rng.uniform(-1.0, 1.0, size=(d,)).astype(dtype)
+    )
+    X = rng.normal(size=(n, d)).astype(dtype)
+    p = 1.0 / (1.0 + np.exp(-(X @ w + intercept)))
+    y = (rng.uniform(size=(n,)) < p).astype(dtype)
+    return X, y, w
+
+
+def svm_data(
+    n: int,
+    d: int,
+    weights: Optional[np.ndarray] = None,
+    intercept: float = 0.0,
+    noise: float = 0.1,
+    seed: int = 42,
+    dtype=np.float32,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Labels in {0,1} by sign of noisy margin (parity with
+    SVMDataGenerator's sign(x.w + noise))."""
+    rng = np.random.default_rng(seed)
+    w = (
+        np.asarray(weights, dtype)
+        if weights is not None
+        else rng.uniform(-1.0, 1.0, size=(d,)).astype(dtype)
+    )
+    X = rng.normal(size=(n, d)).astype(dtype)
+    margin = X @ w + intercept + noise * rng.normal(size=(n,))
+    y = (margin > 0).astype(dtype)
+    return X, y, w

@@ -1,0 +1,59 @@
+"""ctypes loader for the native C++ LIBSVM parser.
+
+The reference's data path runs parsing inside executor JVMs (SURVEY.md §3.4);
+the TPU framework's native analogue is a small C++ shared library
+(``libsvm_parser.cpp``) loaded via ctypes — no pybind11 dependency.  Build it
+with ``python -m tpu_sgd.utils.native.build`` (uses g++); all callers fall
+back to the pure-Python parser when the library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libsvm_parser.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_LIB_PATH):
+            raise ImportError(f"native parser not built at {_LIB_PATH}")
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _lib.parse_libsvm_count.restype = ctypes.c_int64
+        _lib.parse_libsvm_count.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),  # n_rows out
+            ctypes.POINTER(ctypes.c_int64),  # n_nz out
+        ]
+        _lib.parse_libsvm_fill.restype = ctypes.c_int64
+        _lib.parse_libsvm_fill.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+    return _lib
+
+
+def parse_libsvm(path: str):
+    """Parse a LIBSVM file natively -> (labels, rows, cols, vals, max_index)."""
+    lib = _load()
+    n_rows = ctypes.c_int64()
+    n_nz = ctypes.c_int64()
+    rc = lib.parse_libsvm_count(path.encode(), ctypes.byref(n_rows), ctypes.byref(n_nz))
+    if rc != 0:
+        raise IOError(f"native parser failed to open/scan {path} (rc={rc})")
+    labels = np.empty((n_rows.value,), np.float32)
+    rows = np.empty((n_nz.value,), np.int64)
+    cols = np.empty((n_nz.value,), np.int64)
+    vals = np.empty((n_nz.value,), np.float32)
+    max_idx = lib.parse_libsvm_fill(path.encode(), labels, rows, cols, vals)
+    if max_idx < 0:
+        raise IOError(f"native parser failed to parse {path} (rc={max_idx})")
+    return labels, rows, cols, vals, int(max_idx)

@@ -1,0 +1,25 @@
+"""Build the native LIBSVM parser: ``python -m tpu_sgd.utils.native.build``."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "libsvm_parser.cpp")
+OUT = os.path.join(HERE, "libsvm_parser.so")
+
+
+def build(verbose: bool = True) -> str:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", SRC, "-o", OUT]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.exit(0)
