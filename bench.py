@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Benchmark: SGD throughput on the north-star workload (BASELINE.json:5).
+
+Headline metric: SGD epochs/sec on a 10M x 1000 dense least-squares fit,
+mini-batch fraction 0.1 — an "epoch" is one full-dataset-equivalent of row
+processing (10 iterations at frac=0.1).  The TPU side measures the fused
+while_loop SGD program on the largest device-resident slab (bf16 features,
+f32 master weights, indexed sampling) and converts measured rows/sec to
+epochs/sec on the 10M-row problem; the baseline is a faithful 8-process
+NumPy re-implementation of the Spark local[*] topology (per-partition
+gradient sums, broadcast weights, tree combine) as specified in BASELINE.md
+(no JVM/Spark exists in this environment).
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "epochs/sec", "vs_baseline": N}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+TARGET_ROWS = 10_000_000  # the headline problem size
+DIM = int(os.environ.get("BENCH_DIM", "1000"))
+FRAC = 0.1
+TPU_ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", "400000"))
+CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", "4"))
+N_EXECUTORS = 8
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# TPU side
+# ---------------------------------------------------------------------------
+
+def tpu_epochs_per_sec() -> tuple[float, str]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_sgd.utils.platform import honor_cpu_env
+
+    honor_cpu_env()
+    try:
+        devices = jax.devices()
+    except Exception as e:  # tunnel down -> CPU fallback
+        log(f"TPU backend unavailable ({type(e).__name__}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    platform = devices[0].platform
+    on_accel = platform not in ("cpu",)
+    rows = int(
+        os.environ.get("BENCH_ROWS", "3000000" if on_accel else "200000")
+    )
+    log(f"device: {devices[0].device_kind} ({platform}), resident rows={rows}")
+
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    # Generate the slab on device: no host->device transfer of the dataset.
+    key = jax.random.PRNGKey(0)
+    kx, kw, kn = jax.random.split(key, 3)
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+
+    @jax.jit
+    def gen():
+        X = jax.random.normal(kx, (rows, DIM), dtype)
+        w_true = jax.random.uniform(kw, (DIM,), jnp.float32, -1.0, 1.0)
+        y = (X.astype(jnp.float32) @ w_true
+             + 0.1 * jax.random.normal(kn, (rows,), jnp.float32))
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+
+    cfg = SGDConfig(
+        step_size=0.5,
+        num_iterations=TPU_ITERS,
+        mini_batch_fraction=FRAC,
+        convergence_tol=0.0,
+        sampling="indexed",
+    )
+    run = jax.jit(make_run(LeastSquaresGradient(), SimpleUpdater(), cfg))
+    w0 = jnp.zeros((DIM,), jnp.float32)
+    # compile + warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(w0, X, y))
+    log(f"compile+first run: {time.perf_counter() - t0:.1f}s")
+    # timed: one fused XLA program for all iterations
+    t0 = time.perf_counter()
+    w, losses, n_rec = jax.block_until_ready(run(w0, X, y))
+    dt = time.perf_counter() - t0
+    rows_per_sec = TPU_ITERS * FRAC * rows / dt
+    eps = rows_per_sec / TARGET_ROWS
+    log(
+        f"tpu path: {dt * 1e3 / TPU_ITERS:.2f} ms/iter, "
+        f"{rows_per_sec / 1e6:.1f}M rows/s, final loss {float(losses[int(n_rec) - 1]):.4f}"
+    )
+    return eps, platform
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline: 8-process Spark-local[*] topology emulation (BASELINE.md)
+# ---------------------------------------------------------------------------
+
+def _executor(conn, part_rows, dim, seed):
+    """One 'executor': owns a partition, serves per-iteration gradient jobs."""
+    rng = np.random.default_rng(seed)
+    w_true = np.random.default_rng(123).uniform(-1, 1, dim).astype(np.float32)
+    X = rng.normal(size=(part_rows, dim)).astype(np.float32)
+    y = (X @ w_true + 0.1 * rng.normal(size=part_rows)).astype(np.float32)
+    conn.send("ready")
+    while True:
+        msg = conn.recv()  # broadcast: (iter, weights) or "stop"
+        if msg == "stop":
+            break
+        it, w = msg
+        mask = rng.random(part_rows) < FRAC  # Bernoulli sample, like RDD.sample
+        Xb, yb = X[mask], y[mask]
+        resid = Xb @ w - yb
+        grad = Xb.T @ resid
+        loss = 0.5 * float(resid @ resid)
+        conn.send((grad, loss, int(mask.sum())))
+    conn.close()
+
+
+def cpu_epochs_per_sec() -> float:
+    ctx = mp.get_context("fork")  # avoid re-running sitecustomize per worker
+    part = CPU_ROWS // N_EXECUTORS
+    pipes, procs = [], []
+    for i in range(N_EXECUTORS):
+        a, b = ctx.Pipe()
+        p = ctx.Process(target=_executor, args=(b, part, DIM, 1000 + i))
+        p.start()
+        pipes.append(a)
+        procs.append(p)
+    for a in pipes:
+        a.recv()  # ready
+
+    w = np.zeros(DIM, np.float32)
+
+    def iteration(it):
+        nonlocal w
+        for a in pipes:  # broadcast weights
+            a.send((it, w))
+        grads, losses, counts = zip(*(a.recv() for a in pipes))
+        # tree combine, depth 2 (pairs, then root), like treeAggregate
+        partial = [grads[i] + grads[i + 1] for i in range(0, N_EXECUTORS, 2)]
+        total = np.sum(partial, axis=0)
+        c = sum(counts)
+        w = w - 0.5 / np.sqrt(it) * (total / max(c, 1))
+
+    iteration(1)  # warm
+    t0 = time.perf_counter()
+    for it in range(2, 2 + CPU_ITERS):
+        iteration(it)
+    dt = time.perf_counter() - t0
+    for a in pipes:
+        a.send("stop")
+    for p in procs:
+        p.join(timeout=5)
+    rows_per_sec = CPU_ITERS * FRAC * CPU_ROWS / dt
+    log(f"cpu baseline: {dt * 1e3 / CPU_ITERS:.1f} ms/iter, "
+        f"{rows_per_sec / 1e6:.2f}M rows/s")
+    return rows_per_sec / TARGET_ROWS
+
+
+def main():
+    cpu_eps = cpu_epochs_per_sec()
+    tpu_eps, platform = tpu_epochs_per_sec()
+    result = {
+        "metric": "sgd_epochs_per_sec_10Mx1000_dense_least_squares",
+        "value": round(tpu_eps, 4),
+        "unit": "epochs/sec",
+        "vs_baseline": round(tpu_eps / cpu_eps, 2) if cpu_eps > 0 else None,
+    }
+    log(f"platform={platform}, cpu_baseline={cpu_eps:.4f} epochs/sec")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Aux subsystems: listener/event tracing, checkpoint/resume (SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.updaters import SimpleUpdater
+from tpu_sgd.utils.checkpoint import CheckpointManager
+from tpu_sgd.utils.events import CollectingListener, JsonLinesEventLog
+from tpu_sgd.utils.mlutils import linear_data
+
+
+def _opt(iters=30, tol=0.0):
+    return (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.3)
+        .set_num_iterations(iters)
+        .set_convergence_tol(tol)
+    )
+
+
+def test_listener_receives_every_iteration():
+    X, y, _ = linear_data(500, 5, seed=0)
+    lst = CollectingListener()
+    opt = _opt(25).set_listener(lst)
+    w, hist = opt.optimize_with_history((X, y), np.zeros(5, np.float32))
+    assert len(lst.iterations) == 25 == len(hist)
+    assert [e.iteration for e in lst.iterations] == list(range(1, 26))
+    np.testing.assert_allclose([e.loss for e in lst.iterations], hist, rtol=1e-6)
+    assert all(e.mini_batch_size == 500 for e in lst.iterations)
+    assert lst.runs[-1].event == "run_completed"
+    assert lst.runs[-1].num_iterations == 25
+
+
+def test_stepwise_path_matches_fused_path():
+    """The observed path must preserve the exact optimizer semantics."""
+    X, y, _ = linear_data(800, 6, seed=1)
+    w0 = np.zeros(6, np.float32)
+    w_fused, h_fused = _opt(30).optimize_with_history((X, y), w0)
+    opt = _opt(30).set_listener(CollectingListener())
+    w_step, h_step = opt.optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_step), np.asarray(w_fused),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_step, h_fused, rtol=1e-5)
+
+
+def test_stepwise_convergence_early_exit():
+    X, y, _ = linear_data(500, 5, eps=0.0, seed=2)
+    lst = CollectingListener()
+    opt = _opt(500, tol=1e-3).set_listener(lst)
+    opt.optimize_with_history((X, y), np.zeros(5, np.float32))
+    assert lst.runs[-1].converged_early
+    assert lst.runs[-1].num_iterations < 500
+
+
+def test_jsonl_event_log(tmp_path):
+    X, y, _ = linear_data(300, 4, seed=3)
+    path = str(tmp_path / "events.jsonl")
+    log = JsonLinesEventLog(path)
+    _opt(10).set_listener(log).optimize_with_history((X, y), np.zeros(4, np.float32))
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    kinds = [l["kind"] for l in lines]
+    assert kinds[0] == "run_started" and kinds[-1] == "run_completed"
+    assert kinds.count("iteration") == 10
+    assert lines[0]["config"]["num_iterations"] == 10
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    w = np.arange(4, dtype=np.float32)
+    mgr.save(7, w, 0.5, np.asarray([3.0, 2.0]), "cfg")
+    state = mgr.restore()
+    assert state["iteration"] == 7 and state["reg_val"] == 0.5
+    np.testing.assert_array_equal(state["weights"], w)
+    np.testing.assert_array_equal(state["loss_history"], [3.0, 2.0])
+    assert state["config_key"] == "cfg"
+
+
+def test_checkpoint_prune_keeps_newest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for i in (1, 2, 3, 4):
+        mgr.save(i, np.zeros(2, np.float32), 0.0, np.zeros(1))
+    assert mgr.restore()["iteration"] == 4
+    files = sorted(os.listdir(str(tmp_path / "ck")))
+    assert len(files) == 2
+
+
+def test_checkpointed_training_resumes(tmp_path):
+    """Kill training mid-run; resume must continue from the checkpoint and
+    reach the same result as an uninterrupted run."""
+    X, y, _ = linear_data(600, 5, seed=4)
+    w0 = np.zeros(5, np.float32)
+    w_full, h_full = _opt(40).optimize_with_history((X, y), w0)
+
+    ckdir = str(tmp_path / "ck")
+    # phase 1: run only 20 iterations (simulated interruption)
+    opt1 = _opt(20).set_checkpoint(CheckpointManager(ckdir), every=5)
+    opt1.optimize_with_history((X, y), w0)
+    # phase 2: new optimizer instance, full horizon, resumes at iter 21
+    opt2 = _opt(40).set_checkpoint(CheckpointManager(ckdir), every=5)
+    with pytest.warns(RuntimeWarning):  # config differs (20 vs 40 iters)
+        w_res, h_res = opt2.optimize_with_history((X, y), w0)
+    assert len(h_res) == 40
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_res, h_full, rtol=1e-5)
+
+
+def test_checkpoint_with_dp_mesh(tmp_path):
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    X, y, _ = linear_data(640, 5, seed=5)
+    w0 = np.zeros(5, np.float32)
+    w_fused, h_fused = _opt(20).optimize_with_history((X, y), w0)
+    opt = (
+        _opt(20)
+        .set_mesh(data_mesh())
+        .set_checkpoint(CheckpointManager(str(tmp_path / "ck")), every=10)
+        .set_listener(CollectingListener())
+    )
+    w_dp, h_dp = opt.optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_dp), np.asarray(w_fused),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_step_timer():
+    from tpu_sgd.utils.events import StepTimer
+
+    t = StepTimer()
+    with t.time():
+        pass
+    assert len(t.times) == 1 and t.mean_s >= 0

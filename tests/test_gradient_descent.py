@@ -117,6 +117,63 @@ def test_tiny_fraction_warns():
         opt.optimize((X, y), np.zeros(2, np.float32))
 
 
+def test_indexed_sampling_converges():
+    """The TPU fast-path sampler reaches the same solution quality."""
+    X, y, w_true = linear_data(4000, 6, eps=0.01, seed=4)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(300)
+        .set_mini_batch_fraction(0.1)
+        .set_sampling("indexed")
+        .set_convergence_tol(0.0)
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+    assert len(hist) == 300
+
+
+def test_indexed_sampling_dp_parity():
+    """Indexed sampling under the 8-device mesh also converges."""
+    import jax
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    X, y, w_true = linear_data(8000, 8, eps=0.01, seed=5)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(300)
+        .set_mini_batch_fraction(0.1)
+        .set_sampling("indexed")
+        .set_convergence_tol(0.0)
+        .set_mesh(data_mesh())
+    )
+    w, _ = opt.optimize_with_history((X, y), np.zeros(8, np.float32))
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+
+
+def test_invalid_sampling_mode_rejected():
+    with pytest.raises(ValueError, match="sampling"):
+        GradientDescent().set_sampling("nope")
+
+
+def test_bf16_data_f32_weights():
+    """Mixed precision: bf16 features keep f32 master weights and converge."""
+    import jax.numpy as jnp
+
+    X, y, w_true = linear_data(4000, 6, eps=0.01, seed=6)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(200)
+        .set_convergence_tol(0.0)
+    )
+    w, _ = opt.optimize_with_history((jnp.asarray(X, jnp.bfloat16), y),
+                                     np.zeros(6, np.float32))
+    assert w.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+
+
 def test_integer_features_are_cast():
     X = np.asarray([[0, 1], [1, 0], [1, 1], [0, 0]] * 50, np.int64)
     y = (X[:, 0] + 2 * X[:, 1]).astype(np.int64)

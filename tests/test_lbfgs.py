@@ -1,0 +1,69 @@
+"""LBFGS behind the same Optimizer boundary (SURVEY.md §2 #18)."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import LogisticRegressionWithLBFGS
+from tpu_sgd.ops.gradients import LeastSquaresGradient, LogisticGradient
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.lbfgs import LBFGS
+from tpu_sgd.utils.mlutils import linear_data, logistic_data
+
+
+def test_lbfgs_solves_least_squares_exactly():
+    X, y, w_true = linear_data(2000, 10, eps=0.0, seed=0)
+    opt = LBFGS(LeastSquaresGradient(), SimpleUpdater(), max_num_iterations=100)
+    w, hist = opt.optimize_with_history((X, y), np.zeros(10, np.float32))
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=1e-3)
+    assert hist[-1] < 1e-6
+    assert len(hist) < 60  # superlinear: far fewer iters than SGD needs
+
+
+def test_lbfgs_beats_sgd_iteration_count():
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    X, y, _ = logistic_data(2000, 8, seed=1)
+    lb = LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+               max_num_iterations=50)
+    w_lb, h_lb = lb.optimize_with_history((X, y), np.zeros(8, np.float32))
+    sgd = GradientDescent(LogisticGradient(), SquaredL2Updater())
+    sgd.set_reg_param(0.01).set_num_iterations(50).set_convergence_tol(0.0)
+    w_sgd, h_sgd = sgd.optimize_with_history((X, y), np.zeros(8, np.float32))
+    assert h_lb[-1] <= h_sgd[-1] + 1e-4  # at least as good in <= iterations
+
+
+def test_lbfgs_l2_reg_shrinks_weights():
+    X, y, _ = logistic_data(1000, 6, seed=2)
+    w0 = np.zeros(6, np.float32)
+    w_low = np.asarray(LBFGS(LogisticGradient(), SquaredL2Updater(),
+                             reg_param=0.0).optimize((X, y), w0))
+    w_high = np.asarray(LBFGS(LogisticGradient(), SquaredL2Updater(),
+                              reg_param=1.0).optimize((X, y), w0))
+    assert np.linalg.norm(w_high) < np.linalg.norm(w_low)
+
+
+def test_lbfgs_loss_monotone_nonincreasing():
+    X, y, _ = logistic_data(500, 5, seed=3)
+    _, hist = LBFGS(LogisticGradient(), SquaredL2Updater()).optimize_with_history(
+        (X, y), np.zeros(5, np.float32)
+    )
+    assert all(hist[i + 1] <= hist[i] + 1e-6 for i in range(len(hist) - 1))
+
+
+def test_logistic_regression_with_lbfgs_model():
+    X, y, w_true = logistic_data(3000, 8, seed=4)
+    model = LogisticRegressionWithLBFGS.train((X, y), reg_param=0.001,
+                                              intercept=True)
+    acc = np.mean(np.asarray(model.predict(X)) == y)
+    bayes = np.mean((X @ w_true > 0).astype(np.float32) == y)
+    assert acc > bayes - 0.02
+
+
+def test_lbfgs_empty_input():
+    opt = LBFGS(LeastSquaresGradient(), SimpleUpdater())
+    w0 = np.ones(3, np.float32)
+    w, hist = opt.optimize_with_history(
+        (np.zeros((0, 3), np.float32), np.zeros((0,), np.float32)), w0
+    )
+    np.testing.assert_array_equal(np.asarray(w), w0)
+    assert len(hist) == 0

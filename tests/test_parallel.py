@@ -99,6 +99,79 @@ def test_2d_mesh_constructs():
     assert m.shape["data"] == 4 and m.shape["model"] == 2
 
 
+class Test2DMesh:
+    """data x model sharding: feature-axis sharding parity with single device."""
+
+    @pytest.fixture(scope="class")
+    def mesh2d(self):
+        return make_mesh(n_data=4, n_model=2)
+
+    def test_parity_with_single_device(self, mesh2d):
+        from tpu_sgd.parallel.model_parallel import dp_mp_optimize
+
+        X, y, _ = linear_data(512, 16, seed=10)
+        w0 = np.zeros(16, np.float32)
+        cfg = SGDConfig(step_size=0.3, num_iterations=30, convergence_tol=0.0)
+        opt = GradientDescent(LeastSquaresGradient(), SimpleUpdater(), cfg)
+        w_single, h_single = opt.optimize_with_history((X, y), w0)
+        w_2d, h_2d, n_rec = dp_mp_optimize(
+            LeastSquaresGradient(), SimpleUpdater(), cfg, mesh2d, w0, X, y
+        )
+        assert w_2d.shape == (16,)
+        np.testing.assert_allclose(np.asarray(w_2d), np.asarray(w_single),
+                                   rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_2d)[:30], h_single, rtol=3e-4,
+                                   atol=1e-5)
+
+    def test_uneven_rows_and_features(self, mesh2d):
+        """n % n_data != 0 AND d % n_model != 0: both paddings must be
+        invisible in the result."""
+        from tpu_sgd.parallel.model_parallel import dp_mp_optimize
+        from tpu_sgd.ops.updaters import L1Updater
+
+        X, y, _ = linear_data(509, 13, seed=11)
+        w0 = np.zeros(13, np.float32)
+        cfg = SGDConfig(step_size=0.3, num_iterations=20, reg_param=0.05,
+                        convergence_tol=0.0)
+        opt = GradientDescent(LeastSquaresGradient(), L1Updater(), cfg)
+        w_single, h_single = opt.optimize_with_history((X, y), w0)
+        w_2d, h_2d, _ = dp_mp_optimize(
+            LeastSquaresGradient(), L1Updater(), cfg, mesh2d, w0, X, y
+        )
+        assert w_2d.shape == (13,)
+        np.testing.assert_allclose(np.asarray(w_2d), np.asarray(w_single),
+                                   rtol=3e-4, atol=1e-5)
+
+    def test_l2_reg_and_convergence_on_2d(self, mesh2d):
+        """reg_val and the convergence norm need the model-axis psum."""
+        from tpu_sgd.parallel.model_parallel import dp_mp_optimize
+
+        X, y, _ = linear_data(512, 16, eps=0.0, seed=12)
+        w0 = np.zeros(16, np.float32)
+        cfg = SGDConfig(step_size=0.5, num_iterations=400, reg_param=0.01,
+                        convergence_tol=1e-3)
+        opt = GradientDescent(LeastSquaresGradient(), SquaredL2Updater(), cfg)
+        w_single, h_single = opt.optimize_with_history((X, y), w0)
+        w_2d, h_2d, n_rec = dp_mp_optimize(
+            LeastSquaresGradient(), SquaredL2Updater(), cfg, mesh2d, w0, X, y
+        )
+        assert int(n_rec) == len(h_single)  # same early-exit iteration
+        np.testing.assert_allclose(np.asarray(w_2d), np.asarray(w_single),
+                                   rtol=3e-4, atol=1e-5)
+
+    def test_optimizer_routes_2d_mesh(self, mesh2d):
+        X, y, w_true = linear_data(2048, 24, eps=0.01, seed=13)
+        opt = (
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_step_size(0.5)
+            .set_num_iterations(150)
+            .set_convergence_tol(0.0)
+            .set_mesh(mesh2d)
+        )
+        w, hist = opt.optimize_with_history((X, y), np.zeros(24, np.float32))
+        np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+
+
 def test_shard_dataset_places_rows(mesh):
     X, y, _ = linear_data(64, 4, seed=4)
     Xd, yd, valid = shard_dataset(mesh, X, y)

@@ -32,6 +32,14 @@ class SGDConfig:
         ``||w_new - w_old|| < tol * max(||w_new||, 1)``.
       seed: base RNG seed; iteration ``i`` folds in ``seed + i`` (the
         distributional analogue of Spark's per-iteration seed ``42 + i``).
+      sampling: mini-batch sampling strategy when ``mini_batch_fraction < 1``.
+        ``"bernoulli"`` (default) is exact reference parity — a per-example
+        Bernoulli mask, normalized by the realized count; it computes the
+        full-dataset matvec with masked coefficients.  ``"indexed"`` is the
+        TPU fast path: gather a fixed-size batch of ``round(frac * n)`` rows
+        sampled with replacement, touching only ``frac`` of HBM per
+        iteration — distributionally equivalent for SGD, ~1/frac less
+        memory traffic.
     """
 
     step_size: float = 1.0
@@ -40,6 +48,13 @@ class SGDConfig:
     mini_batch_fraction: float = 1.0
     convergence_tol: float = 0.001
     seed: int = 42
+    sampling: str = "bernoulli"
+
+    def __post_init__(self):
+        if self.sampling not in ("bernoulli", "indexed"):
+            raise ValueError(
+                f"sampling must be 'bernoulli' or 'indexed', got {self.sampling!r}"
+            )
 
     def replace(self, **kwargs) -> "SGDConfig":
         return dataclasses.replace(self, **kwargs)

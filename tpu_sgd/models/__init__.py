@@ -10,6 +10,7 @@ from tpu_sgd.models.regression import (
 )
 from tpu_sgd.models.classification import (
     LogisticRegressionModel,
+    LogisticRegressionWithLBFGS,
     LogisticRegressionWithSGD,
     SVMModel,
     SVMWithSGD,
@@ -33,6 +34,7 @@ __all__ = [
     "RidgeRegressionWithSGD",
     "LogisticRegressionModel",
     "LogisticRegressionWithSGD",
+    "LogisticRegressionWithLBFGS",
     "SVMModel",
     "SVMWithSGD",
     "StreamingLinearAlgorithm",

@@ -147,3 +147,49 @@ class SVMWithSGD(_BinaryClassifierWithSGD):
 
     _gradient_cls = HingeGradient
     _model_cls = SVMModel
+
+
+class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
+    """Binary logistic regression via L-BFGS.
+
+    Reference parity: [U] mllib/classification/LogisticRegression.scala's
+    ``LogisticRegressionWithLBFGS`` — same user API as the SGD variant, with
+    the L-BFGS optimizer (SURVEY.md §2 #18) behind the same boundary.
+    """
+
+    def __init__(
+        self,
+        num_corrections: int = 10,
+        convergence_tol: float = 1e-6,
+        max_num_iterations: int = 100,
+        reg_param: float = 0.0,
+    ):
+        super().__init__()
+        from tpu_sgd.optimize.lbfgs import LBFGS
+
+        self.optimizer = LBFGS(
+            LogisticGradient(),
+            SquaredL2Updater(),
+            num_corrections=num_corrections,
+            convergence_tol=convergence_tol,
+            max_num_iterations=max_num_iterations,
+            reg_param=reg_param,
+        )
+
+    def validators(self, X, y):
+        bad = np.logical_and(y != 0.0, y != 1.0)
+        if bad.any():
+            raise ValueError(
+                "Classification labels should be 0 or 1; found "
+                f"{np.unique(np.asarray(y)[bad])[:5]}"
+            )
+
+    def create_model(self, weights, intercept):
+        return LogisticRegressionModel(weights, intercept)
+
+    @classmethod
+    def train(cls, data, max_num_iterations: int = 100, reg_param: float = 0.0,
+              initial_weights=None, intercept: bool = False):
+        alg = cls(max_num_iterations=max_num_iterations, reg_param=reg_param)
+        alg.set_intercept(intercept)
+        return alg.run(data, initial_weights)

@@ -62,6 +62,7 @@ class Gradient:
         y: Array,
         weights: Array,
         mask: Optional[Array] = None,
+        margin_axis_name: Optional[str] = None,
     ) -> Tuple[Array, Array, Array]:
         """Fused mini-batch ``(grad_sum, loss_sum, count)``.
 
@@ -71,8 +72,15 @@ class Gradient:
         mini-batch sampling; sums are *unnormalized* so they can be combined
         across shards with ``lax.psum`` before dividing by the realized
         mini-batch count (parity with ``treeAggregate`` + ``/ miniBatchSize``).
+
+        ``margin_axis_name``: when the FEATURE axis is sharded (wide-weights
+        mode), each core computes a partial margin from its column block;
+        pass the mesh axis to all-reduce those partials into full margins.
+        The returned grad_sum is then the local feature block's gradient.
         """
         margins = X @ weights
+        if margin_axis_name is not None:
+            margins = jax.lax.psum(margins, margin_axis_name)
         coeff, losses = self.pointwise(margins, y)
         if mask is not None:
             m = mask.astype(margins.dtype)
@@ -149,10 +157,13 @@ class MultinomialLogisticGradient:
         y: Array,
         weights: Array,
         mask: Optional[Array] = None,
+        margin_axis_name: Optional[str] = None,
     ) -> Tuple[Array, Array, Array]:
         K = self.num_classes
         W = weights.reshape(K - 1, X.shape[-1])
-        margins = X @ W.T  # (n, K-1)
+        margins = X @ W.T  # (n, K-1); partial if features are sharded
+        if margin_axis_name is not None:
+            margins = jax.lax.psum(margins, margin_axis_name)
         logits = jnp.concatenate(
             [jnp.zeros((X.shape[0], 1), margins.dtype), margins], axis=-1
         )  # (n, K) with pivot logit 0

@@ -5,10 +5,12 @@ from tpu_sgd.optimize.gradient_descent import (
     make_step,
     run_mini_batch_sgd,
 )
+from tpu_sgd.optimize.lbfgs import LBFGS
 
 __all__ = [
     "Optimizer",
     "GradientDescent",
+    "LBFGS",
     "make_run",
     "make_step",
     "run_mini_batch_sgd",

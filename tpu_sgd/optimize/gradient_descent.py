@@ -62,6 +62,7 @@ def make_step(
     updater: Updater,
     config: SGDConfig,
     axis_name: Optional[str] = None,
+    model_axis_name: Optional[str] = None,
 ):
     """Build one SGD iteration as a pure function.
 
@@ -69,13 +70,40 @@ def make_step(
     (new_weights, loss_i, new_reg_val, count)`` — the unit the streaming mode
     and the fused driver both build on.  ``loss_i`` already includes the
     previous iteration's ``reg_val`` per the reference's loss-history contract.
+
+    ``axis_name`` shards the example axis (data parallelism — the reference's
+    only strategy); ``model_axis_name`` additionally shards the FEATURE axis
+    (the optional wide-weights hook, SURVEY.md §2 ledger TP row): each core
+    holds a block of ``w`` and the matching column block of ``X``, partial
+    margins are all-reduced over the model axis, and the updater runs on the
+    local block with its scalar reg value all-reduced.
     """
     cfg = config
     key = jax.random.PRNGKey(cfg.seed)
 
+    indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
+
     def step(weights, X, y, i, reg_val, valid=None):
-        mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
-        g, l, c = gradient.batch_sums(X, y, weights, mask)
+        if indexed:
+            # TPU fast path: gather a fixed-size batch (with replacement)
+            # instead of masking the whole dataset — touches only ``frac``
+            # of HBM per iteration.
+            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
+            k = jax.random.fold_in(key, i)
+            if axis_name is not None:
+                k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+            idx = jax.random.randint(k, (m,), 0, X.shape[0])
+            Xb, yb = X[idx], y[idx]
+            mask = None if valid is None else valid[idx]
+        else:
+            Xb, yb = X, y
+            mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
+        if model_axis_name is None:
+            g, l, c = gradient.batch_sums(Xb, yb, weights, mask)
+        else:
+            g, l, c = gradient.batch_sums(
+                Xb, yb, weights, mask, margin_axis_name=model_axis_name
+            )
         if axis_name is not None:
             g, l, c = jax.lax.psum((g, l, c), axis_name)
         has_batch = c > 0
@@ -84,6 +112,9 @@ def make_step(
         new_w, new_reg = updater.compute(
             weights, g / safe_c, cfg.step_size, i, cfg.reg_param
         )
+        if model_axis_name is not None:
+            # reg value is a sum over features -> combine the local blocks
+            new_reg = jax.lax.psum(new_reg, model_axis_name)
         # Reference behavior on an empty sampled batch: warn, skip the update.
         new_w = jnp.where(has_batch, new_w, weights)
         new_reg = jnp.where(has_batch, new_reg, reg_val)
@@ -97,6 +128,7 @@ def make_run(
     updater: Updater,
     config: SGDConfig,
     axis_name: Optional[str] = None,
+    model_axis_name: Optional[str] = None,
 ):
     """Build the full optimization loop as one traceable function.
 
@@ -104,11 +136,18 @@ def make_run(
     where ``loss_history`` has static length ``config.num_iterations`` padded
     with NaN beyond ``n_recorded`` (the while_loop may exit early on the
     convergence tolerance).  Runs unchanged inside ``shard_map`` when
-    ``axis_name`` is given.
+    ``axis_name`` (and optionally ``model_axis_name``) is given.
     """
     cfg = config
     check_conv = cfg.convergence_tol > 0.0
-    step = make_step(gradient, updater, cfg, axis_name)
+    step = make_step(gradient, updater, cfg, axis_name, model_axis_name)
+
+    def _global_norms(new_w, w):
+        diff_sq = jnp.sum((new_w - w) ** 2)
+        w_sq = jnp.sum(new_w**2)
+        if model_axis_name is not None:
+            diff_sq, w_sq = jax.lax.psum((diff_sq, w_sq), model_axis_name)
+        return jnp.sqrt(diff_sq), jnp.sqrt(w_sq)
 
     def run(initial_weights, X, y, valid=None):
         w0 = initial_weights
@@ -132,11 +171,11 @@ def make_run(
             )
             n_rec = n_rec + has_batch.astype(n_rec.dtype)
             if check_conv:
-                diff = jnp.linalg.norm(new_w - w)
+                diff, w_norm = _global_norms(new_w, w)
                 conv = (
                     has_batch
                     & (i > 1)
-                    & (diff < cfg.convergence_tol * jnp.maximum(jnp.linalg.norm(new_w), 1.0))
+                    & (diff < cfg.convergence_tol * jnp.maximum(w_norm, 1.0))
                 )
             else:
                 conv = jnp.asarray(False)
@@ -176,6 +215,9 @@ class GradientDescent(Optimizer):
         self.updater = updater if updater is not None else SimpleUpdater()
         self.config = config if config is not None else SGDConfig()
         self.mesh = None
+        self.listener = None
+        self.checkpoint_manager = None
+        self.checkpoint_every = 10
         self._loss_history = None
         self._run_cache = {}
 
@@ -218,8 +260,32 @@ class GradientDescent(Optimizer):
         self.config = self.config.replace(seed=int(s))
         return self
 
+    def set_sampling(self, mode: str):
+        """'bernoulli' (reference parity) or 'indexed' (TPU fast path)."""
+        self.config = self.config.replace(sampling=mode)
+        return self
+
     def set_mesh(self, mesh):
         self.mesh = mesh
+        return self
+
+    def set_listener(self, listener):
+        """Attach an ``SGDListener`` (tpu_sgd.utils.events).
+
+        Switches ``optimize`` to the step-wise traced path: one jitted step
+        per iteration with host-visible loss/timing events — the analogue of
+        Spark's per-job listener bus (SURVEY.md §5.1) — instead of the single
+        fused while_loop program.
+        """
+        self.listener = listener
+        return self
+
+    def set_checkpoint(self, manager, every: int = 10):
+        """Attach a ``CheckpointManager``; optimizer state is saved every
+        ``every`` iterations and ``optimize`` resumes from the latest
+        checkpoint when one exists (SURVEY.md §5.4)."""
+        self.checkpoint_manager = manager
+        self.checkpoint_every = int(every)
         return self
 
     # -- optimization ------------------------------------------------------
@@ -242,7 +308,11 @@ class GradientDescent(Optimizer):
             X = X.astype(jnp.float32)  # int/bool features (one-hot etc.)
         if not jnp.issubdtype(y.dtype, jnp.inexact):
             y = y.astype(jnp.float32)
-        w0 = jnp.asarray(initial_weights, X.dtype)
+        # Weights stay float32 even when X is bf16 (mixed-precision mode:
+        # bf16 data halves HBM traffic, f32 master weights keep convergence).
+        w0 = jnp.asarray(initial_weights)
+        if not jnp.issubdtype(w0.dtype, jnp.inexact):
+            w0 = w0.astype(jnp.float32)
         expect_dim = self.gradient.weight_dim(X.shape[1])
         if w0.shape[-1] != expect_dim:
             raise ValueError(
@@ -259,7 +329,21 @@ class GradientDescent(Optimizer):
             warnings.warn(
                 "The miniBatchFraction is too small", RuntimeWarning, stacklevel=2
             )
-        if self.mesh is not None:
+        if self.listener is not None or self.checkpoint_manager is not None:
+            return self._optimize_stepwise(X, y, w0)
+        if self.mesh is not None and self._mesh_kind() == "dp_mp":
+            from tpu_sgd.parallel.model_parallel import dp_mp_optimize
+
+            if self.gradient.weight_dim(X.shape[1]) != X.shape[1]:
+                raise NotImplementedError(
+                    "feature-axis ('model') sharding supports vector-weight "
+                    "gradients only; matrix-weight gradients (multinomial) "
+                    "need a 1-D 'data' mesh"
+                )
+            w, losses, n_rec = dp_mp_optimize(
+                self.gradient, self.updater, self.config, self.mesh, w0, X, y
+            )
+        elif self.mesh is not None:
             from tpu_sgd.parallel.data_parallel import shard_dataset
 
             Xd, yd, valid = shard_dataset(self.mesh, X, y)
@@ -273,6 +357,161 @@ class GradientDescent(Optimizer):
         n_rec = int(n_rec)
         self._loss_history = np.asarray(losses)[:n_rec]
         return w, self._loss_history
+
+    def _optimize_stepwise(self, X, y, w0):
+        """Observed path: jitted step per iteration with host round-trips.
+
+        Used when a listener or checkpoint manager is attached.  Supports
+        single-device and 1-D data-parallel meshes; preserves the exact loss
+        history / convergence semantics of the fused path (same make_step).
+        """
+        import time as _time
+
+        import numpy as np
+
+        from tpu_sgd.utils.events import IterationEvent, RunEvent
+
+        cfg = self.config
+        if self.mesh is not None and self._mesh_kind() == "dp_mp":
+            raise NotImplementedError(
+                "listener/checkpoint mode supports single-device and 1-D "
+                "data meshes"
+            )
+        valid = None
+        if self.mesh is not None:
+            from tpu_sgd.parallel.data_parallel import shard_dataset
+
+            X, y, valid = shard_dataset(self.mesh, X, y)
+        step = self._stepper(with_valid=valid is not None)
+
+        # regVal probe init (same as the fused path)
+        _, reg_val = self.updater.compute(
+            w0, jnp.zeros_like(w0), 0.0, jnp.asarray(1, jnp.int32), cfg.reg_param
+        )
+        reg_val = float(reg_val)
+        losses = []
+        start_iter = 1
+        config_key = repr((type(self.gradient).__name__,
+                           type(self.updater).__name__, cfg))
+        mgr = self.checkpoint_manager
+        if mgr is not None:
+            state = mgr.restore()
+            if state is not None:
+                if state["config_key"] and state["config_key"] != config_key:
+                    import warnings
+
+                    warnings.warn(
+                        "checkpoint config differs from current config; "
+                        "resuming anyway",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+                w0 = jnp.asarray(state["weights"])
+                reg_val = state["reg_val"]
+                losses = list(np.asarray(state["loss_history"], np.float32))
+                start_iter = state["iteration"] + 1
+        if self.listener is not None:
+            self.listener.on_run_start(cfg)
+
+        w = w0
+        t_run = _time.perf_counter()
+        converged_early = False
+        i = start_iter
+        while i <= cfg.num_iterations:
+            t0 = _time.perf_counter()
+            if valid is not None:
+                new_w, loss_i, new_reg, c = step(
+                    w, X, y, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val), valid
+                )
+            else:
+                new_w, loss_i, new_reg, c = step(
+                    w, X, y, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val)
+                )
+            new_w = jax.block_until_ready(new_w)
+            dt = _time.perf_counter() - t0
+            c = int(c)
+            if c > 0:
+                loss_f = float(loss_i)
+                losses.append(loss_f)
+                delta = float(jnp.linalg.norm(new_w - w))
+                reg_val = float(new_reg)
+                if self.listener is not None:
+                    self.listener.on_iteration(
+                        IterationEvent(
+                            iteration=i,
+                            loss=loss_f,
+                            weight_delta_norm=delta,
+                            mini_batch_size=c,
+                            wall_time_s=dt,
+                        )
+                    )
+                if cfg.convergence_tol > 0 and i > 1:
+                    w_norm = float(jnp.linalg.norm(new_w))
+                    if delta < cfg.convergence_tol * max(w_norm, 1.0):
+                        converged_early = True
+                w = new_w
+                if mgr is not None and (
+                    i % self.checkpoint_every == 0
+                    or converged_early
+                    or i == cfg.num_iterations
+                ):
+                    mgr.save(i, np.asarray(w), reg_val, np.asarray(losses),
+                             config_key)
+            if converged_early:
+                break
+            i += 1
+
+        if self.listener is not None:
+            self.listener.on_run_end(
+                RunEvent(
+                    event="run_completed",
+                    num_iterations=len(losses),
+                    final_loss=losses[-1] if losses else None,
+                    converged_early=converged_early,
+                    wall_time_s=_time.perf_counter() - t_run,
+                )
+            )
+        import numpy as _np
+
+        self._loss_history = _np.asarray(losses, _np.float32)
+        return w, self._loss_history
+
+    def _stepper(self, with_valid: bool):
+        """Memoized jitted single-step function (mesh-aware)."""
+        key = ("step", id(self.gradient), id(self.updater), self.config,
+               id(self.mesh), with_valid)
+        fn = self._run_cache.get(key)
+        if fn is None:
+            if self.mesh is None:
+                fn = jax.jit(make_step(self.gradient, self.updater, self.config))
+            else:
+                from jax.sharding import PartitionSpec as P
+
+                from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+                step = make_step(
+                    self.gradient, self.updater, self.config, axis_name=DATA_AXIS
+                )
+                if with_valid:
+                    body = lambda w, X, y, i, r, v: step(w, X, y, i, r, v)
+                    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
+                                P(DATA_AXIS))
+                else:
+                    body = lambda w, X, y, i, r: step(w, X, y, i, r, None)
+                    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P())
+                fn = jax.jit(
+                    shard_map_fn(self.mesh, body, in_specs,
+                                 (P(), P(), P(), P()))
+                )
+            self._run_cache[key] = fn
+        return fn
+
+    def _mesh_kind(self) -> str:
+        from tpu_sgd.parallel.mesh import MODEL_AXIS
+
+        if self.mesh is not None and dict(self.mesh.shape).get(MODEL_AXIS, 1) > 1:
+            return "dp_mp"
+        return "dp"
 
     def _runner(self, with_valid: bool):
         """Memoized jitted runner.

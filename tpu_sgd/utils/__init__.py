@@ -7,8 +7,26 @@ from tpu_sgd.utils.mlutils import (
     svm_data,
 )
 from tpu_sgd.utils.persistence import load_glm_model, save_glm_model
+from tpu_sgd.utils.checkpoint import CheckpointManager
+from tpu_sgd.utils.events import (
+    CollectingListener,
+    IterationEvent,
+    JsonLinesEventLog,
+    RunEvent,
+    SGDListener,
+    StepTimer,
+    profile_trace,
+)
 
 __all__ = [
+    "CheckpointManager",
+    "SGDListener",
+    "CollectingListener",
+    "JsonLinesEventLog",
+    "IterationEvent",
+    "RunEvent",
+    "StepTimer",
+    "profile_trace",
     "append_bias",
     "load_libsvm_file",
     "save_as_libsvm_file",

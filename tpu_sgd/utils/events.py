@@ -1,0 +1,143 @@
+"""Tracing / metrics / observability.
+
+Reference parity: SURVEY.md §5.1 and §5.5 — Spark's event-bus
+(``SparkListenerEvent`` per job/stage/task, JSON event log, per-task
+``TaskMetrics``) and the ``Logging`` trait.  The TPU-native analogues:
+
+  * :class:`SGDListener` — per-iteration callbacks (the analogue of listener
+    events; each reference iteration is a visible Spark job).  Attaching a
+    listener switches the optimizer to its step-wise traced path, trading the
+    single fused XLA program for full per-iteration host observability.
+  * :class:`JsonLinesEventLog` — the analogue of ``spark.eventLog.enabled``:
+    append-only JSONL of run/iteration events.
+  * :func:`profile_trace` — wraps ``jax.profiler`` (TensorBoard/Perfetto),
+    the analogue of the Spark web UI's task-level timeline.
+  * :class:`StepTimer` — wall-clock per-call timing harness built on
+    ``block_until_ready`` (SURVEY.md §5.1 "step-time log").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class IterationEvent:
+    """One optimizer iteration (the analogue of a Spark job for one
+    treeAggregate round)."""
+
+    iteration: int
+    loss: float
+    weight_delta_norm: float
+    mini_batch_size: int
+    wall_time_s: float
+
+
+@dataclass
+class RunEvent:
+    """Run-level summary (the analogue of SparkListenerJobEnd + logged
+    loss history, SURVEY.md §5.5)."""
+
+    event: str  # "run_started" | "run_completed"
+    num_iterations: int = 0
+    final_loss: Optional[float] = None
+    converged_early: bool = False
+    wall_time_s: float = 0.0
+
+
+class SGDListener:
+    """Override any subset; attached via ``GradientDescent.set_listener``."""
+
+    def on_run_start(self, config) -> None: ...
+
+    def on_iteration(self, event: IterationEvent) -> None: ...
+
+    def on_run_end(self, event: RunEvent) -> None: ...
+
+
+class CollectingListener(SGDListener):
+    """Buffers every event in memory (test/introspection helper)."""
+
+    def __init__(self):
+        self.iterations: List[IterationEvent] = []
+        self.runs: List[RunEvent] = []
+
+    def on_run_start(self, config):
+        self.runs.append(RunEvent(event="run_started"))
+
+    def on_iteration(self, event):
+        self.iterations.append(event)
+
+    def on_run_end(self, event):
+        self.runs.append(event)
+
+
+class JsonLinesEventLog(SGDListener):
+    """Append-only JSONL event log (the ``spark.eventLog`` analogue)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a")
+
+    def _write(self, kind: str, payload: dict):
+        self._f.write(json.dumps({"kind": kind, "ts": time.time(), **payload}) + "\n")
+        self._f.flush()
+
+    def on_run_start(self, config):
+        self._write("run_started", {"config": asdict(config)})
+
+    def on_iteration(self, event: IterationEvent):
+        self._write("iteration", asdict(event))
+
+    def on_run_end(self, event: RunEvent):
+        self._write("run_completed", asdict(event))
+
+    def close(self):
+        self._f.close()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """``jax.profiler`` trace context — open the result in TensorBoard or
+    Perfetto (SURVEY.md §5.1 TPU equivalent of the Spark web UI)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Step-time harness.  Use :meth:`timed_call` for jitted functions —
+    it blocks on the result (``jax.block_until_ready``) so device work is
+    included; the raw :meth:`time` context manager measures plain wall clock
+    of the enclosed block (async dispatch is NOT awaited)."""
+
+    def __init__(self):
+        self.times: List[float] = []
+
+    def timed_call(self, fn, *args, **kwargs):
+        """Call ``fn``, block until its outputs are ready, record the time."""
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        out = jax.block_until_ready(out)
+        self.times.append(time.perf_counter() - t0)
+        return out
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        yield
+        self.times.append(time.perf_counter() - t0)
+
+    @property
+    def mean_s(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)

@@ -1,0 +1,22 @@
+"""Platform selection helpers for this TPU environment."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_env() -> bool:
+    """Re-assert ``JAX_PLATFORMS=cpu`` against site hooks.
+
+    This environment's sitecustomize registers a remote-TPU PJRT plugin and
+    force-sets ``jax_platforms="axon,cpu"`` via ``jax.config``, trampling the
+    ``JAX_PLATFORMS`` env var; when the TPU tunnel is down any backend init
+    then stalls for minutes.  Call this before the first ``jax.devices()`` to
+    honor an explicit CPU request.  Returns True when CPU was forced.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
