@@ -152,6 +152,84 @@ def test_indexed_sampling_dp_parity():
     np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
 
 
+def test_host_streaming_converges():
+    """Host-resident dataset, streamed minibatches, same solution quality."""
+    X, y, w_true = linear_data(4000, 6, eps=0.01, seed=7)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5)
+        .set_num_iterations(300)
+        .set_mini_batch_fraction(0.1)
+        .set_convergence_tol(0.0)
+        .set_host_streaming()
+    )
+    w, hist = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    assert len(hist) == 300
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.1)
+
+
+def test_host_streaming_checkpoint_resume(tmp_path):
+    """Streamed path honors checkpointing: interrupt, resume, same result."""
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+
+    X, y, _ = linear_data(2000, 5, seed=9)
+    w0 = np.zeros(5, np.float32)
+
+    def make(iters, ck):
+        return (
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_step_size(0.5).set_num_iterations(iters)
+            .set_mini_batch_fraction(0.2).set_convergence_tol(0.0)
+            .set_host_streaming()
+            .set_checkpoint(CheckpointManager(ck), every=10)
+        )
+
+    full = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.5).set_num_iterations(60)
+        .set_mini_batch_fraction(0.2).set_convergence_tol(0.0)
+        .set_host_streaming()
+    )
+    w_full, h_full = full.optimize_with_history((X, y), w0)
+    ck = str(tmp_path / "ck")
+    make(30, ck).optimize_with_history((X, y), w0)
+    with pytest.warns(RuntimeWarning):
+        w_res, h_res = make(60, ck).optimize_with_history((X, y), w0)
+    assert len(h_res) == 60
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_host_streaming_rejects_mesh():
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    X, y, _ = linear_data(100, 3, seed=10)
+    opt = GradientDescent().set_host_streaming().set_mesh(data_mesh())
+    with pytest.raises(NotImplementedError, match="host streaming"):
+        opt.optimize((X, y), np.zeros(3, np.float32))
+
+
+def test_host_streaming_full_batch_matches_resident():
+    """frac=1.0 streamed == resident path (identical math, no sampling)."""
+    X, y, _ = linear_data(600, 5, seed=8)
+    w0 = np.zeros(5, np.float32)
+    cfg = dict(step_size=0.3, num_iterations=25)
+    res = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.3).set_num_iterations(25).set_convergence_tol(0.0)
+    )
+    w_r, h_r = res.optimize_with_history((X, y), w0)
+    st = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.3).set_num_iterations(25).set_convergence_tol(0.0)
+        .set_host_streaming()
+    )
+    w_s, h_s = st.optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_s), np.asarray(w_r), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(h_s, h_r, rtol=1e-5)
+
+
 def test_invalid_sampling_mode_rejected():
     with pytest.raises(ValueError, match="sampling"):
         GradientDescent().set_sampling("nope")

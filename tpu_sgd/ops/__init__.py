@@ -5,6 +5,7 @@ from tpu_sgd.ops.gradients import (
     LogisticGradient,
     MultinomialLogisticGradient,
 )
+from tpu_sgd.ops.pallas_kernels import PallasGradient, fused_gradient_sums
 from tpu_sgd.ops.updaters import (
     L1Updater,
     SimpleUpdater,
@@ -18,6 +19,8 @@ __all__ = [
     "LogisticGradient",
     "HingeGradient",
     "MultinomialLogisticGradient",
+    "PallasGradient",
+    "fused_gradient_sums",
     "Updater",
     "SimpleUpdater",
     "L1Updater",

@@ -216,6 +216,7 @@ class GradientDescent(Optimizer):
         self.config = config if config is not None else SGDConfig()
         self.mesh = None
         self.listener = None
+        self.host_streaming = False
         self.checkpoint_manager = None
         self.checkpoint_every = 10
         self._loss_history = None
@@ -280,6 +281,13 @@ class GradientDescent(Optimizer):
         self.listener = listener
         return self
 
+    def set_host_streaming(self, flag: bool = True):
+        """Keep the dataset in host RAM and stream per-iteration sampled
+        batches to the device with double-buffered prefetch — for datasets
+        larger than HBM (SURVEY.md §7, config 4 at full 40 GB scale)."""
+        self.host_streaming = bool(flag)
+        return self
+
     def set_checkpoint(self, manager, every: int = 10):
         """Attach a ``CheckpointManager``; optimizer state is saved every
         ``every`` iterations and ``optimize`` resumes from the latest
@@ -302,6 +310,28 @@ class GradientDescent(Optimizer):
         import numpy as np
 
         X, y = data
+        if self.host_streaming:
+            # Route BEFORE any device conversion: the whole point is that X
+            # never lives on the device in full.
+            from tpu_sgd.optimize.streamed import optimize_host_streamed
+
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "host streaming is single-device for now; detach the "
+                    "mesh or stream per host shard"
+                )
+            Xh = np.asarray(X)
+            if Xh.shape[0] == 0:
+                self._loss_history = np.zeros((0,), np.float32)
+                return jnp.asarray(initial_weights), self._loss_history
+            w, hist = optimize_host_streamed(
+                self.gradient, self.updater, self.config, Xh, np.asarray(y),
+                initial_weights, listener=self.listener,
+                checkpoint_manager=self.checkpoint_manager,
+                checkpoint_every=self.checkpoint_every,
+            )
+            self._loss_history = hist
+            return w, hist
         X = jnp.asarray(X)
         y = jnp.asarray(y)
         if not jnp.issubdtype(X.dtype, jnp.inexact):

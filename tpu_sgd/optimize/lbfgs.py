@@ -133,6 +133,19 @@ class LBFGS(Optimizer):
             g = g_sum / c + reg_grad(w)
             return f, g
 
+        if hasattr(gradient, "pointwise"):
+            # Loss-only evaluation for line-search trials: skips the
+            # coeff^T @ X matvec (half the HBM traffic of the fused cost);
+            # the gradient is computed once, on the accepted point.
+            @jax.jit
+            def cost_loss(w):
+                _, losses = gradient.pointwise(X @ w, y)
+                return jnp.sum(losses) / X.shape[0] + reg_value(w)
+
+        else:  # matrix-weight gradients have no pointwise rule
+            def cost_loss(w):
+                return cost(w)[0]
+
         @jax.jit
         def two_loop(g, s_stack, y_stack, rho, k):
             """Standard L-BFGS two-loop recursion over a fixed-size history
@@ -189,13 +202,14 @@ class LBFGS(Optimizer):
             accepted = False
             for _ls in range(25):
                 w_new = w + t * direction
-                f_new, g_new = cost(w_new)
+                f_new = cost_loss(w_new)
                 if float(f_new) <= f0 + 1e-4 * t * g_dot_d:
                     accepted = True
                     break
                 t *= 0.5
             if not accepted:
                 break  # cannot make progress
+            f_new, g_new = cost(w_new)  # gradient only at the accepted point
             s = w_new - w
             yv = g_new - g
             sy = float(jnp.dot(s, yv))
