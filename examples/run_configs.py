@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Run the five reference workload configs (BASELINE.json:6-12) end-to-end.
+
+    python examples/run_configs.py [1|2|3|4|5|all] [--scale small|full]
+
+Config 1: LinearRegressionWithSGD, least squares, dense synthetic.
+Config 2: LogisticRegressionWithSGD, log loss + L2, LIBSVM file (a9a when
+          present at data/a9a, else a synthetic stand-in written to disk).
+Config 3: SVMWithSGD, hinge + L1 updater, sparse->densified LIBSVM.
+Config 4: Mini-batch SGD frac=0.1, 8-way data-parallel all-reduce.
+Config 5: Streaming SGD over micro-batches, online weight updates.
+
+On a machine without the TPU attached, run with JAX_PLATFORMS=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=8.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpu_sgd.utils.platform import honor_cpu_env
+
+honor_cpu_env()
+
+import numpy as np  # noqa: E402
+
+from tpu_sgd import (  # noqa: E402
+    L1Updater,
+    LinearRegressionWithSGD,
+    LogisticRegressionWithSGD,
+    StreamingLinearRegressionWithSGD,
+    SVMWithSGD,
+    data_mesh,
+)
+from tpu_sgd.utils import (  # noqa: E402
+    linear_data,
+    load_libsvm_file,
+    logistic_data,
+    save_as_libsvm_file,
+    svm_data,
+)
+
+def _parse_args(argv):
+    which = "all"
+    scale = os.environ.get("SCALE", "small")
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == "--scale":
+            if not args or args[0] not in ("small", "full"):
+                raise SystemExit("--scale takes 'small' or 'full'")
+            scale = args.pop(0)
+        elif a in ("1", "2", "3", "4", "5", "all"):
+            which = a
+        else:
+            raise SystemExit(
+                f"unknown argument {a!r}; usage: run_configs.py "
+                "[1|2|3|4|5|all] [--scale small|full]"
+            )
+    return which, scale
+
+
+SMALL = True  # overwritten in __main__ from --scale / SCALE env
+
+
+def config1():
+    n, d = (100_000, 100)
+    X, y, w_true = linear_data(n, d, eps=0.1, seed=0)
+    t0 = time.perf_counter()
+    model = LinearRegressionWithSGD.train((X, y), num_iterations=100,
+                                          step_size=0.5)
+    mse = float(np.mean((np.asarray(model.predict(X)) - y) ** 2))
+    print(f"config1: n={n} d={d} mse={mse:.4f} "
+          f"w_err={float(np.linalg.norm(np.asarray(model.weights) - w_true)):.4f} "
+          f"({time.perf_counter() - t0:.1f}s)")
+
+
+def _libsvm_path(name, maker):
+    path = os.path.join(os.path.dirname(__file__), "..", "data", name)
+    if os.path.exists(path):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    X, y = maker()
+    save_as_libsvm_file(path, X, y)
+    return path
+
+
+def config2():
+    path = _libsvm_path(
+        "a9a", lambda: logistic_data(20_000, 123, seed=1)[:2]
+    )
+    X, y = load_libsvm_file(path)
+    y = np.where(y > 0, 1.0, 0.0).astype(np.float32)  # a9a labels are +/-1
+    t0 = time.perf_counter()
+    model = LogisticRegressionWithSGD.train((X, y), num_iterations=100,
+                                            reg_param=0.01, intercept=True)
+    acc = float(np.mean(np.asarray(model.predict(X)) == y))
+    print(f"config2: libsvm={os.path.basename(path)} n={X.shape[0]} "
+          f"d={X.shape[1]} acc={acc:.4f} ({time.perf_counter() - t0:.1f}s)")
+
+
+def config3():
+    path = _libsvm_path(
+        "rcv1_like", lambda: svm_data(20_000, 200, noise=0.05, seed=2)[:2]
+    )
+    X, y = load_libsvm_file(path, dense=True)  # sparse -> densified
+    y = np.where(y > 0, 1.0, 0.0).astype(np.float32)
+    t0 = time.perf_counter()
+    model = SVMWithSGD.train((X, y), num_iterations=100, reg_param=0.01,
+                             updater=L1Updater())
+    acc = float(np.mean(np.asarray(model.predict(X)) == y))
+    print(f"config3: n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
+          f"({time.perf_counter() - t0:.1f}s)")
+
+
+def config4():
+    n, d = (400_000, 200) if SMALL else (10_000_000, 1000)
+    X, y, w_true = linear_data(n, d, eps=0.1, seed=3)
+    mesh = data_mesh()
+    t0 = time.perf_counter()
+    model = LinearRegressionWithSGD.train(
+        (X, y), num_iterations=200, step_size=0.5, mini_batch_fraction=0.1,
+        mesh=mesh,
+    )
+    print(f"config4: n={n} d={d} {dict(mesh.shape)}-way DP "
+          f"w_err={float(np.linalg.norm(np.asarray(model.weights) - w_true)):.4f} "
+          f"({time.perf_counter() - t0:.1f}s)")
+
+
+def config5():
+    d = 50
+    w_true = np.linspace(-1, 1, d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=25)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    t0 = time.perf_counter()
+    errs = []
+    for i in range(10):  # micro-batched DStream analogue
+        Xb, yb, _ = linear_data(2_000, d, weights=w_true, eps=0.05, seed=10 + i)
+        alg.train_on_batch(Xb, yb)
+        errs.append(float(np.linalg.norm(
+            np.asarray(alg.latest_model().weights) - w_true)))
+    print(f"config5: 10 micro-batches w_err {errs[0]:.3f} -> {errs[-1]:.3f} "
+          f"({time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    which, scale = _parse_args(sys.argv[1:])
+    SMALL = scale == "small"
+    fns = {"1": config1, "2": config2, "3": config3, "4": config4,
+           "5": config5}
+    for k, fn in fns.items():
+        if which in (k, "all"):
+            fn()

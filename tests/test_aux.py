@@ -128,6 +128,44 @@ def test_checkpoint_with_dp_mesh(tmp_path):
                                rtol=2e-4, atol=1e-5)
 
 
+def test_check_numerics_raises_on_divergence():
+    """A wildly too-large step size diverges; the sanitizer flags it."""
+    X, y, _ = linear_data(500, 5, seed=6)
+    X = X * 100.0  # blow up the curvature
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(1000.0)
+        .set_num_iterations(50)
+        .set_convergence_tol(0.0)
+        .set_check_numerics()
+    )
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        opt.optimize((X, y), np.zeros(5, np.float32))
+
+
+def test_check_numerics_clean_run_passes():
+    X, y, _ = linear_data(300, 4, seed=7)
+    opt = (
+        GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+        .set_step_size(0.3)
+        .set_num_iterations(10)
+        .set_check_numerics()
+    )
+    opt.optimize((X, y), np.zeros(4, np.float32))  # no raise
+
+
+def test_distributed_helpers_single_process():
+    from tpu_sgd.parallel.distributed import (
+        global_data_mesh,
+        process_count,
+        process_index,
+    )
+
+    assert process_count() == 1 and process_index() == 0
+    mesh = global_data_mesh()
+    assert mesh.shape["data"] == 8  # all 8 virtual devices
+
+
 def test_step_timer():
     from tpu_sgd.utils.events import StepTimer
 

@@ -85,6 +85,36 @@ def test_to_arrays():
     assert X.shape == (1, 2) and y.tolist() == [1.0]
 
 
+def test_k_fold_partitions_disjoint_and_complete():
+    from tpu_sgd.utils.mlutils import k_fold
+
+    X = np.arange(23, dtype=np.float32).reshape(-1, 1)
+    y = np.arange(23, dtype=np.float32)
+    seen = []
+    for (Xtr, ytr), (Xv, yv) in k_fold(X, y, 4, seed=0):
+        assert Xtr.shape[0] + Xv.shape[0] == 23
+        assert set(ytr.tolist()).isdisjoint(yv.tolist())
+        seen.extend(yv.tolist())
+    assert sorted(seen) == list(range(23))  # every row validated exactly once
+
+
+def test_k_fold_rejects_bad_folds():
+    from tpu_sgd.utils.mlutils import k_fold
+
+    with pytest.raises(ValueError):
+        list(k_fold(np.zeros((4, 1)), np.zeros(4), 1))
+
+
+def test_train_test_split():
+    from tpu_sgd.utils.mlutils import train_test_split
+
+    X = np.arange(100, dtype=np.float32).reshape(-1, 1)
+    y = np.arange(100, dtype=np.float32)
+    (Xtr, ytr), (Xte, yte) = train_test_split(X, y, test_fraction=0.25, seed=1)
+    assert Xte.shape[0] == 25 and Xtr.shape[0] == 75
+    assert set(ytr.tolist()).isdisjoint(yte.tolist())
+
+
 class TestLinalg:
     def test_dense_sparse_equality(self):
         d = Vectors.dense(1.0, 0.0, 2.0)

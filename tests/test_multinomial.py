@@ -1,0 +1,64 @@
+"""Multinomial (K-class) logistic regression through the user API."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import (
+    LogisticRegressionWithLBFGS,
+    MultinomialLogisticRegressionModel,
+)
+
+
+def _multiclass_data(n, d, K, seed=0):
+    r = np.random.default_rng(seed)
+    W = r.normal(size=(K, d)).astype(np.float32) * 2.0
+    X = r.normal(size=(n, d)).astype(np.float32)
+    logits = X @ W.T
+    y = np.argmax(logits + r.gumbel(size=(n, K)), axis=1).astype(np.float32)
+    return X, y, W
+
+
+def test_multinomial_lbfgs_accuracy():
+    K, d = 4, 10
+    X, y, W = _multiclass_data(4000, d, K, seed=0)
+    model = LogisticRegressionWithLBFGS.train((X, y), num_classes=K,
+                                              reg_param=0.001)
+    assert isinstance(model, MultinomialLogisticRegressionModel)
+    pred = np.asarray(model.predict(X))
+    acc = np.mean(pred == y)
+    bayes = np.mean(np.argmax(X @ W.T, axis=1) == y)
+    assert acc > bayes - 0.05
+    assert set(np.unique(pred)) <= set(float(k) for k in range(K))
+
+
+def test_multinomial_with_intercept():
+    K, d = 3, 6
+    X, y, _ = _multiclass_data(2000, d, K, seed=1)
+    model = LogisticRegressionWithLBFGS.train((X, y), num_classes=K,
+                                              intercept=True)
+    # bias column folded in: num_features includes it
+    assert model.num_features == d + 1
+    assert model.predict(X).shape == (2000,)
+
+
+def test_multinomial_k2_equals_binary():
+    X, y, _ = _multiclass_data(1000, 5, 2, seed=2)
+    m_bin = LogisticRegressionWithLBFGS.train((X, y))
+    m_k2 = LogisticRegressionWithLBFGS.train((X, y), num_classes=2)
+    np.testing.assert_allclose(np.asarray(m_bin.weights),
+                               np.asarray(m_k2.weights), rtol=1e-4, atol=1e-5)
+
+
+def test_multinomial_label_validation():
+    X = np.zeros((10, 3), np.float32)
+    y = np.full((10,), 5.0, np.float32)
+    with pytest.raises(ValueError, match="in \\[0, 3\\)"):
+        LogisticRegressionWithLBFGS.train((X, y), num_classes=3)
+
+
+def test_single_vector_predict():
+    K, d = 3, 4
+    X, y, _ = _multiclass_data(500, d, K, seed=3)
+    model = LogisticRegressionWithLBFGS.train((X, y), num_classes=K)
+    single = model.predict(X[0])
+    assert np.asarray(single).shape == ()

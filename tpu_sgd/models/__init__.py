@@ -12,6 +12,7 @@ from tpu_sgd.models.classification import (
     LogisticRegressionModel,
     LogisticRegressionWithLBFGS,
     LogisticRegressionWithSGD,
+    MultinomialLogisticRegressionModel,
     SVMModel,
     SVMWithSGD,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "LogisticRegressionModel",
     "LogisticRegressionWithSGD",
     "LogisticRegressionWithLBFGS",
+    "MultinomialLogisticRegressionModel",
     "SVMModel",
     "SVMWithSGD",
     "StreamingLinearAlgorithm",

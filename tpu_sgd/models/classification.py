@@ -149,12 +149,54 @@ class SVMWithSGD(_BinaryClassifierWithSGD):
     _model_cls = SVMModel
 
 
+class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
+    """K-class logistic model over a flat ``(K-1)*D`` weight vector with
+    pivot class 0 (reference parity: ``LogisticRegressionModel`` with
+    ``numClasses > 2``, [U] mllib/classification/LogisticRegression.scala).
+    The intercept per class lives as the last per-class weight when trained
+    with ``intercept=True`` (bias column convention)."""
+
+    def __init__(self, weights, intercept: float = 0.0, num_classes: int = 2,
+                 num_features: int = None, has_intercept_column: bool = False):
+        super().__init__(weights, intercept)
+        self.num_classes = int(num_classes)
+        if num_features is None:
+            num_features = self.weights.shape[-1] // (self.num_classes - 1)
+        self.num_features = int(num_features)
+        #: True when trained with a folded-in bias column; recorded
+        #: explicitly so predict never guesses from input width.
+        self.has_intercept_column = bool(has_intercept_column)
+
+    def predict(self, X):
+        import jax.numpy as jnp
+
+        from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+
+        X = jnp.asarray(X)
+        single = X.ndim == 1
+        Xb = jnp.atleast_2d(X)
+        expect = self.num_features - (1 if self.has_intercept_column else 0)
+        if Xb.shape[-1] != expect:
+            raise ValueError(
+                f"expected {expect}-feature input, got {Xb.shape[-1]}"
+            )
+        if self.has_intercept_column:
+            Xb = jnp.concatenate(
+                [Xb, jnp.ones((Xb.shape[0], 1), Xb.dtype)], axis=-1
+            )
+        g = MultinomialLogisticGradient(self.num_classes)
+        out = g.predict_class(Xb, self.weights)
+        return out[0] if single else out
+
+
 class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
-    """Binary logistic regression via L-BFGS.
+    """Logistic regression via L-BFGS, binary or multinomial.
 
     Reference parity: [U] mllib/classification/LogisticRegression.scala's
     ``LogisticRegressionWithLBFGS`` — same user API as the SGD variant, with
-    the L-BFGS optimizer (SURVEY.md §2 #18) behind the same boundary.
+    the L-BFGS optimizer (SURVEY.md §2 #18) behind the same boundary and
+    ``set_num_classes(K)`` switching to the multinomial gradient (pivot
+    class 0, ``(K-1)*D`` weights), as the reference's does.
     """
 
     def __init__(
@@ -167,6 +209,7 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
         super().__init__()
         from tpu_sgd.optimize.lbfgs import LBFGS
 
+        self.num_classes = 2
         self.optimizer = LBFGS(
             LogisticGradient(),
             SquaredL2Updater(),
@@ -176,20 +219,85 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
             reg_param=reg_param,
         )
 
+    def set_num_classes(self, k: int):
+        from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+
+        if k < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = int(k)
+        if k == 2:
+            self.optimizer.set_gradient(LogisticGradient())
+        else:
+            self.optimizer.set_gradient(MultinomialLogisticGradient(k))
+        return self
+
     def validators(self, X, y):
-        bad = np.logical_and(y != 0.0, y != 1.0)
+        yv = np.asarray(y)
+        bad = (yv < 0) | (yv >= self.num_classes) | (yv != np.floor(yv))
         if bad.any():
             raise ValueError(
-                "Classification labels should be 0 or 1; found "
-                f"{np.unique(np.asarray(y)[bad])[:5]}"
+                f"Classification labels should be integers in [0, "
+                f"{self.num_classes}); found {np.unique(yv[bad])[:5]}"
             )
 
+    def _weight_dim(self) -> int:
+        if self.num_classes == 2:
+            return self.num_features
+        return (self.num_classes - 1) * self.num_features
+
+    def run(self, data, initial_weights=None, initial_intercept: float = 0.0):
+        if self.num_classes > 2 and self.add_intercept:
+            # The bias-column trick gives each class its own intercept as the
+            # last per-class weight; the harness's scalar split doesn't apply.
+            X, y = data if isinstance(data, tuple) else (None, None)
+            if X is None:
+                from tpu_sgd.models.labeled_point import to_arrays
+
+                X, y = to_arrays(data)
+            from tpu_sgd.utils.mlutils import append_bias
+
+            X = np.asarray(X)
+            if X.shape[0] == 0:
+                raise ValueError("empty input")
+            d = X.shape[1]
+            X = append_bias(X)
+            self.num_features = X.shape[1]
+            K = self.num_classes
+            if initial_weights is None:
+                w0 = np.zeros(((K - 1), d), np.float32)
+            else:
+                # User convention: (K-1)*D weights (no bias slots), same as
+                # the non-intercept path; bias slots are added here.
+                w0 = np.asarray(initial_weights, np.float32)
+                if w0.size != (K - 1) * d:
+                    raise ValueError(
+                        f"initial_weights has size {w0.size} but expected "
+                        f"{(K - 1) * d} ((num_classes-1) * num_features)"
+                    )
+                w0 = w0.reshape(K - 1, d)
+            bias0 = np.full((K - 1, 1), float(initial_intercept), np.float32)
+            w0 = np.concatenate([w0, bias0], axis=1).reshape(-1)
+            if self.validate_data:
+                self.validators(X, y)
+            weights = self.optimizer.optimize((X, np.asarray(y)), w0)
+            return MultinomialLogisticRegressionModel(
+                weights, 0.0, self.num_classes, X.shape[1],
+                has_intercept_column=True,
+            )
+        return super().run(data, initial_weights, initial_intercept)
+
     def create_model(self, weights, intercept):
+        if self.num_classes > 2:
+            return MultinomialLogisticRegressionModel(
+                weights, intercept, self.num_classes, self.num_features
+            )
         return LogisticRegressionModel(weights, intercept)
 
     @classmethod
     def train(cls, data, max_num_iterations: int = 100, reg_param: float = 0.0,
-              initial_weights=None, intercept: bool = False):
+              initial_weights=None, intercept: bool = False,
+              num_classes: int = 2):
         alg = cls(max_num_iterations=max_num_iterations, reg_param=reg_param)
         alg.set_intercept(intercept)
+        alg.set_num_classes(num_classes)
         return alg.run(data, initial_weights)

@@ -44,6 +44,20 @@ from tpu_sgd.optimize.optimizer import Dataset, Optimizer
 Array = jax.Array
 
 
+def _raise_if_nonfinite(losses) -> None:
+    """Shared numerics check (``set_check_numerics``), one message for all
+    optimizer paths."""
+    import numpy as np
+
+    arr = np.asarray(losses)
+    bad = np.nonzero(~np.isfinite(arr))[0]
+    if bad.size:
+        raise FloatingPointError(
+            f"non-finite loss at iteration {int(bad[0]) + 1} "
+            f"(loss={arr[bad[0]]}); reduce step_size or check the data"
+        )
+
+
 def _make_mask(cfg: SGDConfig, key, i, n_local, valid, axis_name):
     """Per-iteration Bernoulli mini-batch mask (None = take everything)."""
     if cfg.mini_batch_fraction < 1.0:
@@ -217,6 +231,7 @@ class GradientDescent(Optimizer):
         self.mesh = None
         self.listener = None
         self.host_streaming = False
+        self.check_numerics = False
         self.checkpoint_manager = None
         self.checkpoint_every = 10
         self._loss_history = None
@@ -281,6 +296,14 @@ class GradientDescent(Optimizer):
         self.listener = listener
         return self
 
+    def set_check_numerics(self, flag: bool = True):
+        """Raise ``FloatingPointError`` when the loss goes non-finite
+        (diverging step size, bad data) — the JAX-side analogue of the
+        reference's JVM sanitizer story (SURVEY.md §5.2: functional purity
+        plus explicit NaN checks; no TSAN equivalent is needed)."""
+        self.check_numerics = bool(flag)
+        return self
+
     def set_host_streaming(self, flag: bool = True):
         """Keep the dataset in host RAM and stream per-iteration sampled
         batches to the device with double-buffered prefetch — for datasets
@@ -331,6 +354,8 @@ class GradientDescent(Optimizer):
                 checkpoint_every=self.checkpoint_every,
             )
             self._loss_history = hist
+            if self.check_numerics:
+                _raise_if_nonfinite(hist)
             return w, hist
         X = jnp.asarray(X)
         y = jnp.asarray(y)
@@ -386,6 +411,8 @@ class GradientDescent(Optimizer):
             w, losses, n_rec = self._runner(with_valid=False)(w0, X, y)
         n_rec = int(n_rec)
         self._loss_history = np.asarray(losses)[:n_rec]
+        if self.check_numerics:
+            _raise_if_nonfinite(self._loss_history)
         return w, self._loss_history
 
     def _optimize_stepwise(self, X, y, w0):
@@ -462,6 +489,8 @@ class GradientDescent(Optimizer):
             c = int(c)
             if c > 0:
                 loss_f = float(loss_i)
+                if self.check_numerics and not np.isfinite(loss_f):
+                    _raise_if_nonfinite([loss_f])
                 losses.append(loss_f)
                 delta = float(jnp.linalg.norm(new_w - w))
                 reg_val = float(new_reg)

@@ -1,5 +1,10 @@
 from tpu_sgd.parallel.mesh import DATA_AXIS, MODEL_AXIS, data_mesh, make_mesh
 from tpu_sgd.parallel.data_parallel import dp_optimize, shard_dataset
+from tpu_sgd.parallel.distributed import (
+    global_data_mesh,
+    global_mesh_2d,
+    initialize_distributed,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -8,4 +13,7 @@ __all__ = [
     "make_mesh",
     "dp_optimize",
     "shard_dataset",
+    "initialize_distributed",
+    "global_data_mesh",
+    "global_mesh_2d",
 ]

@@ -1,0 +1,86 @@
+"""Multi-host distributed runtime helpers.
+
+Reference parity: SURVEY.md §5.8 — the reference's communication backend is
+Netty RPC + TorrentBroadcast + shuffle-based treeAggregate across executor
+JVMs.  The TPU-native backend is the JAX distributed runtime: within a slice
+``lax.psum`` compiles to hardware ICI all-reduce; across hosts/slices the
+SAME ``psum`` over a multi-host mesh rides DCN after
+``jax.distributed.initialize`` — no code change in the optimizer, only a
+bigger mesh.  These helpers wrap that bring-up so a cluster launch is:
+
+    initialize_distributed(coordinator, num_processes, process_id)
+    mesh = global_data_mesh()
+    LinearRegressionWithSGD.train((X_local, y_local), mesh=mesh)
+
+Single-process usage needs none of this (jax.devices() already sees the
+local chips).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from tpu_sgd.parallel.mesh import data_mesh, make_mesh
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Bring up the JAX distributed runtime (idempotent).
+
+    On TPU pods the arguments are auto-detected from the environment; on
+    other platforms pass them explicitly.  The DCN transport underneath is
+    the functional replacement for the reference's Netty RPC fabric.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:  # double-init is fine (idempotent contract)
+        msg = str(e).lower()
+        if "already initialized" not in msg and "only be called once" not in msg:
+            raise
+
+
+def global_data_mesh():
+    """1-D data mesh over every device in the job (all hosts).
+
+    ``jax.devices()`` is global after ``initialize_distributed``; collectives
+    over this mesh use ICI within each slice and DCN across slices.
+    """
+    return data_mesh(devices=jax.devices())
+
+
+def global_mesh_2d(n_model: int = 1):
+    """(data, model) mesh over every device in the job.
+
+    Raises when ``n_model`` does not divide the device count — silently
+    idling remainder devices would hide lost parallelism.
+    """
+    devs = jax.devices()
+    if len(devs) % n_model:
+        raise ValueError(
+            f"n_model={n_model} does not divide the {len(devs)}-device job; "
+            "choose a divisor or idle devices explicitly via make_mesh"
+        )
+    return make_mesh(n_data=len(devs) // n_model, n_model=n_model, devices=devs)
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,10 +1,12 @@
 from tpu_sgd.utils.mlutils import (
     append_bias,
+    k_fold,
     linear_data,
     load_libsvm_file,
     logistic_data,
     save_as_libsvm_file,
     svm_data,
+    train_test_split,
 )
 from tpu_sgd.utils.persistence import load_glm_model, save_glm_model
 from tpu_sgd.utils.checkpoint import CheckpointManager
@@ -19,6 +21,8 @@ from tpu_sgd.utils.events import (
 )
 
 __all__ = [
+    "k_fold",
+    "train_test_split",
     "CheckpointManager",
     "SGDListener",
     "CollectingListener",

@@ -104,6 +104,32 @@ def save_as_libsvm_file(path: str, X: np.ndarray, y: np.ndarray) -> None:
             f.write(f"{y[i]:.6g} {feats}\n")
 
 
+def k_fold(X: np.ndarray, y: np.ndarray, num_folds: int, seed: int = 42):
+    """Yield ``(train, validation)`` splits (parity with ``MLUtils.kFold``):
+    a seeded shuffle partitioned into ``num_folds`` disjoint validation
+    folds, each paired with the complement as training data."""
+    n = np.asarray(X).shape[0]
+    if num_folds < 2:
+        raise ValueError("num_folds must be >= 2")
+    perm = np.random.default_rng(seed).permutation(n)
+    folds = np.array_split(perm, num_folds)
+    for i in range(num_folds):
+        val_idx = folds[i]
+        train_idx = np.concatenate([folds[j] for j in range(num_folds) if j != i])
+        yield (X[train_idx], y[train_idx]), (X[val_idx], y[val_idx])
+
+
+def train_test_split(
+    X: np.ndarray, y: np.ndarray, test_fraction: float = 0.2, seed: int = 42
+):
+    """Seeded shuffle split (the common analogue of ``RDD.randomSplit``)."""
+    n = np.asarray(X).shape[0]
+    perm = np.random.default_rng(seed).permutation(n)
+    n_test = int(round(test_fraction * n))
+    te, tr = perm[:n_test], perm[n_test:]
+    return (X[tr], y[tr]), (X[te], y[te])
+
+
 # ---------------------------------------------------------------------------
 # Synthetic data generators (reference: mllib/util/*DataGenerator.scala)
 # ---------------------------------------------------------------------------
