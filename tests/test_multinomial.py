@@ -56,6 +56,20 @@ def test_multinomial_label_validation():
         LogisticRegressionWithLBFGS.train((X, y), num_classes=3)
 
 
+def test_multinomial_save_load_roundtrip(tmp_path):
+    K, d = 3, 5
+    X, y, _ = _multiclass_data(800, d, K, seed=4)
+    model = LogisticRegressionWithLBFGS.train((X, y), num_classes=K,
+                                              intercept=True)
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = MultinomialLogisticRegressionModel.load(path)
+    assert loaded.num_classes == K
+    assert loaded.has_intercept_column
+    np.testing.assert_array_equal(np.asarray(loaded.predict(X)),
+                                  np.asarray(model.predict(X)))
+
+
 def test_single_vector_predict():
     K, d = 3, 4
     X, y, _ = _multiclass_data(500, d, K, seed=3)

@@ -91,6 +91,28 @@ def test_pallas_gradient_weight_dim_delegates():
     assert PallasGradient(LeastSquaresGradient()).weight_dim(7) == 7
 
 
+def test_pallas_gradient_under_dp_mesh():
+    """The fused kernel composes with shard_map data parallelism."""
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.parallel.mesh import data_mesh
+    from tpu_sgd.utils.mlutils import linear_data
+
+    X, y, w_true = linear_data(1024, 16, eps=0.01, seed=5)
+    w = (
+        GradientDescent(
+            PallasGradient(LeastSquaresGradient(), tile_m=64, interpret=True),
+            SimpleUpdater(),
+        )
+        .set_step_size(0.5)
+        .set_num_iterations(60)
+        .set_convergence_tol(0.0)
+        .set_mesh(data_mesh())
+        .optimize((X, y), np.zeros(16, np.float32))
+    )
+    np.testing.assert_allclose(np.asarray(w), w_true, atol=0.05)
+
+
 def test_fused_bf16_inputs():
     import jax.numpy as jnp
 

@@ -1,0 +1,107 @@
+"""Property-based tests (hypothesis), per SURVEY.md §4's test mapping:
+closed-form updater identities and single-vs-sharded parity over random
+inputs.  Shapes are FIXED (only values vary) so jitted functions compile
+once per test."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tpu_sgd.ops.gradients import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+)
+from tpu_sgd.ops.updaters import L1Updater, SimpleUpdater, SquaredL2Updater
+
+D = 8
+finite_vec = st.lists(
+    st.floats(-10, 10, allow_nan=False, width=32), min_size=D, max_size=D
+).map(lambda v: np.asarray(v, np.float32))
+
+
+@settings(max_examples=30, deadline=None)
+@given(w=finite_vec, g=finite_vec,
+       step=st.floats(0.01, 5.0), t=st.integers(1, 1000),
+       reg=st.floats(0.0, 2.0))
+def test_l1_prox_closed_form_property(w, g, step, t, reg):
+    eta = step / np.sqrt(t)
+    raw = w - eta * g
+    expect = np.sign(raw) * np.maximum(np.abs(raw) - reg * eta, 0.0)
+    got, reg_val = L1Updater().compute(w, g, step, t, reg)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        float(reg_val), reg * np.abs(expect).sum(), rtol=1e-4, atol=1e-5
+    )
+
+
+@settings(max_examples=30, deadline=None)
+@given(w=finite_vec, g=finite_vec, step=st.floats(0.01, 5.0),
+       t=st.integers(1, 1000), reg=st.floats(0.0, 2.0))
+def test_l2_shrinkage_property(w, g, step, t, reg):
+    eta = step / np.sqrt(t)
+    expect = w * (1 - eta * reg) - eta * g
+    got, reg_val = SquaredL2Updater().compute(w, g, step, t, reg)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        float(reg_val), 0.5 * reg * (expect**2).sum(), rtol=1e-3, atol=1e-4
+    )
+
+
+@settings(max_examples=25, deadline=None)
+@given(margins=finite_vec, labels=st.lists(st.integers(0, 1), min_size=D,
+                                           max_size=D))
+def test_logistic_pointwise_is_derivative(margins, labels):
+    """coeff must equal d(loss)/d(margin) — finite-difference check."""
+    y = np.asarray(labels, np.float32)
+    g = LogisticGradient()
+    eps = 1e-3
+    coeff, _ = g.pointwise(margins, y)
+    _, lp = g.pointwise(margins + eps, y)
+    _, lm = g.pointwise(margins - eps, y)
+    fd = (np.asarray(lp) - np.asarray(lm)) / (2 * eps)
+    np.testing.assert_allclose(np.asarray(coeff), fd, rtol=5e-2, atol=5e-3)
+
+
+@settings(max_examples=20, deadline=None)
+@given(seed=st.integers(0, 10_000))
+def test_sharded_equals_single_device_property(seed):
+    """psum re-association: 8-shard full-batch grad == single-device grad."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_sgd.parallel.mesh import data_mesh, shard_map_fn
+
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(64, D)).astype(np.float32)
+    y = r.normal(size=(64,)).astype(np.float32)
+    w = r.normal(size=(D,)).astype(np.float32)
+    g = LeastSquaresGradient()
+    gs_ref, ls_ref, c_ref = g.batch_sums(X, y, w)
+    mesh = data_mesh()
+
+    def body(w, X, y):
+        import jax.lax as lax
+
+        gs, ls, c = g.batch_sums(X, y, w)
+        return lax.psum((gs, ls, c), "data")
+
+    fn = shard_map_fn(mesh, body, (P(), P("data", None), P("data")),
+                      (P(), P(), P()))
+    gs, ls, c = fn(w, X, y)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), rtol=2e-3,
+                               atol=2e-2)
+    np.testing.assert_allclose(float(ls), float(ls_ref), rtol=2e-3, atol=1e-2)
+    assert float(c) == float(c_ref)
+
+
+@settings(max_examples=25, deadline=None)
+@given(margins=finite_vec, labels=st.lists(st.integers(0, 1), min_size=D,
+                                           max_size=D))
+def test_hinge_nonnegative_loss_property(margins, labels):
+    y = np.asarray(labels, np.float32)
+    coeff, loss = HingeGradient().pointwise(margins, y)
+    assert np.all(np.asarray(loss) >= 0)
+    # inactive examples (slack <= 0) have zero loss AND zero coefficient
+    inactive = np.asarray(loss) == 0
+    np.testing.assert_array_equal(np.asarray(coeff)[inactive], 0.0)

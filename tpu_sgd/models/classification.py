@@ -189,6 +189,10 @@ class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
         return out[0] if single else out
 
 
+MultinomialLogisticRegressionModel.save = _save
+MultinomialLogisticRegressionModel.load = classmethod(_load)
+
+
 class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
     """Logistic regression via L-BFGS, binary or multinomial.
 

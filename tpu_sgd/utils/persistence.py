@@ -26,10 +26,15 @@ def save_glm_model(path: str, model) -> None:
     meta = {
         "class": type(model).__name__,
         "version": FORMAT_VERSION,
-        "numFeatures": int(weights.shape[-1]),
+        "numFeatures": int(getattr(model, "num_features", weights.shape[-1])),
         "intercept": float(model.intercept),
         "threshold": getattr(model, "threshold", None),
     }
+    if hasattr(model, "num_classes"):
+        meta["numClasses"] = int(model.num_classes)
+        meta["hasInterceptColumn"] = bool(
+            getattr(model, "has_intercept_column", False)
+        )
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f)
     np.savez(os.path.join(path, "data.npz"), weights=weights)
@@ -48,7 +53,19 @@ def load_glm_model(path: str, cls, strict_class: bool = True):
             f"model at {path} is a {meta['class']}, expected {cls.__name__}"
         )
     data = np.load(os.path.join(path, "data.npz"))
-    model = cls(data["weights"], meta["intercept"])
+    import inspect
+
+    accepts_classes = "num_classes" in inspect.signature(cls.__init__).parameters
+    if "numClasses" in meta and accepts_classes:
+        model = cls(
+            data["weights"],
+            meta["intercept"],
+            num_classes=meta["numClasses"],
+            num_features=meta["numFeatures"],
+            has_intercept_column=meta.get("hasInterceptColumn", False),
+        )
+    else:
+        model = cls(data["weights"], meta["intercept"])
     thr: Optional[float] = meta.get("threshold")
     if hasattr(model, "threshold"):
         model.threshold = thr
