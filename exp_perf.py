@@ -1,0 +1,143 @@
+"""Scratch experiment: find the fastest per-iteration step on the real TPU.
+
+Variants:
+  A) indexed gather (current bench path)
+  B) contiguous dynamic_slice batch
+  C) Pallas fused kernel on the sliced batch (2-D everywhere, wide matmuls)
+"""
+import functools, time, sys
+import jax, jax.numpy as jnp
+import numpy as np
+
+ROWS, D, FRAC, ITERS = 3_000_000, 1000, 0.1, 20
+M = int(ROWS * FRAC)
+
+key = jax.random.PRNGKey(0)
+kx, kw, kn = jax.random.split(key, 3)
+
+@jax.jit
+def gen():
+    X = jax.random.normal(kx, (ROWS, D), jnp.bfloat16)
+    w_true = jax.random.uniform(kw, (D,), jnp.float32, -1.0, 1.0)
+    y = X.astype(jnp.float32) @ w_true + 0.1 * jax.random.normal(kn, (ROWS,), jnp.float32)
+    return X, y
+
+X, y = jax.block_until_ready(gen())
+w0 = jnp.zeros((D,), jnp.float32)
+print("data ready", file=sys.stderr)
+
+
+def ls_sums(Xb, yb, w):
+    margins = Xb.astype(jnp.float32) @ w
+    r = margins - yb
+    g = r.astype(Xb.dtype) @ Xb
+    return g.astype(jnp.float32), 0.5 * jnp.sum(r * r)
+
+
+def step_indexed(w, X, y, i):
+    k = jax.random.fold_in(jax.random.PRNGKey(42), i)
+    idx = jax.random.randint(k, (M,), 0, X.shape[0])
+    Xb, yb = X[idx], y[idx]
+    g, l = ls_sums(Xb, yb, w)
+    return w - 0.5 / jnp.sqrt(i.astype(jnp.float32)) * g / M, l / M
+
+
+def step_sliced(w, X, y, i):
+    k = jax.random.fold_in(jax.random.PRNGKey(42), i)
+    start = jax.random.randint(k, (), 0, X.shape[0] - M)
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, M, 0)
+    yb = jax.lax.dynamic_slice_in_dim(y, start, M, 0)
+    g, l = ls_sums(Xb, yb, w)
+    return w - 0.5 / jnp.sqrt(i.astype(jnp.float32)) * g / M, l / M
+
+
+# ---- Pallas fused kernel, 2-D shapes, wide matmuls ----
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 2048
+PADL = 128  # lane width
+
+
+def _kernel(x_ref, y_ref, w_ref, acc_ref):
+    i = pl.program_id(0)
+    Xt = x_ref[:]                       # (TILE, D) bf16
+    W = w_ref[:]                        # (D, PADL) f32, col0 = w
+    margins = jnp.dot(Xt, W.astype(Xt.dtype), preferred_element_type=jnp.float32)[:, 0:1]  # (TILE,1)
+    yv = y_ref[:]                       # (TILE,1) f32
+    r = margins - yv                    # residual  (TILE,1)
+    # C columns: [coeff, loss_contrib] padded to 8 lanes -> one matmul gives
+    # grad row and loss row: contract over rows (dim 0 of both).
+    C = jnp.concatenate([r, 0.5 * r * r] + [jnp.zeros_like(r)] * 6, axis=1)  # (TILE,8)
+    G = jax.lax.dot_general(
+        C.astype(Xt.dtype), Xt, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (8, D)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = G
+
+    @pl.when(i > 0)
+    def _():
+        acc_ref[:] = acc_ref[:] + G
+
+
+def pallas_sums(Xb, yb, w):
+    n, d = Xb.shape
+    n_tiles = n // TILE
+    Wp = jnp.zeros((d, PADL), jnp.float32).at[:, 0].set(w)
+    # append a ones-column to X so row 1 of G gives sum of loss contribs? No:
+    # loss needs C[:,1] . ones = sum -> use Xt itself? Simpler: loss from G is
+    # C[:,1] contracted with X columns -> not a plain sum.  Keep loss via a
+    # second tiny output: acc[1, :] = sum_j r^2/2 * X[:, j] is wrong.
+    # Instead compute loss outside from margins? For the experiment just
+    # return grad; loss via cheap extra pass on margins is negligible.
+    acc = pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((TILE, d), lambda i: (i, 0)),
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d, PADL), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, d), jnp.float32),
+    )(Xb, yb.reshape(-1, 1), Wp)
+    return acc[0], acc[1]  # grad, (unused)
+
+
+def step_pallas(w, X, y, i):
+    k = jax.random.fold_in(jax.random.PRNGKey(42), i)
+    start = jax.random.randint(k, (), 0, X.shape[0] - M)
+    start = (start // TILE) * TILE
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, M // TILE * TILE, 0)
+    yb = jax.lax.dynamic_slice_in_dim(y, start, M // TILE * TILE, 0)
+    g, _ = pallas_sums(Xb, yb, w)
+    m = M // TILE * TILE
+    return w - 0.5 / jnp.sqrt(i.astype(jnp.float32)) * g / m, jnp.float32(0)
+
+
+def run(name, step):
+    f = jax.jit(step)
+    try:
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(w0, X, y, jnp.asarray(1, jnp.int32)))
+        print(f"{name}: compile {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+        w = w0
+        t0 = time.perf_counter()
+        for i in range(1, ITERS + 1):
+            w, l = f(w, X, y, jnp.asarray(i, jnp.int32))
+        jax.block_until_ready(w)
+        dt = (time.perf_counter() - t0) / ITERS
+        gbps = ROWS * FRAC * D * 2 * 2 / dt / 1e9  # X read twice (bf16)
+        print(f"{name}: {dt*1e3:.2f} ms/iter  (~{gbps:.0f} GB/s effective)", file=sys.stderr)
+        return dt
+    except Exception as e:
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:300]}", file=sys.stderr)
+        return None
+
+
+run("indexed", step_indexed)
+run("sliced", step_sliced)
+run("pallas+sliced", step_pallas)

@@ -126,3 +126,74 @@ def test_fused_bf16_inputs():
     assert gs.dtype == jnp.float32  # f32 accumulation
     np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), rtol=0.05,
                                atol=0.5)
+
+
+def test_window_sums_matches_manual_slice():
+    """Zero-copy offset kernel == batch_sums on the same materialized rows."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.ops.pallas_kernels import fused_window_sums
+
+    g = LeastSquaresGradient()
+    X, y, w = _data(n=512, d=24, seed=7)
+    start_tile, num_tiles, tile = 2, 3, 64
+    gs, ls, c = fused_window_sums(
+        g.pointwise, X, y, w, jnp.asarray(start_tile), num_tiles,
+        tile_m=tile, interpret=True,
+    )
+    lo, hi = start_tile * tile, (start_tile + num_tiles) * tile
+    gs_ref, ls_ref, c_ref = g.batch_sums(X[lo:hi], y[lo:hi], w)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), rtol=2e-4,
+                               atol=2e-3)
+    np.testing.assert_allclose(float(ls), float(ls_ref), rtol=2e-4)
+    assert float(c) == float(c_ref) == num_tiles * tile
+
+
+def test_pallas_window_sums_drop_in():
+    """PallasGradient.window_sums clamps the start and matches the base
+    gradient's dynamic-slice path on tile-aligned starts."""
+    import jax.numpy as jnp
+
+    base = LeastSquaresGradient()
+    g = PallasGradient(base, tile_m=64, interpret=True)
+    X, y, w = _data(n=640, d=16, seed=8)
+    m = 128  # two tiles
+    for start in (0, 64, 576):  # 576 clamps to 512 so the window fits
+        gs, ls, c = g.window_sums(X, y, w, jnp.asarray(start), m)
+        eff = min(start, 640 - m)
+        gs_ref, ls_ref, c_ref = base.batch_sums(
+            X[eff:eff + m], y[eff:eff + m], w
+        )
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref),
+                                   rtol=2e-4, atol=2e-3)
+        assert float(c) == m
+
+
+def test_pallas_window_sums_fallback_unaligned():
+    """Non-tile-multiple datasets fall back to the base dynamic-slice path."""
+    import jax.numpy as jnp
+
+    base = LeastSquaresGradient()
+    g = PallasGradient(base, tile_m=64, interpret=True)
+    X, y, w = _data(n=333, d=16, seed=9)
+    gs, ls, c = g.window_sums(X, y, w, jnp.asarray(10), 100)
+    gs_ref, ls_ref, c_ref = base.batch_sums(X[10:110], y[10:110], w)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), rtol=2e-4,
+                               atol=2e-3)
+
+
+def test_pallas_window_sums_subtile_remainder():
+    """m not a tile multiple: kernel bulk + base-path remainder == exactly m
+    rows, matching the pure dynamic-slice path."""
+    import jax.numpy as jnp
+
+    base = LeastSquaresGradient()
+    g = PallasGradient(base, tile_m=64, interpret=True)
+    X, y, w = _data(n=640, d=16, seed=10)
+    m = 150  # 2 tiles + 22-row remainder
+    gs, ls, c = g.window_sums(X, y, w, jnp.asarray(128), m)
+    gs_ref, ls_ref, c_ref = base.batch_sums(X[128:128 + m], y[128:128 + m], w)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), rtol=2e-4,
+                               atol=2e-3)
+    np.testing.assert_allclose(float(ls), float(ls_ref), rtol=2e-4)
+    assert float(c) == m

@@ -35,11 +35,17 @@ class SGDConfig:
       sampling: mini-batch sampling strategy when ``mini_batch_fraction < 1``.
         ``"bernoulli"`` (default) is exact reference parity — a per-example
         Bernoulli mask, normalized by the realized count; it computes the
-        full-dataset matvec with masked coefficients.  ``"indexed"`` is the
+        full-dataset matvec with masked coefficients.  ``"indexed"`` is a
         TPU fast path: gather a fixed-size batch of ``round(frac * n)`` rows
         sampled with replacement, touching only ``frac`` of HBM per
         iteration — distributionally equivalent for SGD, ~1/frac less
-        memory traffic.
+        memory traffic.  ``"sliced"`` is the HBM-optimal fast path: a
+        contiguous row window of ``round(frac * n)`` rows at a per-iteration
+        random offset — sequential DMA instead of a random gather (several
+        times faster again), and zero-copy under ``PallasGradient``.  Sliced
+        sampling is statistically sound when row order carries no signal
+        (shuffled or i.i.d.-generated datasets); shuffle once beforehand if
+        your rows are ordered.
     """
 
     step_size: float = 1.0
@@ -51,9 +57,10 @@ class SGDConfig:
     sampling: str = "bernoulli"
 
     def __post_init__(self):
-        if self.sampling not in ("bernoulli", "indexed"):
+        if self.sampling not in ("bernoulli", "indexed", "sliced"):
             raise ValueError(
-                f"sampling must be 'bernoulli' or 'indexed', got {self.sampling!r}"
+                "sampling must be 'bernoulli', 'indexed' or 'sliced', "
+                f"got {self.sampling!r}"
             )
 
     def replace(self, **kwargs) -> "SGDConfig":

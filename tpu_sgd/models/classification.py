@@ -125,6 +125,7 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
         intercept: bool = False,
         updater=None,
         mesh=None,
+        sampling: str = None,
     ):
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -132,6 +133,8 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
             alg.optimizer.set_updater(updater)
         if mesh is not None:
             alg.optimizer.set_mesh(mesh)
+        if sampling is not None:
+            alg.optimizer.set_sampling(sampling)
         return alg.run(data, initial_weights)
 
 

@@ -78,12 +78,20 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         initial_weights=None,
         intercept: bool = False,
         mesh=None,
+        sampling: str = None,
     ):
-        """Static train() parity with the reference's object methods."""
+        """Static train() parity with the reference's object methods.
+
+        ``mesh`` and ``sampling`` are the TPU-side extensions: a device mesh
+        for data parallelism and the mini-batch sampling strategy
+        (see ``SGDConfig.sampling``).
+        """
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
         if mesh is not None:
             alg.optimizer.set_mesh(mesh)
+        if sampling is not None:
+            alg.optimizer.set_sampling(sampling)
         return alg.run(data, initial_weights)
 
 

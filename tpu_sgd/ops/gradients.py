@@ -93,6 +93,40 @@ class Gradient:
         loss_sum = jnp.sum(losses)
         return grad_sum, loss_sum, count
 
+    def window_sums(
+        self,
+        X: Array,
+        y: Array,
+        weights: Array,
+        start: Array,
+        m: int,
+        valid: Optional[Array] = None,
+        margin_axis_name: Optional[str] = None,
+    ) -> Tuple[Array, Array, Array]:
+        """Sums over the contiguous row window ``[start, start + m)`` — the
+        ``sampling="sliced"`` mini-batch (SURVEY.md §7 hard parts: the HBM-
+        traffic-optimal sampler).  ``start`` is a traced scalar; the default
+        implementation slices and reuses :meth:`batch_sums`.  PallasGradient
+        overrides this with a zero-copy offset kernel.
+        """
+        Xb, yb, mask = _slice_window(X, y, valid, start, m)
+        return self.batch_sums(
+            Xb, yb, weights, mask, margin_axis_name=margin_axis_name
+        )
+
+
+def _slice_window(X, y, valid, start, m):
+    """Shared dynamic-slice of a length-``m`` row window (clamped in-bounds,
+    matching ``lax.dynamic_slice`` semantics)."""
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, m, 0)
+    yb = jax.lax.dynamic_slice_in_dim(y, start, m, 0)
+    mask = (
+        None
+        if valid is None
+        else jax.lax.dynamic_slice_in_dim(valid, start, m, 0)
+    )
+    return Xb, yb, mask
+
 
 class LeastSquaresGradient(Gradient):
     """Squared loss for linear regression: ``L = (x.w - y)^2 / 2``."""
@@ -182,6 +216,10 @@ class MultinomialLogisticGradient:
             count = jnp.asarray(X.shape[0], margins.dtype)
         grad_sum = (coeff.T @ X).reshape(-1)  # flattened (K-1)*D
         return grad_sum, jnp.sum(losses), count
+
+    # Same window contract as the vector-weight gradients (duck-typed: only
+    # pointwise/batch_sums differ between the classes).
+    window_sums = Gradient.window_sums
 
     def predict_class(self, X: Array, weights: Array) -> Array:
         K = self.num_classes

@@ -1,4 +1,4 @@
-"""Pallas fused gradient kernel: the framework's hand-written TPU hot path.
+"""Pallas fused gradient kernels: the framework's hand-written TPU hot path.
 
 Reference parity: SURVEY.md §2 native-component ledger — the reference's one
 native component is JNI BLAS under the per-example gradient loop; the
@@ -6,19 +6,36 @@ TPU-native equivalent is this Mosaic-compiled kernel computing the whole
 mini-batch gradient in one pass over VMEM-resident row tiles:
 
     per row tile (grid step, sequential on TPU):
-        margins = X_tile @ w            # MXU matvec
-        coeff, losses = pointwise(...)  # VPU elementwise, masked
-        grad  += coeff^T @ X_tile       # MXU, accumulated in f32
-        loss  += sum(losses)            # SMEM scalar accumulator
+        margins = X_tile @ W           # MXU, W = w padded to a lane block
+        coeff, losses = pointwise(...) # VPU elementwise, masked
+        grad  += C^T @ X_tile          # MXU, C = coeff padded to 8 lanes
+        loss  += sum(losses)           # SMEM scalar accumulator
         count += sum(mask)
 
 versus the XLA path which materializes margins/coeff in HBM between the two
 matvecs.  Fusing keeps each X tile in VMEM for both matmuls — one HBM read
 of X per iteration, the bandwidth floor.
 
+Mosaic-friendliness notes (learned on TPU v5e): every tensor in the kernel
+stays >= 2-D, and the two matmuls are kept MXU-shaped — the matvec becomes
+``(tile, d) @ (d, 128)`` against a lane-padded weight block, and the
+gradient outer product becomes a ``dot_general`` contracting the ROW axis of
+``(tile, 8) x (tile, d)``.  Degenerate M=1/N=1 matmuls lower to
+``vector.multi_reduction`` ops that Mosaic rejects ("Offset change").
+
+Two variants share the tile body:
+
+  * :func:`fused_gradient_sums` — full scan with a Bernoulli sampling mask
+    (reference parity with ``RDD.sample``).
+  * :func:`fused_window_sums` — a contiguous window of rows starting at a
+    *runtime* row offset, streamed straight out of the full HBM-resident
+    array via a scalar-prefetched block index (``PrefetchScalarGridSpec``).
+    Zero copy: the ``sampling="sliced"`` fast path never materializes the
+    mini-batch.
+
 Exposed as :class:`PallasGradient`, a drop-in wrapper satisfying the
-``Gradient.batch_sums`` contract so it slots behind the same optimizer
-boundary (falls back to the XLA path off-TPU or for feature-sharded runs).
+``Gradient`` contract so it slots behind the same optimizer boundary (falls
+back to the XLA path off-TPU or for feature-sharded runs).
 """
 
 from __future__ import annotations
@@ -33,32 +50,8 @@ from tpu_sgd.ops.gradients import Gradient
 
 Array = jax.Array
 
-
-def _fused_kernel(pointwise, x_ref, y_ref, m_ref, w_ref,
-                  grad_ref, loss_ref, cnt_ref):
-    i = pl.program_id(0)
-    X = x_ref[:]
-    margins = jnp.dot(X, w_ref[:], preferred_element_type=jnp.float32)[:, 0]
-    yv = y_ref[:][:, 0]
-    coeff, losses = pointwise(margins, yv)
-    m = m_ref[:][:, 0]
-    coeff = (coeff * m).astype(X.dtype)
-    losses = losses * m
-    g = jnp.dot(coeff[None, :], X, preferred_element_type=jnp.float32)
-    loss_t = jnp.sum(losses)
-    cnt_t = jnp.sum(m)
-
-    @pl.when(i == 0)
-    def _():
-        grad_ref[:] = g
-        loss_ref[0, 0] = loss_t
-        cnt_ref[0, 0] = cnt_t
-
-    @pl.when(i > 0)
-    def _():
-        grad_ref[:] = grad_ref[:] + g
-        loss_ref[0, 0] = loss_ref[0, 0] + loss_t
-        cnt_ref[0, 0] = cnt_ref[0, 0] + cnt_t
+LANES = 128  # TPU lane width: the weight vector is padded to one lane block
+SUBLANES = 8  # f32 sublane count: the coefficient block's lane dimension
 
 
 try:  # pallas is TPU/Mosaic-specific; keep the module importable anywhere
@@ -70,21 +63,92 @@ except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
 
+def _tile_contrib(pointwise, Xt, yv, mv, W):
+    """One row tile's ``(grad_block, loss_sum, count)``.
+
+    ``Xt (tile, d)``, ``yv``/``mv`` ``(tile, 1)``, ``W (d, LANES)`` with the
+    weight vector in column 0.  Matmul inputs use ``Xt``'s dtype (bf16 data
+    runs both MXU passes in bf16 with f32 accumulation); the returned grad
+    block is ``(SUBLANES, d)`` f32 with the gradient in row 0.
+    """
+    margins = jnp.dot(
+        Xt, W.astype(Xt.dtype), preferred_element_type=jnp.float32
+    )[:, 0:1]
+    coeff, losses = pointwise(margins, yv)
+    if mv is not None:
+        coeff = coeff * mv
+        losses = losses * mv
+        cnt = jnp.sum(mv)
+    else:
+        cnt = jnp.float32(Xt.shape[0])
+    C = jnp.concatenate(
+        [coeff] + [jnp.zeros_like(coeff)] * (SUBLANES - 1), axis=1
+    ).astype(Xt.dtype)
+    G = jax.lax.dot_general(
+        C, Xt, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return G, jnp.sum(losses), cnt
+
+
+def _accumulate(i, grad_ref, loss_ref, cnt_ref, G, lt, ct):
+    @pl.when(i == 0)
+    def _():
+        grad_ref[:] = G
+        loss_ref[0, 0] = lt
+        cnt_ref[0, 0] = ct
+
+    @pl.when(i > 0)
+    def _():
+        grad_ref[:] = grad_ref[:] + G
+        loss_ref[0, 0] = loss_ref[0, 0] + lt
+        cnt_ref[0, 0] = cnt_ref[0, 0] + ct
+
+
+def _masked_kernel(pointwise, x_ref, y_ref, m_ref, w_ref,
+                   grad_ref, loss_ref, cnt_ref):
+    i = pl.program_id(0)
+    G, lt, ct = _tile_contrib(pointwise, x_ref[:], y_ref[:], m_ref[:], w_ref[:])
+    _accumulate(i, grad_ref, loss_ref, cnt_ref, G, lt, ct)
+
+
+def _window_kernel(pointwise, s_ref, x_ref, y_ref, w_ref,
+                   grad_ref, loss_ref, cnt_ref):
+    del s_ref  # consumed by the BlockSpec index maps
+    i = pl.program_id(0)
+    G, lt, ct = _tile_contrib(pointwise, x_ref[:], y_ref[:], None, w_ref[:])
+    _accumulate(i, grad_ref, loss_ref, cnt_ref, G, lt, ct)
+
+
+def _require_pallas():
+    if not HAS_PALLAS:
+        raise ImportError(
+            "Pallas is unavailable in this jax installation; use the XLA "
+            "path (Gradient.batch_sums) instead"
+        )
+
+
+def _pad_w(w: Array) -> Array:
+    return jnp.zeros((w.shape[0], LANES), jnp.float32).at[:, 0].set(
+        w.astype(jnp.float32)
+    )
+
+
 def fused_gradient_sums(
     pointwise,
     X: Array,
     y: Array,
     w: Array,
     mask: Optional[Array] = None,
-    tile_m: int = 1024,
+    tile_m: int = 2048,
     interpret: bool = False,
 ) -> Tuple[Array, Array, Array]:
-    """Public entry point; clear error when Pallas is unavailable."""
-    if not HAS_PALLAS:
-        raise ImportError(
-            "Pallas is unavailable in this jax installation; use the XLA "
-            "path (Gradient.batch_sums) instead"
-        )
+    """Fused ``(grad_sum, loss_sum, count)`` over all row tiles of ``X``.
+
+    ``pointwise(margins, labels) -> (dloss/dmargin, loss)`` is any of the
+    Gradient plugins' elementwise rules (traced into the kernel).  Rows are
+    zero-padded to a tile multiple; padding is excluded via the mask.
+    """
+    _require_pallas()
     return _fused_gradient_sums(
         pointwise, X, y, w, mask, tile_m=tile_m, interpret=interpret
     )
@@ -99,15 +163,9 @@ def _fused_gradient_sums(
     y: Array,
     w: Array,
     mask: Optional[Array] = None,
-    tile_m: int = 1024,
+    tile_m: int = 2048,
     interpret: bool = False,
 ) -> Tuple[Array, Array, Array]:
-    """Fused ``(grad_sum, loss_sum, count)`` over row tiles of ``X``.
-
-    ``pointwise(margins, labels) -> (dloss/dmargin, loss)`` is any of the
-    Gradient plugins' elementwise rules (traced into the kernel).  Rows are
-    zero-padded to a tile multiple; padding is excluded via the mask.
-    """
     n, d = X.shape
     tile = min(tile_m, max(8, n))
     n_pad = (-n) % tile
@@ -123,21 +181,21 @@ def _fused_gradient_sums(
     n_tiles = (n + n_pad) // tile
 
     grad, loss, cnt = pl.pallas_call(
-        functools.partial(_fused_kernel, pointwise),
+        functools.partial(_masked_kernel, pointwise),
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((tile, d), lambda i: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, LANES), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((SUBLANES, d), lambda i: (0, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((SUBLANES, d), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
@@ -146,7 +204,85 @@ def _fused_gradient_sums(
         X,
         y.reshape(-1, 1).astype(jnp.float32),
         mf.reshape(-1, 1),
-        w.reshape(-1, 1).astype(jnp.float32),
+        _pad_w(w),
+    )
+    return grad[0], loss[0, 0], cnt[0, 0]
+
+
+def fused_window_sums(
+    pointwise,
+    X: Array,
+    y: Array,
+    w: Array,
+    start_tile: Array,
+    num_tiles: int,
+    tile_m: int = 2048,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Fused sums over ``num_tiles`` consecutive tiles starting at runtime
+    tile index ``start_tile`` — the zero-copy ``sampling="sliced"`` hot path.
+
+    The window is read straight from the full HBM-resident ``X`` through a
+    scalar-prefetched block offset; the mini-batch is never materialized.
+    ``X.shape[0]`` must be a multiple of ``tile_m`` and ``start_tile`` must
+    satisfy ``(start_tile + num_tiles) * tile_m <= X.shape[0]`` (callers
+    clamp).  Returns ``(grad_sum, loss_sum, count)`` with
+    ``count = num_tiles * tile_m``.
+    """
+    _require_pallas()
+    return _fused_window_sums(
+        pointwise, X, y, w, start_tile,
+        num_tiles=num_tiles, tile_m=tile_m, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pointwise", "num_tiles", "tile_m", "interpret")
+)
+def _fused_window_sums(
+    pointwise,
+    X: Array,
+    y: Array,
+    w: Array,
+    start_tile: Array,
+    num_tiles: int,
+    tile_m: int = 2048,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    n, d = X.shape
+    if n % tile_m:
+        raise ValueError(
+            f"fused_window_sums needs rows ({n}) to be a multiple of the "
+            f"tile size ({tile_m}); pad the dataset or use a smaller tile"
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_m, d), lambda i, s: (s[0] + i, 0)),
+            pl.BlockSpec((tile_m, 1), lambda i, s: (s[0] + i, 0)),
+            pl.BlockSpec((d, LANES), lambda i, s: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((SUBLANES, d), lambda i, s: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+    )
+    grad, loss, cnt = pl.pallas_call(
+        functools.partial(_window_kernel, pointwise),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((SUBLANES, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(start_tile, jnp.int32).reshape(1),
+        X,
+        y.reshape(-1, 1).astype(jnp.float32),
+        _pad_w(w),
     )
     return grad[0], loss[0, 0], cnt[0, 0]
 
@@ -156,12 +292,14 @@ class PallasGradient(Gradient):
 
     Drop-in for the optimizer boundary: ``PallasGradient(LeastSquaresGradient())``
     behaves identically (same pointwise rule, same contract) but computes
-    ``batch_sums`` in the fused kernel.  Off-TPU (or when the feature axis is
-    sharded) it falls back to the base XLA path; set ``interpret=True`` to
-    run the kernel in interpreter mode for CPU testing.
+    ``batch_sums`` in the fused kernel, and ``window_sums`` (the
+    ``sampling="sliced"`` path) in the zero-copy offset kernel.  Off-TPU (or
+    when the feature axis is sharded) it falls back to the base XLA path;
+    set ``interpret=True`` to run the kernels in interpreter mode for CPU
+    testing.
     """
 
-    def __init__(self, base: Gradient, tile_m: int = 1024,
+    def __init__(self, base: Gradient, tile_m: int = 2048,
                  interpret: Optional[bool] = None):
         self.base = base
         self.tile_m = tile_m
@@ -198,3 +336,37 @@ class PallasGradient(Gradient):
             interpret=bool(self.interpret),
         )
         return grad, loss, cnt
+
+    def window_sums(self, X, y, weights, start, m, valid=None,
+                    margin_axis_name=None):
+        n = X.shape[0]
+        usable = (
+            self._use_kernel()
+            and margin_axis_name is None
+            and valid is None
+            and m >= self.tile_m
+            and n % self.tile_m == 0
+        )
+        if not usable:
+            return self.base.window_sums(
+                X, y, weights, start, m, valid=valid,
+                margin_axis_name=margin_axis_name,
+            )
+        # Kernel covers the tile-aligned bulk; any sub-tile remainder is
+        # sliced through the base path so exactly m rows are processed (the
+        # "behaves identically" contract with Gradient.window_sums).
+        num_tiles = m // self.tile_m
+        rem = m - num_tiles * self.tile_m
+        start_tile = jnp.minimum(
+            jnp.asarray(start, jnp.int32) // self.tile_m,
+            (n - m) // self.tile_m,
+        )
+        g, l, c = fused_window_sums(
+            self.base.pointwise, X, y, weights, start_tile, num_tiles,
+            tile_m=self.tile_m, interpret=bool(self.interpret),
+        )
+        if rem:
+            tail = (start_tile + num_tiles) * self.tile_m
+            g2, l2, c2 = self.base.window_sums(X, y, weights, tail, rem)
+            g, l, c = g + g2, l + l2, c + c2
+        return g, l, c

@@ -96,9 +96,24 @@ def make_step(
     key = jax.random.PRNGKey(cfg.seed)
 
     indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
+    sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
 
     def step(weights, X, y, i, reg_val, valid=None):
-        if indexed:
+        if sliced:
+            # HBM-optimal path: a contiguous row window at a random offset —
+            # one sequential DMA (zero-copy under PallasGradient) instead of
+            # a random gather.  Assumes exchangeable row order (see
+            # SGDConfig.sampling docs).
+            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
+            k = jax.random.fold_in(key, i)
+            if axis_name is not None:
+                k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+            start = jax.random.randint(k, (), 0, max(1, X.shape[0] - m + 1))
+            g, l, c = gradient.window_sums(
+                X, y, weights, start, m, valid=valid,
+                margin_axis_name=model_axis_name,
+            )
+        elif indexed:
             # TPU fast path: gather a fixed-size batch (with replacement)
             # instead of masking the whole dataset — touches only ``frac``
             # of HBM per iteration.
@@ -112,9 +127,7 @@ def make_step(
         else:
             Xb, yb = X, y
             mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
-        if model_axis_name is None:
-            g, l, c = gradient.batch_sums(Xb, yb, weights, mask)
-        else:
+        if not sliced:
             g, l, c = gradient.batch_sums(
                 Xb, yb, weights, mask, margin_axis_name=model_axis_name
             )
@@ -277,7 +290,9 @@ class GradientDescent(Optimizer):
         return self
 
     def set_sampling(self, mode: str):
-        """'bernoulli' (reference parity) or 'indexed' (TPU fast path)."""
+        """'bernoulli' (reference parity), 'indexed' (gathered fast path) or
+        'sliced' (contiguous-window fast path — HBM-optimal; assumes
+        exchangeable row order, see ``SGDConfig.sampling``)."""
         self.config = self.config.replace(sampling=mode)
         return self
 
