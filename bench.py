@@ -213,11 +213,15 @@ def main():
     if cpu_losses and len(tpu_losses):
         target = cpu_losses[-1]
         # The stopping rule is symmetric: FIRST crossing on each side.
-        cpu_hit = next(i + 1 for i, l in enumerate(cpu_losses) if l <= target)
+        cpu_hit = next(
+            (i + 1 for i, l in enumerate(cpu_losses) if l <= target), None
+        )
         tpu_hit = next(
             (i + 1 for i, l in enumerate(tpu_losses) if l <= target), None
         )
-        if tpu_hit is not None:
+        if cpu_hit is None:  # NaN trajectory (diverged baseline)
+            log("matched-loss: cpu baseline loss is non-finite; n/a")
+        elif tpu_hit is not None:
             cpu_t = cpu_hit * cpu_iter_s
             tpu_t = tpu_hit * tpu_iter_s
             log(

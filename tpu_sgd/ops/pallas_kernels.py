@@ -291,12 +291,21 @@ class PallasGradient(Gradient):
     """Wrap any pointwise Gradient with the fused Pallas hot path.
 
     Drop-in for the optimizer boundary: ``PallasGradient(LeastSquaresGradient())``
-    behaves identically (same pointwise rule, same contract) but computes
+    computes the same sums (same pointwise rule, same contract) with
     ``batch_sums`` in the fused kernel, and ``window_sums`` (the
     ``sampling="sliced"`` path) in the zero-copy offset kernel.  Off-TPU (or
     when the feature axis is sharded) it falls back to the base XLA path;
     set ``interpret=True`` to run the kernels in interpreter mode for CPU
     testing.
+
+    Window-alignment caveat: on the kernel path ``window_sums`` floors
+    ``start`` to a ``tile_m`` boundary (and clamps so the window stays
+    in-bounds), so for non-tile-aligned starts it sums a *different,
+    equally-sized* row window than the base XLA implementation.  Under
+    ``sampling="sliced"`` the start is uniformly random and rows are
+    exchangeable, so the distribution of sampled windows is unchanged —
+    but bitwise reproducibility across the Pallas and XLA paths only holds
+    for tile-aligned starts.
     """
 
     def __init__(self, base: Gradient, tile_m: int = 2048,

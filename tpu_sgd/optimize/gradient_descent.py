@@ -98,16 +98,23 @@ def make_step(
     indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
     sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
 
+    def _iter_key(i):
+        """Per-iteration (and per-shard, like Spark's per-partition sampler)
+        sample key, deterministic in (seed, iteration, shard index)."""
+        k = jax.random.fold_in(key, i)
+        if axis_name is not None:
+            k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+        return k
+
     def step(weights, X, y, i, reg_val, valid=None):
+        if sliced or indexed:
+            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
+            k = _iter_key(i)
         if sliced:
             # HBM-optimal path: a contiguous row window at a random offset —
             # one sequential DMA (zero-copy under PallasGradient) instead of
             # a random gather.  Assumes exchangeable row order (see
             # SGDConfig.sampling docs).
-            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
-            k = jax.random.fold_in(key, i)
-            if axis_name is not None:
-                k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
             start = jax.random.randint(k, (), 0, max(1, X.shape[0] - m + 1))
             g, l, c = gradient.window_sums(
                 X, y, weights, start, m, valid=valid,
@@ -117,10 +124,6 @@ def make_step(
             # TPU fast path: gather a fixed-size batch (with replacement)
             # instead of masking the whole dataset — touches only ``frac``
             # of HBM per iteration.
-            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
-            k = jax.random.fold_in(key, i)
-            if axis_name is not None:
-                k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
             idx = jax.random.randint(k, (m,), 0, X.shape[0])
             Xb, yb = X[idx], y[idx]
             mask = None if valid is None else valid[idx]
