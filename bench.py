@@ -43,14 +43,43 @@ def log(*a):
 # TPU side
 # ---------------------------------------------------------------------------
 
+def _tpu_preflight(timeout_s: int = 180) -> bool:
+    """Probe the TPU backend from a THROWAWAY subprocess with a hard timeout.
+
+    The remote-TPU tunnel can wedge in a way that makes ``jax.devices()``
+    hang forever (not raise); probing in-process would hang the whole
+    benchmark.  A child process is killable, and the parent can then fall
+    back to CPU before its own jax backend initializes.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        log(f"TPU preflight hung >{timeout_s}s (tunnel wedged)")
+        return False
+
+
 def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
     """Returns (epochs/sec, platform, seconds/iter, loss history)."""
+    # An explicit CPU request never dials the tunnel (the probe would stall
+    # for its full timeout when the tunnel is wedged).
+    tpu_ok = os.environ.get("JAX_PLATFORMS") != "cpu" and _tpu_preflight()
     import jax
     import jax.numpy as jnp
 
     from tpu_sgd.utils.platform import honor_cpu_env
 
     honor_cpu_env()
+    if not tpu_ok:
+        log("TPU backend unavailable; falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
     try:
         devices = jax.devices()
     except Exception as e:  # tunnel down -> CPU fallback

@@ -1,0 +1,59 @@
+"""Normal-equations solver: exact OLS/ridge vs NumPy closed forms, mesh
+parity, and the GLM-harness composition (intercept, model class)."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import LinearRegressionModel, LinearRegressionWithNormal
+from tpu_sgd.optimize.normal import NormalEquations
+from tpu_sgd.parallel.mesh import data_mesh
+from tpu_sgd.utils.mlutils import linear_data
+
+
+def _ols(X, y, reg=0.0):
+    n, d = X.shape
+    A = X.T @ X / n + reg * np.eye(d)
+    return np.linalg.solve(A, X.T @ y / n)
+
+
+def test_exact_ols_matches_numpy():
+    X, y, _ = linear_data(2000, 12, eps=0.3, seed=0)
+    w = np.asarray(NormalEquations().optimize((X, y), np.zeros(12, np.float32)))
+    np.testing.assert_allclose(w, _ols(X, y), rtol=1e-3, atol=1e-4)
+
+
+def test_ridge_matches_numpy():
+    X, y, _ = linear_data(2000, 12, eps=0.3, seed=1)
+    reg = 0.37
+    opt = NormalEquations(reg)
+    w = np.asarray(opt.optimize((X, y), np.zeros(12, np.float32)))
+    np.testing.assert_allclose(w, _ols(X, y, reg), rtol=1e-3, atol=1e-4)
+    # loss history contract: one final-objective entry
+    assert opt.loss_history.shape == (1,)
+    resid = X @ w - y
+    expect = 0.5 * np.mean(resid**2) + 0.5 * reg * np.dot(w, w)
+    np.testing.assert_allclose(opt.loss_history[0], expect, rtol=1e-3)
+
+
+def test_mesh_parity_with_single_device():
+    X, y, _ = linear_data(4099, 10, eps=0.2, seed=2)  # odd n: ragged shards
+    w1 = np.asarray(NormalEquations().optimize((X, y), np.zeros(10, np.float32)))
+    opt = NormalEquations().set_mesh(data_mesh())
+    w8 = np.asarray(opt.optimize((X, y), np.zeros(10, np.float32)))
+    np.testing.assert_allclose(w8, w1, rtol=1e-4, atol=1e-5)
+
+
+def test_model_level_train_with_intercept():
+    X, y, w_true = linear_data(3000, 6, intercept=1.7, eps=0.05, seed=3)
+    model = LinearRegressionWithNormal.train((X, y), intercept=True)
+    assert isinstance(model, LinearRegressionModel)
+    assert abs(model.intercept - 1.7) < 0.05
+    np.testing.assert_allclose(np.asarray(model.weights), w_true, atol=0.05)
+    mse = float(np.mean((np.asarray(model.predict(X)) - y) ** 2))
+    assert mse < 0.01
+
+
+def test_wrong_weight_dim_raises():
+    X, y, _ = linear_data(100, 5, seed=4)
+    with pytest.raises(ValueError):
+        NormalEquations().optimize((X, y), np.zeros(3, np.float32))

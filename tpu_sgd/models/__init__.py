@@ -4,6 +4,7 @@ from tpu_sgd.models.regression import (
     LassoModel,
     LassoWithSGD,
     LinearRegressionModel,
+    LinearRegressionWithNormal,
     LinearRegressionWithSGD,
     RidgeRegressionModel,
     RidgeRegressionWithSGD,
@@ -28,6 +29,7 @@ __all__ = [
     "GeneralizedLinearAlgorithm",
     "GeneralizedLinearModel",
     "LinearRegressionModel",
+    "LinearRegressionWithNormal",
     "LinearRegressionWithSGD",
     "LassoModel",
     "LassoWithSGD",

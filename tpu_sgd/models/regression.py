@@ -113,3 +113,35 @@ class RidgeRegressionWithSGD(_RegressionWithSGD):
     _updater_cls = SquaredL2Updater
     _model_cls = RidgeRegressionModel
     _default_reg = 0.01
+
+
+class LinearRegressionWithNormal(GeneralizedLinearAlgorithm):
+    """Exact least squares via the one-pass normal-equations solver.
+
+    TPU-side extension beyond the reference's SGD-only mllib surface
+    (upstream Spark ships the equivalent as ``spark.ml``'s
+    WeightedLeastSquares "normal" solver): on TPU a single Gram-matrix pass
+    on the MXU is cheaper than iterating whenever ``d`` is modest.  Same
+    harness, intercept handling, and model class as the SGD family;
+    ``reg_param > 0`` gives exact ridge regression.
+    """
+
+    _model_cls = LinearRegressionModel
+
+    def __init__(self, reg_param: float = 0.0):
+        super().__init__()
+        from tpu_sgd.optimize.normal import NormalEquations
+
+        self.optimizer = NormalEquations(reg_param)
+
+    def create_model(self, weights, intercept):
+        return self._model_cls(weights, intercept)
+
+    @classmethod
+    def train(cls, data, reg_param: float = 0.0, intercept: bool = False,
+              mesh=None):
+        alg = cls(reg_param)
+        alg.set_intercept(intercept)
+        if mesh is not None:
+            alg.optimizer.set_mesh(mesh)
+        return alg.run(data)

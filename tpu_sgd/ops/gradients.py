@@ -35,6 +35,15 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def matmul_dtype(X: Array):
+    """The shared mixed-precision contract for every hot-path matmul: run in
+    the data's dtype with f32 accumulation (bf16 data keeps both MXU passes
+    bf16, halving HBM traffic; a plain ``X @ w`` would silently promote the
+    whole X read to f32), while int/bool features (one-hot paths that skip
+    the harness cast) compute in f32 so weights are never truncated."""
+    return X.dtype if jnp.issubdtype(X.dtype, jnp.inexact) else jnp.float32
+
+
 class Gradient:
     """Loss-specific plugin: the ``Gradient`` axis of the optimizer boundary.
 
@@ -78,13 +87,7 @@ class Gradient:
         pass the mesh axis to all-reduce those partials into full margins.
         The returned grad_sum is then the local feature block's gradient.
         """
-        # Mixed-precision contract: matmuls run in X's dtype with f32
-        # accumulation (bf16 data -> both MXU passes in bf16, halving HBM
-        # traffic; a plain ``X @ weights`` would silently promote the whole
-        # X read to f32).  f32 data is untouched; int/bool features (one-hot
-        # paths that skip the harness cast) compute in f32, never truncating
-        # weights to the integer dtype.
-        mm_dtype = X.dtype if jnp.issubdtype(X.dtype, jnp.inexact) else jnp.float32
+        mm_dtype = matmul_dtype(X)
         margins = jnp.dot(
             X.astype(mm_dtype), weights.astype(mm_dtype),
             preferred_element_type=jnp.float32,
@@ -208,7 +211,7 @@ class MultinomialLogisticGradient:
     ) -> Tuple[Array, Array, Array]:
         K = self.num_classes
         W = weights.reshape(K - 1, X.shape[-1])
-        mm_dtype = X.dtype if jnp.issubdtype(X.dtype, jnp.inexact) else jnp.float32
+        mm_dtype = matmul_dtype(X)
         margins = jnp.dot(  # (n, K-1); partial if features are sharded
             X.astype(mm_dtype), W.T.astype(mm_dtype),
             preferred_element_type=jnp.float32,

@@ -18,10 +18,14 @@ of X per iteration, the bandwidth floor.
 
 Mosaic-friendliness notes (learned on TPU v5e): every tensor in the kernel
 stays >= 2-D, and the two matmuls are kept MXU-shaped — the matvec becomes
-``(tile, d) @ (d, 128)`` against a lane-padded weight block, and the
-gradient outer product becomes a ``dot_general`` contracting the ROW axis of
-``(tile, 8) x (tile, d)``.  Degenerate M=1/N=1 matmuls lower to
-``vector.multi_reduction`` ops that Mosaic rejects ("Offset change").
+``(tile, d) @ (d, 8)`` against a sublane-padded weight block (column 0 holds
+``w``), the pointwise rule runs on the whole ``(tile, 8)`` margin block with
+the 7 garbage columns zeroed by an iota lane mask, and the gradient outer
+product is a ``dot_general`` contracting the ROW axis of
+``(tile, 8) x (tile, d)``.  No lane-axis concatenate or slice appears
+anywhere: degenerate M=1/N=1 matmuls and single-lane ops lower to
+``vector.multi_reduction`` / relayout ops that Mosaic either rejects
+("Offset change") or executes slowly.
 
 Two variants share the tile body:
 
@@ -50,8 +54,7 @@ from tpu_sgd.ops.gradients import Gradient
 
 Array = jax.Array
 
-LANES = 128  # TPU lane width: the weight vector is padded to one lane block
-SUBLANES = 8  # f32 sublane count: the coefficient block's lane dimension
+SUBLANES = 8  # f32 sublane count: the weight/coefficient blocks' lane dim
 
 
 try:  # pallas is TPU/Mosaic-specific; keep the module importable anywhere
@@ -66,26 +69,32 @@ except Exception:  # pragma: no cover
 def _tile_contrib(pointwise, Xt, yv, mv, W):
     """One row tile's ``(grad_block, loss_sum, count)``.
 
-    ``Xt (tile, d)``, ``yv``/``mv`` ``(tile, 1)``, ``W (d, LANES)`` with the
-    weight vector in column 0.  Matmul inputs use ``Xt``'s dtype (bf16 data
-    runs both MXU passes in bf16 with f32 accumulation); the returned grad
-    block is ``(SUBLANES, d)`` f32 with the gradient in row 0.
+    ``Xt (tile, d)``, ``yv``/``mv`` ``(tile, 1)``, ``W (d, SUBLANES)`` with
+    the weight vector in column 0.  Matmul inputs use ``Xt``'s dtype (bf16
+    data runs both MXU passes in bf16 with f32 accumulation); the returned
+    grad block is ``(SUBLANES, d)`` f32 with the gradient in row 0.
+
+    The pointwise rule is evaluated on the full ``(tile, SUBLANES)`` margin
+    block — columns 1.. see the garbage margins of the zero weight columns —
+    and an iota lane mask zeroes their coeff/loss before the second matmul,
+    so no single-lane slice or concatenate is ever materialized.
     """
     margins = jnp.dot(
         Xt, W.astype(Xt.dtype), preferred_element_type=jnp.float32
-    )[:, 0:1]
-    coeff, losses = pointwise(margins, yv)
-    if mv is not None:
-        coeff = coeff * mv
-        losses = losses * mv
-        cnt = jnp.sum(mv)
-    else:
-        cnt = jnp.float32(Xt.shape[0])
-    C = jnp.concatenate(
-        [coeff] + [jnp.zeros_like(coeff)] * (SUBLANES - 1), axis=1
-    ).astype(Xt.dtype)
+    )  # (tile, SUBLANES); only column 0 is real
+    coeff, losses = pointwise(margins, yv)  # yv broadcasts over columns
+    col0 = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, SUBLANES), 1) == 0
+    )
+    sel = col0 if mv is None else jnp.logical_and(col0, mv > 0)
+    coeff = jnp.where(sel, coeff, 0.0)
+    losses = jnp.where(sel, losses, 0.0)
+    cnt = jnp.float32(Xt.shape[0]) if mv is None else jnp.sum(mv)
     G = jax.lax.dot_general(
-        C, Xt, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        coeff.astype(Xt.dtype),
+        Xt,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     return G, jnp.sum(losses), cnt
 
@@ -128,7 +137,7 @@ def _require_pallas():
 
 
 def _pad_w(w: Array) -> Array:
-    return jnp.zeros((w.shape[0], LANES), jnp.float32).at[:, 0].set(
+    return jnp.zeros((w.shape[0], SUBLANES), jnp.float32).at[:, 0].set(
         w.astype(jnp.float32)
     )
 
@@ -187,7 +196,7 @@ def _fused_gradient_sums(
             pl.BlockSpec((tile, d), lambda i: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((d, LANES), lambda i: (0, 0)),
+            pl.BlockSpec((d, SUBLANES), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((SUBLANES, d), lambda i: (0, 0)),
@@ -261,7 +270,7 @@ def _fused_window_sums(
         in_specs=[
             pl.BlockSpec((tile_m, d), lambda i, s: (s[0] + i, 0)),
             pl.BlockSpec((tile_m, 1), lambda i, s: (s[0] + i, 0)),
-            pl.BlockSpec((d, LANES), lambda i, s: (0, 0)),
+            pl.BlockSpec((d, SUBLANES), lambda i, s: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((SUBLANES, d), lambda i, s: (0, 0)),

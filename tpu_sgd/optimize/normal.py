@@ -1,0 +1,176 @@
+"""Exact least-squares via normal equations — the one-pass TPU solver.
+
+Reference parity note: the reference solves config 1/4's least-squares
+problems iteratively through ``GradientDescent.runMiniBatchSGD`` ([U]
+mllib/optimization/GradientDescent.scala, SURVEY.md §2 #2) because on a
+Spark cluster each pass over the RDD costs a full job.  On TPU a *single*
+pass is one Gram-matrix matmul on the MXU, so the exact solution
+
+    (XᵀX / n + reg·I) w = Xᵀy / n
+
+is cheaper than a handful of SGD iterations whenever ``d`` is modest
+(d ≤ a few thousand: the Gram matmul reads X once and the (d, d) solve is
+microseconds).  Upstream Spark ships the same idea one package over as
+``spark.ml``'s WeightedLeastSquares "normal" solver; here it slots behind
+the SAME ``Optimizer`` boundary (SURVEY.md §2 #1) so the GLM harness,
+intercept handling, persistence, and streaming warm-starts all compose
+with it unchanged.
+
+Scaling: the Gram accumulation is data-parallel by construction — each
+shard computes its local ``(XᵀX, Xᵀy, yᵀy, n)`` and one ``lax.psum``
+combines them over ICI (the same collective pattern as the SGD path,
+SURVEY.md §5.8); the tiny (d, d) solve then runs replicated on every core.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.ops.gradients import matmul_dtype
+from tpu_sgd.optimize.optimizer import Dataset, Optimizer
+
+Array = jax.Array
+
+
+def _gram_sums(X: Array, y: Array) -> Tuple[Array, Array, Array, Array]:
+    """One pass: ``(XᵀX, Xᵀy, yᵀy, n)`` with f32 accumulation (bf16 data
+    runs the Gram matmul on the MXU in bf16)."""
+    mm_dtype = matmul_dtype(X)
+    Xc = X.astype(mm_dtype)
+    A = jnp.dot(Xc.T, Xc, preferred_element_type=jnp.float32)
+    b = jnp.dot(
+        Xc.T, y.astype(mm_dtype), preferred_element_type=jnp.float32
+    )
+    yty = jnp.dot(y, y, preferred_element_type=jnp.float32)
+    return A, b, yty, jnp.float32(X.shape[0])
+
+
+def _solve(A, b, yty, n, reg_param: float):
+    """Solve the regularized normal equations and return (w, loss).
+
+    Objective matched to the SGD path's SquaredL2Updater semantics:
+    ``(1/n)·Σ ½(x.w − y)² + (reg/2)·‖w‖²``.
+    """
+    d = A.shape[0]
+    An = A / n + reg_param * jnp.eye(d, dtype=A.dtype)
+    bn = b / n
+    # Cholesky: the regularized Gram is SPD for reg>0 and full-rank data;
+    # fall back happens naturally as NaNs which callers can check.
+    L = jax.lax.linalg.cholesky(An)
+    w = jax.lax.linalg.triangular_solve(
+        L,
+        jax.lax.linalg.triangular_solve(
+            L, bn[:, None], left_side=True, lower=True
+        ),
+        left_side=True,
+        lower=True,
+        transpose_a=True,
+    )[:, 0]
+    loss = (
+        0.5 * (jnp.dot(w, A @ w) - 2.0 * jnp.dot(w, b) + yty) / n
+        + 0.5 * reg_param * jnp.dot(w, w)
+    )
+    return w, loss
+
+
+class NormalEquations(Optimizer):
+    """Exact least-squares solver behind the Optimizer boundary.
+
+    Drop-in alternative to ``GradientDescent`` for the least-squares family
+    (LeastSquaresGradient × Simple/SquaredL2 updater); raises nothing for
+    other losses because it never sees them — model wrappers choose it
+    explicitly.  ``reg_param`` is the L2 coefficient (0 = plain OLS).
+
+    ``set_mesh`` shards the Gram accumulation row-wise over a 1-D data mesh
+    with a single ICI all-reduce; the solve is replicated.
+    """
+
+    def __init__(self, reg_param: float = 0.0):
+        self.reg_param = float(reg_param)
+        self.mesh = None
+        self._loss = None
+        self._cache = {}
+
+    def set_reg_param(self, r: float):
+        self.reg_param = float(r)
+        return self
+
+    def set_mesh(self, mesh):
+        self.mesh = mesh
+        return self
+
+    @property
+    def loss_history(self):
+        """Length-1 loss history (the final objective), matching the SGD
+        optimizers' return contract shape (SURVEY.md §5.5)."""
+        return self._loss
+
+    def _solver(self, with_valid: bool):
+        key = (self.reg_param, id(self.mesh), with_valid)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+        reg = self.reg_param
+        if self.mesh is None:
+
+            @jax.jit
+            def fn(X, y):
+                return _solve(*_gram_sums(X, y), reg)
+
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+            def local(X, y, valid=None):
+                if valid is not None:
+                    vf = valid.astype(jnp.float32)
+                    X = X * vf[:, None].astype(X.dtype)
+                    y = y * vf
+                    n_local = jnp.sum(vf)
+                else:
+                    n_local = jnp.float32(X.shape[0])
+                A, b, yty, _ = _gram_sums(X, y)
+                A, b, yty, n = jax.lax.psum(
+                    (A, b, yty, n_local), DATA_AXIS
+                )
+                return _solve(A, b, yty, n, reg)
+
+            if with_valid:
+                body = local
+                in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS))
+            else:
+                body = lambda X, y: local(X, y)
+                in_specs = (P(DATA_AXIS, None), P(DATA_AXIS))
+            fn = jax.jit(shard_map_fn(self.mesh, body, in_specs, (P(), P())))
+        self._cache[key] = fn
+        return fn
+
+    def optimize(self, data: Dataset, initial_weights: Array) -> Array:
+        X, y = data
+        X = jnp.asarray(X)
+        y = jnp.asarray(y)
+        if not jnp.issubdtype(y.dtype, jnp.inexact):
+            y = y.astype(jnp.float32)
+        w0 = jnp.asarray(initial_weights)
+        if w0.shape[-1] != X.shape[1]:
+            raise ValueError(
+                f"initial_weights has length {w0.shape[-1]} but the data has "
+                f"{X.shape[1]} features"
+            )
+        if self.mesh is None:
+            w, loss = self._solver(with_valid=False)(X, y)
+        else:
+            from tpu_sgd.parallel.data_parallel import shard_dataset
+
+            Xd, yd, valid = shard_dataset(self.mesh, X, y)
+            if valid is not None:
+                w, loss = self._solver(with_valid=True)(Xd, yd, valid)
+            else:
+                w, loss = self._solver(with_valid=False)(Xd, yd)
+        self._loss = np.asarray([float(loss)], np.float32)
+        return w
