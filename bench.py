@@ -69,8 +69,12 @@ def _tpu_preflight(timeout_s: int = 180) -> bool:
 def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
     """Returns (epochs/sec, platform, seconds/iter, loss history)."""
     # An explicit CPU request never dials the tunnel (the probe would stall
-    # for its full timeout when the tunnel is wedged).
-    tpu_ok = os.environ.get("JAX_PLATFORMS") != "cpu" and _tpu_preflight()
+    # for its full timeout when the tunnel is wedged).  Same normalization
+    # as honor_cpu_env.
+    cpu_requested = (
+        os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    )
+    tpu_ok = not cpu_requested and _tpu_preflight()
     import jax
     import jax.numpy as jnp
 
@@ -78,7 +82,8 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
 
     honor_cpu_env()
     if not tpu_ok:
-        log("TPU backend unavailable; falling back to CPU")
+        if not cpu_requested:
+            log("TPU backend unavailable; falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
     try:
         devices = jax.devices()

@@ -200,11 +200,35 @@ def test_host_streaming_checkpoint_resume(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_host_streaming_rejects_mesh():
+def test_host_streaming_dp_mesh_parity():
+    """Streamed batches sharded over the 8-way mesh match the single-device
+    streamed trajectory (same host-side sampler, psum'd combine)."""
     from tpu_sgd.parallel.mesh import data_mesh
 
+    X, y, _ = linear_data(3000, 6, eps=0.05, seed=10)
+    w0 = np.zeros(6, np.float32)
+
+    def make():
+        return (
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_step_size(0.4).set_num_iterations(40)
+            .set_mini_batch_fraction(0.2).set_convergence_tol(0.0)
+            .set_host_streaming()
+        )
+
+    w1, h1 = make().optimize_with_history((X, y), w0)
+    w8, h8 = make().set_mesh(data_mesh()).optimize_with_history((X, y), w0)
+    assert len(h8) == 40
+    np.testing.assert_allclose(np.asarray(w8), np.asarray(w1), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h8, h1, rtol=1e-4)
+
+
+def test_host_streaming_rejects_2d_mesh():
+    from tpu_sgd.parallel.mesh import make_mesh
+
     X, y, _ = linear_data(100, 3, seed=10)
-    opt = GradientDescent().set_host_streaming().set_mesh(data_mesh())
+    opt = GradientDescent().set_host_streaming().set_mesh(make_mesh(4, 2))
     with pytest.raises(NotImplementedError, match="host streaming"):
         opt.optimize((X, y), np.zeros(3, np.float32))
 

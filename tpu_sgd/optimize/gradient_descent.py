@@ -325,7 +325,9 @@ class GradientDescent(Optimizer):
     def set_host_streaming(self, flag: bool = True):
         """Keep the dataset in host RAM and stream per-iteration sampled
         batches to the device with double-buffered prefetch — for datasets
-        larger than HBM (SURVEY.md §7, config 4 at full 40 GB scale)."""
+        larger than HBM (SURVEY.md §7, config 4 at full 40 GB scale).
+        Composes with ``set_mesh`` on a 1-D data mesh: each batch is
+        row-sharded across cores and gradients all-reduce over ICI."""
         self.host_streaming = bool(flag)
         return self
 
@@ -356,10 +358,10 @@ class GradientDescent(Optimizer):
             # never lives on the device in full.
             from tpu_sgd.optimize.streamed import optimize_host_streamed
 
-            if self.mesh is not None:
+            if self.mesh is not None and self._mesh_kind() == "dp_mp":
                 raise NotImplementedError(
-                    "host streaming is single-device for now; detach the "
-                    "mesh or stream per host shard"
+                    "host streaming supports 1-D data meshes; feature-axis "
+                    "('model') sharding needs the resident path"
                 )
             Xh = np.asarray(X)
             if Xh.shape[0] == 0:
@@ -367,7 +369,7 @@ class GradientDescent(Optimizer):
                 return jnp.asarray(initial_weights), self._loss_history
             w, hist = optimize_host_streamed(
                 self.gradient, self.updater, self.config, Xh, np.asarray(y),
-                initial_weights, listener=self.listener,
+                initial_weights, mesh=self.mesh, listener=self.listener,
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every,
             )
@@ -562,24 +564,10 @@ class GradientDescent(Optimizer):
             if self.mesh is None:
                 fn = jax.jit(make_step(self.gradient, self.updater, self.config))
             else:
-                from jax.sharding import PartitionSpec as P
+                from tpu_sgd.parallel.data_parallel import dp_step_fn
 
-                from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
-
-                step = make_step(
-                    self.gradient, self.updater, self.config, axis_name=DATA_AXIS
-                )
-                if with_valid:
-                    body = lambda w, X, y, i, r, v: step(w, X, y, i, r, v)
-                    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
-                                P(DATA_AXIS))
-                else:
-                    body = lambda w, X, y, i, r: step(w, X, y, i, r, None)
-                    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P())
-                fn = jax.jit(
-                    shard_map_fn(self.mesh, body, in_specs,
-                                 (P(), P(), P(), P()))
-                )
+                fn = dp_step_fn(self.gradient, self.updater, self.config,
+                                self.mesh, with_valid)
             self._run_cache[key] = fn
         return fn
 

@@ -100,6 +100,14 @@ class NormalEquations(Optimizer):
         return self
 
     def set_mesh(self, mesh):
+        from tpu_sgd.parallel.mesh import MODEL_AXIS
+
+        if mesh is not None and dict(mesh.shape).get(MODEL_AXIS, 1) > 1:
+            raise ValueError(
+                "NormalEquations shards rows over a 1-D 'data' mesh; a "
+                "2-D (data, model) mesh would silently replicate X across "
+                "the model axis — use a data-only mesh"
+            )
         self.mesh = mesh
         return self
 

@@ -37,6 +37,7 @@ def optimize_host_streamed(
     y: np.ndarray,
     initial_weights,
     device=None,
+    mesh=None,
     listener=None,
     checkpoint_manager=None,
     checkpoint_every: int = 10,
@@ -47,6 +48,11 @@ def optimize_host_streamed(
     resident path: per-iteration Bernoulli sample of ``mini_batch_fraction``
     (host-side, seeded ``seed + i``), loss history including the previous
     iteration's reg value, convergence tolerance early exit.
+
+    ``mesh``: a 1-D data mesh combines the two scaling axes — each streamed
+    batch is ``device_put`` row-sharded across cores and the step runs under
+    ``shard_map`` with the ICI gradient all-reduce, so datasets beyond one
+    chip's HBM still use every core (SURVEY.md §7 phase 6).
     """
     import time as _time
 
@@ -60,13 +66,26 @@ def optimize_host_streamed(
         w = w.astype(jnp.float32)
     if n == 0:
         return w, np.zeros((0,), np.float32)
-    if device is None:
-        device = jax.devices()[0]
-    w = jax.device_put(w, device)
 
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
-    step = jax.jit(make_step(gradient, updater, step_cfg))
+    if mesh is None:
+        if device is None:
+            device = jax.devices()[0]
+        w_sharding = device
+        step = jax.jit(make_step(gradient, updater, step_cfg))
+        row_sharding = mask_sharding = device
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_sgd.parallel.data_parallel import dp_step_fn
+        from tpu_sgd.parallel.mesh import DATA_AXIS
+
+        step = dp_step_fn(gradient, updater, step_cfg, mesh, with_valid=True)
+        w_sharding = NamedSharding(mesh, P())
+        row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+        mask_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    w = jax.device_put(w, w_sharding)
 
     _, reg_val = updater.compute(
         w, jnp.zeros_like(w), 0.0, jnp.asarray(1, jnp.int32), cfg.reg_param
@@ -82,6 +101,9 @@ def optimize_host_streamed(
     else:
         sigma = np.sqrt(n * frac * (1.0 - frac))
         cap = int(min(n, np.ceil(n * frac + 6.0 * sigma + 8)))
+    if mesh is not None:
+        n_shards = mesh.shape[DATA_AXIS]
+        cap += (-cap) % n_shards  # even shards; padding rows are invalid
 
     def sample(i: int):
         """Bernoulli sample like RDD.sample(false, frac, seed + i), padded to
@@ -99,9 +121,9 @@ def optimize_host_streamed(
         pad = np.zeros((cap,), np.int64)
         pad[: idx.shape[0]] = idx
         return (
-            jax.device_put(X[pad], device),
-            jax.device_put(y[pad], device),
-            jax.device_put(valid, device),
+            jax.device_put(X[pad], row_sharding),
+            jax.device_put(y[pad], mask_sharding),
+            jax.device_put(valid, mask_sharding),
         )
 
     if listener is not None:
@@ -121,7 +143,7 @@ def optimize_host_streamed(
                     RuntimeWarning,
                     stacklevel=3,
                 )
-            w = jax.device_put(jnp.asarray(state["weights"]), device)
+            w = jax.device_put(jnp.asarray(state["weights"]), w_sharding)
             reg_val = state["reg_val"]
             losses = list(np.asarray(state["loss_history"], np.float32))
             start_iter = state["iteration"] + 1

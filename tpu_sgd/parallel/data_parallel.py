@@ -66,6 +66,31 @@ def shard_dataset(mesh: Mesh, X, y) -> Tuple[Array, Array, Optional[Array]]:
     return Xd, yd, vd
 
 
+def dp_step_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    mesh: Mesh,
+    with_valid: bool,
+):
+    """Build the jitted shard_map'ed SINGLE-step function — the shared
+    wiring for every observed/streamed mesh path (one source of truth for
+    the step's in/out specs)."""
+    from tpu_sgd.optimize.gradient_descent import make_step
+
+    step = make_step(gradient, updater, config, axis_name=DATA_AXIS)
+    if with_valid:
+        body = step
+        in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P(),
+                    P(DATA_AXIS))
+    else:
+        body = lambda w, X, y, i, r: step(w, X, y, i, r, None)
+        in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS), P(), P())
+    return jax.jit(
+        shard_map_fn(mesh, body, in_specs, (P(), P(), P(), P()))
+    )
+
+
 def dp_run_fn(
     gradient: Gradient,
     updater: Updater,
