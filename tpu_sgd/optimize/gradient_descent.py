@@ -572,11 +572,9 @@ class GradientDescent(Optimizer):
         return fn
 
     def _mesh_kind(self) -> str:
-        from tpu_sgd.parallel.mesh import MODEL_AXIS
+        from tpu_sgd.parallel.mesh import has_model_axis
 
-        if self.mesh is not None and dict(self.mesh.shape).get(MODEL_AXIS, 1) > 1:
-            return "dp_mp"
-        return "dp"
+        return "dp_mp" if has_model_axis(self.mesh) else "dp"
 
     def _runner(self, with_valid: bool):
         """Memoized jitted runner.

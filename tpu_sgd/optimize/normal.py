@@ -100,9 +100,9 @@ class NormalEquations(Optimizer):
         return self
 
     def set_mesh(self, mesh):
-        from tpu_sgd.parallel.mesh import MODEL_AXIS
+        from tpu_sgd.parallel.mesh import has_model_axis
 
-        if mesh is not None and dict(mesh.shape).get(MODEL_AXIS, 1) > 1:
+        if has_model_axis(mesh):
             raise ValueError(
                 "NormalEquations shards rows over a 1-D 'data' mesh; a "
                 "2-D (data, model) mesh would silently replicate X across "

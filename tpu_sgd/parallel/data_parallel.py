@@ -51,10 +51,18 @@ def shard_dataset(mesh: Mesh, X, y) -> Tuple[Array, Array, Optional[Array]]:
     Returns device arrays plus a ``valid`` mask (None when no padding was
     needed).  This is the one host->device transfer of the whole run — the
     analogue of the reference's initial ``RDD.cache()`` materialization.
+
+    Multi-host jobs (``jax.process_count() > 1`` after
+    ``initialize_distributed``): ``X``/``y`` are each process's LOCAL rows —
+    the analogue of each Spark executor reading its own input splits
+    (SURVEY.md §3.4) — and the global sharded arrays are assembled without
+    any cross-host data movement; only gradient psums ride DCN.
     """
-    n_shards = mesh.shape[DATA_AXIS]
     Xh = np.asarray(X)
     yh = np.asarray(y)
+    if jax.process_count() > 1:
+        return _shard_dataset_multihost(mesh, Xh, yh)
+    n_shards = mesh.shape[DATA_AXIS]
     n = Xh.shape[0]
     Xh, yh, validh = pad_to_multiple(Xh, yh, n_shards)
     row_sharding = NamedSharding(mesh, P(DATA_AXIS))
@@ -63,6 +71,43 @@ def shard_dataset(mesh: Mesh, X, y) -> Tuple[Array, Array, Optional[Array]]:
     if n == Xh.shape[0]:
         return Xd, yd, None
     vd = jax.device_put(validh, row_sharding)
+    return Xd, yd, vd
+
+
+def _shard_dataset_multihost(mesh: Mesh, Xh, yh):
+    """Assemble globally-sharded arrays from per-process local rows.
+
+    Each process contributes its rows via
+    ``make_array_from_process_local_data`` — no host ever holds (or sends)
+    another host's shard.  Per-process row counts may be uneven (the
+    analogue of Spark's arbitrary-size input splits): a process allgather
+    agrees on one common padded per-process length, so every process infers
+    the SAME global shape; padding rows are masked out via the always-on
+    ``valid`` mask.
+    """
+    from jax.experimental import multihost_utils
+
+    local_shards = dict(mesh.local_mesh.shape).get(DATA_AXIS, 1)
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray(Xh.shape[0]))
+    )
+    target = int(counts.max())
+    target += (-target) % local_shards
+    n = Xh.shape[0]
+    pad = target - n
+    valid = np.ones((target,), dtype=bool)
+    if pad:
+        Xh = np.concatenate(
+            [Xh, np.zeros((pad,) + Xh.shape[1:], Xh.dtype)], axis=0
+        )
+        yh = np.concatenate([yh, np.zeros((pad,), yh.dtype)], axis=0)
+        valid[n:] = False
+    row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    Xd = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS, None)), Xh
+    )
+    yd = jax.make_array_from_process_local_data(row_sharding, yh)
+    vd = jax.make_array_from_process_local_data(row_sharding, valid)
     return Xd, yd, vd
 
 

@@ -47,6 +47,13 @@ def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def has_model_axis(mesh) -> bool:
+    """True when the mesh shards the FEATURE axis (a 2-D (data, model)
+    mesh with a non-trivial 'model' dimension) — the one predicate every
+    mesh-kind routing decision shares."""
+    return mesh is not None and dict(mesh.shape).get(MODEL_AXIS, 1) > 1
+
+
 def shard_map_fn(mesh, fn, in_specs, out_specs, check_vma=False):
     """Version-tolerant shard_map wrapper (jax.shard_map vs experimental)."""
     try:
