@@ -76,3 +76,32 @@ def test_single_vector_predict():
     model = LogisticRegressionWithLBFGS.train((X, y), num_classes=K)
     single = model.predict(X[0])
     assert np.asarray(single).shape == ()
+
+
+def test_multinomial_sgd_dp_mesh_parity():
+    """Multinomial gradient under the 8-way data mesh matches single-device
+    (the matrix-weight pytree flattens through the same psum path)."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    K, d = 3, 6
+    X, y, _ = _multiclass_data(2000, d, K, seed=5)
+    g = MultinomialLogisticGradient(K)
+    w0 = np.zeros(((K - 1) * d,), np.float32)
+
+    def make():
+        return GradientDescent(
+            g, SimpleUpdater(),
+            SGDConfig(step_size=0.5, num_iterations=30,
+                      mini_batch_fraction=1.0, convergence_tol=0.0),
+        )
+
+    w1, h1 = make().optimize_with_history((X, y), w0)
+    opt8 = make().set_mesh(data_mesh())
+    w8, h8 = opt8.optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w8), np.asarray(w1), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(h8, h1, rtol=2e-4)

@@ -56,3 +56,40 @@ def test_native_large_random_roundtrip(native, tmp_path):
     X2, y2 = load_libsvm_file(str(p))
     np.testing.assert_allclose(X2, X, rtol=1e-4)
     np.testing.assert_array_equal(y2, y)
+
+
+@pytest.fixture(scope="module")
+def native_gather():
+    from tpu_sgd.utils.native import _SAMPLER_PATH, gather_rows
+
+    if not os.path.exists(_SAMPLER_PATH):
+        from tpu_sgd.utils.native.build import build
+
+        try:
+            build(verbose=False)
+        except Exception as e:  # pragma: no cover - toolchain missing
+            pytest.skip(f"cannot build native sampler: {e}")
+    return gather_rows
+
+
+def test_native_gather_rows_parity_and_bounds(native_gather):
+    """Multi-threaded native gather == X[idx]; bounds/contract checks."""
+    gather_rows = native_gather
+    r = np.random.default_rng(3)
+    for dtype in (np.float32, np.float64, np.uint16):  # uint16 ~ bf16 bytes
+        X = r.integers(0, 255, size=(500, 17)).astype(dtype)
+        idx = r.integers(0, 500, size=1000).astype(np.int64)
+        np.testing.assert_array_equal(gather_rows(X, idx), X[idx])
+    y = r.normal(size=(500,)).astype(np.float32)
+    idx = r.integers(0, 500, size=100).astype(np.int64)
+    np.testing.assert_array_equal(gather_rows(y, idx), y[idx])
+    # preallocated out round-trip
+    out = np.empty((100,), np.float32)
+    np.testing.assert_array_equal(gather_rows(y, idx, out=out), y[idx])
+    with pytest.raises(IndexError):
+        gather_rows(y, np.asarray([500], np.int64))
+    with pytest.raises(ValueError):  # undersized out
+        gather_rows(y, idx, out=np.empty((99,), np.float32))
+    with pytest.raises(ValueError):  # non-contiguous X
+        X = r.normal(size=(50, 8)).astype(np.float32)
+        gather_rows(X[:, ::2], np.zeros((1,), np.int64))

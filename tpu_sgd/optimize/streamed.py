@@ -105,6 +105,16 @@ def optimize_host_streamed(
         n_shards = mesh.shape[DATA_AXIS]
         cap += (-cap) % n_shards  # even shards; padding rows are invalid
 
+    _gather = lambda A, idx: A[idx]
+    if X.flags.c_contiguous:  # native gather requires contiguous rows
+        try:  # multi-threaded row gather; X[idx] fallback
+            from tpu_sgd.utils.native import gather_rows as _native_gather
+
+            _native_gather(X[:1], np.zeros((1,), np.int64))  # probe once
+            _gather = _native_gather
+        except Exception:
+            pass
+
     def sample(i: int):
         """Bernoulli sample like RDD.sample(false, frac, seed + i), padded to
         the fixed cap."""
@@ -121,7 +131,7 @@ def optimize_host_streamed(
         pad = np.zeros((cap,), np.int64)
         pad[: idx.shape[0]] = idx
         return (
-            jax.device_put(X[pad], row_sharding),
+            jax.device_put(_gather(X, pad), row_sharding),
             jax.device_put(y[pad], mask_sharding),
             jax.device_put(valid, mask_sharding),
         )

@@ -41,6 +41,74 @@ def _load():
     return _lib
 
 
+_SAMPLER_PATH = os.path.join(os.path.dirname(__file__), "batch_sampler.so")
+_sampler_lib = None
+
+
+def _load_sampler():
+    global _sampler_lib
+    if _sampler_lib is None:
+        if not os.path.exists(_SAMPLER_PATH):
+            raise ImportError(f"native sampler not built at {_SAMPLER_PATH}")
+        _sampler_lib = ctypes.CDLL(_SAMPLER_PATH)
+        _sampler_lib.gather_rows.restype = ctypes.c_int64
+        _sampler_lib.gather_rows.argtypes = [
+            ctypes.c_void_p,  # X
+            ctypes.c_int64,   # n_rows
+            ctypes.c_int64,   # row_bytes
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,   # m
+            ctypes.c_void_p,  # out
+            ctypes.c_int64,   # n_threads
+        ]
+    return _sampler_lib
+
+
+def gather_rows(
+    X: np.ndarray, idx: np.ndarray, out: np.ndarray = None,
+    n_threads: int = 8,
+) -> np.ndarray:
+    """Multi-threaded ``X[idx]`` for 1-D/2-D C-contiguous arrays.
+
+    The host-streamed training path's batch assembly (memcpy-bound; NumPy
+    fancy indexing is single-threaded).  ``out`` may be a preallocated
+    destination to avoid per-iteration allocation.  Raises ImportError when
+    the library is not built — callers fall back to ``X[idx]``.
+    """
+    lib = _load_sampler()
+    if not X.flags.c_contiguous:
+        # Copying the whole dataset per call would defeat the point on the
+        # >HBM streamed workload; the caller's X[idx] fallback is cheaper.
+        raise ValueError(
+            "gather_rows needs a C-contiguous X; use X[idx] or "
+            "np.ascontiguousarray(X) once at load time"
+        )
+    idx = np.ascontiguousarray(idx, np.int64)
+    row_shape = X.shape[1:]
+    row_bytes = int(np.prod(row_shape, dtype=np.int64)) * X.itemsize
+    out_shape = (idx.shape[0],) + row_shape
+    if out is None:
+        out = np.empty(out_shape, X.dtype)
+    elif (out.shape != out_shape or out.dtype != X.dtype
+          or not out.flags.c_contiguous):
+        raise ValueError(
+            f"out must be C-contiguous {out_shape} {X.dtype}, got "
+            f"{out.shape} {out.dtype} contiguous={out.flags.c_contiguous}"
+        )
+    rc = lib.gather_rows(
+        X.ctypes.data_as(ctypes.c_void_p),
+        X.shape[0],
+        row_bytes,
+        idx,
+        idx.shape[0],
+        out.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    if rc != 0:
+        raise IndexError("gather_rows: index out of range")
+    return out
+
+
 def parse_libsvm(path: str):
     """Parse a LIBSVM file natively -> (labels, rows, cols, vals, max_index)."""
     lib = _load()

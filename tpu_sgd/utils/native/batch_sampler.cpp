@@ -1,0 +1,70 @@
+// Native multi-threaded mini-batch row gather for tpu_sgd.
+//
+// The host-streamed training path (SURVEY.md §7 phase 6: datasets larger
+// than HBM) assembles each iteration's sampled batch on the host before the
+// device transfer.  The reference's analogue is the executor-side partition
+// iterator feeding the per-example loop (SURVEY.md §3.1); here batch
+// assembly is a pure row gather — memcpy-bound — and NumPy's fancy
+// indexing runs it on one core.  This library splits the gather across a
+// small thread pool so batch assembly keeps up with the device and the
+// double-buffered overlap in optimize_host_streamed stays compute-bound.
+//
+// Dtype-agnostic: rows are opaque byte ranges (row_bytes = d * itemsize),
+// so f32, bf16, f64 and label vectors all go through the same entry point.
+// Plain C ABI consumed via ctypes (no pybind11).
+//
+// Build: python -m tpu_sgd.utils.native.build  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void gather_range(const char* X, int64_t row_bytes, const int64_t* idx,
+                  int64_t begin, int64_t end, char* out) {
+  for (int64_t i = begin; i < end; ++i) {
+    std::memcpy(out + i * row_bytes, X + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather m rows (row_bytes each) of X at positions idx into out.
+// idx values must be in [0, n_rows).  Returns 0 on success, -1 on a
+// detected out-of-range index (checked up front; no partial writes of
+// invalid rows).
+int64_t gather_rows(const void* X, int64_t n_rows, int64_t row_bytes,
+                    const int64_t* idx, int64_t m, void* out,
+                    int64_t n_threads) {
+  if (row_bytes <= 0 || m < 0) return -1;
+  for (int64_t i = 0; i < m; ++i) {
+    if (idx[i] < 0 || idx[i] >= n_rows) return -1;
+  }
+  const char* src = static_cast<const char*>(X);
+  char* dst = static_cast<char*>(out);
+  if (n_threads <= 1 || m < 4096) {
+    gather_range(src, row_bytes, idx, 0, m, dst);
+    return 0;
+  }
+  int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 4;
+  int64_t t = n_threads < hw ? n_threads : hw;
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(t));
+  int64_t chunk = (m + t - 1) / t;
+  for (int64_t k = 0; k < t; ++k) {
+    int64_t b = k * chunk;
+    int64_t e = b + chunk < m ? b + chunk : m;
+    if (b >= e) break;
+    pool.emplace_back(gather_range, src, row_bytes, idx, b, e, dst);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+}  // extern "C"

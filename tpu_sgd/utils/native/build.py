@@ -1,4 +1,7 @@
-"""Build the native LIBSVM parser: ``python -m tpu_sgd.utils.native.build``."""
+"""Build the native libraries: ``python -m tpu_sgd.utils.native.build``.
+
+Targets: the LIBSVM parser and the multi-threaded batch-row gather.
+"""
 
 from __future__ import annotations
 
@@ -7,19 +10,27 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SRC = os.path.join(HERE, "libsvm_parser.cpp")
-OUT = os.path.join(HERE, "libsvm_parser.so")
+TARGETS = {
+    "libsvm_parser": [],
+    "batch_sampler": ["-pthread"],
+}
 
 
-def build(verbose: bool = True) -> str:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", SRC, "-o", OUT]
-    if verbose:
-        print(" ".join(cmd))
-    subprocess.run(cmd, check=True)
-    return OUT
+def build(verbose: bool = True) -> list:
+    outs = []
+    for name, extra in TARGETS.items():
+        src = os.path.join(HERE, f"{name}.cpp")
+        out = os.path.join(HERE, f"{name}.so")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *extra,
+               src, "-o", out]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True)
+        outs.append(out)
+    return outs
 
 
 if __name__ == "__main__":
-    path = build()
-    print(f"built {path}")
+    for path in build():
+        print(f"built {path}")
     sys.exit(0)
