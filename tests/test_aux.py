@@ -173,3 +173,16 @@ def test_step_timer():
     with t.time():
         pass
     assert len(t.times) == 1 and t.mean_s >= 0
+
+
+def test_profile_trace_writes_logdir(tmp_path):
+    """profile_trace captures an XLA trace directory around a jitted call."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.utils import profile_trace
+
+    logdir = str(tmp_path / "tb")
+    with profile_trace(logdir):
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    found = list((tmp_path / "tb").rglob("*"))
+    assert found, "no trace files written"
