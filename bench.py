@@ -176,6 +176,26 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
     eps = rows_per_sec / TARGET_ROWS
     log(f"best: {dt * 1e3 / TPU_ITERS:.2f} ms/iter, "
         f"{rows_per_sec / 1e6:.1f}M rows/s")
+
+    # Diagnostic only (accelerator only — the d^2 Gram pass is minutes on
+    # a starved CPU): the exact one-pass solver on the same slab (the
+    # spark.ml-normal-solver analogue) — what "solved, not iterated" costs.
+    try:
+        if not on_accel:
+            raise RuntimeError("skipped on cpu")
+        from tpu_sgd.optimize.normal import NormalEquations
+
+        ne = NormalEquations()
+        w0_ne = jnp.zeros((DIM,), jnp.float32)
+        t0 = time.perf_counter()
+        jax.block_until_ready(ne.optimize((X, y), w0_ne))
+        t_first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(ne.optimize((X, y), w0_ne))
+        log(f"normal-equations exact solve: {time.perf_counter() - t0:.3f}s "
+            f"for {rows} rows (compile+first run {t_first:.1f}s)")
+    except Exception as e:
+        log(f"normal-equations diagnostic skipped ({type(e).__name__}: {e})")
     return eps, platform, dt / TPU_ITERS, losses
 
 

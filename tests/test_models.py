@@ -149,3 +149,13 @@ def test_warm_start_initial_weights():
     e1 = np.linalg.norm(np.asarray(m1.weights) - w_true)
     e2 = np.linalg.norm(np.asarray(m2.weights) - w_true)
     assert e2 <= e1 + 1e-4
+
+
+def test_train_from_labeled_point_iterable():
+    """The reference's native input is RDD[LabeledPoint]; the analogue here
+    is any iterable of LabeledPoint records."""
+    X, y, w_true = linear_data(2000, 5, eps=0.05, seed=21)
+    points = [LabeledPoint(float(yi), xi) for xi, yi in zip(X, y)]
+    model = LinearRegressionWithSGD.train(points, num_iterations=150,
+                                          step_size=0.5)
+    np.testing.assert_allclose(np.asarray(model.weights), w_true, atol=0.1)

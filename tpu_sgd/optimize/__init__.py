@@ -6,11 +6,13 @@ from tpu_sgd.optimize.gradient_descent import (
     run_mini_batch_sgd,
 )
 from tpu_sgd.optimize.lbfgs import LBFGS
+from tpu_sgd.optimize.normal import NormalEquations
 
 __all__ = [
     "Optimizer",
     "GradientDescent",
     "LBFGS",
+    "NormalEquations",
     "make_run",
     "make_step",
     "run_mini_batch_sgd",
