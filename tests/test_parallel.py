@@ -178,3 +178,11 @@ def test_shard_dataset_places_rows(mesh):
     assert valid is None
     assert len(Xd.sharding.device_set) == 8
     np.testing.assert_allclose(np.asarray(Xd), X)
+
+
+def test_mesh_config_builds_mesh():
+    from tpu_sgd.config import MeshConfig
+    from tpu_sgd.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    m = MeshConfig(data=4, model=2).build()
+    assert dict(m.shape) == {DATA_AXIS: 4, MODEL_AXIS: 2}

@@ -79,6 +79,14 @@ class MeshConfig:
     data: int = 1
     model: int = 1
 
+    def build(self, devices=None):
+        """Materialize the ``jax.sharding.Mesh`` this config describes
+        (``devices`` defaults to all visible devices)."""
+        from tpu_sgd.parallel.mesh import make_mesh
+
+        return make_mesh(n_data=self.data, n_model=self.model,
+                         devices=devices)
+
     @property
     def n_devices(self) -> int:
         return self.data * self.model

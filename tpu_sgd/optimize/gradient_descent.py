@@ -30,7 +30,6 @@ stance):
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax

@@ -16,7 +16,7 @@ line-search control flow is host-side (it is data-dependent and tiny).
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List
 
 import jax
 import jax.numpy as jnp

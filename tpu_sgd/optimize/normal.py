@@ -24,7 +24,7 @@ SURVEY.md §5.8); the tiny (d, d) solve then runs replicated on every core.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,7 @@ size is preserved because the host sampler draws Bernoulli batches).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

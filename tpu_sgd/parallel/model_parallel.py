@@ -11,10 +11,7 @@ with its scalar reg value combined over ``model``.  Both all-reduces ride ICI.
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -13,7 +13,6 @@ restores the reference's any-iteration replay property without lineage
 from __future__ import annotations
 
 import glob
-import json
 import os
 from typing import Optional
 

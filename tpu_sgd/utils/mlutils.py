@@ -19,8 +19,6 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from tpu_sgd.models.labeled_point import LabeledPoint
-
 
 def append_bias(X: np.ndarray) -> np.ndarray:
     """Append a 1.0 bias column (parity with ``MLUtils.appendBias``)."""
