@@ -18,7 +18,7 @@ from tpu_sgd.models import __all__ as _models_all
 from tpu_sgd.ops import *  # noqa: F401,F403
 from tpu_sgd.ops import __all__ as _ops_all
 from tpu_sgd.optimize import (GradientDescent, LBFGS, NormalEquations,
-                              Optimizer, run_mini_batch_sgd)
+                              OWLQN, Optimizer, run_mini_batch_sgd)
 from tpu_sgd.parallel import data_mesh, make_mesh
 
 __version__ = "0.1.0"
@@ -27,7 +27,7 @@ __all__ = (
     ["SGDConfig", "MeshConfig", "Vectors", "DenseVector", "SparseVector", "BLAS"]
     + list(_models_all)
     + list(_ops_all)
-    + ["GradientDescent", "LBFGS", "NormalEquations", "Optimizer",
+    + ["GradientDescent", "LBFGS", "NormalEquations", "OWLQN", "Optimizer",
        "run_mini_batch_sgd",
        "data_mesh", "make_mesh"]
 )

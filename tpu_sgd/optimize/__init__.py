@@ -7,12 +7,14 @@ from tpu_sgd.optimize.gradient_descent import (
 )
 from tpu_sgd.optimize.lbfgs import LBFGS
 from tpu_sgd.optimize.normal import NormalEquations
+from tpu_sgd.optimize.owlqn import OWLQN
 
 __all__ = [
     "Optimizer",
     "GradientDescent",
     "LBFGS",
     "NormalEquations",
+    "OWLQN",
     "make_run",
     "make_step",
     "run_mini_batch_sgd",

@@ -51,6 +51,77 @@ def _reg_terms(updater: Updater, reg_param: float):
     return (lambda w: jnp.zeros((), w.dtype), lambda w: jnp.zeros_like(w))
 
 
+def _coerce_inputs(X, y, w):
+    """Shared (X, y, w) -> inexact jnp arrays coercion for the quasi-Newton
+    optimizers."""
+    X = jnp.asarray(X)
+    y = jnp.asarray(y)
+    if not jnp.issubdtype(X.dtype, jnp.inexact):
+        X = X.astype(jnp.float32)
+    if not jnp.issubdtype(y.dtype, jnp.inexact):
+        y = y.astype(jnp.float32)
+    w = jnp.asarray(w)
+    if not jnp.issubdtype(w.dtype, jnp.inexact):
+        w = w.astype(jnp.float32)
+    return X, y, w
+
+
+def _push_correction(s_stack, y_stack, rho, k, m, s, yv, sy):
+    """Append a curvature pair to the fixed-size history (shift when full);
+    shared by LBFGS and OWLQN.  Returns updated (s_stack, y_stack, rho, k)."""
+    if k < m:
+        return (
+            s_stack.at[k].set(s),
+            y_stack.at[k].set(yv),
+            rho.at[k].set(1.0 / sy),
+            k + 1,
+        )
+    return (
+        jnp.roll(s_stack, -1, axis=0).at[m - 1].set(s),
+        jnp.roll(y_stack, -1, axis=0).at[m - 1].set(yv),
+        jnp.roll(rho, -1).at[m - 1].set(1.0 / sy),
+        k,
+    )
+
+
+@jax.jit
+def _two_loop(g, s_stack, y_stack, rho, k):
+    """Standard L-BFGS two-loop recursion over a fixed-size history buffer
+    holding ``k`` valid corrections (rows [0, k)).  Module-level jit: one
+    compile per history/weight shape across every optimize() call (the
+    streaming mode re-enters per micro-batch)."""
+    m = s_stack.shape[0]
+
+    def bwd(carry, idx):
+        q, alphas = carry
+        valid = idx < k
+        alpha = jnp.where(valid, rho[idx] * jnp.dot(s_stack[idx], q), 0.0)
+        q = q - alpha * y_stack[idx]
+        return (q, alphas.at[idx].set(alpha)), None
+
+    (q, alphas), _ = jax.lax.scan(
+        bwd, (g, jnp.zeros((m,), g.dtype)), jnp.arange(m - 1, -1, -1)
+    )
+    # initial Hessian scaling gamma = s.y / y.y of newest correction
+    newest = jnp.maximum(k - 1, 0)
+    gamma = jnp.where(
+        k > 0,
+        jnp.dot(s_stack[newest], y_stack[newest])
+        / jnp.maximum(jnp.dot(y_stack[newest], y_stack[newest]), 1e-10),
+        1.0,
+    )
+    r = gamma * q
+
+    def fwd(r, idx):
+        valid = idx < k
+        beta = jnp.where(valid, rho[idx] * jnp.dot(y_stack[idx], r), 0.0)
+        r = r + (alphas[idx] - beta) * s_stack[idx]
+        return r, None
+
+    r, _ = jax.lax.scan(fwd, r, jnp.arange(m))
+    return r
+
+
 class LBFGS(Optimizer):
     """Limited-memory BFGS with backtracking Armijo line search."""
 
@@ -110,15 +181,7 @@ class LBFGS(Optimizer):
         import numpy as np
 
         X, y = data
-        X = jnp.asarray(X)
-        y = jnp.asarray(y)
-        if not jnp.issubdtype(X.dtype, jnp.inexact):
-            X = X.astype(jnp.float32)
-        if not jnp.issubdtype(y.dtype, jnp.inexact):
-            y = y.astype(jnp.float32)
-        w = jnp.asarray(initial_weights)
-        if not jnp.issubdtype(w.dtype, jnp.inexact):
-            w = w.astype(jnp.float32)
+        X, y, w = _coerce_inputs(X, y, initial_weights)
         n = X.shape[0]
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
@@ -146,41 +209,6 @@ class LBFGS(Optimizer):
             def cost_loss(w):
                 return cost(w)[0]
 
-        @jax.jit
-        def two_loop(g, s_stack, y_stack, rho, k):
-            """Standard L-BFGS two-loop recursion over a fixed-size history
-            buffer holding ``k`` valid corrections (rows [0, k))."""
-            m = s_stack.shape[0]
-
-            def bwd(carry, idx):
-                q, alphas = carry
-                valid = idx < k
-                alpha = jnp.where(valid, rho[idx] * jnp.dot(s_stack[idx], q), 0.0)
-                q = q - alpha * y_stack[idx]
-                return (q, alphas.at[idx].set(alpha)), None
-
-            (q, alphas), _ = jax.lax.scan(
-                bwd, (g, jnp.zeros((m,), g.dtype)), jnp.arange(m - 1, -1, -1)
-            )
-            # initial Hessian scaling gamma = s.y / y.y of newest correction
-            newest = jnp.maximum(k - 1, 0)
-            gamma = jnp.where(
-                k > 0,
-                jnp.dot(s_stack[newest], y_stack[newest])
-                / jnp.maximum(jnp.dot(y_stack[newest], y_stack[newest]), 1e-10),
-                1.0,
-            )
-            r = gamma * q
-
-            def fwd(r, idx):
-                valid = idx < k
-                beta = jnp.where(valid, rho[idx] * jnp.dot(y_stack[idx], r), 0.0)
-                r = r + (alphas[idx] - beta) * s_stack[idx]
-                return r, None
-
-            r, _ = jax.lax.scan(fwd, r, jnp.arange(m))
-            return r
-
         m = self.num_corrections
         d = w.shape[0]
         s_stack = jnp.zeros((m, d), w.dtype)
@@ -191,7 +219,7 @@ class LBFGS(Optimizer):
         f, g = cost(w)
         losses: List[float] = [float(f)]
         for _ in range(self.max_num_iterations):
-            direction = -two_loop(g, s_stack, y_stack, rho, jnp.asarray(k))
+            direction = -_two_loop(g, s_stack, y_stack, rho, jnp.asarray(k))
             # backtracking Armijo line search (host control flow, tiny)
             g_dot_d = float(jnp.dot(g, direction))
             if g_dot_d >= 0:  # not a descent direction: reset to -g
@@ -214,15 +242,9 @@ class LBFGS(Optimizer):
             yv = g_new - g
             sy = float(jnp.dot(s, yv))
             if sy > 1e-10:  # curvature condition: keep correction
-                if k < m:
-                    s_stack = s_stack.at[k].set(s)
-                    y_stack = y_stack.at[k].set(yv)
-                    rho = rho.at[k].set(1.0 / sy)
-                    k += 1
-                else:  # shift history window
-                    s_stack = jnp.roll(s_stack, -1, axis=0).at[m - 1].set(s)
-                    y_stack = jnp.roll(y_stack, -1, axis=0).at[m - 1].set(yv)
-                    rho = jnp.roll(rho, -1).at[m - 1].set(1.0 / sy)
+                s_stack, y_stack, rho, k = _push_correction(
+                    s_stack, y_stack, rho, k, m, s, yv, sy
+                )
             w, f, g = w_new, f_new, g_new
             losses.append(float(f))
             rel = abs(losses[-2] - losses[-1]) / max(
@@ -230,8 +252,6 @@ class LBFGS(Optimizer):
             )
             if rel < self.convergence_tol:
                 break
-
-        import numpy as np
 
         self._loss_history = np.asarray(losses, np.float32)
         return w, self._loss_history
