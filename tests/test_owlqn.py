@@ -78,3 +78,19 @@ def test_empty_input():
         np.zeros(4, np.float32),
     )
     assert h.shape == (0,)
+
+
+def test_lasso_with_owlqn_model():
+    from tpu_sgd.models import LassoModel, LassoWithOWLQN
+
+    rng = np.random.default_rng(7)
+    w_true = np.zeros(15, np.float32)
+    w_true[:3] = 2.0
+    X = rng.normal(size=(3000, 15)).astype(np.float32)
+    y = (X @ w_true + 1.0 + 0.05 * rng.normal(size=3000)).astype(np.float32)
+    model = LassoWithOWLQN.train((X, y), reg_param=0.02, intercept=True)
+    assert isinstance(model, LassoModel)
+    assert abs(model.intercept - 1.0) < 0.2
+    w = np.asarray(model.weights)
+    assert np.sum(w[3:] == 0.0) >= 8
+    np.testing.assert_allclose(w[:3], 2.0, atol=0.2)

@@ -2,6 +2,7 @@ from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
 from tpu_sgd.models.glm import GeneralizedLinearAlgorithm, GeneralizedLinearModel
 from tpu_sgd.models.regression import (
     LassoModel,
+    LassoWithOWLQN,
     LassoWithSGD,
     LinearRegressionModel,
     LinearRegressionWithNormal,
@@ -32,6 +33,7 @@ __all__ = [
     "LinearRegressionWithNormal",
     "LinearRegressionWithSGD",
     "LassoModel",
+    "LassoWithOWLQN",
     "LassoWithSGD",
     "RidgeRegressionModel",
     "RidgeRegressionWithSGD",

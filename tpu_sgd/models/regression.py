@@ -115,6 +115,42 @@ class RidgeRegressionWithSGD(_RegressionWithSGD):
     _default_reg = 0.01
 
 
+class LassoWithOWLQN(GeneralizedLinearAlgorithm):
+    """Lasso via OWL-QN — the orthant-wise quasi-Newton upstream Spark uses
+    (Breeze ``OWLQN``) where the SGD prox path only approximates: exact
+    zeros on null coordinates, quasi-Newton convergence.  Same harness and
+    model class as ``LassoWithSGD``.
+    """
+
+    _model_cls = LassoModel
+
+    def __init__(self, reg_param: float = 0.01, max_num_iterations: int = 100):
+        super().__init__()
+        from tpu_sgd.optimize.owlqn import OWLQN
+
+        self.optimizer = OWLQN(
+            LeastSquaresGradient(),
+            reg_param=reg_param,
+            max_num_iterations=max_num_iterations,
+        )
+
+    def set_intercept(self, flag: bool):
+        # The bias is the appended LAST column; upstream gives it zero L1
+        # strength — exempt it so the intercept is never shrunk to 0.
+        self.optimizer.set_penalize_intercept(not flag)
+        return super().set_intercept(flag)
+
+    def create_model(self, weights, intercept):
+        return self._model_cls(weights, intercept)
+
+    @classmethod
+    def train(cls, data, reg_param: float = 0.01,
+              max_num_iterations: int = 100, intercept: bool = False):
+        alg = cls(reg_param, max_num_iterations)
+        alg.set_intercept(intercept)
+        return alg.run(data)
+
+
 class LinearRegressionWithNormal(GeneralizedLinearAlgorithm):
     """Exact least squares via the one-pass normal-equations solver.
 

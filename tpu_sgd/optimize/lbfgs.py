@@ -200,9 +200,17 @@ class LBFGS(Optimizer):
             # Loss-only evaluation for line-search trials: skips the
             # coeff^T @ X matvec (half the HBM traffic of the fused cost);
             # the gradient is computed once, on the accepted point.
+            from tpu_sgd.ops.gradients import matmul_dtype
+
+            mmd = matmul_dtype(X)
+
             @jax.jit
             def cost_loss(w):
-                _, losses = gradient.pointwise(X @ w, y)
+                margins = jnp.dot(
+                    X.astype(mmd), w.astype(mmd),
+                    preferred_element_type=jnp.float32,
+                )
+                _, losses = gradient.pointwise(margins, y)
                 return jnp.sum(losses) / X.shape[0] + reg_value(w)
 
         else:  # matrix-weight gradients have no pointwise rule

@@ -28,35 +28,47 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.gradients import Gradient, matmul_dtype
 from tpu_sgd.optimize.lbfgs import (
+    LBFGS,
     _coerce_inputs,
     _push_correction,
     _two_loop,
 )
-from tpu_sgd.optimize.optimizer import Dataset, Optimizer
+from tpu_sgd.optimize.optimizer import Dataset
 
 Array = jax.Array
 
 
-def _pseudo_gradient(w: Array, g: Array, reg: float) -> Array:
-    """⋄F: the steepest-descent direction's negative for f + reg·‖·‖₁."""
+def _pseudo_gradient(w: Array, g: Array, reg: Array) -> Array:
+    """⋄F: the steepest-descent direction's negative for f + ‖reg·w‖₁.
+
+    ``reg`` is a per-coordinate penalty vector (0 entries are unpenalized —
+    the intercept column, matching upstream's zero L1 strength for it)."""
     right = g + reg  # derivative approaching from w_i -> 0+
     left = g - reg   # derivative approaching from w_i -> 0-
     at_zero = jnp.where(right < 0, right, jnp.where(left > 0, left, 0.0))
     return jnp.where(w > 0, right, jnp.where(w < 0, left, at_zero))
 
 
-def _project_orthant(v: Array, xi: Array) -> Array:
-    """Zero components of ``v`` whose sign disagrees with orthant ``xi``."""
-    return jnp.where(jnp.sign(v) == xi, v, 0.0)
+def _project_orthant(v: Array, xi: Array, penalized: Array) -> Array:
+    """Zero PENALIZED components of ``v`` whose sign disagrees with orthant
+    ``xi``; unpenalized coordinates move freely (their objective is
+    smooth)."""
+    return jnp.where(jnp.logical_and(penalized, jnp.sign(v) != xi), 0.0, v)
 
 
-class OWLQN(Optimizer):
+class OWLQN(LBFGS):
     """Orthant-wise LBFGS for ``smooth loss + reg_param * ||w||_1``.
 
-    ``reg_param=0`` degenerates to plain LBFGS on the smooth loss.  Shares
-    the fused cost kernel and the on-device two-loop with :class:`LBFGS`.
+    ``reg_param=0`` degenerates to plain LBFGS on the smooth loss.
+    Subclasses :class:`LBFGS` for the shared surface (fluent setters,
+    ``loss_history``, ``optimize`` wrapper, fused cost kernel, two-loop);
+    only the orthant-wise optimization loop is its own.
+
+    ``penalize_intercept=False`` (used by the model wrappers) exempts the
+    LAST weight coordinate — the GLM harness's appended bias column — from
+    the L1 penalty, matching upstream's zero intercept L1 strength.
     """
 
     def __init__(
@@ -66,44 +78,27 @@ class OWLQN(Optimizer):
         convergence_tol: float = 1e-6,
         max_num_iterations: int = 100,
         reg_param: float = 0.0,
+        penalize_intercept: bool = True,
     ):
-        from tpu_sgd.ops.gradients import LeastSquaresGradient
+        super().__init__(
+            gradient=gradient,
+            updater=None,
+            num_corrections=num_corrections,
+            convergence_tol=convergence_tol,
+            max_num_iterations=max_num_iterations,
+            reg_param=reg_param,
+        )
+        self.penalize_intercept = bool(penalize_intercept)
 
-        self.gradient = gradient if gradient is not None else LeastSquaresGradient()
-        self.num_corrections = int(num_corrections)
-        self.convergence_tol = float(convergence_tol)
-        self.max_num_iterations = int(max_num_iterations)
-        self.reg_param = float(reg_param)
-        self._loss_history = None
+    def set_updater(self, u):  # pragma: no cover - guardrail
+        raise AttributeError(
+            "OWLQN has no Updater axis: the L1 penalty is part of the "
+            "objective (reg_param); use LBFGS for updater-style reg"
+        )
 
-    # fluent setters, same shape as the siblings
-    def set_gradient(self, g):
-        self.gradient = g
+    def set_penalize_intercept(self, flag: bool):
+        self.penalize_intercept = bool(flag)
         return self
-
-    def set_num_corrections(self, m: int):
-        self.num_corrections = int(m)
-        return self
-
-    def set_convergence_tol(self, t: float):
-        self.convergence_tol = float(t)
-        return self
-
-    def set_max_num_iterations(self, n: int):
-        self.max_num_iterations = int(n)
-        return self
-
-    def set_reg_param(self, r: float):
-        self.reg_param = float(r)
-        return self
-
-    @property
-    def loss_history(self):
-        return self._loss_history
-
-    def optimize(self, data: Dataset, initial_weights: Array) -> Array:
-        w, _ = self.optimize_with_history(data, initial_weights)
-        return w
 
     def optimize_with_history(self, data: Dataset, initial_weights: Array):
         import numpy as np
@@ -115,7 +110,11 @@ class OWLQN(Optimizer):
             self._loss_history = np.zeros((0,), np.float32)
             return w, self._loss_history
         gradient = self.gradient
-        reg = self.reg_param
+        reg_vec = jnp.full(w.shape, self.reg_param, w.dtype)
+        if not self.penalize_intercept:
+            reg_vec = reg_vec.at[-1].set(0.0)
+        penalized = reg_vec > 0
+        reg = reg_vec  # per-coordinate, broadcast through the helpers
 
         @jax.jit
         def smooth_cost(w):
@@ -126,18 +125,26 @@ class OWLQN(Optimizer):
             # Loss-only evaluation for line-search trials: skips the
             # coeff^T @ X matvec (half the HBM traffic); gradient is
             # computed once, on the accepted point — same trick as LBFGS.
+            mmd = matmul_dtype(X)
+
             @jax.jit
             def full_loss(w):
-                _, losses = gradient.pointwise(X @ w, y)
+                margins = jnp.dot(
+                    X.astype(mmd), w.astype(mmd),
+                    preferred_element_type=jnp.float32,
+                )
+                _, losses = gradient.pointwise(margins, y)
                 return (
-                    jnp.sum(losses) / X.shape[0] + reg * jnp.sum(jnp.abs(w))
+                    jnp.sum(losses) / X.shape[0] + jnp.sum(reg * jnp.abs(w))
                 )
 
         else:  # matrix-weight gradients have no pointwise rule
             @jax.jit
             def full_loss(w):
                 _, l_sum, c = gradient.batch_sums(X, y, w)
-                return l_sum / c + reg * jnp.sum(jnp.abs(w))
+                return l_sum / c + jnp.sum(reg * jnp.abs(w))
+
+        any_penalty = self.reg_param > 0
 
         m = self.num_corrections
         d_dim = w.shape[0]
@@ -147,14 +154,14 @@ class OWLQN(Optimizer):
         k = 0
 
         f_s, g = smooth_cost(w)
-        F = float(f_s) + reg * float(jnp.sum(jnp.abs(w)))
+        F = float(f_s) + float(jnp.sum(reg * jnp.abs(w)))
         losses: List[float] = [F]
         for _ in range(self.max_num_iterations):
             pg = _pseudo_gradient(w, g, reg)
             direction = -_two_loop(pg, s_stack, y_stack, rho, jnp.asarray(k))
-            if reg > 0:
+            if any_penalty:
                 # restrict to the descent orthant indicated by -pg
-                direction = _project_orthant(direction, jnp.sign(-pg))
+                direction = _project_orthant(direction, jnp.sign(-pg), penalized)
             dir_deriv = float(jnp.dot(pg, direction))
             if dir_deriv >= 0:
                 direction = -pg
@@ -167,10 +174,16 @@ class OWLQN(Optimizer):
             accepted = False
             for _ls in range(30):
                 w_new = w + t * direction
-                if reg > 0:
-                    w_new = _project_orthant(w_new, xi)
+                if any_penalty:
+                    w_new = _project_orthant(w_new, xi, penalized)
                 F_new = float(full_loss(w_new))
-                if F_new <= F + 1e-4 * t * dir_deriv:
+                # Armijo on the PROJECTED step (Andrew & Gao): predicted
+                # decrease is pg . (w_new - w), not t * pg . d — the
+                # projection may have removed part of the movement, and
+                # t * dir_deriv would then over-predict decrease and
+                # reject every halving.
+                pred = float(jnp.dot(pg, w_new - w))
+                if F_new <= F + 1e-4 * pred and pred < 0:
                     accepted = True
                     break
                 t *= 0.5
