@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Micro-benchmark the window-gradient kernel variants on the current device.
+
+The VERDICT-r1 "prove or kill Pallas" sweep: times the XLA sliced paths
+against the Pallas fused window kernel at several tile sizes, on whatever
+platform JAX resolves (the real TPU through the axon tunnel, or CPU with
+``JAX_PLATFORMS=cpu``).  Everything device-side is built inside jit —
+op-by-op dispatch of multi-GB arrays through the tunnel is pathologically
+slow (see tpu_sgd/ops/pallas_kernels.py module notes).
+
+Usage:
+    python bench_kernels.py [--rows N] [--dim D] [--frac F] [--reps K]
+                            [matvec grad ws pallas2048 pallas8192 ...]
+
+With no variant arguments, runs the full default sweep.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("variants", nargs="*",
+                    default=["matvec", "grad", "ws", "pallas2048",
+                             "pallas8192"],
+                    help="which paths to time (pallasN = tile_m N)")
+    ap.add_argument("--rows", type=int, default=2_998_272)
+    ap.add_argument("--dim", type=int, default=1000)
+    ap.add_argument("--frac", type=float, default=0.1,
+                    help="window size as a fraction of rows")
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    rows, d = args.rows, args.dim
+    m = max(1, int(args.frac * rows))
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform}); "
+          f"rows={rows} d={d} window m={m}", flush=True)
+
+    t0 = time.perf_counter()
+
+    @jax.jit
+    def gen():
+        kx, ky = jax.random.split(jax.random.PRNGKey(0))
+        X = jax.random.normal(kx, (rows, d), jnp.bfloat16)
+        y = jax.random.normal(ky, (rows,), jnp.float32)
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+    w = jnp.ones((d,), jnp.float32)
+    print(f"data ready in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    def timeit(name, fn, *fargs, rows_done=None):
+        """Times ``fn`` and reports bandwidth for the rows it ACTUALLY
+        processes (the pallas variants floor the window to a tile multiple,
+        so crediting them with the full m would inflate their GB/s)."""
+        rows_done = m if rows_done is None else rows_done
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*fargs))
+        print(f"{name:28s} compile {time.perf_counter() - t0:5.1f}s",
+              flush=True)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            out = fn(*fargs)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.reps
+        gb = rows_done * d * X.dtype.itemsize / 1e9
+        print(f"{name:28s} {dt * 1e3:8.3f} ms for {rows_done} rows "
+              f"({gb / dt:6.1f} GB/s eff-1-read)", flush=True)
+        return dt, rows_done
+
+    results = {}
+    variants = args.variants
+
+    if "matvec" in variants:
+        @jax.jit
+        def matvec_dyn(w, start, X):
+            Xb = jax.lax.dynamic_slice_in_dim(X, start, m, 0)
+            return (jnp.dot(Xb, w.astype(X.dtype),
+                            preferred_element_type=jnp.float32),)
+
+        results["matvec"] = timeit("matvec dynamic window", matvec_dyn, w,
+                                   jnp.int32(1024), X)
+
+    if "grad" in variants:
+        @jax.jit
+        def grad_dyn(w, start, X, y):
+            Xb = jax.lax.dynamic_slice_in_dim(X, start, m, 0)
+            yb = jax.lax.dynamic_slice_in_dim(y, start, m, 0)
+            r = jnp.dot(Xb, w.astype(X.dtype),
+                        preferred_element_type=jnp.float32) - yb
+            g = jnp.dot(r.astype(X.dtype), Xb,
+                        preferred_element_type=jnp.float32)
+            return (g,)
+
+        results["grad"] = timeit("grad 2-matmul dynamic", grad_dyn, w,
+                                 jnp.int32(1024), X, y)
+
+    if "ws" in variants:
+        from tpu_sgd.ops.gradients import LeastSquaresGradient
+
+        g = LeastSquaresGradient()
+
+        @jax.jit
+        def ws(w, start, X, y):
+            return g.window_sums(X, y, w, start, m)
+
+        results["ws"] = timeit("Gradient.window_sums (xla)", ws, w,
+                               jnp.int32(1024), X, y)
+
+    for v in variants:
+        if v.startswith("pallas"):
+            tile = int(v[len("pallas"):])
+            if m // tile == 0:
+                print(f"{v}: window m={m} < tile {tile}; skipped")
+                continue
+            from tpu_sgd.ops.gradients import LeastSquaresGradient
+            from tpu_sgd.ops.pallas_kernels import fused_window_sums
+
+            g = LeastSquaresGradient()
+            nt = m // tile
+
+            def pw(w, start, X, y, tile=tile, nt=nt):
+                return fused_window_sums(g.pointwise, X, y, w, start, nt,
+                                         tile_m=tile)
+
+            results[v] = timeit(f"pallas window tile={tile}", pw, w,
+                                jnp.int32(1), X, y, rows_done=nt * tile)
+
+    if "ws" in results:
+        base_dt, base_rows = results["ws"]
+        for k, (dt, rows_done) in results.items():
+            if k.startswith("pallas"):
+                # Per-row comparison: the pallas window is floored to a tile
+                # multiple, so raw wall-clock would not be apples-to-apples.
+                ratio = (base_dt / base_rows) / (dt / rows_done)
+                print(f"{k} vs ws (per row): {ratio:.2f}x "
+                      f"({'pallas wins' if ratio > 1 else 'xla wins'})")
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -4,8 +4,9 @@
     python examples/run_configs.py [1|2|3|4|5|all] [--scale small|full]
 
 Config 1: LinearRegressionWithSGD, least squares, dense synthetic.
-Config 2: LogisticRegressionWithSGD, log loss + L2, LIBSVM file (a9a when
-          present at data/a9a, else a synthetic stand-in written to disk).
+Config 2: LogisticRegressionWithSGD, log loss + L2, LIBSVM file (a real a9a
+          when present at data/a9a, else the synthetic stand-in
+          data/a9a_synthetic written on first run — see data/README.md).
 Config 3: SVMWithSGD, hinge + L1 updater, sparse->densified LIBSVM.
 Config 4: Mini-batch SGD frac=0.1, 8-way data-parallel all-reduce.
 Config 5: Streaming SGD over micro-batches, online weight updates.
@@ -79,19 +80,27 @@ def config1():
           f"({time.perf_counter() - t0:.1f}s)")
 
 
-def _libsvm_path(name, maker):
-    path = os.path.join(os.path.dirname(__file__), "..", "data", name)
-    if os.path.exists(path):
-        return path
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    X, y = maker()
-    save_as_libsvm_file(path, X, y)
-    return path
+def _libsvm_path(real_name, synthetic_name, maker):
+    """Prefer a REAL dataset at ``data/<real_name>`` if the user vendored
+    one; otherwise use (writing on first run) the locally generated
+    synthetic stand-in at ``data/<synthetic_name>`` — this environment has
+    no network, so the real LIBSVM files cannot be fetched (see
+    data/README.md)."""
+    data_dir = os.path.join(os.path.dirname(__file__), "..", "data")
+    real = os.path.join(data_dir, real_name)
+    if os.path.exists(real):
+        return real, "real"
+    path = os.path.join(data_dir, synthetic_name)
+    if not os.path.exists(path):
+        os.makedirs(data_dir, exist_ok=True)
+        X, y = maker()
+        save_as_libsvm_file(path, X, y)
+    return path, "synthetic stand-in"
 
 
 def config2():
-    path = _libsvm_path(
-        "a9a", lambda: logistic_data(20_000, 123, seed=1)[:2]
+    path, kind = _libsvm_path(
+        "a9a", "a9a_synthetic", lambda: logistic_data(20_000, 123, seed=1)[:2]
     )
     X, y = load_libsvm_file(path)
     y = np.where(y > 0, 1.0, 0.0).astype(np.float32)  # a9a labels are +/-1
@@ -99,13 +108,15 @@ def config2():
     model = LogisticRegressionWithSGD.train((X, y), num_iterations=100,
                                             reg_param=0.01, intercept=True)
     acc = float(np.mean(np.asarray(model.predict(X)) == y))
-    print(f"config2: libsvm={os.path.basename(path)} n={X.shape[0]} "
-          f"d={X.shape[1]} acc={acc:.4f} ({time.perf_counter() - t0:.1f}s)")
+    print(f"config2: libsvm={os.path.basename(path)} ({kind}) "
+          f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
+          f"({time.perf_counter() - t0:.1f}s)")
 
 
 def config3():
-    path = _libsvm_path(
-        "rcv1_like", lambda: svm_data(20_000, 200, noise=0.05, seed=2)[:2]
+    path, kind = _libsvm_path(
+        "rcv1", "rcv1_synthetic",
+        lambda: svm_data(20_000, 200, noise=0.05, seed=2)[:2],
     )
     X, y = load_libsvm_file(path, dense=True)  # sparse -> densified
     y = np.where(y > 0, 1.0, 0.0).astype(np.float32)
@@ -113,7 +124,8 @@ def config3():
     model = SVMWithSGD.train((X, y), num_iterations=100, reg_param=0.01,
                              updater=L1Updater())
     acc = float(np.mean(np.asarray(model.predict(X)) == y))
-    print(f"config3: n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
+    print(f"config3: libsvm={os.path.basename(path)} ({kind}) "
+          f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
           f"({time.perf_counter() - t0:.1f}s)")
 
 

@@ -44,6 +44,13 @@ def matmul_dtype(X: Array):
     return X.dtype if jnp.issubdtype(X.dtype, jnp.inexact) else jnp.float32
 
 
+def acc_dtype(mm_dtype):
+    """Accumulation dtype paired with :func:`matmul_dtype`: at least f32, but
+    never narrower than the inputs — f64 data under ``jax_enable_x64`` keeps
+    f64 accumulation instead of being silently downcast to f32."""
+    return jnp.promote_types(mm_dtype, jnp.float32)
+
+
 class Gradient:
     """Loss-specific plugin: the ``Gradient`` axis of the optimizer boundary.
 
@@ -90,7 +97,7 @@ class Gradient:
         mm_dtype = matmul_dtype(X)
         margins = jnp.dot(
             X.astype(mm_dtype), weights.astype(mm_dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype(mm_dtype),
         )
         if margin_axis_name is not None:
             margins = jax.lax.psum(margins, margin_axis_name)
@@ -104,7 +111,7 @@ class Gradient:
             count = jnp.asarray(X.shape[0], margins.dtype)
         grad_sum = jnp.dot(  # == X.T @ coeff, row-major friendly
             coeff.astype(mm_dtype), X.astype(mm_dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype(mm_dtype),
         )
         loss_sum = jnp.sum(losses)
         return grad_sum, loss_sum, count
@@ -214,7 +221,7 @@ class MultinomialLogisticGradient:
         mm_dtype = matmul_dtype(X)
         margins = jnp.dot(  # (n, K-1); partial if features are sharded
             X.astype(mm_dtype), W.T.astype(mm_dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype(mm_dtype),
         )
         if margin_axis_name is not None:
             margins = jax.lax.psum(margins, margin_axis_name)
@@ -236,7 +243,7 @@ class MultinomialLogisticGradient:
             count = jnp.asarray(X.shape[0], margins.dtype)
         grad_sum = jnp.dot(
             coeff.T.astype(mm_dtype), X.astype(mm_dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype(mm_dtype),
         ).reshape(-1)  # flattened (K-1)*D
         return grad_sum, jnp.sum(losses), count
 

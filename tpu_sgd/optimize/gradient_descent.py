@@ -556,8 +556,11 @@ class GradientDescent(Optimizer):
 
     def _stepper(self, with_valid: bool):
         """Memoized jitted single-step function (mesh-aware)."""
-        key = ("step", id(self.gradient), id(self.updater), self.config,
-               id(self.mesh), with_valid)
+        # Key on the objects themselves (identity hash, strong ref): an
+        # id()-based key could alias a new gradient/mesh to a stale compiled
+        # fn after GC id reuse.
+        key = ("step", self.gradient, self.updater, self.config,
+               self.mesh, with_valid)
         fn = self._run_cache.get(key)
         if fn is None:
             if self.mesh is None:
@@ -583,8 +586,8 @@ class GradientDescent(Optimizer):
         pattern, SURVEY.md §3.3) hit XLA's compile cache instead of
         retracing; measured ~3000x faster on repeat calls.
         """
-        key = (id(self.gradient), id(self.updater), self.config,
-               id(self.mesh), with_valid)
+        key = ("run", self.gradient, self.updater, self.config,
+               self.mesh, with_valid)
         fn = self._run_cache.get(key)
         if fn is None:
             if self.mesh is not None:

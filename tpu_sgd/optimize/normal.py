@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_sgd.ops.gradients import matmul_dtype
+from tpu_sgd.ops.gradients import acc_dtype, matmul_dtype
 from tpu_sgd.optimize.optimizer import Dataset, Optimizer
 
 Array = jax.Array
@@ -40,12 +40,11 @@ def _gram_sums(X: Array, y: Array) -> Tuple[Array, Array, Array, Array]:
     """One pass: ``(XᵀX, Xᵀy, yᵀy, n)`` with f32 accumulation (bf16 data
     runs the Gram matmul on the MXU in bf16)."""
     mm_dtype = matmul_dtype(X)
+    acc = acc_dtype(mm_dtype)
     Xc = X.astype(mm_dtype)
-    A = jnp.dot(Xc.T, Xc, preferred_element_type=jnp.float32)
-    b = jnp.dot(
-        Xc.T, y.astype(mm_dtype), preferred_element_type=jnp.float32
-    )
-    yty = jnp.dot(y, y, preferred_element_type=jnp.float32)
+    A = jnp.dot(Xc.T, Xc, preferred_element_type=acc)
+    b = jnp.dot(Xc.T, y.astype(mm_dtype), preferred_element_type=acc)
+    yty = jnp.dot(y, y, preferred_element_type=acc)
     return A, b, yty, jnp.float32(X.shape[0])
 
 
@@ -59,7 +58,7 @@ def _solve(A, b, yty, n, reg_param: float):
     An = A / n + reg_param * jnp.eye(d, dtype=A.dtype)
     bn = b / n
     # Cholesky: the regularized Gram is SPD for reg>0 and full-rank data;
-    # fall back happens naturally as NaNs which callers can check.
+    # rank deficiency surfaces as NaNs, which ``optimize`` checks and raises.
     L = jax.lax.linalg.cholesky(An)
     w = jax.lax.linalg.triangular_solve(
         L,
@@ -118,7 +117,9 @@ class NormalEquations(Optimizer):
         return self._loss
 
     def _solver(self, with_valid: bool):
-        key = (self.reg_param, id(self.mesh), with_valid)
+        # Mesh is hashable and used directly (an id() key could alias a new
+        # mesh to a stale compiled solver after GC id reuse).
+        key = (self.reg_param, self.mesh, with_valid)
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -180,5 +181,13 @@ class NormalEquations(Optimizer):
                 w, loss = self._solver(with_valid=True)(Xd, yd, valid)
             else:
                 w, loss = self._solver(with_valid=False)(Xd, yd)
+        if not bool(jnp.all(jnp.isfinite(w))):
+            raise FloatingPointError(
+                "normal-equations solve produced non-finite weights: the "
+                "Gram matrix is rank-deficient (collinear or constant "
+                "features) and reg_param="
+                f"{self.reg_param} does not regularize it; set a positive "
+                "reg_param or drop redundant features"
+            )
         self._loss = np.asarray([float(loss)], np.float32)
         return w

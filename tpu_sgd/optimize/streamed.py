@@ -13,7 +13,10 @@ the next job (SURVEY.md §3.1), without the per-iteration scheduling cost.
 
 The device-side step is the SAME ``make_step`` the resident paths use
 (frac=1.0 over the transferred batch; normalization by the realized batch
-size is preserved because the host sampler draws Bernoulli batches).
+size is preserved because the host sampler marks exactly the sampled rows
+valid).  All three sampling modes (bernoulli / indexed / sliced) are
+honored host-side with the same distributional semantics as the resident
+path.
 """
 
 from __future__ import annotations
@@ -45,9 +48,10 @@ def optimize_host_streamed(
     """Run mini-batch SGD with the dataset resident on the HOST.
 
     Returns ``(weights, loss_history)`` with the same semantics as the
-    resident path: per-iteration Bernoulli sample of ``mini_batch_fraction``
-    (host-side, seeded ``seed + i``), loss history including the previous
-    iteration's reg value, convergence tolerance early exit.
+    resident path: per-iteration sample of ``mini_batch_fraction`` honoring
+    ``config.sampling`` (host-side, seeded ``seed + i``), loss history
+    including the previous iteration's reg value, convergence tolerance
+    early exit.
 
     ``mesh``: a 1-D data mesh combines the two scaling axes — each streamed
     batch is ``device_put`` row-sharded across cores and the step runs under
@@ -91,16 +95,20 @@ def optimize_host_streamed(
         w, jnp.zeros_like(w), 0.0, jnp.asarray(1, jnp.int32), cfg.reg_param
     )
 
-    # Fixed row cap so the device step compiles once. Sized at the binomial
-    # mean + 6 sigma + slack: overflow probability is negligible at any n;
-    # in the astronomically rare overflow a uniformly random subset is kept
-    # (shuffle before truncation), so the estimate stays unbiased.
+    # Fixed row cap so the device step compiles once.  Bernoulli batches are
+    # variable-size: cap at the binomial mean + 6 sigma + slack (overflow is
+    # astronomically rare; a uniformly random subset is kept on overflow —
+    # shuffle before truncation — so the estimate stays unbiased).  Indexed
+    # and sliced batches are fixed-size by construction.
     frac = cfg.mini_batch_fraction
+    m_fixed = max(1, round(frac * n))
     if frac >= 1.0:
         cap = n
-    else:
+    elif cfg.sampling == "bernoulli":
         sigma = np.sqrt(n * frac * (1.0 - frac))
         cap = int(min(n, np.ceil(n * frac + 6.0 * sigma + 8)))
+    else:  # indexed / sliced: same batch size as the device-resident path
+        cap = m_fixed
     if mesh is not None:
         n_shards = mesh.shape[DATA_AXIS]
         cap += (-cap) % n_shards  # even shards; padding rows are invalid
@@ -116,16 +124,38 @@ def optimize_host_streamed(
             pass
 
     def sample(i: int):
-        """Bernoulli sample like RDD.sample(false, frac, seed + i), padded to
-        the fixed cap."""
+        """Per-iteration host-side sample honoring ``config.sampling`` —
+        bernoulli (RDD.sample parity), indexed (fixed-size gather with
+        replacement), or sliced (contiguous window) — deterministic in
+        ``default_rng(seed + i)`` and padded to the fixed cap."""
         rng = np.random.default_rng(cfg.seed + i)
-        if frac < 1.0:
+        if frac < 1.0 and cfg.sampling == "sliced":
+            # Contiguous window: a plain slice (zero-copy view), never the
+            # row gather — sequential host I/O is this mode's entire point.
+            start = int(rng.integers(0, max(1, n - m_fixed + 1)))
+            Xb, yb = X[start:start + m_fixed], y[start:start + m_fixed]
+            valid = np.ones((cap,), bool)
+            if cap > m_fixed:  # mesh shard padding: one tail memcpy
+                valid[m_fixed:] = False
+                Xp = np.zeros((cap, X.shape[1]), X.dtype)
+                Xp[:m_fixed] = Xb
+                yp = np.zeros((cap,), y.dtype)
+                yp[:m_fixed] = yb
+                Xb, yb = Xp, yp
+            return (
+                jax.device_put(Xb, row_sharding),
+                jax.device_put(yb, mask_sharding),
+                jax.device_put(valid, mask_sharding),
+            )
+        if frac >= 1.0:
+            idx = np.arange(n)
+        elif cfg.sampling == "indexed":
+            idx = rng.integers(0, n, size=m_fixed)
+        else:  # bernoulli
             m = rng.random(n) < frac
             idx = np.nonzero(m)[0]
             if idx.shape[0] > cap:
                 idx = rng.permutation(idx)[:cap]
-        else:
-            idx = np.arange(n)
         valid = np.zeros((cap,), bool)
         valid[: idx.shape[0]] = True
         pad = np.zeros((cap,), np.int64)
