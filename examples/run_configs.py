@@ -89,7 +89,12 @@ def _libsvm_path(real_name, synthetic_name, maker):
     data_dir = os.path.join(os.path.dirname(__file__), "..", "data")
     real = os.path.join(data_dir, real_name)
     if os.path.exists(real):
-        return real, "real"
+        # "vendored", not "real": we can only know the user placed a file
+        # here, not that it is the genuine dataset.  (Workspaces that ran
+        # the pre-rename script may have a STALE auto-generated file at
+        # this path — delete it; the honest stand-in lives at
+        # data/<synthetic_name> now.)
+        return real, "vendored"
     path = os.path.join(data_dir, synthetic_name)
     if not os.path.exists(path):
         os.makedirs(data_dir, exist_ok=True)

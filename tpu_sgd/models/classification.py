@@ -303,8 +303,10 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
     @classmethod
     def train(cls, data, max_num_iterations: int = 100, reg_param: float = 0.0,
               initial_weights=None, intercept: bool = False,
-              num_classes: int = 2):
+              num_classes: int = 2, mesh=None):
         alg = cls(max_num_iterations=max_num_iterations, reg_param=reg_param)
         alg.set_intercept(intercept)
         alg.set_num_classes(num_classes)
+        if mesh is not None:
+            alg.optimizer.set_mesh(mesh)
         return alg.run(data, initial_weights)

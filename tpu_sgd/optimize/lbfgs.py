@@ -12,6 +12,14 @@ TPU-first shape: the cost function is one fused batched matvec pass (the same
 ``Gradient.batch_sums`` the SGD path uses, so the MXU kernel is shared); the
 two-loop recursion runs on-device over the correction history; only the
 line-search control flow is host-side (it is data-dependent and tiny).
+
+Distribution: ``set_mesh`` shards the cost function's batch sums row-wise
+over a 1-D data mesh with one ``lax.psum`` over ICI — the analogue of the
+reference's ``CostFun`` running through ``treeAggregate`` ([U]
+mllib/optimization/LBFGS.scala, distributed by construction).  The whole
+backtracking ladder is evaluated as ONE batched multi-weight loss sweep
+(X is read once for all trial points; the host syncs once per iteration
+instead of once per trial — crucial over a high-latency device link).
 """
 
 from __future__ import annotations
@@ -21,7 +29,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.gradients import Gradient, acc_dtype, matmul_dtype
 from tpu_sgd.ops.updaters import (
     L1Updater,
     SimpleUpdater,
@@ -64,6 +72,106 @@ def _coerce_inputs(X, y, w):
     if not jnp.issubdtype(w.dtype, jnp.inexact):
         w = w.astype(jnp.float32)
     return X, y, w
+
+
+def _wrap_mesh(mesh, body, n_weight_args, with_valid, n_outs):
+    """Jit ``body`` — plain, or shard_mapped over the 1-D data mesh with
+    the first ``n_weight_args`` args replicated and (X, y[, valid]) row-
+    sharded; outputs replicated (the psum inside ``body`` makes them so)."""
+    if mesh is None:
+        return jax.jit(body)
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+    in_specs = (P(),) * n_weight_args + (P(DATA_AXIS, None), P(DATA_AXIS))
+    if with_valid:
+        in_specs = in_specs + (P(DATA_AXIS),)
+    out_specs = P() if n_outs == 1 else (P(),) * n_outs
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
+def _build_cost(gradient, reg_value, reg_grad, mesh, with_valid):
+    """``cost(w, X, y[, valid]) -> (f, g)``: full objective and gradient,
+    one fused pass, psum'd per shard under a mesh (the treeAggregate-CostFun
+    analogue)."""
+
+    def body(w, X, y, valid=None):
+        g_sum, l_sum, c = gradient.batch_sums(X, y, w, mask=valid)
+        if mesh is not None:
+            from tpu_sgd.parallel.mesh import DATA_AXIS
+
+            g_sum, l_sum, c = jax.lax.psum((g_sum, l_sum, c), DATA_AXIS)
+        return l_sum / c + reg_value(w), g_sum / c + reg_grad(w)
+
+    if not with_valid:  # fixed arity for shard_map specs
+        full = body
+        body = lambda w, X, y: full(w, X, y)
+    return _wrap_mesh(mesh, body, 1, with_valid, 2)
+
+
+def _build_loss_only(gradient, reg_value, mesh, with_valid):
+    """``loss(w, X, y[, valid]) -> f``: objective WITHOUT the gradient as a
+    compiled output, so XLA dead-code-eliminates the ``coeffᵀ @ X`` matmul —
+    half the HBM traffic of the fused cost.  Used for line-search trials of
+    matrix-weight gradients (``cost(...)[0]`` would keep the matmul live)."""
+
+    def body(w, X, y, valid=None):
+        _, l_sum, c = gradient.batch_sums(X, y, w, mask=valid)
+        if mesh is not None:
+            from tpu_sgd.parallel.mesh import DATA_AXIS
+
+            l_sum, c = jax.lax.psum((l_sum, c), DATA_AXIS)
+        return l_sum / c + reg_value(w)
+
+    if not with_valid:
+        full = body
+        body = lambda w, X, y: full(w, X, y)
+    return _wrap_mesh(mesh, body, 1, with_valid, 1)
+
+
+def _build_loss_sweep(gradient, reg_value, mesh, with_valid):
+    """``sweep(W, X, y[, valid]) -> (T,)`` objective values of T trial
+    weight vectors in ONE fused pass: ``margins = X @ Wᵀ`` is a single MXU
+    matmul reading X once for the entire backtracking ladder, vs T separate
+    matvecs (and T host syncs) for a scalar line search.  Pointwise-rule
+    gradients only (vector weights)."""
+
+    def body(W, X, y, valid=None):
+        mmd = matmul_dtype(X)
+        margins = jnp.dot(  # (n, T)
+            X.astype(mmd), W.T.astype(mmd),
+            preferred_element_type=acc_dtype(mmd),
+        )
+        _, losses = gradient.pointwise(margins, y[:, None])
+        if valid is not None:
+            vf = valid.astype(losses.dtype)
+            losses = losses * vf[:, None]
+            c = jnp.sum(vf)
+        else:
+            c = jnp.asarray(X.shape[0], losses.dtype)
+        l_sum = jnp.sum(losses, axis=0)
+        if mesh is not None:
+            from tpu_sgd.parallel.mesh import DATA_AXIS
+
+            l_sum, c = jax.lax.psum((l_sum, c), DATA_AXIS)
+        return l_sum / c + jax.vmap(reg_value)(W)
+
+    if not with_valid:
+        full = body
+        body = lambda W, X, y: full(W, X, y)
+    return _wrap_mesh(mesh, body, 1, with_valid, 1)
+
+
+def _reject_model_axis(mesh, who: str):
+    from tpu_sgd.parallel.mesh import has_model_axis
+
+    if has_model_axis(mesh):
+        raise ValueError(
+            f"{who} shards rows over a 1-D 'data' mesh; a 2-D (data, "
+            "model) mesh would silently replicate X across the model "
+            "axis — use a data-only mesh"
+        )
 
 
 def _push_correction(s_stack, y_stack, rho, k, m, s, yv, sy):
@@ -142,6 +250,7 @@ class LBFGS(Optimizer):
         self.convergence_tol = convergence_tol
         self.max_num_iterations = max_num_iterations
         self.reg_param = reg_param
+        self.mesh = None
         self._loss_history = None
 
     # fluent setters, reference parity
@@ -169,6 +278,14 @@ class LBFGS(Optimizer):
         self.reg_param = float(r)
         return self
 
+    def set_mesh(self, mesh):
+        """Shard the cost function (and line-search sweep) row-wise over a
+        1-D data mesh — the treeAggregate-CostFun analogue (SURVEY.md §2
+        #18)."""
+        _reject_model_axis(mesh, type(self).__name__)
+        self.mesh = mesh
+        return self
+
     @property
     def loss_history(self):
         return self._loss_history
@@ -176,6 +293,9 @@ class LBFGS(Optimizer):
     def optimize(self, data: Dataset, initial_weights: Array) -> Array:
         w, _ = self.optimize_with_history(data, initial_weights)
         return w
+
+    #: backtracking ladder length (t = 1, 1/2, ..., 2^-(N-1))
+    _LS_TRIALS = 25
 
     def optimize_with_history(self, data: Dataset, initial_weights: Array):
         import numpy as np
@@ -189,33 +309,36 @@ class LBFGS(Optimizer):
         gradient = self.gradient
         reg_value, reg_grad = _reg_terms(self.updater, self.reg_param)
 
-        @jax.jit
-        def cost(w):
-            g_sum, l_sum, c = gradient.batch_sums(X, y, w)
-            f = l_sum / c + reg_value(w)
-            g = g_sum / c + reg_grad(w)
-            return f, g
+        mesh = self.mesh
+        valid = None
+        if mesh is not None:
+            from tpu_sgd.parallel.data_parallel import shard_dataset
 
-        if hasattr(gradient, "pointwise"):
-            # Loss-only evaluation for line-search trials: skips the
-            # coeff^T @ X matvec (half the HBM traffic of the fused cost);
-            # the gradient is computed once, on the accepted point.
-            from tpu_sgd.ops.gradients import matmul_dtype
+            X, y, valid = shard_dataset(mesh, X, y)
+        with_valid = valid is not None
+        data_args = (X, y, valid) if with_valid else (X, y)
 
-            mmd = matmul_dtype(X)
+        cost = _build_cost(gradient, reg_value, reg_grad, mesh, with_valid)
+
+        n_ls = self._LS_TRIALS
+        ladder = jnp.asarray(
+            0.5 ** np.arange(n_ls), jnp.float32
+        )  # trial step sizes, largest first
+        swept = hasattr(gradient, "pointwise")
+        if swept:
+            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid)
 
             @jax.jit
-            def cost_loss(w):
-                margins = jnp.dot(
-                    X.astype(mmd), w.astype(mmd),
-                    preferred_element_type=jnp.float32,
-                )
-                _, losses = gradient.pointwise(margins, y)
-                return jnp.sum(losses) / X.shape[0] + reg_value(w)
+            def make_trials(w, direction):
+                return w[None, :] + ladder[:, None] * direction[None, :]
 
-        else:  # matrix-weight gradients have no pointwise rule
-            def cost_loss(w):
-                return cost(w)[0]
+        else:  # matrix-weight gradients: sequential scalar trials
+            loss_only = _build_loss_only(
+                gradient, reg_value, mesh, with_valid
+            )
+
+            def cost_loss(wt):
+                return loss_only(wt, *data_args)
 
         m = self.num_corrections
         d = w.shape[0]
@@ -224,28 +347,40 @@ class LBFGS(Optimizer):
         rho = jnp.zeros((m,), w.dtype)
         k = 0  # valid corrections
 
-        f, g = cost(w)
+        f, g = cost(w, *data_args)
         losses: List[float] = [float(f)]
         for _ in range(self.max_num_iterations):
             direction = -_two_loop(g, s_stack, y_stack, rho, jnp.asarray(k))
-            # backtracking Armijo line search (host control flow, tiny)
+            # Armijo backtracking; only the accept decision is host-side
             g_dot_d = float(jnp.dot(g, direction))
             if g_dot_d >= 0:  # not a descent direction: reset to -g
                 direction = -g
                 g_dot_d = float(jnp.dot(g, direction))
-            t = 1.0
             f0 = float(f)
-            accepted = False
-            for _ls in range(25):
-                w_new = w + t * direction
-                f_new = cost_loss(w_new)
-                if float(f_new) <= f0 + 1e-4 * t * g_dot_d:
-                    accepted = True
-                    break
-                t *= 0.5
+            if swept:
+                # whole ladder in one device pass + ONE host sync
+                f_trials = np.asarray(
+                    sweep(make_trials(w, direction), *data_args)
+                )
+                ok = f_trials <= f0 + 1e-4 * np.asarray(ladder) * g_dot_d
+                j = int(np.argmax(ok)) if ok.any() else -1
+                accepted = j >= 0
+                if accepted:
+                    t = float(ladder[j])
+                    w_new = w + t * direction
+            else:
+                t = 1.0
+                accepted = False
+                for _ls in range(n_ls):
+                    w_new = w + t * direction
+                    f_new = cost_loss(w_new)
+                    if float(f_new) <= f0 + 1e-4 * t * g_dot_d:
+                        accepted = True
+                        break
+                    t *= 0.5
             if not accepted:
                 break  # cannot make progress
-            f_new, g_new = cost(w_new)  # gradient only at the accepted point
+            f_new, g_new = cost(w_new, *data_args)  # gradient at accepted pt
             s = w_new - w
             yv = g_new - g
             sy = float(jnp.dot(s, yv))

@@ -28,9 +28,12 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from tpu_sgd.ops.gradients import Gradient, matmul_dtype
+from tpu_sgd.ops.gradients import Gradient
 from tpu_sgd.optimize.lbfgs import (
     LBFGS,
+    _build_cost,
+    _build_loss_only,
+    _build_loss_sweep,
     _coerce_inputs,
     _push_correction,
     _two_loop,
@@ -116,35 +119,53 @@ class OWLQN(LBFGS):
         penalized = reg_vec > 0
         reg = reg_vec  # per-coordinate, broadcast through the helpers
 
-        @jax.jit
-        def smooth_cost(w):
-            g_sum, l_sum, c = gradient.batch_sums(X, y, w)
-            return l_sum / c, g_sum / c
+        mesh = self.mesh
+        valid = None
+        if mesh is not None:
+            from tpu_sgd.parallel.data_parallel import shard_dataset
 
-        if hasattr(gradient, "pointwise"):
-            # Loss-only evaluation for line-search trials: skips the
-            # coeff^T @ X matvec (half the HBM traffic); gradient is
-            # computed once, on the accepted point — same trick as LBFGS.
-            mmd = matmul_dtype(X)
+            X, y, valid = shard_dataset(mesh, X, y)
+        with_valid = valid is not None
+        data_args = (X, y, valid) if with_valid else (X, y)
 
-            @jax.jit
-            def full_loss(w):
-                margins = jnp.dot(
-                    X.astype(mmd), w.astype(mmd),
-                    preferred_element_type=jnp.float32,
-                )
-                _, losses = gradient.pointwise(margins, y)
-                return (
-                    jnp.sum(losses) / X.shape[0] + jnp.sum(reg * jnp.abs(w))
-                )
+        l1_value = lambda wv: jnp.sum(reg * jnp.abs(wv))
+        zero = lambda wv: jnp.zeros((), wv.dtype)
+        zero_grad = jnp.zeros_like
+        # smooth cost (mesh-aware psum inside); the L1 part is added where
+        # the algorithm needs the FULL objective
+        _smooth = _build_cost(gradient, zero, zero_grad, mesh, with_valid)
 
-        else:  # matrix-weight gradients have no pointwise rule
-            @jax.jit
-            def full_loss(w):
-                _, l_sum, c = gradient.batch_sums(X, y, w)
-                return l_sum / c + jnp.sum(reg * jnp.abs(w))
+        def smooth_cost(wv):
+            return _smooth(wv, *data_args)
 
         any_penalty = self.reg_param > 0
+        n_ls = 30
+        ladder = np.asarray(0.5 ** np.arange(n_ls), np.float32)
+        swept = hasattr(gradient, "pointwise")
+        if swept:
+            # Whole orthant-projected backtracking ladder in ONE fused
+            # multi-weight pass (X read once, one host sync) — same sweep
+            # machinery as LBFGS, plus the per-trial predicted decrease
+            # pg . (w_trial - w) the Armijo test needs.
+            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid)
+            ladder_j = jnp.asarray(ladder)
+
+            @jax.jit
+            def make_trials(wv, direction, xi, pg):
+                W = wv[None, :] + ladder_j[:, None] * direction[None, :]
+                if any_penalty:
+                    W = jax.vmap(
+                        lambda v: _project_orthant(v, xi, penalized)
+                    )(W)
+                preds = (W - wv[None, :]) @ pg
+                return W, preds
+
+        else:  # matrix-weight gradients have no pointwise rule
+            # loss-only compile: XLA drops the gradient matmul per trial
+            _loss = _build_loss_only(gradient, l1_value, mesh, with_valid)
+
+            def full_loss(wv):
+                return _loss(wv, *data_args)
 
         m = self.num_corrections
         d_dim = w.shape[0]
@@ -170,23 +191,34 @@ class OWLQN(LBFGS):
                     break
             # orthant for the trial points: sign(w), or sign(-pg) at zeros
             xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
-            t = 1.0
-            accepted = False
-            for _ls in range(30):
-                w_new = w + t * direction
-                if any_penalty:
-                    w_new = _project_orthant(w_new, xi, penalized)
-                F_new = float(full_loss(w_new))
-                # Armijo on the PROJECTED step (Andrew & Gao): predicted
-                # decrease is pg . (w_new - w), not t * pg . d — the
-                # projection may have removed part of the movement, and
-                # t * dir_deriv would then over-predict decrease and
-                # reject every halving.
-                pred = float(jnp.dot(pg, w_new - w))
-                if F_new <= F + 1e-4 * pred and pred < 0:
-                    accepted = True
-                    break
-                t *= 0.5
+            # Armijo on the PROJECTED step (Andrew & Gao): predicted
+            # decrease is pg . (w_trial - w), not t * pg . d — the
+            # projection may have removed part of the movement, and
+            # t * dir_deriv would then over-predict decrease and reject
+            # every halving.
+            if swept:
+                W_trials, preds = make_trials(w, direction, xi, pg)
+                F_trials = np.asarray(sweep(W_trials, *data_args))
+                preds_h = np.asarray(preds)
+                ok = (F_trials <= F + 1e-4 * preds_h) & (preds_h < 0)
+                j = int(np.argmax(ok)) if ok.any() else -1
+                accepted = j >= 0
+                if accepted:
+                    w_new = W_trials[j]
+                    F_new = float(F_trials[j])
+            else:
+                t = 1.0
+                accepted = False
+                for _ls in range(n_ls):
+                    w_new = w + t * direction
+                    if any_penalty:
+                        w_new = _project_orthant(w_new, xi, penalized)
+                    F_new = float(full_loss(w_new))
+                    pred = float(jnp.dot(pg, w_new - w))
+                    if F_new <= F + 1e-4 * pred and pred < 0:
+                        accepted = True
+                        break
+                    t *= 0.5
             if not accepted:
                 break
             _, g_new = smooth_cost(w_new)

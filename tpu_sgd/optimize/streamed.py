@@ -15,8 +15,12 @@ The device-side step is the SAME ``make_step`` the resident paths use
 (frac=1.0 over the transferred batch; normalization by the realized batch
 size is preserved because the host sampler marks exactly the sampled rows
 valid).  All three sampling modes (bernoulli / indexed / sliced) are
-honored host-side with the same distributional semantics as the resident
-path.
+honored host-side.  Bernoulli and indexed match the resident path's
+distribution; sliced draws ONE global contiguous window that is then
+sharded, whereas the resident mesh path draws an independent window per
+shard — both are single-window-per-sampler designs, but the streamed batch
+is globally contiguous where the resident mesh batch is a union of 8 local
+windows.
 """
 
 from __future__ import annotations
