@@ -5,11 +5,26 @@ Headline metric: SGD epochs/sec on a 10M x 1000 dense least-squares fit,
 mini-batch fraction 0.1 — an "epoch" is one full-dataset-equivalent of row
 processing (10 iterations at frac=0.1).  The TPU side measures the fused
 while_loop SGD program on the largest device-resident slab (bf16 features,
-f32 master weights, indexed sampling) and converts measured rows/sec to
+f32 master weights, sliced sampling) and converts measured rows/sec to
 epochs/sec on the 10M-row problem; the baseline is a faithful 8-process
 NumPy re-implementation of the Spark local[*] topology (per-partition
 gradient sums, broadcast weights, tree combine) as specified in BASELINE.md
 (no JVM/Spark exists in this environment).
+
+Matched-loss protocol (BASELINE.md): BOTH sides run the SAME generating
+process at the SAME row count (MATCHED_ROWS x 1000, w_true ~ U(-1,1),
+eps=0.1, w0=0, step 0.5/sqrt(t), frac 0.1) for MATCHED_ITERS >= 20
+iterations; the stopping rule is the first iteration whose mini-batch loss
+<= TARGET_LOSS, a PRE-REGISTERED constant (0.05, reached around iteration
+19-20 of the calibrated trajectory — see BASELINE.md).  Wall-clock per side
+= iters-to-target x measured s/iter; the speedup is their ratio.
+
+Tunnel resilience (VERDICT r1 #1): the TPU preflight retries with backoff
+(BENCH_TPU_RETRIES x BENCH_TPU_BACKOFF), every successful TPU measurement
+is persisted to BENCH_LAST_TPU.json immediately, and if the tunnel is
+wedged at bench time but a persisted TPU result exists, that result is
+reported (explicitly marked stale) instead of a meaningless CPU-fallback
+number.
 
 Prints ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": "epochs/sec", "vs_baseline": N}
@@ -29,10 +44,15 @@ import numpy as np
 TARGET_ROWS = 10_000_000  # the headline problem size
 DIM = int(os.environ.get("BENCH_DIM", "1000"))
 FRAC = 0.1
-TPU_ITERS = int(os.environ.get("BENCH_ITERS", "30"))
-CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", "400000"))
-CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", "4"))
 N_EXECUTORS = 8
+
+# Matched-loss protocol constants (pre-registered; see BASELINE.md)
+MATCHED_ROWS = int(os.environ.get("BENCH_MATCHED_ROWS", "399360"))  # 2048-aligned
+MATCHED_ITERS = int(os.environ.get("BENCH_MATCHED_ITERS", "25"))
+TARGET_LOSS = float(os.environ.get("BENCH_TARGET_LOSS", "0.05"))
+STEP_SIZE = 0.5
+
+LAST_TPU_PATH = os.path.join(os.path.dirname(__file__), "BENCH_LAST_TPU.json")
 
 
 def log(*a):
@@ -43,38 +63,51 @@ def log(*a):
 # TPU side
 # ---------------------------------------------------------------------------
 
-def _tpu_preflight(timeout_s: int = 180) -> bool:
-    """Probe the TPU backend from a THROWAWAY subprocess with a hard timeout.
+def _tpu_preflight() -> bool:
+    """Probe the TPU backend from THROWAWAY subprocesses with hard timeouts,
+    retrying with backoff.
 
     The remote-TPU tunnel can wedge in a way that makes ``jax.devices()``
     hang forever (not raise); probing in-process would hang the whole
     benchmark.  A child process is killable, and the parent can then fall
-    back to CPU before its own jax backend initializes.
+    back before its own jax backend initializes.  Retries are spread over
+    BENCH_TPU_RETRIES attempts with BENCH_TPU_BACKOFF seconds between them
+    (the tunnel has been observed to wedge for minutes and recover).
     """
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform != 'cpu'"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        log(f"TPU preflight hung >{timeout_s}s (tunnel wedged)")
-        return False
+    attempts = int(os.environ.get("BENCH_TPU_RETRIES", "3"))
+    backoff = float(os.environ.get("BENCH_TPU_BACKOFF", "60"))
+    timeout_s = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180"))
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform != 'cpu'"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return True
+            log(f"TPU preflight attempt {i + 1}/{attempts}: backend probe "
+                f"failed (rc={r.returncode})")
+        except subprocess.TimeoutExpired:
+            log(f"TPU preflight attempt {i + 1}/{attempts}: hung "
+                f">{timeout_s:.0f}s (tunnel wedged)")
+        if i + 1 < attempts:
+            time.sleep(backoff)
+    return False
 
 
-def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
-    """Returns (epochs/sec, platform, seconds/iter, loss history)."""
-    # An explicit CPU request never dials the tunnel (the probe would stall
-    # for its full timeout when the tunnel is wedged).  Same normalization
-    # as honor_cpu_env.
-    cpu_requested = (
-        os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
-    )
-    tpu_ok = not cpu_requested and _tpu_preflight()
+def tpu_measure(tpu_ok: bool) -> dict:
+    """Measure the TPU (or CPU-fallback) side.
+
+    ``tpu_ok`` is the preflight verdict (probed in ``main`` BEFORE any
+    measurement, so a wedged tunnel with a persisted result skips this
+    entirely).  Returns a dict with platform, the MATCHED workload's s/iter
+    and loss trajectory, and — on an accelerator — the headline big-slab
+    rows/sec converted to epochs/sec, plus the pallas-vs-xla sweep result.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -82,8 +115,6 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
 
     honor_cpu_env()
     if not tpu_ok:
-        if not cpu_requested:
-            log("TPU backend unavailable; falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
     try:
         devices = jax.devices()
@@ -93,45 +124,39 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
         devices = jax.devices()
     platform = devices[0].platform
     on_accel = platform not in ("cpu",)
-    rows = int(
-        os.environ.get("BENCH_ROWS", "3000000" if on_accel else "200000")
-    )
-    rows = max(2048, rows // 2048 * 2048)  # tile-align for the Pallas window
-    log(f"device: {devices[0].device_kind} ({platform}), resident rows={rows}")
+    log(f"device: {devices[0].device_kind} ({platform})")
 
     from tpu_sgd.config import SGDConfig
     from tpu_sgd.ops.gradients import LeastSquaresGradient
     from tpu_sgd.ops.updaters import SimpleUpdater
     from tpu_sgd.optimize.gradient_descent import make_run
 
-    # Generate the slab on device: no host->device transfer of the dataset.
-    key = jax.random.PRNGKey(0)
-    kx, kw, kn = jax.random.split(key, 3)
-    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    def gen_fn(rows, dtype):
+        """Device-side data generation (no host->device transfer), same
+        generating process as the CPU baseline executors."""
+        key = jax.random.PRNGKey(0)
+        kx, kw, kn = jax.random.split(key, 3)
 
-    @jax.jit
-    def gen():
-        X = jax.random.normal(kx, (rows, DIM), dtype)
-        w_true = jax.random.uniform(kw, (DIM,), jnp.float32, -1.0, 1.0)
-        y = (X.astype(jnp.float32) @ w_true
-             + 0.1 * jax.random.normal(kn, (rows,), jnp.float32))
-        return X, y
+        @jax.jit
+        def gen():
+            X = jax.random.normal(kx, (rows, DIM), dtype)
+            w_true = jax.random.uniform(kw, (DIM,), jnp.float32, -1.0, 1.0)
+            y = (X.astype(jnp.float32) @ w_true
+                 + 0.1 * jax.random.normal(kn, (rows,), jnp.float32))
+            return X, y
 
-    X, y = jax.block_until_ready(gen())
+        return gen
 
-    # "sliced" sampling: per-iteration contiguous window — sequential DMA
-    # instead of a random gather (rows here are i.i.d. by construction, so a
-    # window is exactly as random as a gather); zero-copy under Pallas.
-    cfg = SGDConfig(
-        step_size=0.5,
-        num_iterations=TPU_ITERS,
-        mini_batch_fraction=FRAC,
-        convergence_tol=0.0,
-        sampling="sliced",
-    )
-    w0 = jnp.zeros((DIM,), jnp.float32)
-
-    def time_path(name, gradient):
+    def time_run(name, gradient, X, y, iters):
+        """(total seconds, recorded losses) for one fused while_loop run."""
+        cfg = SGDConfig(
+            step_size=STEP_SIZE,
+            num_iterations=iters,
+            mini_batch_fraction=FRAC,
+            convergence_tol=0.0,
+            sampling="sliced",
+        )
+        w0 = jnp.zeros((DIM,), jnp.float32)
         run = jax.jit(make_run(gradient, SimpleUpdater(), cfg))
         t0 = time.perf_counter()
         jax.block_until_ready(run(w0, X, y))  # compile + warm
@@ -140,26 +165,52 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
         w, losses, n_rec = jax.block_until_ready(run(w0, X, y))
         dt = time.perf_counter() - t0
         losses = np.asarray(losses)[: int(n_rec)]
-        log(f"{name}: {dt * 1e3 / TPU_ITERS:.2f} ms/iter, final loss "
+        log(f"{name}: {dt * 1e3 / iters:.2f} ms/iter, final loss "
             f"{float(losses[-1]):.4f}")
         return dt, losses
 
-    # XLA-fused path vs the Pallas fused kernel (two tile sizes): keep the
-    # fastest path whose loss trajectory agrees with XLA's (the Pallas
-    # window floors the start to a tile boundary, so losses differ slightly
-    # but must stay close on i.i.d. data — a silent miscompile does not).
-    dt, losses = time_path("xla", LeastSquaresGradient())
+    out = {"platform": platform}
+
+    # --- matched-loss workload: SAME rows/process/dtype as the CPU
+    # baseline (f32 — bf16 quantization would shift the trajectory near
+    # the target crossing; bf16 belongs only to the headline slab) --------
+    Xm, ym = jax.block_until_ready(gen_fn(MATCHED_ROWS, jnp.float32)())
+    dt_m, losses_m = time_run(
+        f"matched[{MATCHED_ROWS}]", LeastSquaresGradient(), Xm, ym,
+        MATCHED_ITERS,
+    )
+    out["matched_iter_s"] = dt_m / MATCHED_ITERS
+    out["matched_losses"] = [float(x) for x in losses_m]
+    del Xm, ym
+
+    # --- headline throughput: largest resident slab ----------------------
+    rows = int(
+        os.environ.get("BENCH_ROWS", "3000000" if on_accel else str(MATCHED_ROWS))
+    )
+    rows = max(2048, rows // 2048 * 2048)  # tile-align for the Pallas window
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    log(f"headline slab: resident rows={rows}")
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+    X, y = jax.block_until_ready(gen_fn(rows, dtype)())
+    dt, losses = time_run("xla", LeastSquaresGradient(), X, y, iters)
     losses_xla = losses  # every Pallas candidate validates against XLA's
+    out["pallas"] = None
     if on_accel:
+        # XLA-fused path vs the Pallas fused kernel (two tile sizes): keep
+        # the fastest path whose loss trajectory agrees with XLA's (the
+        # Pallas window floors the start to a tile boundary, so losses
+        # differ slightly but must stay close on i.i.d. data — a silent
+        # miscompile does not).
         for tile in (2048, 8192):
             if rows % tile:
                 continue
             try:
                 from tpu_sgd.ops.pallas_kernels import PallasGradient
 
-                dt_p, losses_p = time_path(
+                dt_p, losses_p = time_run(
                     f"pallas[{tile}]",
                     PallasGradient(LeastSquaresGradient(), tile_m=tile),
+                    X, y, iters,
                 )
                 ok = len(losses_p) == len(losses_xla) and np.allclose(
                     losses_p, losses_xla, rtol=0.1
@@ -167,15 +218,23 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
                 if not ok:
                     log(f"pallas[{tile}] trajectory diverges from xla; "
                         "discarding")
-                elif dt_p < dt:
+                    continue
+                out["pallas"] = {
+                    "tile": tile,
+                    "iter_ms": dt_p * 1e3 / iters,
+                    "xla_iter_ms": dt * 1e3 / iters,
+                    "wins": bool(dt_p < dt),
+                }
+                if dt_p < dt:
                     dt, losses = dt_p, losses_p
             except Exception as e:
                 log(f"pallas[{tile}] failed ({type(e).__name__}: {e}); "
                     "skipping")
-    rows_per_sec = TPU_ITERS * FRAC * rows / dt
+    rows_per_sec = iters * FRAC * rows / dt
     eps = rows_per_sec / TARGET_ROWS
-    log(f"best: {dt * 1e3 / TPU_ITERS:.2f} ms/iter, "
+    log(f"best: {dt * 1e3 / iters:.2f} ms/iter, "
         f"{rows_per_sec / 1e6:.1f}M rows/s")
+    out["epochs_per_sec"] = eps
 
     # Diagnostic only (accelerator only — the d^2 Gram pass is minutes on
     # a starved CPU): the exact one-pass solver on the same slab (the
@@ -196,7 +255,7 @@ def tpu_epochs_per_sec() -> "tuple[float, str, float, list]":
             f"for {rows} rows (compile+first run {t_first:.1f}s)")
     except Exception as e:
         log(f"normal-equations diagnostic skipped ({type(e).__name__}: {e})")
-    return eps, platform, dt / TPU_ITERS, losses
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -224,10 +283,10 @@ def _executor(conn, part_rows, dim, seed):
     conn.close()
 
 
-def cpu_epochs_per_sec() -> "tuple[float, float, list]":
-    """Returns (epochs/sec, seconds/iter, loss history)."""
+def cpu_measure() -> dict:
+    """CPU baseline on the MATCHED workload: returns s/iter + trajectory."""
     ctx = mp.get_context("fork")  # avoid re-running sitecustomize per worker
-    part = CPU_ROWS // N_EXECUTORS
+    part = MATCHED_ROWS // N_EXECUTORS
     pipes, procs = [], []
     for i in range(N_EXECUTORS):
         a, b = ctx.Pipe()
@@ -252,62 +311,118 @@ def cpu_epochs_per_sec() -> "tuple[float, float, list]":
         total = np.sum(partial, axis=0)
         c = sum(counts)
         loss_hist.append(sum(losses) / max(c, 1))
-        w = w - 0.5 / np.sqrt(it) * (total / max(c, 1))
+        w = w - STEP_SIZE / np.sqrt(it) * (total / max(c, 1))
 
     iteration(1)  # warm the pipes/caches...
     w = np.zeros(DIM, np.float32)  # ...then restart cold from w0, like the
     loss_hist.clear()              # TPU side, so trajectories are comparable
     t0 = time.perf_counter()
-    for it in range(1, 1 + CPU_ITERS):
+    for it in range(1, 1 + MATCHED_ITERS):
         iteration(it)
     dt = time.perf_counter() - t0
     for a in pipes:
         a.send("stop")
     for p in procs:
         p.join(timeout=5)
-    rows_per_sec = CPU_ITERS * FRAC * CPU_ROWS / dt
-    log(f"cpu baseline: {dt * 1e3 / CPU_ITERS:.1f} ms/iter, "
+    rows_per_sec = MATCHED_ITERS * FRAC * MATCHED_ROWS / dt
+    log(f"cpu baseline: {dt * 1e3 / MATCHED_ITERS:.1f} ms/iter, "
         f"{rows_per_sec / 1e6:.2f}M rows/s")
-    return rows_per_sec / TARGET_ROWS, dt / CPU_ITERS, loss_hist
+    return {
+        "matched_iter_s": dt / MATCHED_ITERS,
+        "matched_losses": loss_hist,
+        "epochs_per_sec": rows_per_sec / TARGET_ROWS,
+    }
+
+
+def _first_crossing(losses, target):
+    return next((i + 1 for i, l in enumerate(losses) if l <= target), None)
+
+
+def matched_loss_speedup(cpu: dict, tpu: dict):
+    """Iters-to-pre-registered-target x s/iter, each side, on the SAME
+    (rows, dim, generating process) workload.  Returns (speedup, detail)."""
+    cpu_hit = _first_crossing(cpu["matched_losses"], TARGET_LOSS)
+    tpu_hit = _first_crossing(tpu["matched_losses"], TARGET_LOSS)
+    if cpu_hit is None or tpu_hit is None:
+        side = "cpu" if cpu_hit is None else "tpu"
+        log(f"matched-loss: {side} did not reach pre-registered target "
+            f"{TARGET_LOSS} in {MATCHED_ITERS} iters; n/a")
+        return None, None
+    cpu_t = cpu_hit * cpu["matched_iter_s"]
+    tpu_t = tpu_hit * tpu["matched_iter_s"]
+    detail = {
+        "target_loss": TARGET_LOSS,
+        "rows": MATCHED_ROWS,
+        "iters_budget": MATCHED_ITERS,
+        "cpu_hit_iter": cpu_hit,
+        "tpu_hit_iter": tpu_hit,
+        "cpu_wall_s": cpu_t,
+        "tpu_wall_s": tpu_t,
+    }
+    log(f"matched-loss: target={TARGET_LOSS} ({MATCHED_ROWS} rows both "
+        f"sides), cpu {cpu_hit} iters ({cpu_t:.2f}s) vs tpu {tpu_hit} "
+        f"iters ({tpu_t:.3f}s) -> {cpu_t / tpu_t:.1f}x wall-clock")
+    return cpu_t / tpu_t, detail
+
+
+def _report_persisted():
+    """Print the persisted last-known-good TPU result, marked stale."""
+    with open(LAST_TPU_PATH) as f:
+        record = json.load(f)
+    log(f"tunnel wedged at bench time; reporting persisted TPU result "
+        f"from {record['timestamp']}")
+    result = dict(record["result"])
+    result["note"] = (
+        f"persisted TPU measurement from {record['timestamp']}; "
+        "tunnel was wedged when the bench ran"
+    )
+    print(json.dumps(result))
 
 
 def main():
-    cpu_eps, cpu_iter_s, cpu_losses = cpu_epochs_per_sec()
-    tpu_eps, platform, tpu_iter_s, tpu_losses = tpu_epochs_per_sec()
-    # Matched-final-loss protocol (BASELINE.md): stopping rule is the first
-    # iteration whose loss <= target; both sides solve the same generating
-    # process from w0=0, so loss trajectories are comparable.  Target = the
-    # CPU baseline's final recorded loss; wall-clock = iters-to-target x
-    # per-iteration time on each side.
-    if cpu_losses and len(tpu_losses):
-        target = cpu_losses[-1]
-        # The stopping rule is symmetric: FIRST crossing on each side.
-        cpu_hit = next(
-            (i + 1 for i, l in enumerate(cpu_losses) if l <= target), None
-        )
-        tpu_hit = next(
-            (i + 1 for i, l in enumerate(tpu_losses) if l <= target), None
-        )
-        if cpu_hit is None:  # NaN trajectory (diverged baseline)
-            log("matched-loss: cpu baseline loss is non-finite; n/a")
-        elif tpu_hit is not None:
-            cpu_t = cpu_hit * cpu_iter_s
-            tpu_t = tpu_hit * tpu_iter_s
-            log(
-                f"matched-loss: target={target:.4f}, cpu {cpu_hit} "
-                f"iters ({cpu_t:.2f}s) vs tpu {tpu_hit} iters ({tpu_t:.3f}s) "
-                f"-> {cpu_t / tpu_t:.1f}x wall-clock"
-            )
-        else:
-            log(f"matched-loss: tpu did not reach target {target:.4f} in "
-                f"{len(tpu_losses)} iters (different data scale); n/a")
+    # Preflight BEFORE any measurement: a wedged tunnel with a persisted
+    # hardware result short-circuits the whole run — no pointless minutes
+    # of jax-CPU fallback compute whose result would be discarded.
+    cpu_requested = (
+        os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    )
+    tpu_ok = not cpu_requested and _tpu_preflight()
+    if not tpu_ok and not cpu_requested:
+        log("TPU backend unavailable")
+        if os.path.exists(LAST_TPU_PATH):
+            _report_persisted()
+            return
+        log("no persisted TPU result; measuring on CPU fallback")
+    cpu = cpu_measure()
+    tpu = tpu_measure(tpu_ok)
+    speedup, matched = matched_loss_speedup(cpu, tpu)
     result = {
         "metric": "sgd_epochs_per_sec_10Mx1000_dense_least_squares",
-        "value": round(tpu_eps, 4),
+        "value": round(tpu["epochs_per_sec"], 4),
         "unit": "epochs/sec",
-        "vs_baseline": round(tpu_eps / cpu_eps, 2) if cpu_eps > 0 else None,
+        "vs_baseline": (
+            round(tpu["epochs_per_sec"] / cpu["epochs_per_sec"], 2)
+            if cpu["epochs_per_sec"] > 0 else None
+        ),
     }
-    log(f"platform={platform}, cpu_baseline={cpu_eps:.4f} epochs/sec")
+    if speedup is not None:
+        result["matched_loss_speedup"] = round(speedup, 2)
+    log(f"platform={tpu['platform']}, "
+        f"cpu_baseline={cpu['epochs_per_sec']:.4f} epochs/sec")
+
+    if tpu["platform"] != "cpu":
+        # Persist the hardware measurement IMMEDIATELY (VERDICT r1 #1):
+        # the tunnel may be wedged the next time anything runs.
+        record = {
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "result": result,
+            "platform": tpu["platform"],
+            "matched": matched,
+            "pallas": tpu.get("pallas"),
+        }
+        with open(LAST_TPU_PATH, "w") as f:
+            json.dump(record, f, indent=1)
+        log(f"persisted TPU result to {LAST_TPU_PATH}")
     print(json.dumps(result))
 
 

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-long TPU tunnel watcher (VERDICT r1 #1: "re-probe every ~10 min from
+# a killable subprocess, run the moment the tunnel answers").
+#
+# Probes the axon tunnel from a timeout-wrapped child process; the moment it
+# answers, runs the kernel sweep and the full benchmark (which persists its
+# hardware result to BENCH_LAST_TPU.json immediately), then keeps watching
+# so a later, healthier tunnel can refresh the numbers.
+#
+# Usage: nohup bash scripts/tpu_watch.sh >> tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-300}"
+SLEEP_BETWEEN="${SLEEP_BETWEEN:-300}"
+MAX_HOURS="${MAX_HOURS:-11}"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+ran_bench=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if timeout "$PROBE_TIMEOUT" python -c \
+      "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "[$(date +%H:%M:%S)] TUNNEL ALIVE"
+    echo "[$(date +%H:%M:%S)] kernel sweep:"
+    timeout 1800 python bench_kernels.py 2>&1 | tee kernels_tpu.log
+    echo "[$(date +%H:%M:%S)] full bench:"
+    BENCH_TPU_RETRIES=2 BENCH_TPU_BACKOFF=30 \
+      timeout 2400 python bench.py 2>&1
+    ran_bench=1
+    echo "[$(date +%H:%M:%S)] bench attempt done (see BENCH_LAST_TPU.json)"
+    # One successful capture is the deliverable; after that, re-check only
+    # hourly in case a healthier tunnel can improve the numbers.
+    sleep 3600
+  else
+    echo "[$(date +%H:%M:%S)] tunnel wedged (probe >${PROBE_TIMEOUT}s or failed)"
+    sleep "$SLEEP_BETWEEN"
+  fi
+done
+echo "[$(date +%H:%M:%S)] watcher deadline reached (ran_bench=$ran_bench)"

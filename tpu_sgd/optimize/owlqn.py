@@ -74,6 +74,10 @@ class OWLQN(LBFGS):
     the L1 penalty, matching upstream's zero intercept L1 strength.
     """
 
+    #: deeper backtracking than plain LBFGS: orthant projection can zero
+    #: out most of a large step, so more halvings are worth trying
+    _LS_TRIALS = 30
+
     def __init__(
         self,
         gradient: Gradient = None,
@@ -139,7 +143,7 @@ class OWLQN(LBFGS):
             return _smooth(wv, *data_args)
 
         any_penalty = self.reg_param > 0
-        n_ls = 30
+        n_ls = self._LS_TRIALS  # inherited ladder-length knob (see LBFGS)
         ladder = np.asarray(0.5 ** np.arange(n_ls), np.float32)
         swept = hasattr(gradient, "pointwise")
         if swept:
