@@ -174,19 +174,24 @@ class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
         import jax.numpy as jnp
 
         from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+        from tpu_sgd.ops.sparse import (append_bias_auto, is_sparse,
+                                        row_matrix_bcoo)
 
-        X = jnp.asarray(X)
+        sparse = is_sparse(X)
+        if not sparse:
+            X = jnp.asarray(X)
         single = X.ndim == 1
-        Xb = jnp.atleast_2d(X)
+        if sparse:
+            Xb = row_matrix_bcoo(X)
+        else:
+            Xb = jnp.atleast_2d(X)
         expect = self.num_features - (1 if self.has_intercept_column else 0)
         if Xb.shape[-1] != expect:
             raise ValueError(
                 f"expected {expect}-feature input, got {Xb.shape[-1]}"
             )
         if self.has_intercept_column:
-            Xb = jnp.concatenate(
-                [Xb, jnp.ones((Xb.shape[0], 1), Xb.dtype)], axis=-1
-            )
+            Xb = append_bias_auto(Xb)
         g = MultinomialLogisticGradient(self.num_classes)
         out = g.predict_class(Xb, self.weights)
         return out[0] if single else out
@@ -261,13 +266,14 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
                 from tpu_sgd.models.labeled_point import to_arrays
 
                 X, y = to_arrays(data)
-            from tpu_sgd.utils.mlutils import append_bias
+            from tpu_sgd.ops.sparse import append_bias_auto, is_sparse
 
-            X = np.asarray(X)
+            if not is_sparse(X):
+                X = np.asarray(X)
             if X.shape[0] == 0:
                 raise ValueError("empty input")
             d = X.shape[1]
-            X = append_bias(X)
+            X = append_bias_auto(X)
             self.num_features = X.shape[1]
             K = self.num_classes
             if initial_weights is None:

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
+from tpu_sgd.ops.sparse import append_bias_auto, is_sparse, row_matrix_bcoo
 from tpu_sgd.optimize.optimizer import Optimizer
 
 DatasetLike = Union[Tuple, Iterable[LabeledPoint]]
@@ -25,6 +26,8 @@ DatasetLike = Union[Tuple, Iterable[LabeledPoint]]
 def _as_arrays(data: DatasetLike) -> Tuple[np.ndarray, np.ndarray]:
     if isinstance(data, tuple) and len(data) == 2:
         X, y = data
+        if is_sparse(X):  # BCOO features pass through undensified
+            return X, np.asarray(y)
         return np.asarray(X), np.asarray(y)
     return to_arrays(data)
 
@@ -37,11 +40,15 @@ class GeneralizedLinearModel:
         self.intercept = float(intercept)
 
     def _margin(self, X):
-        X = jnp.asarray(X)
+        if not is_sparse(X):
+            X = jnp.asarray(X)
         return X @ self.weights + self.intercept
 
     def predict_margin(self, X):
-        """Raw margin(s) ``x.w + b`` for a single vector or a batch."""
+        """Raw margin(s) ``x.w + b`` for a single vector or a batch; always
+        returns a batch-shaped result (a single vector yields shape (1,))."""
+        if is_sparse(X):
+            return self._margin(row_matrix_bcoo(X))
         return self._margin(jnp.atleast_2d(jnp.asarray(X)))
 
     def predict_point(self, margin):
@@ -49,10 +56,12 @@ class GeneralizedLinearModel:
 
     def predict(self, X):
         """Predict for one feature vector or a batch (parity with the
-        reference's ``predict(Vector)`` / ``predict(RDD[Vector])``)."""
-        X = jnp.asarray(X)
+        reference's ``predict(Vector)`` / ``predict(RDD[Vector])``); accepts
+        dense arrays or sparse (BCOO) features."""
+        if not is_sparse(X):
+            X = jnp.asarray(X)
         single = X.ndim == 1
-        out = self.predict_point(self._margin(jnp.atleast_2d(X)))
+        out = self.predict_point(self.predict_margin(X))
         return out[0] if single else out
 
     def __repr__(self):
@@ -111,11 +120,9 @@ class GeneralizedLinearAlgorithm:
             initial_weights = np.zeros((self._weight_dim(),), np.float32)
         w0 = np.asarray(initial_weights, np.float32)
         if self.add_intercept:
-            from tpu_sgd.utils.mlutils import append_bias
-
             # Bias appended as the LAST column ([U] MLUtils.appendBias;
             # SURVEY.md §3.1 intercept prepend/split).
-            Xb = append_bias(X)
+            Xb = append_bias_auto(X)
             w0 = np.concatenate([w0, np.asarray([initial_intercept], np.float32)])
             weights = self.optimizer.optimize((Xb, y), w0)
             intercept = float(weights[-1])

@@ -46,8 +46,12 @@ class StreamingLinearAlgorithm:
         return self
 
     def train_on_batch(self, X, y) -> GeneralizedLinearModel:
-        """One micro-batch update (the body of the reference's foreachRDD)."""
-        X = np.asarray(X)
+        """One micro-batch update (the body of the reference's foreachRDD);
+        accepts dense or sparse (BCOO) feature batches."""
+        from tpu_sgd.ops.sparse import is_sparse
+
+        if not is_sparse(X):
+            X = np.asarray(X)
         if X.shape[0] == 0:  # reference skips empty RDDs
             return self.model
         self.model = self.algorithm.run_warm((X, np.asarray(y)), self.model)

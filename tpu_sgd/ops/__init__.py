@@ -6,6 +6,15 @@ from tpu_sgd.ops.gradients import (
     MultinomialLogisticGradient,
 )
 from tpu_sgd.ops.pallas_kernels import PallasGradient, fused_gradient_sums
+from tpu_sgd.ops.sparse import (
+    append_bias_auto,
+    append_bias_bcoo,
+    csr_to_bcoo,
+    is_sparse,
+    load_libsvm_file_bcoo,
+    row_matrix_bcoo,
+    sparse_data,
+)
 from tpu_sgd.ops.updaters import (
     L1Updater,
     SimpleUpdater,
@@ -21,6 +30,13 @@ __all__ = [
     "MultinomialLogisticGradient",
     "PallasGradient",
     "fused_gradient_sums",
+    "is_sparse",
+    "csr_to_bcoo",
+    "load_libsvm_file_bcoo",
+    "append_bias_bcoo",
+    "append_bias_auto",
+    "row_matrix_bcoo",
+    "sparse_data",
     "Updater",
     "SimpleUpdater",
     "L1Updater",

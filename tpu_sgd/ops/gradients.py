@@ -32,6 +32,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from tpu_sgd.ops.sparse import is_sparse as _is_sparse
+
 Array = jax.Array
 
 
@@ -49,6 +51,40 @@ def acc_dtype(mm_dtype):
     never narrower than the inputs — f64 data under ``jax_enable_x64`` keeps
     f64 accumulation instead of being silently downcast to f32."""
     return jnp.promote_types(mm_dtype, jnp.float32)
+
+
+def margins_of(X, weights):
+    """``X @ w`` (or ``X @ Wᵀ`` for matrix trial/class weights) with the
+    mixed-precision matmul contract on dense features and the BCOO
+    gather/segment-sum lowering on sparse ones.
+
+    Sparse path note: with ~0.1% nnz the matmul FLOPs are negligible, so the
+    bf16 HBM-traffic argument doesn't apply — sparse compute runs at the
+    accumulation dtype (>= f32; int one-hot data promotes instead of
+    truncating the weights)."""
+    rhs = weights.T if weights.ndim == 2 else weights
+    if _is_sparse(X):
+        cd = acc_dtype(matmul_dtype(X))
+        return X.astype(cd) @ rhs.astype(cd)
+    mm_dtype = matmul_dtype(X)
+    return jnp.dot(
+        X.astype(mm_dtype), rhs.astype(mm_dtype),
+        preferred_element_type=acc_dtype(mm_dtype),
+    )
+
+
+def grad_sum_of(coeff, X):
+    """``coeffᵀ @ X`` (the gradient-sum matvec / matmul), sparse-aware; the
+    dense path is written ``coeff @ X`` so it stays row-major friendly."""
+    lhs = coeff.T if coeff.ndim == 2 else coeff
+    if _is_sparse(X):
+        cd = acc_dtype(matmul_dtype(X))
+        return lhs.astype(cd) @ X.astype(cd)
+    mm_dtype = matmul_dtype(X)
+    return jnp.dot(
+        lhs.astype(mm_dtype), X.astype(mm_dtype),
+        preferred_element_type=acc_dtype(mm_dtype),
+    )
 
 
 class Gradient:
@@ -94,11 +130,7 @@ class Gradient:
         pass the mesh axis to all-reduce those partials into full margins.
         The returned grad_sum is then the local feature block's gradient.
         """
-        mm_dtype = matmul_dtype(X)
-        margins = jnp.dot(
-            X.astype(mm_dtype), weights.astype(mm_dtype),
-            preferred_element_type=acc_dtype(mm_dtype),
-        )
+        margins = margins_of(X, weights)
         if margin_axis_name is not None:
             margins = jax.lax.psum(margins, margin_axis_name)
         coeff, losses = self.pointwise(margins, y)
@@ -109,10 +141,7 @@ class Gradient:
             count = jnp.sum(m)
         else:
             count = jnp.asarray(X.shape[0], margins.dtype)
-        grad_sum = jnp.dot(  # == X.T @ coeff, row-major friendly
-            coeff.astype(mm_dtype), X.astype(mm_dtype),
-            preferred_element_type=acc_dtype(mm_dtype),
-        )
+        grad_sum = grad_sum_of(coeff, X)  # == X.T @ coeff
         loss_sum = jnp.sum(losses)
         return grad_sum, loss_sum, count
 
@@ -132,6 +161,11 @@ class Gradient:
         implementation slices and reuses :meth:`batch_sums`.  PallasGradient
         overrides this with a zero-copy offset kernel.
         """
+        if _is_sparse(X):
+            raise NotImplementedError(
+                "sliced sampling needs a dense row layout; use bernoulli "
+                "sampling with sparse (BCOO) features"
+            )
         Xb, yb, mask = _slice_window(X, y, valid, start, m)
         return self.batch_sums(
             Xb, yb, weights, mask, margin_axis_name=margin_axis_name
@@ -218,11 +252,8 @@ class MultinomialLogisticGradient:
     ) -> Tuple[Array, Array, Array]:
         K = self.num_classes
         W = weights.reshape(K - 1, X.shape[-1])
-        mm_dtype = matmul_dtype(X)
-        margins = jnp.dot(  # (n, K-1); partial if features are sharded
-            X.astype(mm_dtype), W.T.astype(mm_dtype),
-            preferred_element_type=acc_dtype(mm_dtype),
-        )
+        # (n, K-1); partial if features are sharded
+        margins = margins_of(X, W)
         if margin_axis_name is not None:
             margins = jax.lax.psum(margins, margin_axis_name)
         logits = jnp.concatenate(
@@ -241,10 +272,7 @@ class MultinomialLogisticGradient:
             count = jnp.sum(m)
         else:
             count = jnp.asarray(X.shape[0], margins.dtype)
-        grad_sum = jnp.dot(
-            coeff.T.astype(mm_dtype), X.astype(mm_dtype),
-            preferred_element_type=acc_dtype(mm_dtype),
-        ).reshape(-1)  # flattened (K-1)*D
+        grad_sum = grad_sum_of(coeff, X).reshape(-1)  # flattened (K-1)*D
         return grad_sum, jnp.sum(losses), count
 
     # Same window contract as the vector-weight gradients (duck-typed: only

@@ -340,7 +340,12 @@ class PallasGradient(Gradient):
             return False
 
     def batch_sums(self, X, y, weights, mask=None, margin_axis_name=None):
-        if margin_axis_name is not None or not self._use_kernel():
+        from tpu_sgd.ops.sparse import is_sparse
+
+        if (margin_axis_name is not None or is_sparse(X)
+                or not self._use_kernel()):
+            # BCOO features take the base path's sparse lowering — the
+            # Mosaic kernel needs a dense row layout.
             return self.base.batch_sums(
                 X, y, weights, mask, margin_axis_name=margin_axis_name
             )
@@ -357,9 +362,12 @@ class PallasGradient(Gradient):
 
     def window_sums(self, X, y, weights, start, m, valid=None,
                     margin_axis_name=None):
+        from tpu_sgd.ops.sparse import is_sparse
+
         n = X.shape[0]
         usable = (
-            self._use_kernel()
+            not is_sparse(X)
+            and self._use_kernel()
             and margin_axis_name is None
             and valid is None
             and m >= self.tile_m

@@ -44,6 +44,43 @@ def is_sparse(X) -> bool:
         return False
 
 
+def row_matrix_bcoo(x):
+    """1-D BCOO feature vector -> unbatched ``(1, d)`` BCOO row matrix.
+
+    ``BCOO.reshape`` would return a *batched* layout (leading batch dim on
+    data/indices) that the unbatched consumers (``append_bias_bcoo``, the
+    matvec paths) don't accept; this builds the plain 2-D layout directly."""
+    from jax.experimental.sparse import BCOO
+
+    if x.ndim != 1:
+        return x
+    nse = x.data.shape[0]
+    idx = jnp.concatenate(
+        [jnp.zeros((nse, 1), x.indices.dtype), x.indices], axis=1
+    )
+    return BCOO((x.data, idx), shape=(1, x.shape[0]))
+
+
+def append_bias_auto(X):
+    """Sparse-aware ``MLUtils.appendBias`` dispatch: BCOO features get the
+    sparse bias column, everything else the dense one."""
+    if is_sparse(X):
+        return append_bias_bcoo(X)
+    from tpu_sgd.utils.mlutils import append_bias
+
+    return append_bias(X)
+
+
+def reject_sparse_mesh(X, who: str) -> None:
+    """Shared optimizer guard: mesh sharding needs dense row layouts
+    (per-shard nse varies), so sparse features train single-device."""
+    if is_sparse(X):
+        raise NotImplementedError(
+            f"{who}: mesh sharding needs dense row layouts (per-shard nse "
+            "varies); sparse (BCOO) features train single-device"
+        )
+
+
 def csr_to_bcoo(csr: Tuple, num_features: int, dtype=jnp.float32):
     """Build a BCOO matrix from the loader's scipy-free CSR triple
     ``(data, indices, indptr)`` (``load_libsvm_file(dense=False)``)."""

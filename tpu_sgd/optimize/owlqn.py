@@ -126,6 +126,9 @@ class OWLQN(LBFGS):
         mesh = self.mesh
         valid = None
         if mesh is not None:
+            from tpu_sgd.ops.sparse import reject_sparse_mesh
+
+            reject_sparse_mesh(X, type(self).__name__)
             from tpu_sgd.parallel.data_parallel import shard_dataset
 
             X, y, valid = shard_dataset(mesh, X, y)
