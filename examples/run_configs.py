@@ -37,6 +37,17 @@ from tpu_sgd import (  # noqa: E402
     SVMWithSGD,
     data_mesh,
 )
+from tpu_sgd.optimize.oracle import (  # noqa: E402
+    hinge_l1_oracle,
+    least_squares_oracle,
+    logistic_l2_oracle,
+    objective_gap,
+)
+from tpu_sgd.ops.gradients import (  # noqa: E402
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+)
 from tpu_sgd.utils import (  # noqa: E402
     linear_data,
     load_libsvm_file,
@@ -73,10 +84,17 @@ def config1():
     X, y, w_true = linear_data(n, d, eps=0.1, seed=0)
     t0 = time.perf_counter()
     model = LinearRegressionWithSGD.train((X, y), num_iterations=100,
-                                          step_size=0.5)
+                                          step_size=1.0)
     mse = float(np.mean((np.asarray(model.predict(X)) - y) ** 2))
+    # BASELINE.md pass criterion: final loss matches the EXACT oracle
+    # (normal equations) within 1%
+    gap, L, L_star = objective_gap(
+        LeastSquaresGradient(), X, y, model.weights,
+        least_squares_oracle(X, y))
+    verdict = "PASS" if gap < 0.01 else "FAIL"
     print(f"config1: n={n} d={d} mse={mse:.4f} "
           f"w_err={float(np.linalg.norm(np.asarray(model.weights) - w_true)):.4f} "
+          f"oracle_gap={gap * 100:.2f}% [{verdict} <1%] "
           f"({time.perf_counter() - t0:.1f}s)")
 
 
@@ -110,11 +128,20 @@ def config2():
     X, y = load_libsvm_file(path)
     y = np.where(y > 0, 1.0, 0.0).astype(np.float32)  # a9a labels are +/-1
     t0 = time.perf_counter()
-    model = LogisticRegressionWithSGD.train((X, y), num_iterations=100,
-                                            reg_param=0.01, intercept=True)
+    reg = 0.01
+    alg = LogisticRegressionWithSGD(2.0, 500, reg, 1.0)
+    alg.optimizer.set_convergence_tol(0.0)  # run the full budget
+    model = alg.run((X, y))
     acc = float(np.mean(np.asarray(model.predict(X)) == y))
+    # BASELINE.md pass criterion: matches a tight-tolerance LBFGS oracle
+    # on the same (unbiased) objective within 1%
+    gap, L, L_star = objective_gap(
+        LogisticGradient(), X, y, model.weights,
+        logistic_l2_oracle(X, y, reg), reg, "l2")
+    verdict = "PASS" if gap < 0.01 else "FAIL"
     print(f"config2: libsvm={os.path.basename(path)} ({kind}) "
           f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
+          f"oracle_gap={gap * 100:.2f}% [{verdict} <1%] "
           f"({time.perf_counter() - t0:.1f}s)")
 
 
@@ -126,12 +153,27 @@ def config3():
     X, y = load_libsvm_file(path, dense=True)  # sparse -> densified
     y = np.where(y > 0, 1.0, 0.0).astype(np.float32)
     t0 = time.perf_counter()
-    model = SVMWithSGD.train((X, y), num_iterations=100, reg_param=0.01,
-                             updater=L1Updater())
+    reg = 1e-4
+    alg = SVMWithSGD(10.0, 3000, reg, 1.0)
+    alg.optimizer.set_updater(L1Updater()).set_convergence_tol(0.0)
+    model = alg.run((X, y))
     acc = float(np.mean(np.asarray(model.predict(X)) == y))
+    # Subgradient descent is O(1/sqrt(t)) on the nonsmooth hinge (the
+    # reference's SVMWithSGD has the same rate), so the criterion is a
+    # documented 20% objective bound vs the tight OWL-QN reference point
+    # plus accuracy parity (see tpu_sgd/optimize/oracle.py)
+    w_star = hinge_l1_oracle(X, y, reg)
+    gap, L, L_star = objective_gap(
+        HingeGradient(), X, y, model.weights, w_star, reg, "l1")
+    from tpu_sgd.models.classification import SVMModel
+
+    acc_star = float(np.mean(np.asarray(SVMModel(w_star, 0.0).predict(X)) == y))
+    ok = gap < 0.20 and acc > acc_star - 0.01
+    verdict = "PASS" if ok else "FAIL"
     print(f"config3: libsvm={os.path.basename(path)} ({kind}) "
           f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
-          f"({time.perf_counter() - t0:.1f}s)")
+          f"(oracle acc={acc_star:.4f}) oracle_gap={gap * 100:.1f}% "
+          f"[{verdict} <20%+acc] ({time.perf_counter() - t0:.1f}s)")
 
 
 def config4():

@@ -197,3 +197,20 @@ def test_pallas_window_sums_subtile_remainder():
                                atol=2e-3)
     np.testing.assert_allclose(float(ls), float(ls_ref), rtol=2e-4)
     assert float(c) == m
+
+
+def test_vmem_guard_rejects_oversized_tile():
+    """Tiles whose double-buffered footprint cannot compile raise an
+    actionable error instead of a Mosaic scoped-VMEM OOM (seen on hardware
+    at tile 8192 x d=1000 bf16 = 40 MB vs the 16 MB budget)."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.ops.pallas_kernels import fused_window_sums
+
+    n, d = 16384, 1000
+    X = jnp.zeros((n, d), jnp.bfloat16)
+    y = jnp.zeros((n,), jnp.float32)
+    w = jnp.zeros((d,), jnp.float32)
+    g = LeastSquaresGradient()
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_window_sums(g.pointwise, X, y, w, 0, 2, tile_m=8192)

@@ -39,7 +39,18 @@ Two variants share the tile body:
 
 Exposed as :class:`PallasGradient`, a drop-in wrapper satisfying the
 ``Gradient`` contract so it slots behind the same optimizer boundary (falls
-back to the XLA path off-TPU or for feature-sharded runs).
+back to the XLA path off-TPU, for sparse features, or for feature-sharded
+runs).
+
+**Status: opt-in experiment — XLA won on hardware.**  Measured on a real
+TPU v5 lite (round 2, 3M x 1000 bf16 window workload, BASELINE.md):
+XLA's sliced ``Gradient.window_sums`` runs 3.87 ms/iter vs this kernel's
+6.32 ms/iter at tile 2048 (micro-sweep 0.054 ms vs 0.089 ms per window),
+with the trajectory cross-check green — the kernel is correct, just
+slower: XLA already fuses the two MXU matvecs with the elementwise ops and
+saturates HBM bandwidth at this arithmetic intensity.  Per SURVEY.md §2's
+native-component ledger the XLA-compiled fused matvec IS the TPU-native
+analogue of the reference's JNI BLAS; nothing routes here by default.
 """
 
 from __future__ import annotations
@@ -51,10 +62,41 @@ import jax
 import jax.numpy as jnp
 
 from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.sparse import is_sparse
 
 Array = jax.Array
 
 SUBLANES = 8  # f32 sublane count: the weight/coefficient blocks' lane dim
+
+#: scoped-VMEM stack budget per kernel observed on TPU v5e (the compiler
+#: rejects kernels over ~16 MB of scoped allocation); keep headroom below it
+_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def _check_tile_vmem(tile: int, X, interpret: bool) -> None:
+    """Reject tile sizes whose double-buffered VMEM footprint cannot compile
+    (measured: tile 8192 x d=1000 bf16 = 40 MB scoped vs the 16 MB limit)
+    with an actionable error instead of a Mosaic compile-time OOM."""
+    if interpret:
+        return
+    d = X.shape[1]
+    itemsize = jnp.dtype(X.dtype).itemsize
+    # X tile double-buffered + y/mask tiles + the (8, d) f32 accumulator
+    need = 2 * tile * d * itemsize + 4 * tile * 4 + SUBLANES * d * 4
+    if need > _VMEM_BUDGET:
+        per_tile = 2 * d * itemsize + 16
+        max_tile = (_VMEM_BUDGET - SUBLANES * d * 4) // per_tile // 8 * 8
+        hint = (
+            f"use tile_m <= {max_tile}"
+            if max_tile >= 8
+            else f"feature dim d={d} is too wide for this kernel at any "
+            "tile size; use the XLA path"
+        )
+        raise ValueError(
+            f"tile_m={tile} with d={d} {jnp.dtype(X.dtype).name} needs "
+            f"~{need / 2**20:.0f} MB of double-buffered VMEM, over the "
+            f"~{_VMEM_BUDGET / 2**20:.0f} MB scoped budget; {hint}"
+        )
 
 
 try:  # pallas is TPU/Mosaic-specific; keep the module importable anywhere
@@ -158,6 +200,7 @@ def fused_gradient_sums(
     zero-padded to a tile multiple; padding is excluded via the mask.
     """
     _require_pallas()
+    _check_tile_vmem(min(tile_m, max(8, X.shape[0])), X, interpret)
     return _fused_gradient_sums(
         pointwise, X, y, w, mask, tile_m=tile_m, interpret=interpret
     )
@@ -239,6 +282,7 @@ def fused_window_sums(
     ``count = num_tiles * tile_m``.
     """
     _require_pallas()
+    _check_tile_vmem(tile_m, X, interpret)
     return _fused_window_sums(
         pointwise, X, y, w, start_tile,
         num_tiles=num_tiles, tile_m=tile_m, interpret=interpret,
@@ -340,8 +384,6 @@ class PallasGradient(Gradient):
             return False
 
     def batch_sums(self, X, y, weights, mask=None, margin_axis_name=None):
-        from tpu_sgd.ops.sparse import is_sparse
-
         if (margin_axis_name is not None or is_sparse(X)
                 or not self._use_kernel()):
             # BCOO features take the base path's sparse lowering — the
@@ -362,8 +404,6 @@ class PallasGradient(Gradient):
 
     def window_sums(self, X, y, weights, start, m, valid=None,
                     margin_axis_name=None):
-        from tpu_sgd.ops.sparse import is_sparse
-
         n = X.shape[0]
         usable = (
             not is_sparse(X)
