@@ -21,9 +21,12 @@ matmuls: with ~0.1% nnz the arithmetic is negligible and the win is the
 ~4.7M nse = ~56 MB).
 
 Supported surface: Bernoulli sampling (the reference-parity mode), all
-vector-weight gradients, GradientDescent / LBFGS / OWLQN, intercept via
-``append_bias_bcoo``.  Sliced/indexed sampling, host streaming, and mesh
-sharding require dense row layouts and raise clear errors.
+gradients, GradientDescent / LBFGS / OWLQN — single-device AND data-
+parallel over a 1-D mesh (equal-nse per-shard blocks,
+tpu_sgd/parallel/sparse_parallel.py — the distributed-sparse
+treeAggregate analogue).  Sliced/indexed sampling, host streaming,
+feature-axis ('model') sharding, and NormalEquations need dense row
+layouts and raise clear errors.
 """
 
 from __future__ import annotations
@@ -69,16 +72,6 @@ def append_bias_auto(X):
     from tpu_sgd.utils.mlutils import append_bias
 
     return append_bias(X)
-
-
-def reject_sparse_mesh(X, who: str) -> None:
-    """Shared optimizer guard: mesh sharding needs dense row layouts
-    (per-shard nse varies), so sparse features train single-device."""
-    if is_sparse(X):
-        raise NotImplementedError(
-            f"{who}: mesh sharding needs dense row layouts (per-shard nse "
-            "varies); sparse (BCOO) features train single-device"
-        )
 
 
 def csr_to_bcoo(csr: Tuple, num_features: int, dtype=jnp.float32):

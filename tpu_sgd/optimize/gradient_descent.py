@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from tpu_sgd.config import SGDConfig
 from tpu_sgd.ops.gradients import Gradient, LeastSquaresGradient
-from tpu_sgd.ops.sparse import is_sparse, reject_sparse_mesh
+from tpu_sgd.ops.sparse import is_sparse
 from tpu_sgd.ops.updaters import SimpleUpdater, Updater
 from tpu_sgd.optimize.optimizer import Dataset, Optimizer
 
@@ -363,8 +363,12 @@ class GradientDescent(Optimizer):
                     "host streaming needs dense rows; BCOO features are "
                     "~1000x smaller and stay device-resident instead"
                 )
-            if self.mesh is not None:
-                reject_sparse_mesh(X, type(self).__name__)
+            if self.mesh is not None and self._mesh_kind() == "dp_mp":
+                raise NotImplementedError(
+                    "feature-axis ('model') sharding needs dense column "
+                    "blocks; sparse (BCOO) features support 1-D 'data' "
+                    "meshes"
+                )
             if (self.config.sampling != "bernoulli"
                     and self.config.mini_batch_fraction < 1.0):
                 raise NotImplementedError(
@@ -424,8 +428,36 @@ class GradientDescent(Optimizer):
                 "The miniBatchFraction is too small", RuntimeWarning, stacklevel=2
             )
         if self.listener is not None or self.checkpoint_manager is not None:
+            if sparse_X and self.mesh is not None:
+                raise NotImplementedError(
+                    "listener/checkpoint mode with sparse features runs "
+                    "single-device; drop the mesh or the observer"
+                )
             return self._optimize_stepwise(X, y, w0)
-        if self.mesh is not None and self._mesh_kind() == "dp_mp":
+        if sparse_X and self.mesh is not None:
+            # Distributed sparse: equal-nse BCOO blocks per shard, same
+            # make_run body, psum over ICI (the treeAggregate-over-sparse-
+            # partitions analogue — see parallel/sparse_parallel.py).
+            from tpu_sgd.parallel.sparse_parallel import (
+                shard_bcoo,
+                sparse_dp_run_fn,
+            )
+
+            data, idx, yd, valid, rows_local, d = shard_bcoo(self.mesh, X, y)
+            with_valid = valid is not None
+            key = ("sparse_run", self.gradient, self.updater, self.config,
+                   self.mesh, rows_local, d, with_valid)
+            fn = self._run_cache.get(key)
+            if fn is None:
+                fn = sparse_dp_run_fn(self.gradient, self.updater,
+                                      self.config, self.mesh, rows_local, d,
+                                      with_valid)
+                self._run_cache[key] = fn
+            if with_valid:
+                w, losses, n_rec = fn(w0, data, idx, yd, valid)
+            else:
+                w, losses, n_rec = fn(w0, data, idx, yd)
+        elif self.mesh is not None and self._mesh_kind() == "dp_mp":
             from tpu_sgd.parallel.model_parallel import dp_mp_optimize
 
             if self.gradient.weight_dim(X.shape[1]) != X.shape[1]:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_sgd.ops.gradients import Gradient, margins_of
-from tpu_sgd.ops.sparse import is_sparse, reject_sparse_mesh
+from tpu_sgd.ops.sparse import is_sparse
 from tpu_sgd.ops.updaters import (
     L1Updater,
     SimpleUpdater,
@@ -77,29 +77,47 @@ def _coerce_inputs(X, y, w):
     return X, y, w
 
 
-def _wrap_mesh(mesh, body, n_weight_args, with_valid, n_outs):
+def _wrap_mesh(mesh, body, n_weight_args, with_valid, n_outs,
+               sparse=False):
     """Jit ``body`` — plain, or shard_mapped over the 1-D data mesh with
     the first ``n_weight_args`` args replicated and (X, y[, valid]) row-
-    sharded; outputs replicated (the psum inside ``body`` makes them so)."""
+    sharded; outputs replicated (the psum inside ``body`` makes them so).
+    ``sparse``: X arrives as sharded BCOO component arrays ``(data, idx)``
+    (see parallel/sparse_parallel.py) instead of a dense row block."""
     if mesh is None:
         return jax.jit(body)
     from jax.sharding import PartitionSpec as P
 
     from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
 
-    in_specs = (P(),) * n_weight_args + (P(DATA_AXIS, None), P(DATA_AXIS))
+    x_spec = (
+        (P(DATA_AXIS), P(DATA_AXIS, None)) if sparse else P(DATA_AXIS, None)
+    )
+    in_specs = (P(),) * n_weight_args + (x_spec, P(DATA_AXIS))
     if with_valid:
         in_specs = in_specs + (P(DATA_AXIS),)
     out_specs = P() if n_outs == 1 else (P(),) * n_outs
     return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
 
 
-def _build_cost(gradient, reg_value, reg_grad, mesh, with_valid):
+def _maybe_bcoo(X, sparse_shape):
+    """Reassemble a shard's ``(data, idx)`` components into its local BCOO
+    block inside the shard_map body; dense X passes through."""
+    if sparse_shape is None:
+        return X
+    from tpu_sgd.parallel.sparse_parallel import local_bcoo
+
+    return local_bcoo(X[0], X[1], *sparse_shape)
+
+
+def _build_cost(gradient, reg_value, reg_grad, mesh, with_valid,
+                sparse_shape=None):
     """``cost(w, X, y[, valid]) -> (f, g)``: full objective and gradient,
     one fused pass, psum'd per shard under a mesh (the treeAggregate-CostFun
     analogue)."""
 
     def body(w, X, y, valid=None):
+        X = _maybe_bcoo(X, sparse_shape)
         g_sum, l_sum, c = gradient.batch_sums(X, y, w, mask=valid)
         if mesh is not None:
             from tpu_sgd.parallel.mesh import DATA_AXIS
@@ -110,16 +128,19 @@ def _build_cost(gradient, reg_value, reg_grad, mesh, with_valid):
     if not with_valid:  # fixed arity for shard_map specs
         full = body
         body = lambda w, X, y: full(w, X, y)
-    return _wrap_mesh(mesh, body, 1, with_valid, 2)
+    return _wrap_mesh(mesh, body, 1, with_valid, 2,
+                      sparse=sparse_shape is not None)
 
 
-def _build_loss_only(gradient, reg_value, mesh, with_valid):
+def _build_loss_only(gradient, reg_value, mesh, with_valid,
+                     sparse_shape=None):
     """``loss(w, X, y[, valid]) -> f``: objective WITHOUT the gradient as a
     compiled output, so XLA dead-code-eliminates the ``coeffᵀ @ X`` matmul —
     half the HBM traffic of the fused cost.  Used for line-search trials of
     matrix-weight gradients (``cost(...)[0]`` would keep the matmul live)."""
 
     def body(w, X, y, valid=None):
+        X = _maybe_bcoo(X, sparse_shape)
         _, l_sum, c = gradient.batch_sums(X, y, w, mask=valid)
         if mesh is not None:
             from tpu_sgd.parallel.mesh import DATA_AXIS
@@ -130,10 +151,12 @@ def _build_loss_only(gradient, reg_value, mesh, with_valid):
     if not with_valid:
         full = body
         body = lambda w, X, y: full(w, X, y)
-    return _wrap_mesh(mesh, body, 1, with_valid, 1)
+    return _wrap_mesh(mesh, body, 1, with_valid, 1,
+                      sparse=sparse_shape is not None)
 
 
-def _build_loss_sweep(gradient, reg_value, mesh, with_valid):
+def _build_loss_sweep(gradient, reg_value, mesh, with_valid,
+                      sparse_shape=None):
     """``sweep(W, X, y[, valid]) -> (T,)`` objective values of T trial
     weight vectors in ONE fused pass: ``margins = X @ Wᵀ`` is a single MXU
     matmul reading X once for the entire backtracking ladder, vs T separate
@@ -141,6 +164,7 @@ def _build_loss_sweep(gradient, reg_value, mesh, with_valid):
     gradients only (vector weights)."""
 
     def body(W, X, y, valid=None):
+        X = _maybe_bcoo(X, sparse_shape)
         margins = margins_of(X, W)  # (n, T)
         _, losses = gradient.pointwise(margins, y[:, None])
         if valid is not None:
@@ -159,7 +183,25 @@ def _build_loss_sweep(gradient, reg_value, mesh, with_valid):
     if not with_valid:
         full = body
         body = lambda W, X, y: full(W, X, y)
-    return _wrap_mesh(mesh, body, 1, with_valid, 1)
+    return _wrap_mesh(mesh, body, 1, with_valid, 1,
+                      sparse=sparse_shape is not None)
+
+
+def _shard_for_mesh(mesh, X, y):
+    """Shard (X, y) over the data mesh: dense rows via ``shard_dataset``,
+    BCOO via equal-nse component blocks (``shard_bcoo``) — the distributed-
+    sparse CostFun analogue.  Returns ``(X, y, valid, sparse_shape)`` where
+    dense X keeps ``sparse_shape=None`` and sparse X becomes the component
+    tuple ``(data, idx)``."""
+    if is_sparse(X):
+        from tpu_sgd.parallel.sparse_parallel import shard_bcoo
+
+        data, idx, y, valid, rows_local, d = shard_bcoo(mesh, X, y)
+        return (data, idx), y, valid, (rows_local, d)
+    from tpu_sgd.parallel.data_parallel import shard_dataset
+
+    X, y, valid = shard_dataset(mesh, X, y)
+    return X, y, valid, None
 
 
 def _reject_model_axis(mesh, who: str):
@@ -310,15 +352,14 @@ class LBFGS(Optimizer):
 
         mesh = self.mesh
         valid = None
+        sparse_shape = None
         if mesh is not None:
-            reject_sparse_mesh(X, type(self).__name__)
-            from tpu_sgd.parallel.data_parallel import shard_dataset
-
-            X, y, valid = shard_dataset(mesh, X, y)
+            X, y, valid, sparse_shape = _shard_for_mesh(mesh, X, y)
         with_valid = valid is not None
         data_args = (X, y, valid) if with_valid else (X, y)
 
-        cost = _build_cost(gradient, reg_value, reg_grad, mesh, with_valid)
+        cost = _build_cost(gradient, reg_value, reg_grad, mesh, with_valid,
+                           sparse_shape)
 
         n_ls = self._LS_TRIALS
         ladder = jnp.asarray(
@@ -326,7 +367,8 @@ class LBFGS(Optimizer):
         )  # trial step sizes, largest first
         swept = hasattr(gradient, "pointwise")
         if swept:
-            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid)
+            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid,
+                                      sparse_shape)
 
             @jax.jit
             def make_trials(w, direction):
@@ -334,7 +376,7 @@ class LBFGS(Optimizer):
 
         else:  # matrix-weight gradients: sequential scalar trials
             loss_only = _build_loss_only(
-                gradient, reg_value, mesh, with_valid
+                gradient, reg_value, mesh, with_valid, sparse_shape
             )
 
             def cost_loss(wt):

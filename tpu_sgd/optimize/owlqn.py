@@ -36,6 +36,7 @@ from tpu_sgd.optimize.lbfgs import (
     _build_loss_sweep,
     _coerce_inputs,
     _push_correction,
+    _shard_for_mesh,
     _two_loop,
 )
 from tpu_sgd.optimize.optimizer import Dataset
@@ -125,13 +126,9 @@ class OWLQN(LBFGS):
 
         mesh = self.mesh
         valid = None
+        sparse_shape = None
         if mesh is not None:
-            from tpu_sgd.ops.sparse import reject_sparse_mesh
-
-            reject_sparse_mesh(X, type(self).__name__)
-            from tpu_sgd.parallel.data_parallel import shard_dataset
-
-            X, y, valid = shard_dataset(mesh, X, y)
+            X, y, valid, sparse_shape = _shard_for_mesh(mesh, X, y)
         with_valid = valid is not None
         data_args = (X, y, valid) if with_valid else (X, y)
 
@@ -140,7 +137,8 @@ class OWLQN(LBFGS):
         zero_grad = jnp.zeros_like
         # smooth cost (mesh-aware psum inside); the L1 part is added where
         # the algorithm needs the FULL objective
-        _smooth = _build_cost(gradient, zero, zero_grad, mesh, with_valid)
+        _smooth = _build_cost(gradient, zero, zero_grad, mesh, with_valid,
+                              sparse_shape)
 
         def smooth_cost(wv):
             return _smooth(wv, *data_args)
@@ -154,7 +152,8 @@ class OWLQN(LBFGS):
             # multi-weight pass (X read once, one host sync) — same sweep
             # machinery as LBFGS, plus the per-trial predicted decrease
             # pg . (w_trial - w) the Armijo test needs.
-            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid)
+            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid,
+                                      sparse_shape)
             ladder_j = jnp.asarray(ladder)
 
             @jax.jit
@@ -169,7 +168,8 @@ class OWLQN(LBFGS):
 
         else:  # matrix-weight gradients have no pointwise rule
             # loss-only compile: XLA drops the gradient matmul per trial
-            _loss = _build_loss_only(gradient, l1_value, mesh, with_valid)
+            _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
+                                     sparse_shape)
 
             def full_loss(wv):
                 return _loss(wv, *data_args)

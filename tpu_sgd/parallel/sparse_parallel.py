@@ -1,0 +1,134 @@
+"""Data-parallel training on sparse (BCOO) features.
+
+Reference parity: Spark trains sparse ``RDD[LabeledPoint]`` DISTRIBUTED —
+each executor holds its partitions' sparse rows and ``treeAggregate``
+combines per-partition gradient sums ([U] mllib/optimization/
+GradientDescent.scala over sparse Vectors, SURVEY.md §2 #10/#13).  The
+single-device BCOO path (tpu_sgd/ops/sparse.py) alone would cap the
+framework below the reference's distributed-sparse capability.
+
+The obstacle to sharding a BCOO directly is that a row range's nse varies
+by shard, and ``shard_map`` needs one static local shape.  The layout here
+makes nse uniform *by construction*:
+
+  1. rows are split into ``n_shards`` contiguous equal blocks (row-padded
+     like the dense path, with a ``valid`` mask);
+  2. each block's entries are rebased to LOCAL row indices and padded to
+     the max per-shard nse with null entries — value 0.0 at (row 0, col 0),
+     which contribute exactly 0 to both matvecs;
+  3. the per-shard blocks are concatenated into flat component arrays
+     (``data``, ``indices``) sharded over the 'data' axis, and the
+     shard_map body reassembles its LOCAL block into a BCOO of static shape
+     ``(rows_local, d)``.
+
+From there the body is *the same* ``make_run`` the dense mesh path uses —
+the sparse gather/segment lowering per shard, one ``lax.psum`` of
+``(grad_sum, loss_sum, count)`` over ICI per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.updaters import Updater
+from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+Array = jax.Array
+
+
+def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
+    """Lay a BCOO matrix out for ``shard_map`` over the 'data' axis.
+
+    Returns ``(data, indices, y, valid, rows_local, d)`` where the arrays
+    are device-sharded so each core sees one equal-nse block with local row
+    indices (see module docstring); ``valid`` is None when the row count
+    divides evenly (the dense path's mask-free fast path).  This is the one
+    host->device transfer of the run — the sparse analogue of
+    ``shard_dataset``.
+    """
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "distributed sparse training is single-process (multi-host "
+            "assembly of equal-nse BCOO blocks is not implemented); "
+            "densify the features or run one process"
+        )
+    n_shards = mesh.shape[DATA_AXIS]
+    n, d = X.shape
+    rows_local = -(-n // n_shards)  # ceil: same contiguous blocks as the
+    n_padded = rows_local * n_shards  # dense path's pad_to_multiple
+    yh = np.zeros((n_padded,), np.asarray(y).dtype)
+    yh[:n] = np.asarray(y)
+    valid = np.zeros((n_padded,), bool)
+    valid[:n] = True
+
+    rows = np.asarray(X.indices[:, 0])
+    cols = np.asarray(X.indices[:, 1], np.int32)
+    vals = np.asarray(X.data)
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    shard_of = rows // rows_local
+    local_row = (rows % rows_local).astype(np.int32)
+    counts = np.bincount(shard_of, minlength=n_shards)
+    nse_local = max(1, int(counts.max()))
+
+    # (n_shards, nse_local) blocks prefilled with null entries (0.0 at
+    # local (0, 0)); real entries scatter into slot offsets within shards
+    data_h = np.zeros((n_shards, nse_local), vals.dtype)
+    idx_h = np.zeros((n_shards, nse_local, 2), np.int32)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot = np.arange(rows.shape[0]) - offsets[shard_of]
+    data_h[shard_of, slot] = vals
+    idx_h[shard_of, slot, 0] = local_row
+    idx_h[shard_of, slot, 1] = cols
+
+    entry_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    data_d = jax.device_put(data_h.reshape(-1), entry_sharding)
+    idx_d = jax.device_put(
+        idx_h.reshape(-1, 2), NamedSharding(mesh, P(DATA_AXIS, None))
+    )
+    y_d = jax.device_put(yh, entry_sharding)
+    valid_d = (
+        None if n == n_padded else jax.device_put(valid, entry_sharding)
+    )
+    return data_d, idx_d, y_d, valid_d, rows_local, int(d)
+
+
+def local_bcoo(data: Array, indices: Array, rows_local: int, d: int):
+    """Reassemble one shard's component arrays into its local BCOO block
+    (static shape; called inside the shard_map body)."""
+    from jax.experimental.sparse import BCOO
+
+    return BCOO((data, indices), shape=(rows_local, d))
+
+
+def sparse_dp_run_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    mesh: Mesh,
+    rows_local: int,
+    d: int,
+    with_valid: bool,
+):
+    """Jitted shard_map'ed full-loop runner over sharded BCOO components —
+    the sparse twin of ``dp_run_fn`` (same ``make_run``, same psum)."""
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    run = make_run(gradient, updater, config, axis_name=DATA_AXIS)
+
+    def local(w, data, idx, y, valid=None):
+        return run(w, local_bcoo(data, idx, rows_local, d), y, valid)
+
+    in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS))
+    if with_valid:
+        body = local
+        in_specs = in_specs + (P(DATA_AXIS),)
+    else:
+        body = lambda w, data, idx, y: local(w, data, idx, y)
+    return jax.jit(shard_map_fn(mesh, body, in_specs, (P(), P(), P())))
