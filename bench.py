@@ -5,7 +5,9 @@ Headline metric: SGD epochs/sec on a 10M x 1000 dense least-squares fit,
 mini-batch fraction 0.1 — an "epoch" is one full-dataset-equivalent of row
 processing (10 iterations at frac=0.1).  The TPU side measures the fused
 while_loop SGD program on the largest device-resident slab (bf16 features,
-f32 master weights, sliced sampling) and converts measured rows/sec to
+f32 master weights, sliced sampling), takes the STEADY-STATE s/iter via a
+two-point fit (the ~64 ms fixed per-launch cost cancels; a real 10M-row
+job amortizes it over hundreds of iterations), and converts rows/sec to
 epochs/sec on the 10M-row problem; the baseline is a faithful 8-process
 NumPy re-implementation of the Spark local[*] topology (per-partition
 gradient sums, broadcast weights, tree combine) as specified in BASELINE.md
@@ -169,6 +171,28 @@ def tpu_measure(tpu_ok: bool) -> dict:
             f"{float(losses[-1]):.4f}")
         return dt, losses
 
+    def time_run_slope(name, gradient, X, y, iters):
+        """Steady-state s/iter via a two-point fit: one launch at ``iters``
+        and one at 4x iterations — the fixed per-launch cost (~60 ms
+        through the remote-TPU tunnel, measured round 2: nop dispatch is
+        0.03 ms but a full program launch carries ~64 ms of fixed overhead)
+        cancels in the slope.  A real 10M-row job runs hundreds of
+        iterations per launch, so the slope is the honest
+        sustained-throughput number; the intercept is logged alongside.
+        Returns ``(slope_s_per_iter, fixed_s, losses_of_long_run)``."""
+        dt1, _ = time_run(f"{name}[{iters}]", gradient, X, y, iters)
+        dt4, losses4 = time_run(f"{name}[{4 * iters}]", gradient, X, y,
+                                4 * iters)
+        slope = (dt4 - dt1) / (3 * iters)
+        if slope <= 0:  # jitter-dominated fit (noisy/CPU host): fall back
+            log(f"{name}: two-point fit inverted (dt1={dt1:.3f}s "
+                f"dt4={dt4:.3f}s); using the long run's mean instead")
+            slope = dt4 / (4 * iters)
+        fixed = max(dt1 - slope * iters, 0.0)
+        log(f"{name}: steady-state {slope * 1e3:.3f} ms/iter "
+            f"(+ {fixed * 1e3:.0f} ms fixed launch cost)")
+        return slope, fixed, losses4
+
     out = {"platform": platform}
 
     # --- matched-loss workload: SAME rows/process/dtype as the CPU
@@ -192,8 +216,10 @@ def tpu_measure(tpu_ok: bool) -> dict:
     log(f"headline slab: resident rows={rows}")
     dtype = jnp.bfloat16 if on_accel else jnp.float32
     X, y = jax.block_until_ready(gen_fn(rows, dtype)())
-    dt, losses = time_run("xla", LeastSquaresGradient(), X, y, iters)
-    losses_xla = losses  # every Pallas candidate validates against XLA's
+    slope, fixed, losses_xla = time_run_slope(
+        "xla", LeastSquaresGradient(), X, y, iters
+    )
+    xla_slope = slope  # fixed baseline for every Pallas record below
     out["pallas"] = None
     if on_accel:
         # XLA-fused path vs the Pallas fused kernel (two tile sizes): keep
@@ -201,13 +227,13 @@ def tpu_measure(tpu_ok: bool) -> dict:
         # Pallas window floors the start to a tile boundary, so losses
         # differ slightly but must stay close on i.i.d. data — a silent
         # miscompile does not).
-        for tile in (2048, 8192):
+        for tile in (1024, 2048):
             if rows % tile:
                 continue
             try:
                 from tpu_sgd.ops.pallas_kernels import PallasGradient
 
-                dt_p, losses_p = time_run(
+                slope_p, fixed_p, losses_p = time_run_slope(
                     f"pallas[{tile}]",
                     PallasGradient(LeastSquaresGradient(), tile_m=tile),
                     X, y, iters,
@@ -221,20 +247,22 @@ def tpu_measure(tpu_ok: bool) -> dict:
                     continue
                 out["pallas"] = {
                     "tile": tile,
-                    "iter_ms": dt_p * 1e3 / iters,
-                    "xla_iter_ms": dt * 1e3 / iters,
-                    "wins": bool(dt_p < dt),
+                    "iter_ms": slope_p * 1e3,
+                    "xla_iter_ms": xla_slope * 1e3,
+                    "wins": bool(slope_p < xla_slope),
                 }
-                if dt_p < dt:
-                    dt, losses = dt_p, losses_p
+                if slope_p < slope:
+                    slope, fixed = slope_p, fixed_p
             except Exception as e:
                 log(f"pallas[{tile}] failed ({type(e).__name__}: {e}); "
                     "skipping")
-    rows_per_sec = iters * FRAC * rows / dt
+    rows_per_sec = FRAC * rows / slope
     eps = rows_per_sec / TARGET_ROWS
-    log(f"best: {dt * 1e3 / iters:.2f} ms/iter, "
-        f"{rows_per_sec / 1e6:.1f}M rows/s")
+    log(f"best: steady-state {slope * 1e3:.2f} ms/iter "
+        f"(+{fixed * 1e3:.0f} ms/launch), {rows_per_sec / 1e6:.1f}M rows/s")
     out["epochs_per_sec"] = eps
+    out["steady_state_iter_ms"] = slope * 1e3
+    out["fixed_launch_ms"] = fixed * 1e3
 
     # Diagnostic only (accelerator only — the d^2 Gram pass is minutes on
     # a starved CPU): the exact one-pass solver on the same slab (the

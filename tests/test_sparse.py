@@ -280,6 +280,29 @@ def test_sparse_owlqn_dp_trains():
     np.testing.assert_allclose(hist[-1], h1[-1], rtol=1e-3)
 
 
+def test_sparse_dp_handles_nse_sentinel_padding():
+    """jax pads BCOO nse with out-of-bounds sentinel indices (== shape);
+    BCOO ops drop them, and the mesh shard layout must too."""
+    from jax.experimental.sparse import BCOO
+    from tpu_sgd.parallel import data_mesh
+
+    Xd = np.zeros((16, 5), np.float32)
+    Xd[np.arange(16), np.arange(16) % 5] = 1.0
+    X = BCOO.fromdense(jnp.asarray(Xd), nse=24)  # 8 sentinel entries
+    y = jnp.asarray(np.arange(16, dtype=np.float32) % 5)
+
+    def run(Xin, mesh):
+        opt = GradientDescent().set_num_iterations(5).set_step_size(0.1)
+        if mesh is not None:
+            opt.set_mesh(mesh)
+        return opt.optimize_with_history((Xin, y), jnp.zeros((5,)))
+
+    w_m, h_m = run(X, data_mesh())
+    w_d, h_d = run(jnp.asarray(Xd), data_mesh())
+    np.testing.assert_allclose(h_m, h_d, rtol=1e-5)
+    np.testing.assert_allclose(w_m, w_d, rtol=1e-5, atol=1e-6)
+
+
 def test_sparse_model_train_with_mesh():
     """SVMWithSGD.train(..., mesh=...) end-to-end on BCOO features."""
     from tpu_sgd.parallel import data_mesh

@@ -70,6 +70,12 @@ def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
     rows = np.asarray(X.indices[:, 0])
     cols = np.asarray(X.indices[:, 1], np.int32)
     vals = np.asarray(X.data)
+    # jax pads BCOO nse with out-of-bounds sentinel indices == shape
+    # (e.g. fromdense(..., nse=k), sum_duplicates); BCOO ops drop them,
+    # so the shard layout must too
+    keep = (rows < n) & (cols < d)
+    if not keep.all():
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
     order = np.lexsort((cols, rows))
     rows, cols, vals = rows[order], cols[order], vals[order]
     shard_of = rows // rows_local
