@@ -238,20 +238,29 @@ def tpu_measure(tpu_ok: bool) -> dict:
                     PallasGradient(LeastSquaresGradient(), tile_m=tile),
                     X, y, iters,
                 )
+                # Miscompile guard: trajectories must track XLA's.  atol
+                # covers late iterations where losses sit near the noise
+                # floor and the tile-floored window's sampling stream
+                # legitimately differs; a miscompile diverges far more.
                 ok = len(losses_p) == len(losses_xla) and np.allclose(
-                    losses_p, losses_xla, rtol=0.1
+                    losses_p, losses_xla, rtol=0.1, atol=0.01
                 )
                 if not ok:
-                    log(f"pallas[{tile}] trajectory diverges from xla; "
-                        "discarding")
-                    continue
-                out["pallas"] = {
+                    log(f"pallas[{tile}] trajectory diverges from xla "
+                        "(possible miscompile); recording, never selecting")
+                # Record EVERY tile's measurement — the persisted artifact
+                # must substantiate the XLA-vs-Pallas verdict either way;
+                # only a trajectory-clean winner may take the headline.
+                if not isinstance(out["pallas"], list):
+                    out["pallas"] = []
+                out["pallas"].append({
                     "tile": tile,
                     "iter_ms": slope_p * 1e3,
                     "xla_iter_ms": xla_slope * 1e3,
-                    "wins": bool(slope_p < xla_slope),
-                }
-                if slope_p < slope:
+                    "trajectory_ok": bool(ok),
+                    "wins": bool(ok and slope_p < xla_slope),
+                })
+                if ok and slope_p < slope:
                     slope, fixed = slope_p, fixed_p
             except Exception as e:
                 log(f"pallas[{tile}] failed ({type(e).__name__}: {e}); "
@@ -446,6 +455,8 @@ def main():
             "result": result,
             "platform": tpu["platform"],
             "matched": matched,
+            "steady_state_iter_ms": tpu.get("steady_state_iter_ms"),
+            "fixed_launch_ms": tpu.get("fixed_launch_ms"),
             "pallas": tpu.get("pallas"),
         }
         with open(LAST_TPU_PATH, "w") as f:

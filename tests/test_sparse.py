@@ -303,6 +303,30 @@ def test_sparse_dp_handles_nse_sentinel_padding():
     np.testing.assert_allclose(w_m, w_d, rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_multihost_assembly_degenerate_single_process():
+    """The multi-host BCOO assembly path, run in its single-process
+    degenerate form (process_allgather over one process), must produce the
+    same global layout as the single-host path."""
+    from tpu_sgd.parallel import data_mesh
+    from tpu_sgd.parallel.sparse_parallel import (
+        _shard_bcoo_multihost,
+        shard_bcoo,
+    )
+
+    X, y, _ = _uneven_sparse()
+    mesh = data_mesh()
+    d1, i1, y1, v1, rl1, dd1 = shard_bcoo(mesh, X, np.asarray(y))
+    d2, i2, y2, v2, rl2, dd2 = _shard_bcoo_multihost(mesh, X, np.asarray(y))
+    assert (rl1, dd1) == (rl2, dd2)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    # single-host fast path may drop the mask; multihost always keeps it
+    assert v2 is not None
+    if v1 is not None:
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+
 def test_sparse_model_train_with_mesh():
     """SVMWithSGD.train(..., mesh=...) end-to-end on BCOO features."""
     from tpu_sgd.parallel import data_mesh
@@ -400,6 +424,38 @@ def test_sparse_int_features_promote():
     gs, ls, c = LeastSquaresGradient().batch_sums(X, y, w)
     assert jnp.issubdtype(ls.dtype, jnp.floating)
     assert float(ls) > 0.0  # margins were 0.5, not int-truncated 0
+
+
+def test_sparse_stepwise_listener_and_checkpoint(tmp_path, small_sparse):
+    """The observed path (listener + checkpoint manager) accepts BCOO
+    features single-device: per-iteration events fire and a mid-run
+    checkpoint resumes to the same trajectory."""
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+    from tpu_sgd.utils.events import CollectingListener
+
+    X, y, _ = small_sparse
+    w0 = jnp.zeros((X.shape[1],))
+
+    listener = CollectingListener()
+    opt = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(0.1).set_num_iterations(8).set_reg_param(0.01)
+           .set_seed(5).set_listener(listener))
+    w_full, h_full = opt.optimize_with_history((X, y), w0)
+    assert len(listener.iterations) == 8
+    assert listener.iterations[0].mini_batch_size == X.shape[0]
+
+    # interrupted run saves at iteration 4; a fresh optimizer resumes
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    opt_a = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+             .set_step_size(0.1).set_num_iterations(4).set_reg_param(0.01)
+             .set_seed(5).set_checkpoint(mgr, every=4))
+    opt_a.optimize_with_history((X, y), w0)
+    opt_b = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+             .set_step_size(0.1).set_num_iterations(8).set_reg_param(0.01)
+             .set_seed(5).set_checkpoint(mgr, every=4))
+    w_res, h_res = opt_b.optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_res), np.asarray(w_full),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_config3_shape_trains_undensified():

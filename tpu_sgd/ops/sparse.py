@@ -24,9 +24,10 @@ Supported surface: Bernoulli sampling (the reference-parity mode), all
 gradients, GradientDescent / LBFGS / OWLQN — single-device AND data-
 parallel over a 1-D mesh (equal-nse per-shard blocks,
 tpu_sgd/parallel/sparse_parallel.py — the distributed-sparse
-treeAggregate analogue).  Sliced/indexed sampling, host streaming,
-feature-axis ('model') sharding, and NormalEquations need dense row
-layouts and raise clear errors.
+treeAggregate analogue), including multi-host assembly from per-process
+local rows.  Sliced/indexed sampling, host streaming, feature-axis
+('model') sharding, and NormalEquations need dense row layouts and raise
+clear errors.
 """
 
 from __future__ import annotations

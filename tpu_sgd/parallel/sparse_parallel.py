@@ -42,6 +42,39 @@ from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
 Array = jax.Array
 
 
+def _entries_of(X, n: int, d: int):
+    """Host-side ``(rows, cols, vals)`` of a BCOO, row-major sorted, with
+    jax's out-of-bounds nse sentinel entries (``fromdense(..., nse=k)``,
+    ``sum_duplicates``) dropped — BCOO ops ignore them, so the shard layout
+    must too."""
+    rows = np.asarray(X.indices[:, 0])
+    cols = np.asarray(X.indices[:, 1], np.int32)
+    vals = np.asarray(X.data)
+    keep = (rows < n) & (cols < d)
+    if not keep.all():
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    order = np.lexsort((cols, rows))
+    return rows[order], cols[order], vals[order]
+
+
+def _layout_blocks(rows, cols, vals, n_shards: int, rows_local: int,
+                   nse_local: int):
+    """Scatter sorted entries into ``(n_shards, nse_local)`` equal-nse
+    blocks with LOCAL row indices; unfilled slots stay null entries
+    (0.0 at local (0, 0))."""
+    shard_of = rows // rows_local
+    local_row = (rows % rows_local).astype(np.int32)
+    counts = np.bincount(shard_of, minlength=n_shards)
+    data_h = np.zeros((n_shards, nse_local), vals.dtype)
+    idx_h = np.zeros((n_shards, nse_local, 2), np.int32)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot = np.arange(rows.shape[0]) - offsets[shard_of]
+    data_h[shard_of, slot] = vals
+    idx_h[shard_of, slot, 0] = local_row
+    idx_h[shard_of, slot, 1] = cols
+    return data_h, idx_h
+
+
 def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
     """Lay a BCOO matrix out for ``shard_map`` over the 'data' axis.
 
@@ -51,13 +84,14 @@ def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
     divides evenly (the dense path's mask-free fast path).  This is the one
     host->device transfer of the run — the sparse analogue of
     ``shard_dataset``.
+
+    Multi-host jobs: ``X``/``y`` are each process's LOCAL sparse rows (the
+    analogue of each executor reading its own input splits); processes
+    agree on a common per-shard ``(rows_local, nse_local)`` via allgather
+    and assemble the global arrays without moving any row cross-host.
     """
     if jax.process_count() > 1:
-        raise NotImplementedError(
-            "distributed sparse training is single-process (multi-host "
-            "assembly of equal-nse BCOO blocks is not implemented); "
-            "densify the features or run one process"
-        )
+        return _shard_bcoo_multihost(mesh, X, y)
     n_shards = mesh.shape[DATA_AXIS]
     n, d = X.shape
     rows_local = -(-n // n_shards)  # ceil: same contiguous blocks as the
@@ -67,31 +101,13 @@ def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
     valid = np.zeros((n_padded,), bool)
     valid[:n] = True
 
-    rows = np.asarray(X.indices[:, 0])
-    cols = np.asarray(X.indices[:, 1], np.int32)
-    vals = np.asarray(X.data)
-    # jax pads BCOO nse with out-of-bounds sentinel indices == shape
-    # (e.g. fromdense(..., nse=k), sum_duplicates); BCOO ops drop them,
-    # so the shard layout must too
-    keep = (rows < n) & (cols < d)
-    if not keep.all():
-        rows, cols, vals = rows[keep], cols[keep], vals[keep]
-    order = np.lexsort((cols, rows))
-    rows, cols, vals = rows[order], cols[order], vals[order]
-    shard_of = rows // rows_local
-    local_row = (rows % rows_local).astype(np.int32)
-    counts = np.bincount(shard_of, minlength=n_shards)
-    nse_local = max(1, int(counts.max()))
-
-    # (n_shards, nse_local) blocks prefilled with null entries (0.0 at
-    # local (0, 0)); real entries scatter into slot offsets within shards
-    data_h = np.zeros((n_shards, nse_local), vals.dtype)
-    idx_h = np.zeros((n_shards, nse_local, 2), np.int32)
-    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
-    slot = np.arange(rows.shape[0]) - offsets[shard_of]
-    data_h[shard_of, slot] = vals
-    idx_h[shard_of, slot, 0] = local_row
-    idx_h[shard_of, slot, 1] = cols
+    rows, cols, vals = _entries_of(X, n, d)
+    nse_local = max(
+        1, int(np.bincount(rows // rows_local, minlength=n_shards).max())
+    )
+    data_h, idx_h = _layout_blocks(
+        rows, cols, vals, n_shards, rows_local, nse_local
+    )
 
     entry_sharding = NamedSharding(mesh, P(DATA_AXIS))
     data_d = jax.device_put(data_h.reshape(-1), entry_sharding)
@@ -103,6 +119,59 @@ def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
         None if n == n_padded else jax.device_put(valid, entry_sharding)
     )
     return data_d, idx_d, y_d, valid_d, rows_local, int(d)
+
+
+def _shard_bcoo_multihost(mesh: Mesh, X, y):
+    """Assemble globally-sharded BCOO component arrays from per-process
+    local sparse rows (the sparse twin of ``_shard_dataset_multihost``).
+
+    Processes allgather their ``(row count, per-shard max nse, d)`` so
+    every process infers the SAME global shapes — common padded per-process
+    row count, common per-shard nse — then contribute their local blocks
+    via ``make_array_from_process_local_data``; no host ever holds another
+    host's rows, and only gradient psums ride DCN at train time.  The
+    validity mask is always on (per-process padding differs).
+    """
+    from jax.experimental import multihost_utils
+
+    local_shards = dict(mesh.local_mesh.shape).get(DATA_AXIS, 1)
+    n, d_local = X.shape
+    rows, cols, vals = _entries_of(X, n, d_local)
+
+    # agree on (padded per-process rows, per-shard nse, d)
+    counts0 = np.asarray(multihost_utils.process_allgather(np.asarray(n)))
+    target = int(counts0.max())
+    target += (-target) % local_shards
+    rows_local = target // local_shards
+    local_max_nse = int(
+        np.bincount(rows // rows_local, minlength=local_shards).max()
+    ) if rows.size else 0
+    nse_all = np.asarray(
+        multihost_utils.process_allgather(np.asarray(local_max_nse))
+    )
+    nse_local = max(1, int(nse_all.max()))
+    d = int(np.asarray(
+        multihost_utils.process_allgather(np.asarray(d_local))
+    ).max())
+
+    data_h, idx_h = _layout_blocks(
+        rows, cols, vals, local_shards, rows_local, nse_local
+    )
+    yh = np.zeros((target,), np.asarray(y).dtype)
+    yh[:n] = np.asarray(y)
+    valid = np.zeros((target,), bool)
+    valid[:n] = True
+
+    entry_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    data_d = jax.make_array_from_process_local_data(
+        entry_sharding, data_h.reshape(-1)
+    )
+    idx_d = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS, None)), idx_h.reshape(-1, 2)
+    )
+    y_d = jax.make_array_from_process_local_data(entry_sharding, yh)
+    valid_d = jax.make_array_from_process_local_data(entry_sharding, valid)
+    return data_d, idx_d, y_d, valid_d, rows_local, d
 
 
 def local_bcoo(data: Array, indices: Array, rows_local: int, d: int):
