@@ -1,7 +1,10 @@
 """Property-based tests (hypothesis), per SURVEY.md §4's test mapping:
 closed-form updater identities and single-vs-sharded parity over random
 inputs.  Shapes are FIXED (only values vary) so jitted functions compile
-once per test."""
+once per test — EXCEPT the sparse layout tests at the bottom, which
+deliberately vary shapes (their edge cases — empty shards, row counts
+below the shard count, ragged nse — live in the shape/sparsity structure)
+and keep example counts small to bound the per-example compile cost."""
 
 import numpy as np
 import pytest
@@ -105,3 +108,99 @@ def test_hinge_nonnegative_loss_property(margins, labels):
     # inactive examples (slack <= 0) have zero loss AND zero coefficient
     inactive = np.asarray(loss) == 0
     np.testing.assert_array_equal(np.asarray(coeff)[inactive], 0.0)
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    seed=st.integers(0, 10_000),
+    n=st.integers(3, 60),
+    d=st.integers(2, 40),
+    grad_idx=st.integers(0, 2),
+    with_mask=st.booleans(),
+)
+def test_sparse_batch_sums_equals_dense_property(seed, n, d, grad_idx,
+                                                 with_mask):
+    """For ANY sparsity pattern (including empty rows and columns), the
+    BCOO lowering of batch_sums equals the dense path."""
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    rng = np.random.default_rng(seed)
+    Xd = rng.normal(size=(n, d)).astype(np.float32)
+    Xd[rng.uniform(size=(n, d)) < rng.uniform(0.3, 1.0)] = 0.0
+    grad = [LeastSquaresGradient(), LogisticGradient(), HingeGradient()][
+        grad_idx
+    ]
+    y = (
+        rng.normal(size=(n,)).astype(np.float32)
+        if grad_idx == 0
+        else rng.integers(0, 2, size=(n,)).astype(np.float32)
+    )
+    w = rng.normal(size=(d,)).astype(np.float32)
+    mask = jnp.asarray(rng.uniform(size=(n,)) < 0.6) if with_mask else None
+    X = BCOO.fromdense(jnp.asarray(Xd))
+    gs, ls, cs = grad.batch_sums(X, jnp.asarray(y), jnp.asarray(w), mask)
+    gd, ld, cd = grad.batch_sums(
+        jnp.asarray(Xd), jnp.asarray(y), jnp.asarray(w), mask
+    )
+    np.testing.assert_allclose(gs, gd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-5)
+    assert float(cs) == float(cd)
+
+
+@settings(max_examples=15, deadline=None)
+@given(seed=st.integers(0, 10_000), n=st.integers(1, 100), d=st.integers(1, 30))
+def test_shard_bcoo_layout_reconstructs_dense_property(seed, n, d):
+    """The equal-nse shard layout is lossless: reassembling every shard's
+    local block reproduces the original matrix exactly — including empty
+    shards, empty rows, and row counts far below the shard count."""
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    from tpu_sgd.parallel import data_mesh
+    from tpu_sgd.parallel.sparse_parallel import shard_bcoo
+
+    rng = np.random.default_rng(seed)
+    Xd = rng.normal(size=(n, d)).astype(np.float32)
+    Xd[rng.uniform(size=(n, d)) < 0.8] = 0.0
+    X = BCOO.fromdense(jnp.asarray(Xd))
+    y = rng.normal(size=(n,)).astype(np.float32)
+    mesh = data_mesh()
+    n_shards = mesh.shape["data"]
+    data, idx, yd, valid, rows_local, dd = shard_bcoo(mesh, X, y)
+    assert dd == d
+    data_h = np.asarray(data).reshape(n_shards, -1)
+    idx_h = np.asarray(idx).reshape(n_shards, -1, 2)
+    dense = np.zeros((n_shards * rows_local, d), np.float32)
+    for s in range(n_shards):
+        # scatter-ADD: null padding entries (0.0 at (0,0)) must be no-ops
+        np.add.at(
+            dense, (s * rows_local + idx_h[s, :, 0], idx_h[s, :, 1]),
+            data_h[s],
+        )
+    np.testing.assert_allclose(dense[:n], Xd, rtol=1e-6)
+    np.testing.assert_allclose(dense[n:], 0.0)
+    np.testing.assert_allclose(np.asarray(yd)[:n], y)
+    if valid is not None:
+        v = np.asarray(valid)
+        assert v[:n].all() and not v[n:].any()
+    else:
+        assert n == n_shards * rows_local
+
+
+def test_sparse_batch_sums_fully_empty_matrix():
+    """Deterministic pin of the nse=0 edge case (a random draw only rarely
+    produces it): an all-zero BCOO matches the all-zero dense result."""
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    n, d = 12, 5
+    X = BCOO.fromdense(jnp.zeros((n, d)))
+    y = jnp.ones((n,), jnp.float32)
+    w = jnp.ones((d,), jnp.float32)
+    for grad in (LeastSquaresGradient(), LogisticGradient(), HingeGradient()):
+        gs, ls, cs = grad.batch_sums(X, y, w)
+        gd, ld, cd = grad.batch_sums(jnp.zeros((n, d)), y, w)
+        np.testing.assert_allclose(gs, gd, atol=1e-6)
+        np.testing.assert_allclose(ls, ld, rtol=1e-6)
+        assert float(cs) == float(cd) == n
