@@ -478,3 +478,22 @@ def test_config3_shape_trains_undensified():
     assert hist.shape[0] == 5
     assert np.isfinite(hist).all()
     assert hist[-1] < hist[0]
+
+
+def test_rcv1_like_full_width_trains_undensified():
+    """The realistic RCV1 stand-in at the REAL 47,236-feature width (Zipf
+    feature frequencies, unit-norm tfidf-like rows) trains undensified."""
+    from tpu_sgd.utils.mlutils import rcv1_like_data
+
+    X, y, _ = rcv1_like_data(4000, d=47_236, seed=3)
+    opt = (
+        GradientDescent(HingeGradient(), L1Updater())
+        .set_step_size(100.0)
+        .set_num_iterations(30)
+        .set_reg_param(1e-5)
+    )
+    w, hist = opt.optimize_with_history(
+        (X, jnp.asarray(y)), jnp.zeros((47_236,))
+    )
+    assert np.isfinite(hist).all()
+    assert hist[-1] < hist[0]

@@ -5,6 +5,7 @@ from tpu_sgd.parallel.distributed import (
     global_mesh_2d,
     initialize_distributed,
 )
+from tpu_sgd.parallel.sparse_parallel import shard_bcoo, sparse_dp_run_fn
 
 __all__ = [
     "DATA_AXIS",
@@ -13,6 +14,8 @@ __all__ = [
     "make_mesh",
     "dp_optimize",
     "shard_dataset",
+    "shard_bcoo",
+    "sparse_dp_run_fn",
     "initialize_distributed",
     "global_data_mesh",
     "global_mesh_2d",

@@ -1,9 +1,11 @@
 from tpu_sgd.utils.mlutils import (
     append_bias,
     k_fold,
+    a9a_like_data,
     linear_data,
     load_libsvm_file,
     logistic_data,
+    rcv1_like_data,
     save_as_libsvm_file,
     svm_data,
     train_test_split,
@@ -37,6 +39,8 @@ __all__ = [
     "linear_data",
     "logistic_data",
     "svm_data",
+    "a9a_like_data",
+    "rcv1_like_data",
     "save_glm_model",
     "load_glm_model",
 ]
