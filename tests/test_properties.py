@@ -23,7 +23,7 @@ finite_vec = st.lists(
 ).map(lambda v: np.asarray(v, np.float32))
 
 
-@settings(max_examples=30, deadline=None)
+@settings(derandomize=True, max_examples=30, deadline=None)
 @given(w=finite_vec, g=finite_vec,
        step=st.floats(0.01, 5.0), t=st.integers(1, 1000),
        reg=st.floats(0.0, 2.0))
@@ -38,7 +38,7 @@ def test_l1_prox_closed_form_property(w, g, step, t, reg):
     )
 
 
-@settings(max_examples=30, deadline=None)
+@settings(derandomize=True, max_examples=30, deadline=None)
 @given(w=finite_vec, g=finite_vec, step=st.floats(0.01, 5.0),
        t=st.integers(1, 1000), reg=st.floats(0.0, 2.0))
 def test_l2_shrinkage_property(w, g, step, t, reg):
@@ -51,7 +51,7 @@ def test_l2_shrinkage_property(w, g, step, t, reg):
     )
 
 
-@settings(max_examples=25, deadline=None)
+@settings(derandomize=True, max_examples=25, deadline=None)
 @given(margins=finite_vec, labels=st.lists(st.integers(0, 1), min_size=D,
                                            max_size=D))
 def test_logistic_pointwise_is_derivative(margins, labels):
@@ -66,7 +66,7 @@ def test_logistic_pointwise_is_derivative(margins, labels):
     np.testing.assert_allclose(np.asarray(coeff), fd, rtol=5e-2, atol=5e-3)
 
 
-@settings(max_examples=20, deadline=None)
+@settings(derandomize=True, max_examples=20, deadline=None)
 @given(seed=st.integers(0, 10_000))
 def test_sharded_equals_single_device_property(seed):
     """psum re-association: 8-shard full-batch grad == single-device grad."""
@@ -98,7 +98,7 @@ def test_sharded_equals_single_device_property(seed):
     assert float(c) == float(c_ref)
 
 
-@settings(max_examples=25, deadline=None)
+@settings(derandomize=True, max_examples=25, deadline=None)
 @given(margins=finite_vec, labels=st.lists(st.integers(0, 1), min_size=D,
                                            max_size=D))
 def test_hinge_nonnegative_loss_property(margins, labels):
@@ -110,7 +110,7 @@ def test_hinge_nonnegative_loss_property(margins, labels):
     np.testing.assert_array_equal(np.asarray(coeff)[inactive], 0.0)
 
 
-@settings(max_examples=20, deadline=None)
+@settings(derandomize=True, max_examples=20, deadline=None)
 @given(
     seed=st.integers(0, 10_000),
     n=st.integers(3, 60),
@@ -148,7 +148,7 @@ def test_sparse_batch_sums_equals_dense_property(seed, n, d, grad_idx,
     assert float(cs) == float(cd)
 
 
-@settings(max_examples=15, deadline=None)
+@settings(derandomize=True, max_examples=15, deadline=None)
 @given(seed=st.integers(0, 10_000), n=st.integers(1, 100), d=st.integers(1, 30))
 def test_shard_bcoo_layout_reconstructs_dense_property(seed, n, d):
     """The equal-nse shard layout is lossless: reassembling every shard's

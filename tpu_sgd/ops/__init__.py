@@ -14,6 +14,7 @@ from tpu_sgd.ops.sparse import (
     load_libsvm_file_bcoo,
     row_matrix_bcoo,
     sparse_data,
+    take_rows_bcoo,
 )
 from tpu_sgd.ops.updaters import (
     L1Updater,
@@ -36,6 +37,7 @@ __all__ = [
     "append_bias_bcoo",
     "append_bias_auto",
     "row_matrix_bcoo",
+    "take_rows_bcoo",
     "sparse_data",
     "Updater",
     "SimpleUpdater",

@@ -65,6 +65,48 @@ def row_matrix_bcoo(x):
     return BCOO((x.data, idx), shape=(1, x.shape[0]))
 
 
+def host_entries(X):
+    """Host-side ``(rows, cols, vals)`` of a BCOO, row-major sorted, with
+    jax's out-of-bounds nse sentinel entries (``fromdense(..., nse=k)``,
+    ``sum_duplicates``) dropped — BCOO ops ignore them, so every host-side
+    relayout (shard layout, row gather) must too.  The single home of that
+    invariant."""
+    n, d = X.shape
+    rows = np.asarray(X.indices[:, 0])
+    cols = np.asarray(X.indices[:, 1], np.int32)
+    vals = np.asarray(X.data)
+    keep = (rows < n) & (cols < d)
+    if not keep.all():
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    order = np.lexsort((cols, rows))
+    return rows[order], cols[order], vals[order]
+
+
+def take_rows_bcoo(X, idx):
+    """Row-gather a BCOO by an index array of UNIQUE row ids — the sparse
+    analogue of ``X[idx]`` for k-fold / train-test splitting (host-side
+    relayout; rows appear in ``idx`` order)."""
+    from jax.experimental.sparse import BCOO
+
+    idx = np.asarray(idx)
+    if np.unique(idx).size != idx.size:
+        raise ValueError("take_rows_bcoo needs unique row indices")
+    n, d = X.shape
+    rows, cols, vals = host_entries(X)
+    pos = np.full((n,), -1, np.int64)
+    pos[idx] = np.arange(idx.size)
+    sel = pos[rows] >= 0
+    new_rows = pos[rows[sel]].astype(np.int32)
+    cols, vals = cols[sel], vals[sel]
+    order = np.lexsort((cols, new_rows))
+    out_idx = np.stack([new_rows[order], cols[order]], axis=1)
+    return BCOO(
+        (jnp.asarray(vals[order]), jnp.asarray(out_idx)),
+        shape=(int(idx.size), int(d)),
+        indices_sorted=True, unique_indices=True,
+    )
+
+
 def append_bias_auto(X):
     """Sparse-aware ``MLUtils.appendBias`` dispatch: BCOO features get the
     sparse bias column, everything else the dense one."""

@@ -36,25 +36,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_sgd.config import SGDConfig
 from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.sparse import host_entries
 from tpu_sgd.ops.updaters import Updater
 from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
 
 Array = jax.Array
-
-
-def _entries_of(X, n: int, d: int):
-    """Host-side ``(rows, cols, vals)`` of a BCOO, row-major sorted, with
-    jax's out-of-bounds nse sentinel entries (``fromdense(..., nse=k)``,
-    ``sum_duplicates``) dropped — BCOO ops ignore them, so the shard layout
-    must too."""
-    rows = np.asarray(X.indices[:, 0])
-    cols = np.asarray(X.indices[:, 1], np.int32)
-    vals = np.asarray(X.data)
-    keep = (rows < n) & (cols < d)
-    if not keep.all():
-        rows, cols, vals = rows[keep], cols[keep], vals[keep]
-    order = np.lexsort((cols, rows))
-    return rows[order], cols[order], vals[order]
 
 
 def _layout_blocks(rows, cols, vals, n_shards: int, rows_local: int,
@@ -101,7 +87,7 @@ def shard_bcoo(mesh: Mesh, X, y) -> Tuple[Array, Array, Array, Array, int, int]:
     valid = np.zeros((n_padded,), bool)
     valid[:n] = True
 
-    rows, cols, vals = _entries_of(X, n, d)
+    rows, cols, vals = host_entries(X)
     nse_local = max(
         1, int(np.bincount(rows // rows_local, minlength=n_shards).max())
     )
@@ -136,7 +122,7 @@ def _shard_bcoo_multihost(mesh: Mesh, X, y):
 
     local_shards = dict(mesh.local_mesh.shape).get(DATA_AXIS, 1)
     n, d_local = X.shape
-    rows, cols, vals = _entries_of(X, n, d_local)
+    rows, cols, vals = host_entries(X)
 
     # agree on (padded per-process rows, per-shard nse, d)
     counts0 = np.asarray(multihost_utils.process_allgather(np.asarray(n)))

@@ -102,30 +102,49 @@ def save_as_libsvm_file(path: str, X: np.ndarray, y: np.ndarray) -> None:
             f.write(f"{y[i]:.6g} {feats}\n")
 
 
-def k_fold(X: np.ndarray, y: np.ndarray, num_folds: int, seed: int = 42):
-    """Yield ``(train, validation)`` splits (parity with ``MLUtils.kFold``):
-    a seeded shuffle partitioned into ``num_folds`` disjoint validation
-    folds, each paired with the complement as training data."""
-    n = np.asarray(X).shape[0]
+def _take_rows(X, idx):
+    """Row-select helper shared by the fold utilities: fancy indexing for
+    dense arrays, host-side relayout for sparse (BCOO) features."""
+    from tpu_sgd.ops.sparse import is_sparse, take_rows_bcoo
+
+    return take_rows_bcoo(X, idx) if is_sparse(X) else X[idx]
+
+
+def _num_rows(X) -> int:
+    from tpu_sgd.ops.sparse import is_sparse
+
+    return int(X.shape[0]) if is_sparse(X) else int(np.asarray(X).shape[0])
+
+
+def k_fold(X, y, num_folds: int, seed: int = 42):
+    """Yield ``(train, validation)`` splits (parity with ``MLUtils.kFold``,
+    which serves sparse and dense RDDs alike): a seeded shuffle partitioned
+    into ``num_folds`` disjoint validation folds, each paired with the
+    complement as training data.  Accepts dense arrays or BCOO features."""
+    n = _num_rows(X)
     if num_folds < 2:
         raise ValueError("num_folds must be >= 2")
     perm = np.random.default_rng(seed).permutation(n)
     folds = np.array_split(perm, num_folds)
+    y = np.asarray(y)
     for i in range(num_folds):
         val_idx = folds[i]
         train_idx = np.concatenate([folds[j] for j in range(num_folds) if j != i])
-        yield (X[train_idx], y[train_idx]), (X[val_idx], y[val_idx])
+        yield (
+            (_take_rows(X, train_idx), y[train_idx]),
+            (_take_rows(X, val_idx), y[val_idx]),
+        )
 
 
-def train_test_split(
-    X: np.ndarray, y: np.ndarray, test_fraction: float = 0.2, seed: int = 42
-):
-    """Seeded shuffle split (the common analogue of ``RDD.randomSplit``)."""
-    n = np.asarray(X).shape[0]
+def train_test_split(X, y, test_fraction: float = 0.2, seed: int = 42):
+    """Seeded shuffle split (the common analogue of ``RDD.randomSplit``);
+    accepts dense arrays or BCOO features."""
+    n = _num_rows(X)
     perm = np.random.default_rng(seed).permutation(n)
     n_test = int(round(test_fraction * n))
     te, tr = perm[:n_test], perm[n_test:]
-    return (X[tr], y[tr]), (X[te], y[te])
+    y = np.asarray(y)
+    return (_take_rows(X, tr), y[tr]), (_take_rows(X, te), y[te])
 
 
 # ---------------------------------------------------------------------------
@@ -244,7 +263,9 @@ def rcv1_like_data(
     chunk = max(1, min(n, (1 << 27) // max(d, 1)))  # ~512 MB f32 noise cap
     for lo in range(0, n, chunk):
         hi = min(lo + chunk, n)
-        u = rng.uniform(size=(hi - lo, d)).astype(np.float32)
+        # dtype=f32 draws directly (uniform() would materialize an f64
+        # buffer ~3x the intended cap before the cast)
+        u = rng.random(size=(hi - lo, d), dtype=np.float32)
         # guard both logs: u=0 breaks the inner, u=1 the outer
         np.clip(u, np.finfo(np.float32).tiny, 1.0 - 1e-7, out=u)
         gumbel = -np.log(-np.log(u))
