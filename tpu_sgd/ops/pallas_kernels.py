@@ -44,11 +44,15 @@ runs).
 
 **Status: opt-in experiment — XLA won on hardware.**  Measured on a real
 TPU v5 lite (round 2, 3M x 1000 bf16 window workload, BASELINE.md):
-XLA's sliced ``Gradient.window_sums`` runs 3.87 ms/iter vs this kernel's
-6.32 ms/iter at tile 2048 (micro-sweep 0.054 ms vs 0.089 ms per window),
-with the trajectory cross-check green — the kernel is correct, just
-slower: XLA already fuses the two MXU matvecs with the elementwise ops and
-saturates HBM bandwidth at this arithmetic intensity.  Per SURVEY.md §2's
+steady-state 3.1-3.4 ms/iter at tiles 1024/2048 vs XLA's 1.64 ms/iter,
+trajectory cross-checks green — correct, ~2x slower.  The arithmetic
+points at WHY: per 2048-row tile the measured ~23 us decomposes as ~5 us
+of X-tile DMA plus ~2 x 5 us of MXU matmul whose M/N dimension is the
+8-lane weight/coeff block — a 128x128 systolic array running 16x
+underutilized (the very reshapes that made Mosaic accept the kernel, see
+the notes above, cap its throughput).  XLA's matvec instead lowers to a
+bandwidth-bound reduction and runs at the HBM floor, so the kernel's
+one-read advantage cannot pay for its compute shape.  Per SURVEY.md §2's
 native-component ledger the XLA-compiled fused matvec IS the TPU-native
 analogue of the reference's JNI BLAS; nothing routes here by default.
 """
