@@ -534,3 +534,30 @@ def test_k_fold_and_split_on_sparse(small_sparse):
     np.testing.assert_allclose(
         _dense(Xtr).sum() + _dense(Xte).sum(), _dense(X).sum(), rtol=1e-4
     )
+
+
+def test_sparse_stepwise_mesh_listener_matches_fused():
+    """Listener/checkpoint (observed) mode now runs sparse over the data
+    mesh; its trajectory matches the fused while_loop path exactly."""
+    from tpu_sgd.parallel import data_mesh
+    from tpu_sgd.utils.events import CollectingListener
+
+    X, y, _ = _uneven_sparse()
+    mesh = data_mesh()
+    w0 = jnp.zeros((X.shape[1],))
+
+    def mk():
+        return (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+                .set_step_size(0.2).set_num_iterations(10)
+                .set_reg_param(0.01).set_mini_batch_fraction(0.5)
+                .set_seed(7).set_mesh(mesh))
+
+    listener = CollectingListener()
+    w_obs, h_obs = mk().set_listener(listener).optimize_with_history(
+        (X, y), w0
+    )
+    assert len(listener.iterations) == 10
+    w_fused, h_fused = mk().optimize_with_history((X, y), w0)
+    np.testing.assert_allclose(h_obs, h_fused, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w_obs), np.asarray(w_fused),
+                               rtol=1e-5, atol=1e-6)

@@ -428,11 +428,6 @@ class GradientDescent(Optimizer):
                 "The miniBatchFraction is too small", RuntimeWarning, stacklevel=2
             )
         if self.listener is not None or self.checkpoint_manager is not None:
-            if sparse_X and self.mesh is not None:
-                raise NotImplementedError(
-                    "listener/checkpoint mode with sparse features runs "
-                    "single-device; drop the mesh or the observer"
-                )
             return self._optimize_stepwise(X, y, w0)
         if sparse_X and self.mesh is not None:
             # Distributed sparse: equal-nse BCOO blocks per shard, same
@@ -506,11 +501,22 @@ class GradientDescent(Optimizer):
                 "data meshes"
             )
         valid = None
+        sparse_shape = None
         if self.mesh is not None:
-            from tpu_sgd.parallel.data_parallel import shard_dataset
+            if is_sparse(X):
+                from tpu_sgd.parallel.sparse_parallel import shard_bcoo
 
-            X, y, valid = shard_dataset(self.mesh, X, y)
-        step = self._stepper(with_valid=valid is not None)
+                data, idx, y, valid, rows_local, d_feat = shard_bcoo(
+                    self.mesh, X, y
+                )
+                X = (data, idx)  # component tuple; the stepper rebuilds
+                sparse_shape = (rows_local, d_feat)
+            else:
+                from tpu_sgd.parallel.data_parallel import shard_dataset
+
+                X, y, valid = shard_dataset(self.mesh, X, y)
+        step = self._stepper(with_valid=valid is not None,
+                             sparse_shape=sparse_shape)
 
         # regVal probe init (same as the fused path)
         _, reg_val = self.updater.compute(
@@ -606,17 +612,26 @@ class GradientDescent(Optimizer):
         self._loss_history = _np.asarray(losses, _np.float32)
         return w, self._loss_history
 
-    def _stepper(self, with_valid: bool):
-        """Memoized jitted single-step function (mesh-aware)."""
+    def _stepper(self, with_valid: bool, sparse_shape=None):
+        """Memoized jitted single-step function (mesh-aware; pass
+        ``sparse_shape=(rows_local, d)`` when X arrives as sharded BCOO
+        component tuples)."""
         # Key on the objects themselves (identity hash, strong ref): an
         # id()-based key could alias a new gradient/mesh to a stale compiled
         # fn after GC id reuse.
         key = ("step", self.gradient, self.updater, self.config,
-               self.mesh, with_valid)
+               self.mesh, with_valid, sparse_shape)
         fn = self._run_cache.get(key)
         if fn is None:
             if self.mesh is None:
                 fn = jax.jit(make_step(self.gradient, self.updater, self.config))
+            elif sparse_shape is not None:
+                from tpu_sgd.parallel.sparse_parallel import sparse_dp_step_fn
+
+                fn = sparse_dp_step_fn(
+                    self.gradient, self.updater, self.config, self.mesh,
+                    sparse_shape[0], sparse_shape[1], with_valid,
+                )
             else:
                 from tpu_sgd.parallel.data_parallel import dp_step_fn
 

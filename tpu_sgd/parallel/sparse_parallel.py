@@ -168,6 +168,38 @@ def local_bcoo(data: Array, indices: Array, rows_local: int, d: int):
     return BCOO((data, indices), shape=(rows_local, d))
 
 
+def sparse_dp_step_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    mesh: Mesh,
+    rows_local: int,
+    d: int,
+    with_valid: bool,
+):
+    """Jitted shard_map'ed SINGLE-step function over sharded BCOO
+    components — the sparse twin of ``dp_step_fn``, used by the observed
+    (listener / checkpoint) path."""
+    from tpu_sgd.optimize.gradient_descent import make_step
+
+    step = make_step(gradient, updater, config, axis_name=DATA_AXIS)
+
+    def local(w, X, y, i, reg_val, valid=None):
+        return step(w, local_bcoo(X[0], X[1], rows_local, d), y, i, reg_val,
+                    valid)
+
+    # X arrives as the (data, idx) component tuple, matching the stepwise
+    # caller's ``step(w, X, y, ...)`` signature for dense X
+    # ``local`` defaults valid=None, so it serves both arities directly
+    x_spec = (P(DATA_AXIS), P(DATA_AXIS, None))
+    in_specs = (P(), x_spec, P(DATA_AXIS), P(), P())
+    if with_valid:
+        in_specs = in_specs + (P(DATA_AXIS),)
+    return jax.jit(
+        shard_map_fn(mesh, local, in_specs, (P(), P(), P(), P()))
+    )
+
+
 def sparse_dp_run_fn(
     gradient: Gradient,
     updater: Updater,
@@ -186,10 +218,8 @@ def sparse_dp_run_fn(
     def local(w, data, idx, y, valid=None):
         return run(w, local_bcoo(data, idx, rows_local, d), y, valid)
 
+    # ``local`` defaults valid=None, so it serves both arities directly
     in_specs = (P(), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS))
     if with_valid:
-        body = local
         in_specs = in_specs + (P(DATA_AXIS),)
-    else:
-        body = lambda w, data, idx, y: local(w, data, idx, y)
-    return jax.jit(shard_map_fn(mesh, body, in_specs, (P(), P(), P())))
+    return jax.jit(shard_map_fn(mesh, local, in_specs, (P(), P(), P())))
