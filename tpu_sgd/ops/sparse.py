@@ -190,14 +190,28 @@ def sparse_data(
         if weights is not None
         else rng.uniform(-1.0, 1.0, size=(d,)).astype(np.float32)
     )
-    cols = np.stack(
-        [rng.choice(d, size=nnz_per_row, replace=False) for _ in range(n)]
-    ).astype(np.int32)
+    if nnz_per_row * nnz_per_row * 4 < d:
+        # vectorized draw-and-repair: collisions are rare at this density,
+        # so draw all rows at once and re-roll only the few that collide
+        # (the per-row rng.choice loop is O(n*d) — minutes at d=47k)
+        cols = rng.integers(0, d, size=(n, nnz_per_row), dtype=np.int32)
+        cols.sort(axis=1)
+        bad = np.nonzero((np.diff(cols, axis=1) == 0).any(axis=1))[0]
+        for i in bad:
+            cols[i] = np.sort(
+                rng.choice(d, size=nnz_per_row, replace=False)
+            ).astype(np.int32)
+    else:
+        cols = np.stack(
+            [np.sort(rng.choice(d, size=nnz_per_row, replace=False))
+             for _ in range(n)]
+        ).astype(np.int32)
     vals = rng.normal(size=(n, nnz_per_row)).astype(np.float32)
     rows = np.repeat(np.arange(n, dtype=np.int32), nnz_per_row)
     idx = np.stack([rows, cols.reshape(-1)], axis=1)
     X = BCOO(
-        (jnp.asarray(vals.reshape(-1)), jnp.asarray(idx)), shape=(n, d)
+        (jnp.asarray(vals.reshape(-1)), jnp.asarray(idx)), shape=(n, d),
+        indices_sorted=True, unique_indices=True,
     )
     # margins computed sparsely on the host for label generation
     margins = np.einsum("ij,ij->i", vals, w[cols])
