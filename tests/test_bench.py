@@ -1,0 +1,73 @@
+"""Unit tests for bench.py's decision logic.
+
+The benchmark is the round's headline artifact; its host-side arithmetic
+(stopping rule, matched-loss speedup, persisted-result fallback) must not
+regress silently.  Device measurement itself is exercised on hardware, not
+here.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    """Import bench.py as a module without running main()."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_module", _BENCH_PATH
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_module"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_first_crossing(bench):
+    assert bench._first_crossing([1.0, 0.5, 0.04, 0.01], 0.05) == 3
+    assert bench._first_crossing([0.04], 0.05) == 1
+    assert bench._first_crossing([1.0, 0.9], 0.05) is None
+    assert bench._first_crossing([], 0.05) is None
+
+
+def test_matched_loss_speedup_math(bench):
+    t = bench.TARGET_LOSS
+    cpu = {"matched_iter_s": 0.5,
+           "matched_losses": [t * 4, t * 2, t, t / 2]}
+    tpu = {"matched_iter_s": 0.001,
+           "matched_losses": [t * 4, t * 2, t * 1.5, t * 0.9]}
+    speedup, detail = bench.matched_loss_speedup(cpu, tpu)
+    # cpu hits at iter 3 (1.5 s), tpu at iter 4 (0.004 s)
+    np.testing.assert_allclose(speedup, 1.5 / 0.004)
+    assert detail["cpu_hit_iter"] == 3 and detail["tpu_hit_iter"] == 4
+    np.testing.assert_allclose(detail["cpu_wall_s"], 1.5)
+
+
+def test_matched_loss_speedup_no_crossing(bench):
+    t = bench.TARGET_LOSS
+    cpu = {"matched_iter_s": 0.5, "matched_losses": [t * 4, t * 2]}
+    tpu = {"matched_iter_s": 0.001, "matched_losses": [t / 2]}
+    speedup, detail = bench.matched_loss_speedup(cpu, tpu)
+    assert speedup is None and detail is None
+
+
+def test_report_persisted_marks_stale(bench, tmp_path, monkeypatch, capsys):
+    record = {
+        "timestamp": "2026-07-30T06:11:17",
+        "result": {"metric": "m", "value": 18.2, "unit": "epochs/sec"},
+    }
+    path = tmp_path / "last.json"
+    path.write_text(json.dumps(record))
+    monkeypatch.setattr(bench, "LAST_TPU_PATH", str(path))
+    bench._report_persisted()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    reported = json.loads(out)
+    assert reported["value"] == 18.2
+    assert "persisted TPU measurement" in reported["note"]
+    assert "2026-07-30T06:11:17" in reported["note"]

@@ -5,10 +5,12 @@ mini-batch SGD for generalized linear models).
 The reference's capability contract is preserved — the
 Optimizer × Gradient × Updater plugin boundary, the model families
 (Linear/Lasso/Ridge regression, logistic regression, linear SVM, streaming
-variants), seeded mini-batch sampling, loss history, convergence tolerance —
-re-designed TPU-first: fused XLA matvec gradient steps, a whole-run
-``lax.while_loop`` driver, and ``shard_map`` + ``lax.psum`` data parallelism
-over ICI.  See SURVEY.md for the reference analysis this build follows.
+variants), seeded mini-batch sampling, loss history, convergence tolerance,
+and sparse (BCOO) feature training that never densifies — re-designed
+TPU-first: fused XLA matvec gradient steps, a whole-run ``lax.while_loop``
+driver, and ``shard_map`` + ``lax.psum`` data parallelism over ICI for
+dense rows and equal-nse sparse blocks alike.  See SURVEY.md for the
+reference analysis this build follows.
 """
 
 from tpu_sgd.config import MeshConfig, SGDConfig
