@@ -56,6 +56,9 @@ def _run(cpu: bool, timeout: int) -> dict:
     env = dict(os.environ)
     if cpu:
         env["SPARSE_CHECK_CPU"] = "1"
+    else:
+        env.pop("SPARSE_CHECK_CPU", None)  # a stale flag must not silently
+        # turn the TPU leg into a CPU-vs-CPU comparison
     code = _CHILD % {"repo": REPO, "n": N, "d": D, "nnz": NNZ, "iters": ITERS}
     proc = subprocess.run(
         [sys.executable, "-c", code], env=env, timeout=timeout,
@@ -74,6 +77,10 @@ def main() -> int:
     tpu = _run(cpu=False, timeout=1200)
     print(f"tpu side: {tpu['device']} ({tpu['platform']}), "
           f"{tpu['wall_s']}s, final loss {tpu['losses'][-1]}", flush=True)
+    if tpu["platform"] == "cpu":
+        print("TPU leg fell back to CPU (tunnel down?); aborting before "
+              "the long CPU cross-check", flush=True)
+        return 1
     cpu = _run(cpu=True, timeout=3600)
     print(f"cpu side: {cpu['wall_s']}s, final loss {cpu['losses'][-1]}",
           flush=True)

@@ -561,3 +561,24 @@ def test_sparse_stepwise_mesh_listener_matches_fused():
     np.testing.assert_allclose(h_obs, h_fused, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(w_obs), np.asarray(w_fused),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_multinomial_lbfgs_sparse_over_mesh():
+    """Matrix-weight (multinomial) gradient + BCOO + data mesh: the
+    quasi-Newton scalar line search path over sharded sparse components
+    matches the single-device result."""
+    from tpu_sgd.parallel import data_mesh
+
+    X, y, _ = sparse_data(640, 24, nnz_per_row=6, kind="linear", seed=37)
+    y3 = jnp.asarray(((np.asarray(y) > -0.5).astype(np.float32)
+                      + (np.asarray(y) > 0.5).astype(np.float32)))
+    g = MultinomialLogisticGradient(3)  # stateless: shared by both runs
+    w0 = jnp.zeros((2 * 24,))
+    _, h_m = (LBFGS(g, max_num_iterations=20)
+              .set_mesh(data_mesh())
+              .optimize_with_history((X, y3), w0))
+    _, h_1 = LBFGS(
+        g, max_num_iterations=20
+    ).optimize_with_history((X, y3), w0)
+    assert h_m[-1] < h_m[0]
+    np.testing.assert_allclose(h_m[-1], h_1[-1], rtol=1e-3)
