@@ -61,15 +61,25 @@ def main(argv=None):
     def timeit(name, fn, *fargs, rows_done=None):
         """Times ``fn`` and reports bandwidth for the rows it ACTUALLY
         processes (the pallas variants floor the window to a tile multiple,
-        so crediting them with the full m would inflate their GB/s)."""
+        so crediting them with the full m would inflate their GB/s).
+
+        Reps are CHAINED through a device scalar folded into the first
+        argument (the weight vector): independent dispatches let the async
+        runtime overlap reps and over-report bandwidth by orders of
+        magnitude (an early sweep printed 11 TB/s "effective" on a chip
+        with <1 TB/s of HBM)."""
         rows_done = m if rows_done is None else rows_done
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*fargs))
+        out = jax.block_until_ready(fn(*fargs))
         print(f"{name:28s} compile {time.perf_counter() - t0:5.1f}s",
               flush=True)
+        w0, rest = fargs[0], fargs[1:]
+        zero = jnp.zeros((), w0.dtype)
         t0 = time.perf_counter()
         for _ in range(args.reps):
-            out = fn(*fargs)
+            out = fn(w0 + zero, *rest)
+            # 0-valued, but data-dependent on the previous dispatch
+            zero = out[0].ravel()[0] * 0.0
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / args.reps
         gb = rows_done * d * X.dtype.itemsize / 1e9
