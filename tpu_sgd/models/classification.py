@@ -126,6 +126,7 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
         updater=None,
         mesh=None,
         sampling: str = None,
+        host_streaming: bool = False,
     ):
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -135,6 +136,8 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
             alg.optimizer.set_mesh(mesh)
         if sampling is not None:
             alg.optimizer.set_sampling(sampling)
+        if host_streaming:
+            alg.optimizer.set_host_streaming(True)
         return alg.run(data, initial_weights)
 
 

@@ -79,12 +79,14 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         intercept: bool = False,
         mesh=None,
         sampling: str = None,
+        host_streaming: bool = False,
     ):
         """Static train() parity with the reference's object methods.
 
-        ``mesh`` and ``sampling`` are the TPU-side extensions: a device mesh
-        for data parallelism and the mini-batch sampling strategy
-        (see ``SGDConfig.sampling``).
+        ``mesh``, ``sampling`` and ``host_streaming`` are the TPU-side
+        extensions: a device mesh for data parallelism, the mini-batch
+        sampling strategy (see ``SGDConfig.sampling``), and host-resident
+        streaming for datasets larger than device HBM.
         """
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -92,6 +94,8 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
             alg.optimizer.set_mesh(mesh)
         if sampling is not None:
             alg.optimizer.set_sampling(sampling)
+        if host_streaming:
+            alg.optimizer.set_host_streaming(True)
         return alg.run(data, initial_weights)
 
 
