@@ -582,3 +582,41 @@ def test_multinomial_lbfgs_sparse_over_mesh():
     ).optimize_with_history((X, y3), w0)
     assert h_m[-1] < h_m[0]
     np.testing.assert_allclose(h_m[-1], h_1[-1], rtol=1e-3)
+
+
+def test_labeled_points_with_sparse_vectors_train_undensified():
+    """The reference's primary sparse form — LabeledPoint records holding
+    SparseVector features — converts to one BCOO matrix and trains through
+    the sparse path (previously crashed in to_arrays)."""
+    from tpu_sgd.linalg import DenseVector, SparseVector
+    from tpu_sgd.models.labeled_point import LabeledPoint, to_arrays
+
+    rng = np.random.default_rng(41)
+    d = 30
+    pts = []
+    dense_rows = np.zeros((300, d), np.float32)
+    w_true = rng.normal(size=(d,)).astype(np.float32)
+    for i in range(300):
+        idx = np.sort(rng.choice(d, size=5, replace=False))
+        vals = rng.normal(size=5).astype(np.float32)
+        dense_rows[i, idx] = vals
+        label = float(dense_rows[i] @ w_true > 0)
+        pts.append(LabeledPoint(label, SparseVector(d, idx, vals)))
+    X, y = to_arrays(pts)
+    assert is_sparse(X) and X.shape == (300, d)
+    np.testing.assert_allclose(_dense(X), dense_rows, rtol=1e-6)
+    model = SVMWithSGD.train(pts, num_iterations=40, reg_param=1e-4)
+    acc = float(np.mean(np.asarray(model.predict(X)) == y))
+    assert acc > 0.85
+    # DenseVector records still take the dense path
+    dpts = [LabeledPoint(float(l), DenseVector(r))
+            for l, r in zip(y, dense_rows)]
+    Xd, yd = to_arrays(dpts)
+    assert isinstance(Xd, np.ndarray)
+    np.testing.assert_allclose(Xd, dense_rows)
+    # a MIXED collection (reference RDDs mix freely) goes sparse, dense
+    # rows contributing their nonzeros
+    mixed = pts[:150] + dpts[150:]
+    Xm, ym = to_arrays(mixed)
+    assert is_sparse(Xm)
+    np.testing.assert_allclose(_dense(Xm), dense_rows, rtol=1e-6)

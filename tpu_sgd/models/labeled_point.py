@@ -35,10 +35,57 @@ class LabeledPoint(NamedTuple):
 
 
 def to_arrays(points: Iterable[LabeledPoint]) -> Tuple[np.ndarray, np.ndarray]:
-    """Collection of LabeledPoints -> columnar ``(X, y)`` float32 arrays."""
+    """Collection of LabeledPoints -> columnar ``(X, y)`` float32 form.
+
+    Features may be raw arrays, ``linalg.DenseVector`` records, or
+    ``linalg.SparseVector`` records — the reference's ``RDD[LabeledPoint]``
+    carries SparseVectors for a9a/RCV1 ([U] mllib/regression/
+    LabeledPoint.scala + Vectors.scala); those stay sparse here, returned
+    as one BCOO matrix that flows through the undensified training path.
+    """
     pts = list(points)
     if not pts:
         return np.zeros((0, 0), np.float32), np.zeros((0,), np.float32)
-    X = np.stack([np.asarray(p.features, np.float32) for p in pts])
     y = np.asarray([p.label for p in pts], np.float32)
+    from tpu_sgd.linalg import DenseVector, SparseVector
+
+    if any(isinstance(p.features, SparseVector) for p in pts):
+        # ANY sparse row makes the whole collection sparse (the reference's
+        # RDD[LabeledPoint] mixes dense and sparse vectors freely); dense
+        # rows contribute their nonzeros.  One CSR pass feeds the shared
+        # csr_to_bcoo constructor (sorted/unique flags included).
+        from tpu_sgd.ops.sparse import csr_to_bcoo
+
+        cols_list, vals_list = [], []
+        d = 0
+        for p in pts:
+            f = p.features
+            if isinstance(f, SparseVector):
+                order = np.argsort(f.indices)
+                c = np.asarray(f.indices)[order].astype(np.int32)
+                v = np.asarray(f.values)[order].astype(np.float32)
+                d = max(d, f.size)
+            else:
+                arr = (
+                    f.to_array()
+                    if isinstance(f, DenseVector)
+                    else np.asarray(f, np.float32)
+                )
+                c = np.nonzero(arr)[0].astype(np.int32)
+                v = arr[c].astype(np.float32)
+                d = max(d, arr.shape[0])
+            cols_list.append(c)
+            vals_list.append(v)
+        indptr = np.concatenate(
+            [[0], np.cumsum([len(c) for c in cols_list])]
+        )
+        cols = np.concatenate(cols_list)
+        vals = np.concatenate(vals_list)
+        return csr_to_bcoo((vals, cols, indptr), d), y
+    X = np.stack([
+        p.features.to_array()
+        if isinstance(p.features, DenseVector)
+        else np.asarray(p.features, np.float32)
+        for p in pts
+    ])
     return X, y
