@@ -620,3 +620,26 @@ def test_labeled_points_with_sparse_vectors_train_undensified():
     Xm, ym = to_arrays(mixed)
     assert is_sparse(Xm)
     np.testing.assert_allclose(_dense(Xm), dense_rows, rtol=1e-6)
+
+
+def test_streaming_predict_on_sparse_batches():
+    """predict_on / predict_on_values consume BCOO feature batches."""
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    X, y, _ = _uneven_sparse()
+    alg = StreamingLinearRegressionWithSGD(step_size=0.2, num_iterations=10)
+    alg.set_initial_weights(np.zeros(X.shape[1]))
+    alg.train_on_batch(X, np.asarray(y))
+    from tpu_sgd.ops.sparse import take_rows_bcoo
+
+    batches = [take_rows_bcoo(X, np.arange(0, 100)),
+               take_rows_bcoo(X, np.arange(100, 250))]
+    preds = list(alg.predict_on(iter(batches)))
+    assert [p.shape[0] for p in preds] == [100, 150]
+    keyed = list(alg.predict_on_values([("a", batches[0])]))
+    assert keyed[0][0] == "a" and keyed[0][1].shape == (100,)
+    # sparse and dense batch predictions agree
+    np.testing.assert_allclose(
+        preds[0], np.asarray(alg.latest_model().predict(_dense(batches[0]))),
+        rtol=1e-5,
+    )
