@@ -136,3 +136,29 @@ class TestLinalg:
 
     def test_zeros(self):
         assert Vectors.zeros(4).size == 4
+
+
+def test_labeled_point_parse_sparse_form():
+    """Reference text-form parity: the sparse '(label,(size,[i],[v]))'
+    variant parses into a SparseVector feature record."""
+    p = LabeledPoint.parse("(1.0,(5,[0,3],[2.0,-1.5]))")
+    assert p.label == 1.0 and isinstance(p.features, SparseVector)
+    np.testing.assert_array_equal(p.features.indices, [0, 3])
+    np.testing.assert_allclose(p.features.values, [2.0, -1.5])
+    # empty sparse vector
+    p0 = LabeledPoint.parse("(0.0,(4,[],[]))")
+    assert p0.features.size == 4 and p0.features.indices.size == 0
+    # round-trips through the sparse to_arrays path
+    from tpu_sgd.ops.sparse import is_sparse
+
+    X, y = to_arrays([p, LabeledPoint.parse("(0.0,(5,[1],[3.0]))")])
+    assert is_sparse(X) and X.shape == (2, 5)
+    np.testing.assert_allclose(
+        np.asarray(X.todense()),
+        [[2.0, 0.0, 0.0, -1.5, 0.0], [0.0, 3.0, 0.0, 0.0, 0.0]],
+    )
+    # dense forms unchanged
+    pd = LabeledPoint.parse("(1.0,[1.0,2.0])")
+    np.testing.assert_allclose(pd.features, [1.0, 2.0])
+    pd2 = LabeledPoint.parse("1.0 3.0 4.0")
+    np.testing.assert_allclose(pd2.features, [3.0, 4.0])

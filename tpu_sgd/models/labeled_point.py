@@ -9,22 +9,49 @@ points into the columnar form the optimizer consumes.
 
 from __future__ import annotations
 
-from typing import Iterable, NamedTuple, Tuple
+from typing import TYPE_CHECKING, Iterable, NamedTuple, Tuple, Union
 
 import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tpu_sgd.linalg import DenseVector, SparseVector
 
 
 class LabeledPoint(NamedTuple):
     label: float
-    features: np.ndarray
+    #: raw array, or a linalg Dense/SparseVector record (the reference's
+    #: Vector trait); sparse records flow to BCOO via ``to_arrays``
+    features: Union[np.ndarray, "DenseVector", "SparseVector"]
 
     @staticmethod
     def parse(s: str) -> "LabeledPoint":
-        """Parse "(label,[f0,f1,...])" or "label f0 f1 ..." forms."""
+        """Parse the reference's text forms ([U] LabeledPoint.parse):
+        dense "(label,[f0,f1,...])" / "label f0 f1 ...", or sparse
+        "(label,(size,[i0,i1,...],[v0,v1,...]))" — the latter yields a
+        ``linalg.SparseVector`` feature record."""
         s = s.strip()
         if s.startswith("("):
             label_str, feat_str = s[1:-1].split(",", 1)
-            feats = feat_str.strip().lstrip("[").rstrip("]")
+            feat_str = feat_str.strip()
+            if feat_str.startswith("("):
+                # sparse form: (size,[indices],[values])
+                from tpu_sgd.linalg import SparseVector
+
+                size_str, rest = feat_str[1:-1].split(",", 1)
+                li = rest.index("[")
+                ri = rest.index("]")
+                idx_str = rest[li + 1:ri]
+                val_part = rest[ri + 1:]
+                vals_str = val_part[val_part.index("[") + 1:
+                                    val_part.index("]")]
+                idx = (np.fromstring(idx_str, sep=",", dtype=np.int64)
+                       if idx_str.strip() else np.zeros((0,), np.int64))
+                vals = (np.fromstring(vals_str, sep=",", dtype=np.float32)
+                        if vals_str.strip() else np.zeros((0,), np.float32))
+                return LabeledPoint(
+                    float(label_str), SparseVector(int(size_str), idx, vals)
+                )
+            feats = feat_str.lstrip("[").rstrip("]")
             return LabeledPoint(
                 float(label_str), np.fromstring(feats, sep=",", dtype=np.float32)
             )
