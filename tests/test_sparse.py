@@ -643,3 +643,43 @@ def test_streaming_predict_on_sparse_batches():
         preds[0], np.asarray(alg.latest_model().predict(_dense(batches[0]))),
         rtol=1e-5,
     )
+
+
+def test_save_libsvm_from_bcoo_round_trips(tmp_path, small_sparse):
+    """saveAsLibSVMFile parity on sparse input: a BCOO saves without
+    densifying and round-trips through the sparse loader."""
+    from tpu_sgd.utils.mlutils import save_as_libsvm_file
+
+    X, y, _ = small_sparse
+    path = str(tmp_path / "sp.libsvm")
+    save_as_libsvm_file(path, X, np.asarray(y))
+    X2, y2 = load_libsvm_file_bcoo(path, num_features=X.shape[1])
+    np.testing.assert_allclose(_dense(X2), _dense(X), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y), rtol=1e-5)
+
+
+def test_save_libsvm_coalesces_duplicates_and_zeros(tmp_path):
+    """Duplicate BCOO entries sum (BCOO semantics) and stored zeros drop
+    in the LIBSVM writer, so the text round-trips losslessly."""
+    from jax.experimental.sparse import BCOO
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    idx = np.asarray([[0, 1], [0, 1], [0, 3], [1, 2]], np.int32)
+    vals = jnp.asarray([1.5, 2.5, 0.0, -1.0], jnp.float32)
+    X = BCOO((vals, jnp.asarray(idx)), shape=(2, 5))
+    path = str(tmp_path / "dups.libsvm")
+    save_as_libsvm_file(path, X, np.asarray([1.0, 0.0], np.float32))
+    text = open(path).read()
+    assert "2:4" in text  # 1.5 + 2.5 summed at column index 1 (1-based 2)
+    assert "4:0" not in text  # stored zero dropped
+    Xd, yd = load_libsvm_file(path, num_features=5)
+    np.testing.assert_allclose(Xd, np.asarray(X.todense()), rtol=1e-5)
+
+
+def test_sparse_vector_rejects_out_of_range_indices():
+    from tpu_sgd.linalg import SparseVector
+
+    with pytest.raises(ValueError, match="indices must be in"):
+        SparseVector(3, [-1], [9.0])
+    with pytest.raises(ValueError, match="indices must be in"):
+        SparseVector(3, [5], [9.0])

@@ -52,6 +52,15 @@ class SparseVector:
         self.values = np.asarray(values, np.float32)
         if self.indices.shape != self.values.shape:
             raise ValueError("indices and values must have the same length")
+        if self.indices.size and (
+            self.indices.min() < 0 or self.indices.max() >= self.size
+        ):
+            # reference parity: SparseVector rejects out-of-range indices
+            # rather than silently wrapping (numpy) or dropping (BCOO)
+            raise ValueError(
+                f"indices must be in [0, {self.size}); got "
+                f"[{self.indices.min()}, {self.indices.max()}]"
+            )
 
     def to_array(self) -> np.ndarray:
         out = np.zeros((self.size,), np.float32)

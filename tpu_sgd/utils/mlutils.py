@@ -90,11 +90,41 @@ def load_libsvm_file(
     return (vals.astype(dtype), cols, indptr), labels, d
 
 
-def save_as_libsvm_file(path: str, X: np.ndarray, y: np.ndarray) -> None:
+def save_as_libsvm_file(path: str, X, y: np.ndarray) -> None:
     """Write ``(X, y)`` in 1-based LIBSVM text (parity with
-    ``MLUtils.saveAsLibSVMFile``); zero entries are dropped."""
-    X = np.asarray(X)
+    ``MLUtils.saveAsLibSVMFile``, which serves sparse and dense RDDs
+    alike); zero entries are dropped.  ``X`` may be a dense array or a
+    BCOO matrix — sparse rows are written straight from the entry lists,
+    never densified."""
+    from tpu_sgd.ops.sparse import host_entries, is_sparse
+
     y = np.asarray(y)
+    if is_sparse(X):
+        rows, cols, vals = host_entries(X)  # row-major sorted
+        n, d = X.shape
+        # Coalesce duplicate (i, j) entries (BCOO semantics: values sum —
+        # writing them verbatim would be invalid LIBSVM and reload
+        # last-wins) and drop stored zeros, matching the dense branch.
+        key = rows.astype(np.int64) * d + cols
+        uniq, inv = np.unique(key, return_inverse=True)
+        summed = np.zeros(uniq.shape, np.float64)
+        np.add.at(summed, inv, vals)
+        keep = summed != 0.0
+        uniq, summed = uniq[keep], summed[keep]
+        rows, cols = uniq // d, (uniq % d).astype(np.int64)
+        starts = np.searchsorted(rows, np.arange(n))
+        ends = np.searchsorted(rows, np.arange(n), side="right")
+        cols_l, vals_l = cols.tolist(), summed.tolist()
+        y_l = y.tolist()
+        with open(path, "w") as f:
+            for i in range(n):
+                feats = " ".join(
+                    f"{cols_l[k] + 1}:{vals_l[k]:.6g}"
+                    for k in range(starts[i], ends[i])
+                )
+                f.write(f"{y_l[i]:.6g} {feats}\n")
+        return
+    X = np.asarray(X)
     with open(path, "w") as f:
         for i in range(X.shape[0]):
             nz = np.nonzero(X[i])[0]
