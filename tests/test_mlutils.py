@@ -162,3 +162,32 @@ def test_labeled_point_parse_sparse_form():
     np.testing.assert_allclose(pd.features, [1.0, 2.0])
     pd2 = LabeledPoint.parse("1.0 3.0 4.0")
     np.testing.assert_allclose(pd2.features, [3.0, 4.0])
+
+
+def test_vectors_parse_both_forms():
+    """Reference Vectors.parse parity: dense and sparse text forms."""
+    v = Vectors.parse("[1.0,2.5,-3.0]")
+    assert isinstance(v, DenseVector)
+    np.testing.assert_allclose(v.to_array(), [1.0, 2.5, -3.0])
+    sv = Vectors.parse("(4,[0,2],[1.5,2.5])")
+    assert isinstance(sv, SparseVector)
+    np.testing.assert_allclose(sv.to_array(), [1.5, 0.0, 2.5, 0.0])
+    assert Vectors.parse("[]").size == 0
+    with pytest.raises(ValueError, match="cannot parse"):
+        Vectors.parse("1 2 3")
+    with pytest.raises(ValueError, match="indices must be in"):
+        Vectors.parse("(2,[7],[1.0])")
+
+
+def test_vector_parse_rejects_malformed_text():
+    with pytest.raises(ValueError):
+        Vectors.parse("[1.0,25")  # unterminated
+    with pytest.raises(ValueError):
+        Vectors.parse("[1.0,abc,3.0]")  # corrupt token
+    with pytest.raises(ValueError, match="non-negative"):
+        Vectors.parse("(-3,[],[])")
+    # bracket-less dense tuple form of LabeledPoint still parses
+    from tpu_sgd.models.labeled_point import LabeledPoint
+
+    p = LabeledPoint.parse("(1.0,1.5,2.5)")
+    np.testing.assert_allclose(p.features, [1.5, 2.5])

@@ -50,6 +50,8 @@ class SparseVector:
         self.size = int(size)
         self.indices = np.asarray(indices, np.int64)
         self.values = np.asarray(values, np.float32)
+        if self.size < 0:
+            raise ValueError(f"size must be non-negative, got {self.size}")
         if self.indices.shape != self.values.shape:
             raise ValueError("indices and values must have the same length")
         if self.indices.size and (
@@ -104,6 +106,34 @@ class Vectors:
     @staticmethod
     def zeros(size: int) -> DenseVector:
         return DenseVector(np.zeros((size,), np.float32))
+
+    @staticmethod
+    def parse(s: str) -> Vector:
+        """Parse the reference's vector text forms ([U] Vectors.parse):
+        dense "[v0,v1,...]" or sparse "(size,[i0,...],[v0,...])"."""
+        s = s.strip()
+        if s.startswith("["):
+            if not s.endswith("]"):
+                raise ValueError(f"unterminated vector text {s!r}")
+            body = s[1:-1].strip()
+            # float() per token so corrupt text raises instead of being
+            # silently truncated (np.fromstring stops at the first bad
+            # token without error)
+            vals = [float(t) for t in body.split(",") if t.strip()] \
+                if body else []
+            return DenseVector(np.asarray(vals, np.float32))
+        if s.startswith("("):
+            size_str, rest = s[1:-1].split(",", 1)
+            li, ri = rest.index("["), rest.index("]")
+            idx_str = rest[li + 1:ri]
+            val_part = rest[ri + 1:]
+            vals_str = val_part[val_part.index("[") + 1:val_part.index("]")]
+            idx = (np.fromstring(idx_str, sep=",", dtype=np.int64)
+                   if idx_str.strip() else np.zeros((0,), np.int64))
+            vals = (np.fromstring(vals_str, sep=",", dtype=np.float32)
+                    if vals_str.strip() else np.zeros((0,), np.float32))
+            return SparseVector(int(size_str), idx, vals)
+        raise ValueError(f"cannot parse vector text {s!r}")
 
 
 class BLAS:

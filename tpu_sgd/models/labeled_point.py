@@ -31,30 +31,23 @@ class LabeledPoint(NamedTuple):
         ``linalg.SparseVector`` feature record."""
         s = s.strip()
         if s.startswith("("):
+            # the feature text is exactly Vectors.parse's input (dense
+            # "[...]" or sparse "(size,[i],[v])"); dense stays a raw array
+            # for backward compatibility
+            from tpu_sgd.linalg import DenseVector, Vectors
+
             label_str, feat_str = s[1:-1].split(",", 1)
             feat_str = feat_str.strip()
-            if feat_str.startswith("("):
-                # sparse form: (size,[indices],[values])
-                from tpu_sgd.linalg import SparseVector
-
-                size_str, rest = feat_str[1:-1].split(",", 1)
-                li = rest.index("[")
-                ri = rest.index("]")
-                idx_str = rest[li + 1:ri]
-                val_part = rest[ri + 1:]
-                vals_str = val_part[val_part.index("[") + 1:
-                                    val_part.index("]")]
-                idx = (np.fromstring(idx_str, sep=",", dtype=np.int64)
-                       if idx_str.strip() else np.zeros((0,), np.int64))
-                vals = (np.fromstring(vals_str, sep=",", dtype=np.float32)
-                        if vals_str.strip() else np.zeros((0,), np.float32))
-                return LabeledPoint(
-                    float(label_str), SparseVector(int(size_str), idx, vals)
+            if feat_str.startswith(("[", "(")):
+                feats = Vectors.parse(feat_str)
+                if isinstance(feats, DenseVector):
+                    feats = feats.to_array()
+            else:  # bracket-less tuple form "(label,f0,f1,...)"
+                feats = np.asarray(
+                    [float(t) for t in feat_str.split(",") if t.strip()],
+                    np.float32,
                 )
-            feats = feat_str.lstrip("[").rstrip("]")
-            return LabeledPoint(
-                float(label_str), np.fromstring(feats, sep=",", dtype=np.float32)
-            )
+            return LabeledPoint(float(label_str), feats)
         parts = s.split()
         return LabeledPoint(
             float(parts[0]), np.asarray([float(p) for p in parts[1:]], np.float32)
