@@ -105,3 +105,118 @@ def test_multinomial_sgd_dp_mesh_parity():
     np.testing.assert_allclose(np.asarray(w8), np.asarray(w1), rtol=2e-4,
                                atol=2e-5)
     np.testing.assert_allclose(h8, h1, rtol=2e-4)
+
+
+def test_multinomial_loss_sweep_matches_per_trial():
+    """The stacked line-search sweep equals T independent batch_sums losses
+    (with and without a Bernoulli mask)."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+
+    K, d, T = 4, 7, 6
+    X, y, _ = _multiclass_data(300, d, K, seed=6)
+    g = MultinomialLogisticGradient(K)
+    r = np.random.default_rng(7)
+    W = r.normal(size=(T, (K - 1) * d)).astype(np.float32)
+    mask = (r.random(300) < 0.5).astype(np.float32)
+
+    for m in (None, jnp.asarray(mask)):
+        sums, count = g.loss_sweep(jnp.asarray(X), jnp.asarray(y),
+                                   jnp.asarray(W), mask=m)
+        for t in range(T):
+            _, l_t, c_t = g.batch_sums(jnp.asarray(X), jnp.asarray(y),
+                                       jnp.asarray(W[t]), mask=m)
+            np.testing.assert_allclose(float(sums[t]), float(l_t), rtol=1e-5)
+            np.testing.assert_allclose(float(count), float(c_t))
+
+
+class _NoSweep:
+    """A gradient with ``loss_sweep`` hidden: forces LBFGS/OWLQN's
+    sequential line-search fallback branch (shared by the swept-vs-
+    sequential parity tests)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        if name == "loss_sweep":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+def test_multinomial_lbfgs_swept_equals_sequential():
+    """The batched multinomial line-search ladder (one host sync/iter) must
+    reproduce the sequential scalar ladder's trajectory exactly — same
+    Armijo test, same largest-first acceptance order."""
+    from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    K, d = 3, 6
+    X, y, _ = _multiclass_data(1500, d, K, seed=8)
+    w0 = np.zeros(((K - 1) * d,), np.float32)
+
+    g = MultinomialLogisticGradient(K)
+    w_swept, h_swept = LBFGS(g, max_num_iterations=15).optimize_with_history(
+        (X, y), w0
+    )
+    w_seq, h_seq = LBFGS(
+        _NoSweep(MultinomialLogisticGradient(K)), max_num_iterations=15
+    ).optimize_with_history((X, y), w0)
+    assert not hasattr(_NoSweep(g), "loss_sweep")
+    np.testing.assert_allclose(np.asarray(w_swept), np.asarray(w_seq),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_swept, h_seq, rtol=1e-5)
+
+
+def test_multinomial_owlqn_swept_equals_sequential():
+    """OWL-QN's orthant-projected ladder goes through the matrix-weight
+    sweep as well (was: 30 sequential host syncs per iteration); the
+    batched ladder must reproduce the sequential ladder's trajectory —
+    same orthant projection, same Armijo-on-projected-step test."""
+    from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+    from tpu_sgd.optimize.owlqn import OWLQN
+
+    K, d = 3, 5
+    X, y, _ = _multiclass_data(1200, d, K, seed=9)
+    w0 = np.zeros(((K - 1) * d,), np.float32)
+
+    def run(g):
+        opt = OWLQN(g, reg_param=0.01, max_num_iterations=20)
+        return opt.optimize_with_history((X, y), w0)
+
+    w_swept, h_swept = run(MultinomialLogisticGradient(K))
+    w_seq, h_seq = run(_NoSweep(MultinomialLogisticGradient(K)))
+    assert h_swept[-1] < h_swept[0]
+    np.testing.assert_allclose(np.asarray(w_swept), np.asarray(w_seq),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_swept, h_seq, rtol=1e-5)
+
+
+def test_multinomial_loss_sweep_chunked_matches_unchunked(monkeypatch):
+    """The memory-bounding trial chunking is invisible in the results:
+    force a multi-chunk sweep (chunk=2 < T=7, incl. an odd tail chunk) by
+    shrinking the element budget and compare against the single-pass sweep
+    and per-trial evaluations."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.ops import gradients as G
+
+    K, d, T = 3, 6, 7
+    n = 200
+    X, y, _ = _multiclass_data(n, d, K, seed=11)
+    g = G.MultinomialLogisticGradient(K)
+    W = np.random.default_rng(12).normal(size=(T, (K - 1) * d)).astype(
+        np.float32
+    )
+    Xj, yj, Wj = jnp.asarray(X), jnp.asarray(y), jnp.asarray(W)
+    full, c_full = g.loss_sweep(Xj, yj, Wj)  # chunk == T: single pass
+    monkeypatch.setattr(G, "SWEEP_BUDGET_ELEMS", 2 * n * K)  # chunk == 2
+    chunked, c_chunked = g.loss_sweep(Xj, yj, Wj)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(c_chunked), float(c_full))
+    per_trial = [
+        float(g.loss_sweep(Xj, yj, Wj[t:t + 1])[0][0]) for t in range(T)
+    ]
+    np.testing.assert_allclose(np.asarray(full), per_trial, rtol=1e-5)

@@ -29,7 +29,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from tpu_sgd.ops.gradients import Gradient, margins_of
+from tpu_sgd.ops.gradients import Gradient
 from tpu_sgd.ops.sparse import is_sparse
 from tpu_sgd.ops.updaters import (
     L1Updater,
@@ -158,22 +158,15 @@ def _build_loss_only(gradient, reg_value, mesh, with_valid,
 def _build_loss_sweep(gradient, reg_value, mesh, with_valid,
                       sparse_shape=None):
     """``sweep(W, X, y[, valid]) -> (T,)`` objective values of T trial
-    weight vectors in ONE fused pass: ``margins = X @ Wᵀ`` is a single MXU
-    matmul reading X once for the entire backtracking ladder, vs T separate
-    matvecs (and T host syncs) for a scalar line search.  Pointwise-rule
-    gradients only (vector weights)."""
+    weight vectors in ONE fused pass: the gradient's ``loss_sweep`` rule
+    reads X once for the entire backtracking ladder (a single MXU matmul)
+    vs T separate matvecs (and T host syncs) for a scalar line search.
+    Covers vector weights (derived from ``pointwise``) AND matrix weights
+    (``MultinomialLogisticGradient.loss_sweep``'s stacked-class matmul)."""
 
     def body(W, X, y, valid=None):
         X = _maybe_bcoo(X, sparse_shape)
-        margins = margins_of(X, W)  # (n, T)
-        _, losses = gradient.pointwise(margins, y[:, None])
-        if valid is not None:
-            vf = valid.astype(losses.dtype)
-            losses = losses * vf[:, None]
-            c = jnp.sum(vf)
-        else:
-            c = jnp.asarray(X.shape[0], losses.dtype)
-        l_sum = jnp.sum(losses, axis=0)
+        l_sum, c = gradient.loss_sweep(X, y, W, mask=valid)
         if mesh is not None:
             from tpu_sgd.parallel.mesh import DATA_AXIS
 
@@ -365,7 +358,7 @@ class LBFGS(Optimizer):
         ladder = jnp.asarray(
             0.5 ** np.arange(n_ls), jnp.float32
         )  # trial step sizes, largest first
-        swept = hasattr(gradient, "pointwise")
+        swept = hasattr(gradient, "loss_sweep")
         if swept:
             sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid,
                                       sparse_shape)
@@ -374,7 +367,7 @@ class LBFGS(Optimizer):
             def make_trials(w, direction):
                 return w[None, :] + ladder[:, None] * direction[None, :]
 
-        else:  # matrix-weight gradients: sequential scalar trials
+        else:  # exotic gradients without a sweep rule: sequential trials
             loss_only = _build_loss_only(
                 gradient, reg_value, mesh, with_valid, sparse_shape
             )

@@ -146,7 +146,7 @@ class OWLQN(LBFGS):
         any_penalty = self.reg_param > 0
         n_ls = self._LS_TRIALS  # inherited ladder-length knob (see LBFGS)
         ladder = np.asarray(0.5 ** np.arange(n_ls), np.float32)
-        swept = hasattr(gradient, "pointwise")
+        swept = hasattr(gradient, "loss_sweep")
         if swept:
             # Whole orthant-projected backtracking ladder in ONE fused
             # multi-weight pass (X read once, one host sync) — same sweep
@@ -166,7 +166,7 @@ class OWLQN(LBFGS):
                 preds = (W - wv[None, :]) @ pg
                 return W, preds
 
-        else:  # matrix-weight gradients have no pointwise rule
+        else:  # exotic gradients without a sweep rule
             # loss-only compile: XLA drops the gradient matmul per trial
             _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
                                      sparse_shape)
