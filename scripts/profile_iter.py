@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Decompose the fused SGD iteration's steady-state time on hardware.
+
+VERDICT r2 #8: BASELINE.md puts the two-HBM-read bandwidth floor at
+~1.46 ms/iter vs the achieved 1.64 ms — this script names where the
+remaining ~0.18 ms goes, by MEASUREMENT rather than argument.  It times a
+ladder of stripped-down loop bodies on the same resident slab, each
+isolating one component of the full step:
+
+  full        the real ``make_run`` fused while_loop step (loss history,
+              convergence norm, updater, dynamic window)
+  two_read    both matvecs (margins + gradient) with the dynamic window,
+              but no loss-history scatter / convergence / reg bookkeeping
+  two_read_0  both matvecs with a STATIC window start (isolates the
+              dynamic-slice cost)
+  one_read    the margins matvec only (one HBM read of the window — the
+              single-read floor; the gradient matvec is the second read)
+
+Per-iter times come from a two-point fit (K and 4K iterations per launch)
+so the fixed tunnel launch cost cancels — the same protocol as bench.py.
+Optionally captures a jax.profiler trace of the full run (PROFILE_TRACE=1)
+under bench_logs/profile_trace/.
+
+Writes PROFILE_TPU.json at the repo root.  Run when the tunnel is up:
+    python scripts/profile_iter.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "PROFILE_TPU.json")
+
+ROWS = int(os.environ.get("PROFILE_ROWS", "3000000"))
+DIM = int(os.environ.get("PROFILE_DIM", "1000"))
+FRAC = 0.1
+ITERS = int(os.environ.get("PROFILE_ITERS", "30"))
+STEP_SIZE = 0.5
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from tpu_sgd.utils.platform import honor_cpu_env
+
+    honor_cpu_env()
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    log(f"device: {devices[0].device_kind} ({platform})")
+
+    rows = max(2048, ROWS // 2048 * 2048)
+    m = int(FRAC * rows)
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+
+    key = jax.random.PRNGKey(0)
+    kx, kw, kn = jax.random.split(key, 3)
+
+    @jax.jit
+    def gen():
+        X = jax.random.normal(kx, (rows, DIM), dtype)
+        w_true = jax.random.uniform(kw, (DIM,), jnp.float32, -1.0, 1.0)
+        y = (X.astype(jnp.float32) @ w_true
+             + 0.1 * jax.random.normal(kn, (rows,), jnp.float32))
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+    w0 = jnp.zeros((DIM,), jnp.float32)
+
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient, matmul_dtype
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    mm = matmul_dtype(X)
+
+    def window(i, Xa, ya):
+        """Same per-iteration window draw as make_run's sliced sampling."""
+        start = jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(42), i), (), 0,
+            max(rows - m, 1),
+        )
+        Xb = lax.dynamic_slice_in_dim(Xa, start, m, 0)
+        yb = lax.dynamic_slice_in_dim(ya, start, m, 0)
+        return Xb, yb
+
+    def loop_of(body, iters):
+        @jax.jit
+        def run(w, Xa, ya):
+            return lax.fori_loop(
+                1, iters + 1, lambda i, wc: body(i, wc, Xa, ya), w
+            )
+        return run
+
+    def body_two_read(i, w, Xa, ya):
+        Xb, yb = window(i, Xa, ya)
+        r = jnp.dot(Xb.astype(mm), w.astype(mm),
+                    preferred_element_type=jnp.float32) - yb
+        g = jnp.dot(r.astype(mm), Xb.astype(mm),
+                    preferred_element_type=jnp.float32)
+        return w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * g / m
+
+    def body_two_read_static(i, w, Xa, ya):
+        Xb = lax.dynamic_slice_in_dim(Xa, 0, m, 0)
+        yb = lax.dynamic_slice_in_dim(ya, 0, m, 0)
+        r = jnp.dot(Xb.astype(mm), w.astype(mm),
+                    preferred_element_type=jnp.float32) - yb
+        g = jnp.dot(r.astype(mm), Xb.astype(mm),
+                    preferred_element_type=jnp.float32)
+        return w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * g / m
+
+    def body_one_read(i, w, Xa, ya):
+        Xb, yb = window(i, Xa, ya)
+        r = jnp.dot(Xb.astype(mm), w.astype(mm),
+                    preferred_element_type=jnp.float32) - yb
+        # depend on r without a second X read: rank-1-free update proxy
+        return w * (1.0 - 1e-9 * jnp.mean(r))
+
+    def time_fn(name, fn, *args):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        log(f"{name}: compile+first {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        return time.perf_counter() - t0
+
+    def slope_of(name, make_fn):
+        """Two-point fit over K and 4K iterations; launch cost cancels."""
+        f1 = make_fn(ITERS)
+        f4 = make_fn(4 * ITERS)
+        dt1 = time_fn(f"{name}[{ITERS}]", f1, w0, X, y)
+        dt4 = time_fn(f"{name}[{4 * ITERS}]", f4, w0, X, y)
+        slope = (dt4 - dt1) / (3 * ITERS)
+        if slope <= 0:
+            slope = dt4 / (4 * ITERS)
+        log(f"{name}: {slope * 1e3:.3f} ms/iter steady-state")
+        return slope
+
+    # the real fused program, loss history and all
+    def make_full(iters):
+        cfg = SGDConfig(step_size=STEP_SIZE, num_iterations=iters,
+                        mini_batch_fraction=FRAC, convergence_tol=0.0,
+                        sampling="sliced")
+        return jax.jit(make_run(LeastSquaresGradient(), SimpleUpdater(), cfg))
+
+    results = {}
+    results["full_ms"] = slope_of("full", make_full) * 1e3
+    results["two_read_ms"] = slope_of(
+        "two_read", lambda k: loop_of(body_two_read, k)) * 1e3
+    results["two_read_static_ms"] = slope_of(
+        "two_read_static", lambda k: loop_of(body_two_read_static, k)) * 1e3
+    results["one_read_ms"] = slope_of(
+        "one_read", lambda k: loop_of(body_one_read, k)) * 1e3
+
+    bytes_per_read = m * DIM * (2 if dtype == jnp.bfloat16 else 4)
+    results.update({
+        "platform": platform,
+        "rows": rows,
+        "window_rows": m,
+        "dim": DIM,
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "window_gb_per_read": bytes_per_read / 1e9,
+        # attribution by subtraction
+        "bookkeeping_ms": results["full_ms"] - results["two_read_ms"],
+        "dynamic_slice_ms": (
+            results["two_read_ms"] - results["two_read_static_ms"]
+        ),
+        "second_read_ms": results["two_read_ms"] - results["one_read_ms"],
+    })
+
+    if os.environ.get("PROFILE_TRACE", "0") == "1":
+        trace_dir = os.path.join(REPO, "bench_logs", "profile_trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        fn = make_full(ITERS)
+        jax.block_until_ready(fn(w0, X, y))  # compiled
+        with jax.profiler.trace(trace_dir):
+            jax.block_until_ready(fn(w0, X, y))
+        results["trace_dir"] = trace_dir
+        log(f"trace written to {trace_dir}")
+
+    record = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "results": results,
+    }
+    with open(OUT, "w") as f:
+        json.dump(record, f, indent=1)
+    log(f"wrote {OUT}")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
