@@ -318,6 +318,32 @@ def _streamed_measure() -> dict:
     rows = int(os.environ.get("BENCH_STREAM_ROWS", str(TARGET_ROWS)))
     iters = int(os.environ.get("BENCH_STREAM_ITERS", "12"))
     bf16 = ml_dtypes.bfloat16
+
+    # Bulk-transfer preflight: large host->device transfers have been
+    # observed to hang through the tunnel even when compile/execute works
+    # (round-2 note).  Probe a 256 MB device_put from a killable subprocess
+    # before paying for 20 GB of generation and a possibly-wedged stream.
+    import subprocess
+    probe_timeout = float(os.environ.get("BENCH_STREAM_PROBE_TIMEOUT", "300"))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import numpy as np, jax;"
+             "assert jax.devices()[0].platform != 'cpu';"  # no CPU fallback
+             "x = np.ones((128, 1000, 1000), np.float16);"
+             "jax.block_until_ready(jax.device_put(x))"],
+            timeout=probe_timeout, capture_output=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"bulk-transfer probe failed (rc={r.returncode})"
+            )
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(
+            f"bulk host->device transfer wedged (256 MB probe hung "
+            f">{probe_timeout:.0f}s); skipping the streamed measurement"
+        )
+    log("streamed: 256 MB transfer probe ok")
     log(f"streamed: generating {rows}x{DIM} bf16 host-resident "
         f"({rows * DIM * 2 / 1e9:.0f} GB)...")
     t0 = time.perf_counter()
@@ -349,31 +375,42 @@ def _streamed_measure() -> dict:
     )
     total_s = time.perf_counter() - t0
     iter_walls = [ev.wall_time_s for ev in listener.iterations]
+    summary = _streamed_summary(rows, DIM, FRAC, gen_s, iter_walls, total_s,
+                                float(losses[-1]))
+    log(f"streamed: {summary['steady_state_iter_s'] * 1e3:.0f} ms/iter "
+        f"steady ({summary['batch_gb']:.1f} GB/iter moved, "
+        f"{summary['feed_gb_per_s']:.2f} GB/s feed), "
+        f"{summary['rows_per_sec'] / 1e6:.1f}M rows/s -> "
+        f"{summary['epochs_per_sec']:.3f} epochs/sec; "
+        f"final loss {summary['final_loss']:.4f}")
+    return summary
+
+
+def _streamed_summary(rows, dim, frac, gen_s, iter_walls, total_s,
+                      final_loss):
+    """Pure summary arithmetic for the streamed measurement (unit-tested).
+
+    ``epochs_per_sec`` is epochs of the MEASURED dataset — never a converted
+    problem size (a BENCH_STREAM_ROWS override must not silently rescale to
+    10M rows, the exact distortion this measurement exists to eliminate)."""
     steady = float(np.median(iter_walls[2:])) if len(iter_walls) > 2 else (
         total_s / max(len(iter_walls), 1)
     )
-    rows_per_sec = FRAC * rows / steady
-    # epochs of the MEASURED dataset — never a converted problem size (a
-    # BENCH_STREAM_ROWS override must not silently rescale to 10M rows,
-    # the exact distortion this measurement exists to eliminate)
-    eps = rows_per_sec / rows
-    batch_gb = FRAC * rows * DIM * 2 / 1e9
-    log(f"streamed: {steady * 1e3:.0f} ms/iter steady "
-        f"({batch_gb:.1f} GB/iter moved, {batch_gb / steady:.2f} GB/s feed), "
-        f"{rows_per_sec / 1e6:.1f}M rows/s -> {eps:.3f} epochs/sec; "
-        f"final loss {float(losses[-1]):.4f}")
+    rows_per_sec = frac * rows / steady
+    batch_gb = frac * rows * dim * 2 / 1e9
     return {
         "rows": rows,
-        "dim": DIM,
+        "dim": dim,
         "host_dtype": "bfloat16",
         "gen_s": round(gen_s, 1),
-        "iters": iters,
+        "iters": len(iter_walls),
         "iter_walls_s": [round(t, 4) for t in iter_walls],
         "steady_state_iter_s": steady,
         "rows_per_sec": rows_per_sec,
-        "epochs_per_sec": eps,
+        "epochs_per_sec": rows_per_sec / rows,
+        "batch_gb": batch_gb,
         "feed_gb_per_s": batch_gb / steady,
-        "final_loss": float(losses[-1]),
+        "final_loss": final_loss,
     }
 
 

@@ -71,3 +71,27 @@ def test_report_persisted_marks_stale(bench, tmp_path, monkeypatch, capsys):
     assert reported["value"] == 18.2
     assert "persisted TPU measurement" in reported["note"]
     assert "2026-07-30T06:11:17" in reported["note"]
+
+
+def test_streamed_summary_uses_measured_rows(bench):
+    """epochs/sec must be epochs of the MEASURED dataset: overriding
+    BENCH_STREAM_ROWS must not silently rescale to the 10M-row problem."""
+    walls = [5.0, 1.2, 1.0, 1.0, 1.0]  # first two are compile/cold
+    s = bench._streamed_summary(
+        rows=1_000_000, dim=1000, frac=0.1, gen_s=10.0, iter_walls=walls,
+        total_s=9.2, final_loss=0.05,
+    )
+    assert s["steady_state_iter_s"] == 1.0
+    # frac=0.1 of 1M rows per second of steady iteration
+    assert s["rows_per_sec"] == pytest.approx(100_000.0)
+    # epochs of the 1M-row dataset, NOT divided by TARGET_ROWS
+    assert s["epochs_per_sec"] == pytest.approx(0.1)
+    assert s["iters"] == 5
+
+
+def test_streamed_summary_short_run_falls_back_to_mean(bench):
+    s = bench._streamed_summary(
+        rows=100, dim=10, frac=0.1, gen_s=0.0, iter_walls=[2.0, 2.0], total_s=4.0,
+        final_loss=1.0,
+    )
+    assert s["steady_state_iter_s"] == pytest.approx(2.0)

@@ -124,3 +124,25 @@ def test_lbfgs_multinomial_mesh():
     assert len(h8) == len(h1)
     np.testing.assert_allclose(np.asarray(w8), np.asarray(w1), rtol=1e-3,
                                atol=1e-4)
+
+
+def test_run_lbfgs_signature_parity():
+    """``run_lbfgs`` mirrors the reference's ``object LBFGS.runLBFGS``
+    argument order and (weights, loss_history) return contract."""
+    from tpu_sgd.optimize.lbfgs import run_lbfgs
+
+    X, y, w_true = logistic_data(2000, 6, seed=11)
+    w, hist = run_lbfgs(
+        (X, y),
+        LogisticGradient(),
+        SquaredL2Updater(),
+        10,      # num_corrections
+        1e-6,    # convergence_tol
+        50,      # max_num_iterations
+        0.01,    # reg_param
+        np.zeros(6, np.float32),
+    )
+    assert hist[-1] < hist[0]
+    opt = LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01)
+    w2, hist2 = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    np.testing.assert_allclose(np.asarray(w), np.asarray(w2), rtol=1e-6)

@@ -20,7 +20,8 @@ from tpu_sgd.models import __all__ as _models_all
 from tpu_sgd.ops import *  # noqa: F401,F403
 from tpu_sgd.ops import __all__ as _ops_all
 from tpu_sgd.optimize import (GradientDescent, LBFGS, NormalEquations,
-                              OWLQN, Optimizer, run_mini_batch_sgd)
+                              OWLQN, Optimizer, run_lbfgs,
+                              run_mini_batch_sgd)
 from tpu_sgd.parallel import data_mesh, make_mesh
 
 __version__ = "0.1.0"
@@ -30,6 +31,6 @@ __all__ = (
     + list(_models_all)
     + list(_ops_all)
     + ["GradientDescent", "LBFGS", "NormalEquations", "OWLQN", "Optimizer",
-       "run_mini_batch_sgd",
+       "run_mini_batch_sgd", "run_lbfgs",
        "data_mesh", "make_mesh"]
 )

@@ -5,7 +5,7 @@ from tpu_sgd.optimize.gradient_descent import (
     make_step,
     run_mini_batch_sgd,
 )
-from tpu_sgd.optimize.lbfgs import LBFGS
+from tpu_sgd.optimize.lbfgs import LBFGS, run_lbfgs
 from tpu_sgd.optimize.normal import NormalEquations
 from tpu_sgd.optimize.owlqn import OWLQN
 
@@ -18,4 +18,5 @@ __all__ = [
     "make_run",
     "make_step",
     "run_mini_batch_sgd",
+    "run_lbfgs",
 ]

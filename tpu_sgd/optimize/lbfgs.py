@@ -433,3 +433,32 @@ class LBFGS(Optimizer):
 
         self._loss_history = np.asarray(losses, np.float32)
         return w, self._loss_history
+
+
+def run_lbfgs(
+    data: Dataset,
+    gradient: Gradient,
+    updater: Updater,
+    num_corrections: int,
+    convergence_tol: float,
+    max_num_iterations: int,
+    reg_param: float,
+    initial_weights: Array,
+    mesh=None,
+):
+    """Functional entry point, signature-parity with the reference's
+    ``object LBFGS.runLBFGS`` ([U] mllib/optimization/LBFGS.scala,
+    SURVEY.md §2 #18): same argument order, returns
+    ``(weights, loss_history)``.
+    """
+    opt = LBFGS(
+        gradient,
+        updater,
+        num_corrections=num_corrections,
+        convergence_tol=convergence_tol,
+        max_num_iterations=max_num_iterations,
+        reg_param=reg_param,
+    )
+    if mesh is not None:
+        opt.set_mesh(mesh)
+    return opt.optimize_with_history(data, initial_weights)
