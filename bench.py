@@ -306,25 +306,18 @@ def _streamed_measure() -> dict:
     first two (compile + cold caches), reported next to the resident-slab
     number so the 18.2 epochs/sec conversion is either validated or
     corrected by artifact (BASELINE.json:5,10; SURVEY.md §7 phase 6)."""
-    import jax.numpy as jnp
-    import ml_dtypes
-
-    from tpu_sgd.config import SGDConfig
-    from tpu_sgd.ops.gradients import LeastSquaresGradient
-    from tpu_sgd.ops.updaters import SimpleUpdater
-    from tpu_sgd.optimize.streamed import optimize_host_streamed
-    from tpu_sgd.utils.events import CollectingListener
-
-    rows = int(os.environ.get("BENCH_STREAM_ROWS", str(TARGET_ROWS)))
-    iters = int(os.environ.get("BENCH_STREAM_ITERS", "12"))
-    bf16 = ml_dtypes.bfloat16
-
     # Bulk-transfer preflight: large host->device transfers have been
     # observed to hang through the tunnel even when compile/execute works
     # (round-2 note).  Probe a 256 MB device_put from a killable subprocess
     # before paying for 20 GB of generation and a possibly-wedged stream.
     import subprocess
     probe_timeout = float(os.environ.get("BENCH_STREAM_PROBE_TIMEOUT", "300"))
+    if probe_timeout <= 0:  # explicit skip (CPU smoke tests)
+        log("streamed: transfer probe skipped (timeout <= 0)")
+        from tpu_sgd.utils.platform import honor_cpu_env
+
+        honor_cpu_env()  # direct CPU invocation: never dial the tunnel
+        return _streamed_body()
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -344,6 +337,23 @@ def _streamed_measure() -> dict:
             f">{probe_timeout:.0f}s); skipping the streamed measurement"
         )
     log("streamed: 256 MB transfer probe ok")
+    return _streamed_body()
+
+
+def _streamed_body() -> dict:
+    """Generation + the plain and partial-residency streamed runs (split
+    from the transfer-probe front door so CPU smoke tests can skip it)."""
+    import ml_dtypes
+
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.streamed import optimize_host_streamed
+    from tpu_sgd.utils.events import CollectingListener
+
+    rows = int(os.environ.get("BENCH_STREAM_ROWS", str(TARGET_ROWS)))
+    iters = int(os.environ.get("BENCH_STREAM_ITERS", "12"))
+    bf16 = ml_dtypes.bfloat16
     log(f"streamed: generating {rows}x{DIM} bf16 host-resident "
         f"({rows * DIM * 2 / 1e9:.0f} GB)...")
     t0 = time.perf_counter()
@@ -367,22 +377,50 @@ def _streamed_measure() -> dict:
         convergence_tol=0.0,
         sampling="sliced",
     )
-    listener = CollectingListener()
-    t0 = time.perf_counter()
-    w, losses = optimize_host_streamed(
-        LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
-        np.zeros((DIM,), np.float32), listener=listener,
-    )
-    total_s = time.perf_counter() - t0
-    iter_walls = [ev.wall_time_s for ev in listener.iterations]
-    summary = _streamed_summary(rows, DIM, FRAC, gen_s, iter_walls, total_s,
-                                float(losses[-1]))
-    log(f"streamed: {summary['steady_state_iter_s'] * 1e3:.0f} ms/iter "
-        f"steady ({summary['batch_gb']:.1f} GB/iter moved, "
-        f"{summary['feed_gb_per_s']:.2f} GB/s feed), "
-        f"{summary['rows_per_sec'] / 1e6:.1f}M rows/s -> "
-        f"{summary['epochs_per_sec']:.3f} epochs/sec; "
-        f"final loss {summary['final_loss']:.4f}")
+
+    def run_once(tag, resident_rows):
+        listener = CollectingListener()
+        t0 = time.perf_counter()
+        _, losses = optimize_host_streamed(
+            LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+            np.zeros((DIM,), np.float32), listener=listener,
+            resident_rows=resident_rows,
+        )
+        total_s = time.perf_counter() - t0
+        iter_walls = [ev.wall_time_s for ev in listener.iterations]
+        s = _streamed_summary(rows, DIM, FRAC, gen_s, iter_walls, total_s,
+                              float(losses[-1]))
+        log(f"{tag}: {s['steady_state_iter_s'] * 1e3:.0f} ms/iter steady "
+            f"({s['batch_gb']:.1f} GB/iter window, "
+            f"{s['feed_gb_per_s']:.2f} GB/s equiv feed), "
+            f"{s['rows_per_sec'] / 1e6:.1f}M rows/s -> "
+            f"{s['epochs_per_sec']:.3f} epochs/sec; "
+            f"final loss {s['final_loss']:.4f}")
+        return s
+
+    summary = run_once("streamed", 0)
+
+    # Partial residency: keep as much of the dataset on the device as HBM
+    # allows and slice those windows on-device — per-epoch feed traffic
+    # drops by ~resident/rows with an unchanged window sequence (the
+    # beyond-HBM optimization the 20 GB north star actually wants; v5 lite
+    # HBM is 16 GB, so ~6M bf16 rows fit beside the batch buffers).
+    resident = int(os.environ.get("BENCH_STREAM_RESIDENT", "6000000"))
+    resident = min(resident, rows)
+    m_fixed = max(1, round(FRAC * rows))
+    if resident and resident >= m_fixed:
+        hybrid = run_once(f"streamed_hybrid[res={resident}]", resident)
+        hybrid["resident_rows"] = resident
+        # feed_gb_per_s assumes every iteration transfers the window; in
+        # the hybrid run ~resident/rows of iterations move zero bytes, so
+        # record it as an EQUIVALENT rate plus the honest transfer odds —
+        # the artifact must not read as a higher link bandwidth.
+        hybrid["equiv_feed_gb_per_s"] = hybrid.pop("feed_gb_per_s")
+        p_resident = min(
+            1.0, (resident - m_fixed + 1) / max(rows - m_fixed + 1, 1)
+        )
+        hybrid["expected_transfer_fraction"] = round(1.0 - p_resident, 4)
+        summary["hybrid"] = hybrid
     return summary
 
 

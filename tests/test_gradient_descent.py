@@ -425,3 +425,79 @@ def test_sliced_sampling_ragged_shards():
     w, hist = opt.optimize_with_history((X, y), np.zeros(6, np.float32))
     assert np.all(np.isfinite(hist))
     np.testing.assert_allclose(np.asarray(w), w_true, atol=0.06)
+
+
+def test_partial_residency_matches_plain_streaming():
+    """resident_rows changes WHERE windows are read from (device prefix vs
+    host transfer), never WHICH windows are drawn or what they compute: the
+    trajectory must match plain streaming exactly, at every residency level
+    including fully resident."""
+    X, y, _ = linear_data(5000, 6, eps=0.01, seed=13)
+    w0 = np.zeros(6, np.float32)
+
+    def run(resident_rows):
+        opt = (
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_step_size(0.4).set_num_iterations(80)
+            .set_mini_batch_fraction(0.1).set_convergence_tol(0.0)
+            .set_sampling("sliced")
+            .set_host_streaming(True, resident_rows=resident_rows)
+        )
+        return opt.optimize_with_history((X, y), w0)
+
+    w_plain, h_plain = run(0)
+    for r in (1000, 3000, 5000):  # partial 20%/60%, fully resident
+        w_r, h_r = run(r)
+        np.testing.assert_allclose(np.asarray(w_r), np.asarray(w_plain),
+                                   rtol=1e-6, atol=1e-7)
+        # the two compiled programs (sliced-on-device vs transferred batch)
+        # fuse differently -> ~1e-9 absolute reassociation noise in losses
+        np.testing.assert_allclose(h_r, h_plain, rtol=1e-5, atol=1e-8)
+
+
+def test_partial_residency_guards():
+    """resident_rows misuse raises actionable errors instead of silently
+    changing semantics."""
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    X, y, _ = linear_data(1000, 4, seed=14)
+    w0 = np.zeros(4, np.float32)
+
+    def make(**hs):
+        return (
+            GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_num_iterations(3).set_mini_batch_fraction(0.1)
+            .set_sampling("sliced")
+            .set_host_streaming(True, **hs)
+        )
+
+    with pytest.raises(NotImplementedError, match="single device"):
+        make(resident_rows=500).set_mesh(data_mesh()).optimize_with_history(
+            (X, y), w0
+        )
+    with pytest.raises(NotImplementedError, match="sliced"):
+        make(resident_rows=500).set_sampling("bernoulli") \
+            .optimize_with_history((X, y), w0)
+    with pytest.raises(ValueError, match="smaller than one window"):
+        make(resident_rows=10).optimize_with_history((X, y), w0)
+
+
+def test_partial_residency_via_train_api():
+    """streaming_resident_rows is reachable from the user-facing train()
+    and reproduces the plain streamed result."""
+    from tpu_sgd.models import LinearRegressionWithSGD
+
+    X, y, _ = linear_data(3000, 5, eps=0.01, seed=15)
+
+    def fit(**kw):
+        return LinearRegressionWithSGD.train(
+            (X, y), num_iterations=60, step_size=0.4,
+            mini_batch_fraction=0.2, sampling="sliced",
+            host_streaming=True, **kw,
+        )
+
+    m_plain = fit()
+    m_res = fit(streaming_resident_rows=2000)
+    np.testing.assert_allclose(np.asarray(m_res.weights),
+                               np.asarray(m_plain.weights),
+                               rtol=1e-6, atol=1e-7)

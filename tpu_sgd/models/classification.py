@@ -127,6 +127,7 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
         mesh=None,
         sampling: str = None,
         host_streaming: bool = False,
+        streaming_resident_rows: int = 0,
     ):
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -137,7 +138,9 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
         if sampling is not None:
             alg.optimizer.set_sampling(sampling)
         if host_streaming:
-            alg.optimizer.set_host_streaming(True)
+            alg.optimizer.set_host_streaming(
+                True, resident_rows=streaming_resident_rows
+            )
         return alg.run(data, initial_weights)
 
 

@@ -80,13 +80,17 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         mesh=None,
         sampling: str = None,
         host_streaming: bool = False,
+        streaming_resident_rows: int = 0,
     ):
         """Static train() parity with the reference's object methods.
 
         ``mesh``, ``sampling`` and ``host_streaming`` are the TPU-side
         extensions: a device mesh for data parallelism, the mini-batch
         sampling strategy (see ``SGDConfig.sampling``), and host-resident
-        streaming for datasets larger than device HBM.
+        streaming for datasets larger than device HBM —
+        ``streaming_resident_rows`` additionally keeps that many leading
+        rows on the device (partial residency; sliced sampling, single
+        device) so most windows need no host transfer.
         """
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -95,7 +99,9 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         if sampling is not None:
             alg.optimizer.set_sampling(sampling)
         if host_streaming:
-            alg.optimizer.set_host_streaming(True)
+            alg.optimizer.set_host_streaming(
+                True, resident_rows=streaming_resident_rows
+            )
         return alg.run(data, initial_weights)
 
 

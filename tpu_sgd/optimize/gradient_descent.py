@@ -247,6 +247,7 @@ class GradientDescent(Optimizer):
         self.mesh = None
         self.listener = None
         self.host_streaming = False
+        self.streaming_resident_rows = 0
         self.check_numerics = False
         self.checkpoint_manager = None
         self.checkpoint_every = 10
@@ -322,13 +323,20 @@ class GradientDescent(Optimizer):
         self.check_numerics = bool(flag)
         return self
 
-    def set_host_streaming(self, flag: bool = True):
+    def set_host_streaming(self, flag: bool = True, resident_rows: int = 0):
         """Keep the dataset in host RAM and stream per-iteration sampled
         batches to the device with double-buffered prefetch — for datasets
         larger than HBM (SURVEY.md §7, config 4 at full 40 GB scale).
         Composes with ``set_mesh`` on a 1-D data mesh: each batch is
-        row-sharded across cores and gradients all-reduce over ICI."""
+        row-sharded across cores and gradients all-reduce over ICI.
+
+        ``resident_rows``: partial residency (sliced sampling, single
+        device) — rows ``[0, resident_rows)`` are placed on the device once
+        and windows inside that prefix are sliced on-device, cutting
+        per-epoch host->device traffic by ~``resident_rows/n`` with an
+        unchanged window sequence (see ``optimize_host_streamed``)."""
         self.host_streaming = bool(flag)
+        self.streaming_resident_rows = int(resident_rows)
         return self
 
     def set_checkpoint(self, manager, every: int = 10):
@@ -394,6 +402,7 @@ class GradientDescent(Optimizer):
                 initial_weights, mesh=self.mesh, listener=self.listener,
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every,
+                resident_rows=self.streaming_resident_rows,
             )
             self._loss_history = hist
             if self.check_numerics:

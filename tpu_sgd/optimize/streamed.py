@@ -48,6 +48,7 @@ def optimize_host_streamed(
     listener=None,
     checkpoint_manager=None,
     checkpoint_every: int = 10,
+    resident_rows: int = 0,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -61,6 +62,15 @@ def optimize_host_streamed(
     batch is ``device_put`` row-sharded across cores and the step runs under
     ``shard_map`` with the ICI gradient all-reduce, so datasets beyond one
     chip's HBM still use every core (SURVEY.md §7 phase 6).
+
+    ``resident_rows``: partial residency for datasets only somewhat beyond
+    HBM (the 10M x 1000 bf16 north star is 20 GB vs a 16 GB chip): rows
+    ``[0, resident_rows)`` are placed on the device ONCE, and any sliced
+    window falling inside that prefix is sliced on-device — zero
+    host->device traffic for a ``resident_rows/n`` fraction of iterations,
+    cutting per-epoch feed bytes by the same factor while drawing the
+    identical window sequence (the sampler's RNG stream is unchanged).
+    Sliced sampling, single device (``mesh=None``) only.
     """
     import time as _time
 
@@ -77,11 +87,35 @@ def optimize_host_streamed(
 
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
+    frac = cfg.mini_batch_fraction
+    m_fixed = max(1, round(frac * n))
+    R = 0
+    if resident_rows:
+        if mesh is not None:
+            raise NotImplementedError(
+                "resident_rows composes with a single device; a mesh "
+                "shards the resident slab with its own layout — use the "
+                "fully-resident mesh path or plain streaming"
+            )
+        if cfg.sampling != "sliced" or frac >= 1.0:
+            raise NotImplementedError(
+                "resident_rows requires sampling='sliced' with "
+                "mini_batch_fraction < 1 (contiguous windows are what can "
+                "be sliced on-device)"
+            )
+        R = min(int(resident_rows), n)
+        if R < m_fixed:
+            raise ValueError(
+                f"resident_rows={resident_rows} is smaller than one "
+                f"window ({m_fixed} rows); no window can ever hit the "
+                "resident prefix — raise it or use plain streaming"
+            )
     if mesh is None:
         if device is None:
             device = jax.devices()[0]
         w_sharding = device
-        step = jax.jit(make_step(gradient, updater, step_cfg))
+        base_step = make_step(gradient, updater, step_cfg)
+        step = jax.jit(base_step)
         row_sharding = mask_sharding = device
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -104,8 +138,6 @@ def optimize_host_streamed(
     # astronomically rare; a uniformly random subset is kept on overflow —
     # shuffle before truncation — so the estimate stays unbiased).  Indexed
     # and sliced batches are fixed-size by construction.
-    frac = cfg.mini_batch_fraction
-    m_fixed = max(1, round(frac * n))
     if frac >= 1.0:
         cap = n
     elif cfg.sampling == "bernoulli":
@@ -116,6 +148,20 @@ def optimize_host_streamed(
     if mesh is not None:
         n_shards = mesh.shape[DATA_AXIS]
         cap += (-cap) % n_shards  # even shards; padding rows are invalid
+
+    if R:
+        # One-time placement of the resident prefix; windows inside it are
+        # sliced on-device by the SAME step math (identical mask/count ops
+        # to the transferred path, so trajectories are bitwise-unchanged).
+        Xres = jax.device_put(X[:R], device)
+        yres = jax.device_put(y[:R], device)
+        ones_mask = jnp.ones((m_fixed,), bool)
+
+        @jax.jit
+        def resident_step(w, Xr, yr, start, i, reg_val):
+            Xb = jax.lax.dynamic_slice_in_dim(Xr, start, m_fixed, 0)
+            yb = jax.lax.dynamic_slice_in_dim(yr, start, m_fixed, 0)
+            return base_step(w, Xb, yb, i, reg_val, ones_mask)
 
     _gather = lambda A, idx: A[idx]
     if X.flags.c_contiguous:  # native gather requires contiguous rows
@@ -137,6 +183,12 @@ def optimize_host_streamed(
             # Contiguous window: a plain slice (zero-copy view), never the
             # row gather — sequential host I/O is this mode's entire point.
             start = int(rng.integers(0, max(1, n - m_fixed + 1)))
+            if start + m_fixed <= R:
+                # window lies in the device-resident prefix: no transfer;
+                # the RNG stream is identical either way, so residency
+                # changes WHERE a window is read from, never WHICH windows
+                # are drawn
+                return ("resident", start)
             Xb, yb = X[start:start + m_fixed], y[start:start + m_fixed]
             valid = np.ones((cap,), bool)
             if cap > m_fixed:  # mesh shard padding: one tail memcpy
@@ -196,14 +248,21 @@ def optimize_host_streamed(
     nxt = sample(start_iter)
     i = start_iter
     while i <= cfg.num_iterations and not converged:
-        Xb, yb, valid = nxt
         t0 = _time.perf_counter()
         # Dispatch the device step FIRST (async), then assemble the next
         # batch on the host while the device computes — this is the overlap;
         # only the final block_until_ready waits on the device.
-        new_w, loss_i, new_reg, c = step(
-            w, Xb, yb, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val), valid
-        )
+        if R and isinstance(nxt[0], str):  # ("resident", start)
+            new_w, loss_i, new_reg, c = resident_step(
+                w, Xres, yres, jnp.asarray(nxt[1], jnp.int32),
+                jnp.asarray(i, jnp.int32), jnp.asarray(reg_val),
+            )
+        else:
+            Xb, yb, valid = nxt
+            new_w, loss_i, new_reg, c = step(
+                w, Xb, yb, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val),
+                valid,
+            )
         if i < cfg.num_iterations:
             nxt = sample(i + 1)
         new_w = jax.block_until_ready(new_w)
