@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Launch a 2-process multi-host training job on one machine.
+
+The multi-host bring-up the framework documents (SURVEY.md §5.8: DCN
+across hosts after ``jax.distributed.initialize``) demonstrated end to
+end with REAL processes: this launcher spawns two worker processes that
+form a ``jax.distributed`` job over localhost (CPU backend + gloo
+collectives standing in for a TPU pod's ICI/DCN), each holding its own
+local rows — the analogue of Spark executors reading their own input
+splits — and trains one model over the combined 8-device global mesh.
+
+On an actual TPU pod the same worker code runs unchanged with ONE line
+different per host (no explicit coordinator args — they auto-detect):
+
+    initialize_distributed()            # on every host
+    mesh = global_data_mesh()
+    LinearRegressionWithSGD.train((X_local, y_local), mesh=mesh)
+
+Usage:  python examples/run_multihost.py
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import sys
+import numpy as np
+
+proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+import jax
+jax.config.update("jax_platforms", "cpu")          # demo runs on CPU
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from tpu_sgd.parallel.distributed import (
+    global_data_mesh,
+    initialize_distributed,
+    process_count,
+)
+
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=num_procs,
+    process_id=proc_id,
+)
+assert process_count() == num_procs
+
+from tpu_sgd.models import LinearRegressionWithSGD
+
+# Each process generates ITS OWN rows (different seeds) — no process ever
+# sees another's data; only gradient all-reduces cross the process
+# boundary at train time.
+rng = np.random.default_rng(100 + proc_id)
+w_true = np.linspace(-1, 1, 16).astype(np.float32)   # same truth everywhere
+n_local = 4000 + 1000 * proc_id                      # uneven on purpose
+X = rng.normal(size=(n_local, 16)).astype(np.float32)
+y = (X @ w_true + 0.05 * rng.normal(size=n_local)).astype(np.float32)
+
+model = LinearRegressionWithSGD.train(
+    (X, y), num_iterations=150, step_size=0.4, mini_batch_fraction=1.0,
+    mesh=global_data_mesh(),
+)
+err = float(np.linalg.norm(np.asarray(model.weights) - w_true))
+print(f"process {proc_id}: {len(jax.devices())}-device global mesh, "
+      f"local rows={n_local}, w_err={err:.4f}", flush=True)
+assert err < 0.05
+"""
+
+
+def main() -> None:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=os.pathsep.join(
+            p for p in (REPO, os.environ.get("PYTHONPATH")) if p
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(i), "2", str(port)], env=env
+        )
+        for i in range(2)
+    ]
+    try:
+        rcs = [p.wait(timeout=300) for p in procs]
+    finally:
+        # a hung or crashed worker must not orphan its peer (a standard
+        # jax.distributed failure mode: one side stuck in a collective)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rcs):
+        raise SystemExit(f"worker failure: rcs={rcs}")
+    print("multi-host demo ok: 2 processes, one global mesh, one model")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,8 @@ isolating one component of the full step:
 
   full        the real ``make_run`` fused while_loop step (loss history,
               convergence norm, updater, dynamic window)
+  two_read_hist  both matvecs + the per-iteration loss-history scatter
+              (isolates the scatter from the rest of the bookkeeping)
   two_read    both matvecs (margins + gradient) with the dynamic window,
               but no loss-history scatter / convergence / reg bookkeeping
   two_read_0  both matvecs with a STATIC window start (isolates the
@@ -112,6 +114,32 @@ def main():
                     preferred_element_type=jnp.float32)
         return w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * g / m
 
+    def loop_hist(iters):
+        """Two matvecs + the loss-history scatter — the carry is (w, hist)
+        like the real run, so the scatter's cost (and any fusion it
+        blocks) is measured in isolation from convergence/reg
+        bookkeeping."""
+
+        def body(i, carry, Xa, ya):
+            w, hist = carry
+            Xb, yb = window(i, Xa, ya)
+            r = jnp.dot(Xb.astype(mm), w.astype(mm),
+                        preferred_element_type=jnp.float32) - yb
+            g = jnp.dot(r.astype(mm), Xb.astype(mm),
+                        preferred_element_type=jnp.float32)
+            loss = 0.5 * jnp.mean(r * r)
+            hist = jax.lax.dynamic_update_index_in_dim(hist, loss, i - 1, 0)
+            w = w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * g / m
+            return (w, hist)
+
+        @jax.jit
+        def run(w, Xa, ya):
+            hist0 = jnp.zeros((iters,), jnp.float32)
+            return jax.lax.fori_loop(
+                1, iters + 1, lambda i, c: body(i, c, Xa, ya), (w, hist0)
+            )
+        return run
+
     def body_two_read_static(i, w, Xa, ya):
         Xb = lax.dynamic_slice_in_dim(Xa, 0, m, 0)
         yb = lax.dynamic_slice_in_dim(ya, 0, m, 0)
@@ -157,6 +185,7 @@ def main():
 
     results = {}
     results["full_ms"] = slope_of("full", make_full) * 1e3
+    results["two_read_hist_ms"] = slope_of("two_read_hist", loop_hist) * 1e3
     results["two_read_ms"] = slope_of(
         "two_read", lambda k: loop_of(body_two_read, k)) * 1e3
     results["two_read_static_ms"] = slope_of(
@@ -174,6 +203,9 @@ def main():
         "window_gb_per_read": bytes_per_read / 1e9,
         # attribution by subtraction
         "bookkeeping_ms": results["full_ms"] - results["two_read_ms"],
+        "history_scatter_ms": (
+            results["two_read_hist_ms"] - results["two_read_ms"]
+        ),
         "dynamic_slice_ms": (
             results["two_read_ms"] - results["two_read_static_ms"]
         ),

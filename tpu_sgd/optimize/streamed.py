@@ -36,6 +36,13 @@ from tpu_sgd.ops.gradients import Gradient
 from tpu_sgd.ops.updaters import Updater
 
 
+def sliced_window_rows(n: int, frac: float) -> int:
+    """Rows per sliced-sampling window — THE definition shared by the
+    sampler and by external consumers (bench's residency math), so they
+    cannot silently desync on rounding."""
+    return max(1, round(frac * n))
+
+
 def optimize_host_streamed(
     gradient: Gradient,
     updater: Updater,
@@ -88,7 +95,7 @@ def optimize_host_streamed(
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
     frac = cfg.mini_batch_fraction
-    m_fixed = max(1, round(frac * n))
+    m_fixed = sliced_window_rows(n, frac)
     R = 0
     if resident_rows:
         if mesh is not None:
@@ -151,8 +158,9 @@ def optimize_host_streamed(
 
     if R:
         # One-time placement of the resident prefix; windows inside it are
-        # sliced on-device by the SAME step math (identical mask/count ops
-        # to the transferred path, so trajectories are bitwise-unchanged).
+        # sliced on-device by the SAME step math (identical window sequence
+        # and mask/count ops; the two compiled programs may fuse
+        # differently, so trajectories agree to reassociation noise).
         Xres = jax.device_put(X[:R], device)
         yres = jax.device_put(y[:R], device)
         ones_mask = jnp.ones((m_fixed,), bool)
@@ -162,6 +170,22 @@ def optimize_host_streamed(
             Xb = jax.lax.dynamic_slice_in_dim(Xr, start, m_fixed, 0)
             yb = jax.lax.dynamic_slice_in_dim(yr, start, m_fixed, 0)
             return base_step(w, Xb, yb, i, reg_val, ones_mask)
+
+        # Prewarm BOTH compiled programs (dummy on-device inputs, no host
+        # transfer): the window sequence decides per iteration which
+        # program runs, so without this the OTHER program's first compile
+        # would land mid-run at an RNG-dependent iteration — a multi-second
+        # wall spike that corrupts steady-state timing.
+        i0 = jnp.asarray(1, jnp.int32)
+        r0 = jnp.zeros((), jnp.float32)
+        jax.block_until_ready(resident_step(
+            w, Xres, yres, jnp.asarray(0, jnp.int32), i0, r0
+        ))
+        Xb0 = jnp.zeros((m_fixed,) + X.shape[1:], Xres.dtype)
+        yb0 = jnp.zeros((m_fixed,), yres.dtype)
+        v0 = jnp.ones((m_fixed,), bool)
+        jax.block_until_ready(step(w, Xb0, yb0, i0, r0, v0))
+        del Xb0, yb0, v0
 
     _gather = lambda A, idx: A[idx]
     if X.flags.c_contiguous:  # native gather requires contiguous rows
@@ -177,7 +201,11 @@ def optimize_host_streamed(
         """Per-iteration host-side sample honoring ``config.sampling`` —
         bernoulli (RDD.sample parity), indexed (fixed-size gather with
         replacement), or sliced (contiguous window) — deterministic in
-        ``default_rng(seed + i)`` and padded to the fixed cap."""
+        ``default_rng(seed + i)`` and padded to the fixed cap.
+
+        Returns a tagged pair: ``("resident", start)`` for an on-device
+        window of the resident prefix, or ``("batch", (Xb, yb, valid))``
+        for a transferred batch — explicit dispatch, no type-sniffing."""
         rng = np.random.default_rng(cfg.seed + i)
         if frac < 1.0 and cfg.sampling == "sliced":
             # Contiguous window: a plain slice (zero-copy view), never the
@@ -198,11 +226,11 @@ def optimize_host_streamed(
                 yp = np.zeros((cap,), y.dtype)
                 yp[:m_fixed] = yb
                 Xb, yb = Xp, yp
-            return (
+            return ("batch", (
                 jax.device_put(Xb, row_sharding),
                 jax.device_put(yb, mask_sharding),
                 jax.device_put(valid, mask_sharding),
-            )
+            ))
         if frac >= 1.0:
             idx = np.arange(n)
         elif cfg.sampling == "indexed":
@@ -216,11 +244,11 @@ def optimize_host_streamed(
         valid[: idx.shape[0]] = True
         pad = np.zeros((cap,), np.int64)
         pad[: idx.shape[0]] = idx
-        return (
+        return ("batch", (
             jax.device_put(_gather(X, pad), row_sharding),
             jax.device_put(y[pad], mask_sharding),
             jax.device_put(valid, mask_sharding),
-        )
+        ))
 
     if listener is not None:
         listener.on_run_start(cfg)
@@ -252,15 +280,18 @@ def optimize_host_streamed(
         # Dispatch the device step FIRST (async), then assemble the next
         # batch on the host while the device computes — this is the overlap;
         # only the final block_until_ready waits on the device.
-        if R and isinstance(nxt[0], str):  # ("resident", start)
+        kind, payload = nxt
+        if kind == "resident":
             new_w, loss_i, new_reg, c = resident_step(
-                w, Xres, yres, jnp.asarray(nxt[1], jnp.int32),
-                jnp.asarray(i, jnp.int32), jnp.asarray(reg_val),
+                w, Xres, yres, jnp.asarray(payload, jnp.int32),
+                jnp.asarray(i, jnp.int32),
+                jnp.asarray(reg_val, jnp.float32),
             )
         else:
-            Xb, yb, valid = nxt
+            Xb, yb, valid = payload
             new_w, loss_i, new_reg, c = step(
-                w, Xb, yb, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val),
+                w, Xb, yb, jnp.asarray(i, jnp.int32),
+                jnp.asarray(reg_val, jnp.float32),
                 valid,
             )
         if i < cfg.num_iterations:
