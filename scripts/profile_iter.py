@@ -9,10 +9,13 @@ isolating one component of the full step:
 
   full        the real ``make_run`` fused while_loop step (loss history,
               convergence norm, updater, dynamic window)
-  two_read_hist  both matvecs + the per-iteration loss-history scatter
-              (isolates the scatter from the rest of the bookkeeping)
+  two_read_hist  both matvecs + loss reduction + the per-iteration
+              loss-history scatter
+  two_read_loss  both matvecs + the loss reduction kept live (no scatter)
+              — so hist − loss isolates the SCATTER and loss − two_read
+              isolates the loss REDUCTION
   two_read    both matvecs (margins + gradient) with the dynamic window,
-              but no loss-history scatter / convergence / reg bookkeeping
+              but no loss / scatter / convergence / reg bookkeeping
   two_read_0  both matvecs with a STATIC window start (isolates the
               dynamic-slice cost)
   one_read    the margins matvec only (one HBM read of the window — the
@@ -140,6 +143,19 @@ def main():
             )
         return run
 
+    def body_two_read_loss(i, w, Xa, ya):
+        """Two matvecs + the loss reduction, kept live via an epsilon-add
+        (a plain unused loss would be dead-code-eliminated; 1e-30*loss is
+        numerically negligible but not algebraically removable)."""
+        Xb, yb = window(i, Xa, ya)
+        r = jnp.dot(Xb.astype(mm), w.astype(mm),
+                    preferred_element_type=jnp.float32) - yb
+        g = jnp.dot(r.astype(mm), Xb.astype(mm),
+                    preferred_element_type=jnp.float32)
+        loss = 0.5 * jnp.mean(r * r)
+        return (w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * g / m
+                + 1e-30 * loss)
+
     def body_two_read_static(i, w, Xa, ya):
         Xb = lax.dynamic_slice_in_dim(Xa, 0, m, 0)
         yb = lax.dynamic_slice_in_dim(ya, 0, m, 0)
@@ -186,6 +202,8 @@ def main():
     results = {}
     results["full_ms"] = slope_of("full", make_full) * 1e3
     results["two_read_hist_ms"] = slope_of("two_read_hist", loop_hist) * 1e3
+    results["two_read_loss_ms"] = slope_of(
+        "two_read_loss", lambda k: loop_of(body_two_read_loss, k)) * 1e3
     results["two_read_ms"] = slope_of(
         "two_read", lambda k: loop_of(body_two_read, k)) * 1e3
     results["two_read_static_ms"] = slope_of(
@@ -204,7 +222,10 @@ def main():
         # attribution by subtraction
         "bookkeeping_ms": results["full_ms"] - results["two_read_ms"],
         "history_scatter_ms": (
-            results["two_read_hist_ms"] - results["two_read_ms"]
+            results["two_read_hist_ms"] - results["two_read_loss_ms"]
+        ),
+        "loss_reduction_ms": (
+            results["two_read_loss_ms"] - results["two_read_ms"]
         ),
         "dynamic_slice_ms": (
             results["two_read_ms"] - results["two_read_static_ms"]

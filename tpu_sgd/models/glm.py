@@ -64,6 +64,28 @@ class GeneralizedLinearModel:
         out = self.predict_point(self.predict_margin(X))
         return out[0] if single else out
 
+    def predict_streamed(self, X, batch_rows: int = 1_000_000) -> np.ndarray:
+        """Chunked prediction for host-resident matrices beyond device HBM
+        — the analogue of the reference's ``predict(RDD[Vector])`` scoring
+        partitions executor-side ([U] GeneralizedLinearModel, SURVEY.md §2
+        #5): each chunk is transferred, scored on device, and materialized
+        back to host memory before the next chunk moves, so peak device
+        memory is one ``batch_rows`` block regardless of ``len(X)``."""
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        if not is_sparse(X):  # BCOO chunks by row slicing, undensified
+            X = np.asarray(X)
+        if X.ndim == 1:
+            return np.asarray(self.predict(X))
+        outs = [
+            np.asarray(self.predict(X[s:s + batch_rows]))
+            for s in range(0, X.shape[0], batch_rows)
+        ]
+        return (
+            np.concatenate(outs) if outs
+            else np.zeros((0,), np.float32)
+        )
+
     def __repr__(self):
         return (
             f"{type(self).__name__}(numFeatures={self.weights.shape[-1]}, "
