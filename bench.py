@@ -350,6 +350,7 @@ def _streamed_body() -> dict:
     from tpu_sgd.ops.updaters import SimpleUpdater
     from tpu_sgd.optimize.streamed import (
         optimize_host_streamed,
+        resident_window_probability,
         sliced_window_rows,
     )
     from tpu_sgd.utils.events import CollectingListener
@@ -367,8 +368,12 @@ def _streamed_body() -> dict:
     chunk = 250_000
     for s in range(0, rows, chunk):
         e = min(s + chunk, rows)
-        Xc = rng.normal(size=(e - s, DIM)).astype(np.float32)
-        y[s:e] = Xc @ w_true + 0.1 * rng.normal(size=e - s).astype(np.float32)
+        # standard_normal(dtype=f32) draws f32 directly — ~2x faster on
+        # this 1-core host than normal()+astype for the 10^10-draw dataset
+        Xc = rng.standard_normal(size=(e - s, DIM), dtype=np.float32)
+        y[s:e] = Xc @ w_true + 0.1 * rng.standard_normal(
+            size=e - s, dtype=np.float32
+        )
         X[s:e] = Xc.astype(bf16)
     gen_s = time.perf_counter() - t0
     log(f"streamed: generated in {gen_s:.0f}s")
@@ -428,9 +433,7 @@ def _streamed_body() -> dict:
             # transfer odds — the artifact must not read as a higher link
             # bandwidth.
             hybrid["equiv_feed_gb_per_s"] = hybrid.pop("feed_gb_per_s")
-            p_resident = min(
-                1.0, (resident - m_fixed + 1) / max(rows - m_fixed + 1, 1)
-            )
+            p_resident = resident_window_probability(rows, FRAC, resident)
             hybrid["expected_transfer_fraction"] = round(1.0 - p_resident, 4)
         except Exception as e:
             log(f"hybrid run failed ({type(e).__name__}: {e}); keeping the "

@@ -204,3 +204,64 @@ def test_sparse_batch_sums_fully_empty_matrix():
         np.testing.assert_allclose(gs, gd, atol=1e-6)
         np.testing.assert_allclose(ls, ld, rtol=1e-6)
         assert float(cs) == float(cd) == n
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    seed=st.integers(0, 2**16),
+    n=st.integers(5, 60),
+    d=st.integers(2, 8),
+    t=st.integers(1, 6),
+    grad_idx=st.integers(0, 2),
+    with_mask=st.booleans(),
+)
+def test_loss_sweep_equals_per_trial_property(seed, n, d, t, grad_idx,
+                                              with_mask):
+    """For every vector-weight gradient: the batched line-search sweep over
+    T stacked trial weights equals T independent batch_sums losses, with
+    identical counts, masked or not."""
+    import jax.numpy as jnp
+
+    gradient = [LeastSquaresGradient(), LogisticGradient(), HingeGradient()][
+        grad_idx
+    ]
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, d)).astype(np.float32)
+    y = (r.random(n) < 0.5).astype(np.float32)
+    W = r.normal(size=(t, d)).astype(np.float32)
+    mask = jnp.asarray((r.random(n) < 0.7).astype(np.float32)) if with_mask \
+        else None
+    sums, count = gradient.loss_sweep(jnp.asarray(X), jnp.asarray(y),
+                                      jnp.asarray(W), mask=mask)
+    assert sums.shape == (t,)
+    for k in range(t):
+        _, l_k, c_k = gradient.batch_sums(jnp.asarray(X), jnp.asarray(y),
+                                          jnp.asarray(W[k]), mask=mask)
+        np.testing.assert_allclose(float(sums[k]), float(l_k), rtol=2e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(float(count), float(c_k))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    n=st.integers(10, 500),
+    frac=st.floats(0.05, 0.99),
+    r_frac=st.floats(0.0, 1.0),
+)
+def test_resident_window_probability_property(n, frac, r_frac):
+    """The residency hit-rate formula bench records matches the sampler's
+    actual accept set: a window [start, start+m) drawn from
+    integers(0, n-m+1) lies in the resident prefix iff start <= R-m."""
+    from tpu_sgd.optimize.streamed import (
+        resident_window_probability,
+        sliced_window_rows,
+    )
+
+    m = sliced_window_rows(n, frac)
+    R = int(r_frac * n)
+    hits = sum(
+        1 for start in range(0, n - m + 1) if start + m <= R
+    )
+    assert hits / max(n - m + 1, 1) == pytest.approx(
+        resident_window_probability(n, frac, R)
+    )

@@ -43,6 +43,16 @@ def sliced_window_rows(n: int, frac: float) -> int:
     return max(1, round(frac * n))
 
 
+def resident_window_probability(n: int, frac: float, resident: int) -> float:
+    """Probability a sliced window lies in the resident prefix: the sampler
+    draws ``start ~ integers(0, n-m+1)`` and the window is resident iff
+    ``start + m <= resident`` — shared with bench's recorded
+    ``expected_transfer_fraction`` so the artifact cannot desync from the
+    sampler's actual accept set."""
+    m = sliced_window_rows(n, frac)
+    return min(1.0, max(0.0, (resident - m + 1) / max(n - m + 1, 1)))
+
+
 def optimize_host_streamed(
     gradient: Gradient,
     updater: Updater,
