@@ -28,10 +28,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("variants", nargs="*",
                     default=["matvec", "grad", "ws", "pallas1024",
-                             "pallas2048"],
-                    help="which paths to time (pallasN = tile_m N; tiles "
-                         "over the VMEM budget are rejected with a clear "
-                         "error, see pallas_kernels._check_tile_vmem)")
+                             "pallas2048", "vpu1024", "vpu2048"],
+                    help="which paths to time (pallasN = MXU fused window "
+                         "kernel at tile_m N; vpuN = the VPU-reduction "
+                         "variant, see fused_window_sums_vpu; tiles over "
+                         "the VMEM budget are rejected with a clear error, "
+                         "see pallas_kernels._check_tile_vmem)")
     ap.add_argument("--rows", type=int, default=2_998_272)
     ap.add_argument("--dim", type=int, default=1000)
     ap.add_argument("--frac", type=float, default=0.1,
@@ -127,23 +129,28 @@ def main(argv=None):
                                jnp.int32(1024), X, y)
 
     for v in variants:
-        if v.startswith("pallas"):
-            tile = int(v[len("pallas"):])
+        if v.startswith("pallas") or v.startswith("vpu"):
+            kind = "vpu" if v.startswith("vpu") else "pallas"
+            tile = int(v[len(kind):])
             if m // tile == 0:
                 print(f"{v}: window m={m} < tile {tile}; skipped")
                 continue
             from tpu_sgd.ops.gradients import LeastSquaresGradient
-            from tpu_sgd.ops.pallas_kernels import fused_window_sums
+            from tpu_sgd.ops.pallas_kernels import (
+                fused_window_sums,
+                fused_window_sums_vpu,
+            )
 
             g = LeastSquaresGradient()
             nt = m // tile
+            kernel = (fused_window_sums_vpu if kind == "vpu"
+                      else fused_window_sums)
 
-            def pw(w, start, X, y, tile=tile, nt=nt):
-                return fused_window_sums(g.pointwise, X, y, w, start, nt,
-                                         tile_m=tile)
+            def pw(w, start, X, y, tile=tile, nt=nt, kernel=kernel):
+                return kernel(g.pointwise, X, y, w, start, nt, tile_m=tile)
 
             try:
-                results[v] = timeit(f"pallas window tile={tile}", pw, w,
+                results[v] = timeit(f"{kind} window tile={tile}", pw, w,
                                     jnp.int32(1), X, y, rows_done=nt * tile)
             except Exception as e:  # keep sweeping past a bad tile size
                 print(f"{v} failed ({type(e).__name__}: "
@@ -153,12 +160,12 @@ def main(argv=None):
     if "ws" in results:
         base_dt, base_rows = results["ws"]
         for k, (dt, rows_done) in results.items():
-            if k.startswith("pallas"):
+            if k.startswith("pallas") or k.startswith("vpu"):
                 # Per-row comparison: the pallas window is floored to a tile
                 # multiple, so raw wall-clock would not be apples-to-apples.
                 ratio = (base_dt / base_rows) / (dt / rows_done)
                 print(f"{k} vs ws (per row): {ratio:.2f}x "
-                      f"({'pallas wins' if ratio > 1 else 'xla wins'})")
+                      f"({'kernel wins' if ratio > 1 else 'xla wins'})")
     return results
 
 

@@ -214,3 +214,40 @@ def test_vmem_guard_rejects_oversized_tile():
     g = LeastSquaresGradient()
     with pytest.raises(ValueError, match="VMEM"):
         fused_window_sums(g.pointwise, X, y, w, 0, 2, tile_m=8192)
+
+
+def test_vpu_window_kernel_matches_base():
+    """The VPU-reduction window kernel (round-3 experiment) computes the
+    same sums as the MXU variant and the base path, for every pointwise
+    gradient rule."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.ops.gradients import (
+        HingeGradient,
+        LeastSquaresGradient,
+        LogisticGradient,
+    )
+    from tpu_sgd.ops.pallas_kernels import (
+        fused_window_sums,
+        fused_window_sums_vpu,
+    )
+
+    X, y, w = _data(n=512, d=24, seed=11)
+    start_tile, num_tiles, tile = 1, 4, 64
+    lo, hi = start_tile * tile, (start_tile + num_tiles) * tile
+    for g in (LeastSquaresGradient(), LogisticGradient(), HingeGradient()):
+        gs_v, ls_v, c_v = fused_window_sums_vpu(
+            g.pointwise, X, y, w, jnp.asarray(start_tile), num_tiles,
+            tile_m=tile, interpret=True,
+        )
+        gs_m, ls_m, c_m = fused_window_sums(
+            g.pointwise, X, y, w, jnp.asarray(start_tile), num_tiles,
+            tile_m=tile, interpret=True,
+        )
+        gs_ref, ls_ref, c_ref = g.batch_sums(X[lo:hi], y[lo:hi], w)
+        np.testing.assert_allclose(np.asarray(gs_v), np.asarray(gs_ref),
+                                   rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(gs_v), np.asarray(gs_m),
+                                   rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(float(ls_v), float(ls_ref), rtol=2e-4)
+        assert float(c_v) == float(c_ref) == num_tiles * tile

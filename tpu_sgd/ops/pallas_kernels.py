@@ -55,6 +55,16 @@ bandwidth-bound reduction and runs at the HBM floor, so the kernel's
 one-read advantage cannot pay for its compute shape.  Per SURVEY.md §2's
 native-component ledger the XLA-compiled fused matvec IS the TPU-native
 analogue of the reference's JNI BLAS; nothing routes here by default.
+
+**Round-3 follow-up experiment:** :func:`fused_window_sums_vpu` attacks
+the diagnosed bottleneck directly — the second (gradient) matmul is
+recast as elementwise-multiply + sublane reduction, VPU work at memory
+rate, leaving only ONE underutilized MXU pass.  If the VPU lowering is
+clean, the one-read fusion finally beats the XLA path's two-read floor
+(~1.46 ms/iter on the 3M-row workload) instead of losing to compute
+shape; semantics are interpreter-verified (tests/test_pallas.py), the
+hardware verdict comes from ``bench_kernels.py``'s ``vpuN`` variants via
+the tunnel watcher.
 """
 
 from __future__ import annotations
@@ -77,18 +87,24 @@ SUBLANES = 8  # f32 sublane count: the weight/coefficient blocks' lane dim
 _VMEM_BUDGET = 14 * 1024 * 1024
 
 
-def _check_tile_vmem(tile: int, X, interpret: bool) -> None:
+def _check_tile_vmem(tile: int, X, interpret: bool,
+                     extra_tiles: int = 0) -> None:
     """Reject tile sizes whose double-buffered VMEM footprint cannot compile
     (measured: tile 8192 x d=1000 bf16 = 40 MB scoped vs the 16 MB limit)
-    with an actionable error instead of a Mosaic compile-time OOM."""
+    with an actionable error instead of a Mosaic compile-time OOM.
+
+    ``extra_tiles``: additional (tile, d) X-dtype temporaries the kernel
+    body materializes (the VPU variant's elementwise product)."""
     if interpret:
         return
     d = X.shape[1]
     itemsize = jnp.dtype(X.dtype).itemsize
-    # X tile double-buffered + y/mask tiles + the (8, d) f32 accumulator
-    need = 2 * tile * d * itemsize + 4 * tile * 4 + SUBLANES * d * 4
+    # X tile double-buffered (+ body temps) + y/mask tiles + the (8, d)
+    # f32 accumulator
+    need = ((2 + extra_tiles) * tile * d * itemsize + 4 * tile * 4
+            + SUBLANES * d * 4)
     if need > _VMEM_BUDGET:
-        per_tile = 2 * d * itemsize + 16
+        per_tile = (2 + extra_tiles) * d * itemsize + 16
         max_tile = (_VMEM_BUDGET - SUBLANES * d * 4) // per_tile // 8 * 8
         hint = (
             f"use tile_m <= {max_tile}"
@@ -112,19 +128,16 @@ except Exception:  # pragma: no cover
     HAS_PALLAS = False
 
 
-def _tile_contrib(pointwise, Xt, yv, mv, W):
-    """One row tile's ``(grad_block, loss_sum, count)``.
+def _masked_coeff_losses(pointwise, Xt, yv, mv, W):
+    """Shared tile prologue: one MXU margins pass + masked pointwise rule.
 
     ``Xt (tile, d)``, ``yv``/``mv`` ``(tile, 1)``, ``W (d, SUBLANES)`` with
-    the weight vector in column 0.  Matmul inputs use ``Xt``'s dtype (bf16
-    data runs both MXU passes in bf16 with f32 accumulation); the returned
-    grad block is ``(SUBLANES, d)`` f32 with the gradient in row 0.
-
-    The pointwise rule is evaluated on the full ``(tile, SUBLANES)`` margin
-    block — columns 1.. see the garbage margins of the zero weight columns —
-    and an iota lane mask zeroes their coeff/loss before the second matmul,
-    so no single-lane slice or concatenate is ever materialized.
-    """
+    the weight vector in column 0.  The pointwise rule is evaluated on the
+    full ``(tile, SUBLANES)`` margin block — columns 1.. see the garbage
+    margins of the zero weight columns — and an iota lane mask zeroes their
+    coeff/loss, so no single-lane slice or concatenate is materialized.
+    Returns ``(coeff, losses, count)`` with coeff/losses ``(tile,
+    SUBLANES)`` and only column 0 live."""
     margins = jnp.dot(
         Xt, W.astype(Xt.dtype), preferred_element_type=jnp.float32
     )  # (tile, SUBLANES); only column 0 is real
@@ -136,6 +149,15 @@ def _tile_contrib(pointwise, Xt, yv, mv, W):
     coeff = jnp.where(sel, coeff, 0.0)
     losses = jnp.where(sel, losses, 0.0)
     cnt = jnp.float32(Xt.shape[0]) if mv is None else jnp.sum(mv)
+    return coeff, losses, cnt
+
+
+def _tile_contrib(pointwise, Xt, yv, mv, W):
+    """One row tile's ``(grad_block, loss_sum, count)``: the MXU variant —
+    both reductions are matmuls (bf16 data runs both passes in bf16 with
+    f32 accumulation); the returned grad block is ``(SUBLANES, d)`` f32
+    with the gradient in row 0."""
+    coeff, losses, cnt = _masked_coeff_losses(pointwise, Xt, yv, mv, W)
     G = jax.lax.dot_general(
         coeff.astype(Xt.dtype),
         Xt,
@@ -157,6 +179,58 @@ def _accumulate(i, grad_ref, loss_ref, cnt_ref, G, lt, ct):
         grad_ref[:] = grad_ref[:] + G
         loss_ref[0, 0] = loss_ref[0, 0] + lt
         cnt_ref[0, 0] = cnt_ref[0, 0] + ct
+
+
+def _tile_contrib_vpu(pointwise, Xt, yv, mv, W):
+    """One row tile's sums with the gradient reduction on the VPU.
+
+    Round-3 experiment against the round-2 finding that BOTH MXU matmuls
+    underutilize the systolic array 16x (M/N = 8): margins stay on the MXU
+    (one (tile, d) @ (d, 8) pass), but the gradient outer-product-sum is
+    recast as elementwise-multiply + sublane reduction —
+    ``sum(coeff_vec * Xt, axis=0)`` — which is VPU work at memory rate, so
+    the kernel's cost model becomes one DMA + one matmul + one
+    bandwidth-rate reduction instead of two underutilized matmuls.
+    Returns a ``(1, d)`` gradient row (accumulated into row 0 of the
+    ``(SUBLANES, d)`` output by the caller)."""
+    coeff, losses, cnt = _masked_coeff_losses(pointwise, Xt, yv, mv, W)
+    # (tile, 8) -> (tile, 1): an 8-lane reduction (cheap), keeping >= 2-D
+    coeff_vec = jnp.sum(coeff, axis=1, keepdims=True)
+    # Elementwise multiply in Xt's dtype with f32 SUM accumulation — the
+    # same precision contract as the MXU variant's bf16 dot_general, and
+    # no f32 (tile, d) temp blowing the VMEM budget (_check_tile_vmem
+    # models one extra tile-sized temp for this path).
+    contrib = coeff_vec.astype(Xt.dtype) * Xt
+    g1 = jnp.sum(contrib, axis=0, keepdims=True,
+                 dtype=jnp.float32)  # (1, d)
+    return g1, jnp.sum(losses), cnt
+
+
+def _accumulate_vpu(i, grad_ref, loss_ref, cnt_ref, g1, lt, ct):
+    """Accumulate a (1, d) gradient row into row 0 of the (SUBLANES, d)
+    output block (sublane-axis slice writes; the lane axis is untouched)."""
+    @pl.when(i == 0)
+    def _():
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+        grad_ref[0:1] = g1
+        loss_ref[0, 0] = lt
+        cnt_ref[0, 0] = ct
+
+    @pl.when(i > 0)
+    def _():
+        grad_ref[0:1] = grad_ref[0:1] + g1
+        loss_ref[0, 0] = loss_ref[0, 0] + lt
+        cnt_ref[0, 0] = cnt_ref[0, 0] + ct
+
+
+def _window_kernel_vpu(pointwise, s_ref, x_ref, y_ref, w_ref,
+                       grad_ref, loss_ref, cnt_ref):
+    del s_ref  # consumed by the BlockSpec index maps
+    i = pl.program_id(0)
+    g1, lt, ct = _tile_contrib_vpu(
+        pointwise, x_ref[:], y_ref[:], None, w_ref[:]
+    )
+    _accumulate_vpu(i, grad_ref, loss_ref, cnt_ref, g1, lt, ct)
 
 
 def _masked_kernel(pointwise, x_ref, y_ref, m_ref, w_ref,
@@ -294,7 +368,9 @@ def fused_window_sums(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("pointwise", "num_tiles", "tile_m", "interpret")
+    jax.jit,
+    static_argnames=("pointwise", "num_tiles", "tile_m", "interpret",
+                     "use_vpu"),
 )
 def _fused_window_sums(
     pointwise,
@@ -305,6 +381,7 @@ def _fused_window_sums(
     num_tiles: int,
     tile_m: int = 2048,
     interpret: bool = False,
+    use_vpu: bool = False,
 ) -> Tuple[Array, Array, Array]:
     n, d = X.shape
     if n % tile_m:
@@ -326,8 +403,9 @@ def _fused_window_sums(
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
     )
+    kernel = _window_kernel_vpu if use_vpu else _window_kernel
     grad, loss, cnt = pl.pallas_call(
-        functools.partial(_window_kernel, pointwise),
+        functools.partial(kernel, pointwise),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((SUBLANES, d), jnp.float32),
@@ -342,6 +420,28 @@ def _fused_window_sums(
         _pad_w(w),
     )
     return grad[0], loss[0, 0], cnt[0, 0]
+
+
+def fused_window_sums_vpu(
+    pointwise,
+    X: Array,
+    y: Array,
+    w: Array,
+    start_tile: Array,
+    num_tiles: int,
+    tile_m: int = 2048,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """VPU-reduction variant of :func:`fused_window_sums` (round-3
+    experiment; see ``_tile_contrib_vpu``).  Same contract and constraints;
+    the gradient lands in row 0 of the block like the MXU variant."""
+    _require_pallas()
+    _check_tile_vmem(tile_m, X, interpret, extra_tiles=1)
+    return _fused_window_sums(
+        pointwise, X, y, w, start_tile,
+        num_tiles=num_tiles, tile_m=tile_m, interpret=interpret,
+        use_vpu=True,
+    )
 
 
 class PallasGradient(Gradient):
