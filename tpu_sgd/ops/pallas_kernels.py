@@ -466,10 +466,18 @@ class PallasGradient(Gradient):
     """
 
     def __init__(self, base: Gradient, tile_m: int = 2048,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None, window_kernel: str = "mxu"):
+        if window_kernel not in ("mxu", "vpu"):
+            raise ValueError(
+                f"window_kernel must be 'mxu' or 'vpu', got {window_kernel!r}"
+            )
         self.base = base
         self.tile_m = tile_m
         self.interpret = interpret
+        #: which fused window kernel serves window_sums: the round-2 MXU
+        #: variant (default) or the round-3 VPU-reduction experiment (one
+        #: underutilized matmul instead of two; see fused_window_sums_vpu)
+        self.window_kernel = window_kernel
 
     def pointwise(self, margin, label):
         return self.base.pointwise(margin, label)
@@ -531,7 +539,9 @@ class PallasGradient(Gradient):
             jnp.asarray(start, jnp.int32) // self.tile_m,
             (n - m) // self.tile_m,
         )
-        g, l, c = fused_window_sums(
+        kernel = (fused_window_sums_vpu if self.window_kernel == "vpu"
+                  else fused_window_sums)
+        g, l, c = kernel(
             self.base.pointwise, X, y, weights, start_tile, num_tiles,
             tile_m=self.tile_m, interpret=bool(self.interpret),
         )
