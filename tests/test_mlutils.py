@@ -191,3 +191,70 @@ def test_vector_parse_rejects_malformed_text():
 
     p = LabeledPoint.parse("(1.0,1.5,2.5)")
     np.testing.assert_allclose(p.features, [1.5, 2.5])
+
+
+def test_load_libsvm_directory_and_glob(tmp_path):
+    """Directory-of-part-files and glob inputs load like the reference's
+    sc.textFile paths: rows concatenate in sorted-filename order, feature
+    count is the max across files, Hadoop markers are skipped."""
+    import numpy as np
+
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    r = np.random.default_rng(31)
+    X1 = np.round(r.normal(size=(5, 4)), 3).astype(np.float32)
+    X2 = np.round(r.normal(size=(7, 4)), 3).astype(np.float32)
+    y1 = r.integers(0, 2, 5).astype(np.float32)
+    y2 = r.integers(0, 2, 7).astype(np.float32)
+    d = tmp_path / "parts"
+    d.mkdir()
+    save_as_libsvm_file(str(d / "part-00000"), X1, y1)
+    save_as_libsvm_file(str(d / "part-00001"), X2, y2)
+    (d / "_SUCCESS").write_text("")  # Hadoop marker: must be skipped
+    (d / ".hidden").write_text("junk not libsvm")
+
+    X_dir, y_dir = load_libsvm_file(str(d))
+    np.testing.assert_allclose(X_dir, np.concatenate([X1, X2]), atol=1e-6)
+    np.testing.assert_array_equal(y_dir, np.concatenate([y1, y2]))
+
+    X_glob, y_glob = load_libsvm_file(str(d / "part-*"))
+    np.testing.assert_allclose(X_glob, X_dir)
+    np.testing.assert_array_equal(y_glob, y_dir)
+
+    # sparse CSR multi-file path keeps row offsets straight
+    (data, indices, indptr), y_csr, nf = load_libsvm_file(
+        str(d), dense=False
+    )
+    dense_back = np.zeros((12, nf), np.float32)
+    for i in range(12):
+        sl = slice(indptr[i], indptr[i + 1])
+        dense_back[i, indices[sl]] = data[sl]
+    np.testing.assert_allclose(dense_back, X_dir, atol=1e-6)
+    np.testing.assert_array_equal(y_csr, y_dir)
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="no input files"):
+        load_libsvm_file(str(d / "nope-*"))
+
+
+def test_load_libsvm_literal_path_with_glob_chars(tmp_path):
+    """A literal filename containing glob metacharacters still loads, and
+    glob expansion skips Hadoop marker files like the directory form."""
+    import numpy as np
+
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    X = np.eye(3, dtype=np.float32)
+    y = np.ones((3,), np.float32)
+    weird = tmp_path / "a9a[train].txt"
+    save_as_libsvm_file(str(weird), X, y)
+    X_back, y_back = load_libsvm_file(str(weird))
+    np.testing.assert_allclose(X_back, X)
+
+    d = tmp_path / "out"
+    d.mkdir()
+    save_as_libsvm_file(str(d / "part-00000"), X, y)
+    (d / "_metadata").write_text("not libsvm at all")
+    X_g, y_g = load_libsvm_file(str(d / "*"))  # glob skips _metadata
+    np.testing.assert_allclose(X_g, X)

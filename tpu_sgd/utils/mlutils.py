@@ -55,26 +55,75 @@ def _parse_libsvm_python(path: str):
     )
 
 
+def _resolve_input_paths(path: str):
+    """Expand ``path`` the way the reference's ``sc.textFile`` does
+    ([U] MLUtils.loadLibSVMFile over HDFS paths, SURVEY.md §3.4): a
+    directory reads its part files (sorted; Hadoop markers like _SUCCESS
+    and hidden files skipped), a glob pattern expands, a plain path is one
+    file.  Raises FileNotFoundError when nothing matches."""
+    import glob as _glob
+
+    def _is_data_file(p):
+        base = os.path.basename(p)
+        return (not base.startswith((".", "_"))) and os.path.isfile(p)
+
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if _is_data_file(os.path.join(path, f))
+        )
+    elif any(c in path for c in "*?["):
+        files = sorted(p for p in _glob.glob(path) if _is_data_file(p))
+        # a literal filename that merely CONTAINS glob chars (e.g.
+        # "a9a[train].txt") still loads directly
+        if not files and os.path.isfile(path):
+            files = [path]
+    else:
+        files = [path] if os.path.isfile(path) else []
+    if not files:
+        raise FileNotFoundError(f"no input files match {path!r}")
+    return files
+
+
+def _parse_one(path):
+    try:
+        from tpu_sgd.utils.native import parse_libsvm as _native
+
+        return _native(path)
+    except Exception:
+        return _parse_libsvm_python(path)
+
+
 def load_libsvm_file(
     path: str,
     num_features: Optional[int] = None,
     dense: bool = True,
     dtype=np.float32,
 ):
-    """Load a LIBSVM-format file into ``(X, y)``.
+    """Load LIBSVM-format data into ``(X, y)``.
 
-    ``num_features`` discovery scans for the max index, exactly like the
-    reference's one extra reduce job (SURVEY.md §3.4).  ``dense=True``
-    densifies (the TPU-resident layout; config 3's "sparse->densified",
-    BASELINE.json:9); ``dense=False`` returns a scipy-free CSR triple
-    ``((data, indices, indptr), y, num_features)``.
+    ``path`` may be one file, a directory of part files, or a glob — the
+    reference reads all three through ``sc.textFile`` (SURVEY.md §3.4);
+    rows concatenate in sorted-filename order.  ``num_features`` discovery
+    scans for the max index, exactly like the reference's one extra reduce
+    job.  ``dense=True`` densifies (the TPU-resident layout; config 3's
+    "sparse->densified", BASELINE.json:9); ``dense=False`` returns a
+    scipy-free CSR triple ``((data, indices, indptr), y, num_features)``.
     """
-    try:
-        from tpu_sgd.utils.native import parse_libsvm as _native
-
-        labels, rows, cols, vals, max_idx = _native(path)
-    except Exception:
-        labels, rows, cols, vals, max_idx = _parse_libsvm_python(path)
+    files = _resolve_input_paths(path)
+    if len(files) == 1:
+        labels, rows, cols, vals, max_idx = _parse_one(files[0])
+    else:
+        parts = [_parse_one(f) for f in files]
+        offsets = np.cumsum([0] + [p[0].shape[0] for p in parts[:-1]])
+        labels = np.concatenate([p[0] for p in parts])
+        rows = np.concatenate(
+            [p[1] + off for p, off in zip(parts, offsets)]
+        )
+        cols = np.concatenate([p[2] for p in parts])
+        vals = np.concatenate([p[3] for p in parts])
+        max_idx = max(p[4] for p in parts)
     d = num_features if num_features is not None else max_idx
     n = labels.shape[0]
     if dense:
