@@ -258,3 +258,70 @@ def test_load_libsvm_literal_path_with_glob_chars(tmp_path):
     (d / "_metadata").write_text("not libsvm at all")
     X_g, y_g = load_libsvm_file(str(d / "*"))  # glob skips _metadata
     np.testing.assert_allclose(X_g, X)
+
+
+def test_save_libsvm_partitioned_roundtrip(tmp_path):
+    """num_partitions > 1 writes the reference's saveAsTextFile directory
+    layout (part files + _SUCCESS) and round-trips through the directory
+    loader, dense AND sparse."""
+    import numpy as np
+
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    r = np.random.default_rng(33)
+    X = np.round(r.normal(size=(11, 5)), 3).astype(np.float32)
+    y = r.integers(0, 2, 11).astype(np.float32)
+    out = tmp_path / "out"
+    save_as_libsvm_file(str(out), X, y, num_partitions=3)
+    parts = sorted(p.name for p in out.iterdir())
+    assert parts == ["_SUCCESS", "part-00000", "part-00001", "part-00002"]
+    X2, y2 = load_libsvm_file(str(out), num_features=5)
+    np.testing.assert_allclose(X2, X, atol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+
+    # sparse BCOO partitioned write
+    from tpu_sgd.ops.sparse import load_libsvm_file_bcoo, sparse_data
+
+    Xs, ys, _ = sparse_data(9, 6, nnz_per_row=2, seed=5)
+    out2 = tmp_path / "out_sparse"
+    save_as_libsvm_file(str(out2), Xs, ys, num_partitions=2)
+    Xb, yb = load_libsvm_file_bcoo(str(out2), num_features=6)
+    np.testing.assert_allclose(np.asarray(Xb.todense()),
+                               np.asarray(Xs.todense()), atol=1e-5)
+    # labels print at %.6g (6 significant digits): ~1e-5 text round-trip
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(ys), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_partitioned_save_refuses_existing_path(tmp_path):
+    """saveAsTextFile semantics: an existing output path is an error (a
+    fewer-partition rewrite would leave stale part files the directory
+    loader silently mixes in)."""
+    import numpy as np
+
+    from tpu_sgd.utils.mlutils import save_as_libsvm_file
+
+    X = np.eye(2, dtype=np.float32)
+    y = np.ones((2,), np.float32)
+    out = tmp_path / "out"
+    save_as_libsvm_file(str(out), X, y, num_partitions=2)
+    with pytest.raises(FileExistsError, match="already exists"):
+        save_as_libsvm_file(str(out), X, y, num_partitions=1 + 1)
+
+
+def test_literal_path_wins_over_glob_shadowing(tmp_path):
+    """A literal file whose NAME is also a valid glob pattern must load
+    itself, never what its pattern matches."""
+    import numpy as np
+
+    from tpu_sgd.utils.mlutils import load_libsvm_file, save_as_libsvm_file
+
+    literal = tmp_path / "a9a[ab].txt"
+    shadow = tmp_path / "a9aa.txt"
+    save_as_libsvm_file(str(literal), np.eye(2, dtype=np.float32),
+                        np.ones((2,), np.float32))
+    save_as_libsvm_file(str(shadow), np.eye(3, dtype=np.float32),
+                        np.full((3,), 2.0, np.float32))
+    X, y = load_libsvm_file(str(literal))
+    assert X.shape[0] == 2
+    np.testing.assert_array_equal(y, [1.0, 1.0])

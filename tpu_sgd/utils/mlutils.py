@@ -73,14 +73,16 @@ def _resolve_input_paths(path: str):
             for f in os.listdir(path)
             if _is_data_file(os.path.join(path, f))
         )
+    elif os.path.exists(path):
+        # literal path wins over glob interpretation — a filename that
+        # merely CONTAINS glob chars ("a9a[train].txt") must never be
+        # shadowed by whatever its pattern-reading matches; existence (not
+        # isfile) so FIFOs / /dev/stdin / process substitutions still load
+        files = [path]
     elif any(c in path for c in "*?["):
         files = sorted(p for p in _glob.glob(path) if _is_data_file(p))
-        # a literal filename that merely CONTAINS glob chars (e.g.
-        # "a9a[train].txt") still loads directly
-        if not files and os.path.isfile(path):
-            files = [path]
     else:
-        files = [path] if os.path.isfile(path) else []
+        files = []
     if not files:
         raise FileNotFoundError(f"no input files match {path!r}")
     return files
@@ -139,15 +141,38 @@ def load_libsvm_file(
     return (vals.astype(dtype), cols, indptr), labels, d
 
 
-def save_as_libsvm_file(path: str, X, y: np.ndarray) -> None:
+def save_as_libsvm_file(path: str, X, y: np.ndarray,
+                        num_partitions: int = 1) -> None:
     """Write ``(X, y)`` in 1-based LIBSVM text (parity with
     ``MLUtils.saveAsLibSVMFile``, which serves sparse and dense RDDs
     alike); zero entries are dropped.  ``X`` may be a dense array or a
     BCOO matrix — sparse rows are written straight from the entry lists,
-    never densified."""
+    never densified.
+
+    ``num_partitions > 1`` writes ``path`` as a DIRECTORY of part-NNNNN
+    files plus a ``_SUCCESS`` marker — the reference's ``saveAsTextFile``
+    output layout, read back by ``load_libsvm_file(path)``."""
     from tpu_sgd.ops.sparse import host_entries, is_sparse
 
     y = np.asarray(y)
+    if num_partitions > 1:
+        if os.path.exists(path):
+            # Spark's saveAsTextFile refuses an existing output path: a
+            # rewrite with fewer partitions would otherwise leave stale
+            # part files that the directory loader silently mixes in.
+            raise FileExistsError(
+                f"output path {path!r} already exists; remove it first "
+                "(saveAsTextFile semantics)"
+            )
+        os.makedirs(path)
+        bounds = np.linspace(0, y.shape[0], num_partitions + 1).astype(int)
+        for p in range(num_partitions):
+            lo, hi = int(bounds[p]), int(bounds[p + 1])
+            save_as_libsvm_file(
+                os.path.join(path, f"part-{p:05d}"), X[lo:hi], y[lo:hi]
+            )
+        open(os.path.join(path, "_SUCCESS"), "w").close()
+        return
     if is_sparse(X):
         rows, cols, vals = host_entries(X)  # row-major sorted
         n, d = X.shape
