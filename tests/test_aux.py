@@ -166,6 +166,17 @@ def test_distributed_helpers_single_process():
     assert mesh.shape["data"] == 8  # all 8 virtual devices
 
 
+def test_global_mesh_2d():
+    import pytest
+
+    from tpu_sgd.parallel.distributed import global_mesh_2d
+
+    mesh = global_mesh_2d(n_model=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    with pytest.raises(ValueError, match="does not divide"):
+        global_mesh_2d(n_model=3)
+
+
 def test_step_timer():
     from tpu_sgd.utils.events import StepTimer
 
