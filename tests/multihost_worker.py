@@ -73,7 +73,15 @@ def main():
     # BEFORE any backend init so the worker never dials the tunnel
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
+
+    from tpu_sgd.parallel.distributed import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    initialize_distributed(  # idempotent contract: second call is a no-op
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=num_procs,
         process_id=proc_id,
