@@ -1,0 +1,184 @@
+"""Evaluation metrics tests.
+
+Mirrors the reference's evaluation suites ([U] mllib/evaluation/*Suite) —
+closed-form fixtures plus sklearn oracle cross-checks (SURVEY.md §4's
+unit-tests-vs-closed-forms strategy).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.evaluation import (BinaryClassificationMetrics,
+                                MulticlassMetrics, RegressionMetrics)
+
+
+class TestRegressionMetrics:
+    def test_against_sklearn(self, rng):
+        from sklearn import metrics as sk
+
+        obs = rng.normal(size=(300,)).astype(np.float32)
+        pred = obs + 0.3 * rng.normal(size=(300,)).astype(np.float32)
+        m = RegressionMetrics(pred, obs)
+        assert m.mean_squared_error == pytest.approx(
+            sk.mean_squared_error(obs, pred), rel=1e-4
+        )
+        assert m.root_mean_squared_error == pytest.approx(
+            np.sqrt(sk.mean_squared_error(obs, pred)), rel=1e-4
+        )
+        assert m.mean_absolute_error == pytest.approx(
+            sk.mean_absolute_error(obs, pred), rel=1e-4
+        )
+        assert m.r2 == pytest.approx(sk.r2_score(obs, pred), rel=1e-3)
+
+    def test_explained_variance_convention(self):
+        # [U] RegressionMetrics.explainedVariance = sum((pred-mean(obs))^2)/n
+        pred = np.array([1.0, 2.0, 3.0], np.float32)
+        obs = np.array([1.0, 2.0, 9.0], np.float32)
+        m = RegressionMetrics(pred, obs)
+        expected = float(np.mean((pred - obs.mean()) ** 2))
+        assert m.explained_variance == pytest.approx(expected, rel=1e-5)
+
+    def test_perfect_fit(self):
+        y = np.array([1.0, -2.0, 5.0], np.float32)
+        m = RegressionMetrics(y, y)
+        assert m.mean_squared_error == 0.0
+        assert m.r2 == pytest.approx(1.0)
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            RegressionMetrics([], [])
+
+
+class TestBinaryClassificationMetrics:
+    def test_auc_against_sklearn(self, rng):
+        from sklearn import metrics as sk
+
+        labels = (rng.random(500) < 0.4).astype(np.float32)
+        scores = (labels + rng.normal(scale=0.8, size=500)).astype(np.float32)
+        m = BinaryClassificationMetrics(scores, labels)
+        assert m.area_under_roc == pytest.approx(
+            sk.roc_auc_score(labels, scores), abs=1e-4
+        )
+
+    def test_auc_with_ties(self):
+        from sklearn import metrics as sk
+
+        # Heavy ties: scores quantized to 3 levels — the group-tail collapse
+        # must reproduce sklearn's tie handling exactly.
+        rng = np.random.default_rng(7)
+        labels = (rng.random(400) < 0.5).astype(np.float32)
+        scores = np.round(labels * 0.6 + rng.random(400) * 0.4, 1).astype(
+            np.float32
+        )
+        m = BinaryClassificationMetrics(scores, labels)
+        assert m.area_under_roc == pytest.approx(
+            sk.roc_auc_score(labels, scores), abs=1e-4
+        )
+
+    def test_curve_shapes_and_anchors(self):
+        scores = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+        labels = np.array([1.0, 1.0, 0.0, 1.0, 0.0], np.float32)
+        m = BinaryClassificationMetrics(scores, labels)
+        roc = m.roc()
+        assert tuple(roc[0]) == (0.0, 0.0)
+        assert tuple(roc[-1]) == (1.0, 1.0)
+        pr = m.pr()
+        assert pr[0, 0] == 0.0
+        assert pr[0, 1] == pr[1, 1]  # anchored at the first precision
+        # 5 distinct thresholds
+        assert m.thresholds().shape == (5,)
+        # precision at threshold 0.9: top prediction is a true positive
+        p = dict(map(tuple, m.precision_by_threshold()))
+        assert p[np.float32(0.9)] == pytest.approx(1.0)
+        r = dict(map(tuple, m.recall_by_threshold()))
+        assert r[np.float32(0.5)] == pytest.approx(1.0)  # all pos recalled
+
+    def test_perfect_separation(self):
+        scores = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+        labels = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+        m = BinaryClassificationMetrics(scores, labels)
+        assert m.area_under_roc == pytest.approx(1.0)
+        assert m.area_under_pr == pytest.approx(1.0)
+
+    def test_f1_matches_closed_form(self):
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        labels = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+        m = BinaryClassificationMetrics(scores, labels)
+        f = dict(map(tuple, m.f_measure_by_threshold()))
+        # at threshold 0.7: tp=2, fp=1, fn=0 -> p=2/3, r=1 -> f1=0.8
+        assert f[np.float32(0.7)] == pytest.approx(0.8)
+
+    def test_num_bins_downsamples(self, rng):
+        labels = (rng.random(1000) < 0.5).astype(np.float32)
+        scores = rng.random(1000).astype(np.float32)
+        full = BinaryClassificationMetrics(scores, labels)
+        binned = BinaryClassificationMetrics(scores, labels, num_bins=20)
+        assert binned.thresholds().size <= 21
+        assert binned.thresholds().size < full.thresholds().size
+        # binning must not change the AUCs (they integrate the full curve)
+        assert binned.area_under_roc == pytest.approx(full.area_under_roc)
+
+    def test_single_class_rejected(self):
+        with pytest.raises(ValueError, match="both classes"):
+            BinaryClassificationMetrics(
+                np.array([0.5, 0.6], np.float32),
+                np.array([1.0, 1.0], np.float32),
+            )
+
+
+class TestMulticlassMetrics:
+    def test_confusion_and_aggregates(self):
+        pred = np.array([0, 0, 1, 1, 2, 2, 2, 0], np.float64)
+        obs = np.array([0, 1, 1, 1, 2, 2, 0, 0], np.float64)
+        m = MulticlassMetrics(pred, obs)
+        np.testing.assert_array_equal(
+            m.confusion_matrix,
+            [[2.0, 0.0, 1.0], [1.0, 2.0, 0.0], [0.0, 0.0, 2.0]],
+        )
+        assert m.accuracy == pytest.approx(6 / 8)
+        assert m.precision(0) == pytest.approx(2 / 3)
+        assert m.recall(0) == pytest.approx(2 / 3)
+        assert m.precision(2) == pytest.approx(2 / 3)
+        assert m.recall(2) == pytest.approx(1.0)
+        assert m.f_measure(1) == pytest.approx(2 * (1.0 * 2 / 3) / (1.0 + 2 / 3))
+
+    def test_weighted_against_sklearn(self, rng):
+        from sklearn import metrics as sk
+
+        obs = rng.integers(0, 4, size=200).astype(np.float64)
+        pred = np.where(rng.random(200) < 0.7, obs,
+                        rng.integers(0, 4, size=200)).astype(np.float64)
+        m = MulticlassMetrics(pred, obs)
+        assert m.accuracy == pytest.approx(sk.accuracy_score(obs, pred))
+        assert m.weighted_precision == pytest.approx(
+            sk.precision_score(obs, pred, average="weighted",
+                               zero_division=0), abs=1e-6
+        )
+        assert m.weighted_recall == pytest.approx(
+            sk.recall_score(obs, pred, average="weighted", zero_division=0),
+            abs=1e-6,
+        )
+        assert m.weighted_f_measure() == pytest.approx(
+            sk.f1_score(obs, pred, average="weighted", zero_division=0),
+            abs=1e-6,
+        )
+
+    def test_explicit_num_classes(self):
+        m = MulticlassMetrics([0.0, 1.0], [0.0, 1.0], num_classes=5)
+        assert m.confusion_matrix.shape == (5, 5)
+        assert m.recall(4) == 0.0  # absent class: 0, not NaN
+
+
+class TestModelIntegration:
+    def test_logistic_scores_feed_binary_metrics(self, rng):
+        from tpu_sgd.models.classification import LogisticRegressionWithSGD
+
+        n, d = 400, 5
+        w = rng.normal(size=(d,)).astype(np.float32)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X @ w > 0).astype(np.float32)
+        model = LogisticRegressionWithSGD.train((X, y), num_iterations=30)
+        model.clear_threshold()
+        scores = np.asarray(model.predict(X))
+        m = BinaryClassificationMetrics(scores, y)
+        assert m.area_under_roc > 0.95

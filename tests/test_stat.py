@@ -1,0 +1,115 @@
+"""Summary-statistics tests ([U] mllib/stat/StatisticsSuite shape)."""
+
+import numpy as np
+import pytest
+
+from tpu_sgd.ops.sparse import sparse_data
+from tpu_sgd.stat import col_stats, corr
+
+
+class TestColStats:
+    def test_dense_closed_forms(self, rng):
+        X = rng.normal(size=(200, 5)).astype(np.float32) * 3 + 1
+        X[:, 3] = 0.0
+        s = col_stats(X)
+        assert s.count == 200
+        np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            s.variance, X.var(0, ddof=1), rtol=1e-3, atol=1e-6
+        )
+        np.testing.assert_allclose(s.max, X.max(0), rtol=1e-6)
+        np.testing.assert_allclose(s.min, X.min(0), rtol=1e-6)
+        np.testing.assert_array_equal(s.num_nonzeros, (X != 0).sum(0))
+        np.testing.assert_allclose(
+            s.norm_l1, np.abs(X).sum(0), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            s.norm_l2, np.sqrt((X * X).sum(0)), rtol=1e-4
+        )
+
+    def test_sparse_matches_dense(self):
+        X, _, _ = sparse_data(300, 50, nnz_per_row=6, seed=9)
+        s_sp = col_stats(X)
+        Xd = np.asarray(X.todense())
+        s_d = col_stats(Xd)
+        np.testing.assert_allclose(s_sp.mean, s_d.mean, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            s_sp.variance, s_d.variance, rtol=1e-3, atol=1e-6
+        )
+        np.testing.assert_allclose(s_sp.max, s_d.max, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(s_sp.min, s_d.min, rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(s_sp.num_nonzeros, s_d.num_nonzeros)
+        np.testing.assert_allclose(
+            s_sp.norm_l2, s_d.norm_l2, rtol=1e-4, atol=1e-6
+        )
+
+    def test_sparse_implicit_zero_extrema(self):
+        """A column whose stored values are all positive still has min 0
+        when some row lacks an entry (reference summarizer semantics)."""
+        from jax.experimental.sparse import BCOO
+        import jax.numpy as jnp
+
+        # col 0: entries in rows 0,1 only (of 3) -> min must be 0
+        idx = np.array([[0, 0], [1, 0], [2, 1]], np.int32)
+        vals = np.array([2.0, 3.0, -4.0], np.float32)
+        X = BCOO((jnp.asarray(vals), jnp.asarray(idx)), shape=(3, 2))
+        s = col_stats(X)
+        assert s.min[0] == 0.0
+        assert s.max[0] == 3.0
+        assert s.max[1] == 0.0  # all stored values negative, zeros exist
+        assert s.min[1] == -4.0
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            col_stats(np.zeros((0, 3), np.float32))
+
+
+class TestCorr:
+    def test_pearson_against_numpy(self, rng):
+        X = rng.normal(size=(400, 6)).astype(np.float32)
+        X[:, 1] = 2.0 * X[:, 0] + 0.1 * X[:, 1]  # strong correlation
+        C = corr(X)
+        np.testing.assert_allclose(C, np.corrcoef(X.T), rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(np.diag(C), 1.0)
+        assert C[0, 1] > 0.99
+
+    def test_spearman_against_scipy_convention(self, rng):
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        X[:, 2] = np.exp(X[:, 0])  # monotone -> spearman 1, pearson < 1
+        C = corr(X, method="spearman")
+        assert C[0, 2] == pytest.approx(1.0, abs=1e-5)
+        assert corr(X)[0, 2] < 0.95
+
+    def test_spearman_ties(self):
+        # quantized data with heavy ties: average-rank convention
+        from scipy.stats import spearmanr
+
+        rng = np.random.default_rng(4)
+        X = np.round(rng.normal(size=(200, 2)), 1).astype(np.float32)
+        C = corr(X, method="spearman")
+        expect = spearmanr(X[:, 0], X[:, 1]).statistic
+        assert C[0, 1] == pytest.approx(expect, abs=1e-4)
+
+    def test_constant_column_nan_off_diagonal(self, rng):
+        X = rng.normal(size=(100, 2)).astype(np.float32)
+        X[:, 1] = 5.0
+        C = corr(X)
+        assert np.isnan(C[0, 1])
+        assert C[0, 0] == 1.0
+
+    def test_unknown_method_rejected(self, rng):
+        with pytest.raises(ValueError, match="unknown"):
+            corr(rng.normal(size=(10, 2)), method="kendall")
+
+    def test_sparse_pearson_never_densifies_input(self):
+        """BCOO Pearson goes through the sparse-sparse Gram and must match
+        the dense computation."""
+        X, _, _ = sparse_data(300, 25, nnz_per_row=5, seed=11)
+        C_sp = corr(X)
+        C_d = corr(np.asarray(X.todense()))
+        np.testing.assert_allclose(C_sp, C_d, rtol=2e-3, atol=2e-3)
+
+    def test_sparse_spearman_rejected(self):
+        X, _, _ = sparse_data(50, 10, nnz_per_row=3, seed=5)
+        with pytest.raises(ValueError, match="dense rank"):
+            corr(X, method="spearman")

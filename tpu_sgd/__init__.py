@@ -14,6 +14,9 @@ reference analysis this build follows.
 """
 
 from tpu_sgd.config import MeshConfig, SGDConfig
+from tpu_sgd.evaluation import (BinaryClassificationMetrics,
+                                MulticlassMetrics, RegressionMetrics)
+from tpu_sgd.feature import StandardScaler, StandardScalerModel
 from tpu_sgd.linalg import BLAS, DenseVector, SparseVector, Vectors
 from tpu_sgd.models import *  # noqa: F401,F403
 from tpu_sgd.models import __all__ as _models_all
@@ -23,6 +26,7 @@ from tpu_sgd.optimize import (GradientDescent, LBFGS, NormalEquations,
                               OWLQN, Optimizer, run_lbfgs,
                               run_mini_batch_sgd)
 from tpu_sgd.parallel import data_mesh, make_mesh
+from tpu_sgd.stat import MultivariateStatisticalSummary, col_stats, corr
 
 __version__ = "0.1.0"
 
@@ -32,5 +36,9 @@ __all__ = (
     + list(_ops_all)
     + ["GradientDescent", "LBFGS", "NormalEquations", "OWLQN", "Optimizer",
        "run_mini_batch_sgd", "run_lbfgs",
-       "data_mesh", "make_mesh"]
+       "data_mesh", "make_mesh",
+       "StandardScaler", "StandardScalerModel",
+       "RegressionMetrics", "BinaryClassificationMetrics",
+       "MulticlassMetrics",
+       "col_stats", "corr", "MultivariateStatisticalSummary"]
 )

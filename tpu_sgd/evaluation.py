@@ -1,0 +1,276 @@
+"""Model evaluation metrics.
+
+Reference parity: [U] mllib/evaluation/{RegressionMetrics,
+BinaryClassificationMetrics,MulticlassMetrics}.scala — the metrics surface
+the reference's users score every trained GLM with (SURVEY.md §2 #6-#8
+models produce the score/label pairs these consume).
+
+TPU-first design: the reference computes curve metrics with a combineByKey
+over score bins and a driver-side scan; here the whole ROC/PR construction
+is ONE jitted program — sort by score (descending), cumulative-sum the
+positive/negative indicators, collapse tied scores to their group tail with
+a reverse ``lax.cummin``, and integrate with a fused trapezoid.  Duplicate
+curve points from ties contribute zero width, so the integral needs no
+dynamic-shape dedup — static shapes end to end, MXU-free but fully fused.
+The confusion matrix is a single on-device scatter-add.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Regression
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _regression_stats(pred, obs):
+    err = pred - obs
+    n = pred.shape[0]
+    mse = jnp.mean(err * err)
+    mae = jnp.mean(jnp.abs(err))
+    obs_mean = jnp.mean(obs)
+    ss_tot = jnp.sum((obs - obs_mean) ** 2)
+    ss_err = jnp.sum(err * err)
+    # [U] RegressionMetrics.explainedVariance: sum((pred - mean(obs))^2)/n.
+    explained = jnp.sum((pred - obs_mean) ** 2) / n
+    r2 = 1.0 - ss_err / ss_tot
+    return mse, mae, explained, r2
+
+
+class RegressionMetrics:
+    """Error metrics over ``(prediction, observation)`` arrays.
+
+    Mirrors [U] RegressionMetrics: ``mean_squared_error``,
+    ``root_mean_squared_error``, ``mean_absolute_error``, ``r2``,
+    ``explained_variance`` — computed in one fused device pass.
+    """
+
+    def __init__(self, predictions, observations):
+        pred = jnp.asarray(predictions, jnp.float32).reshape(-1)
+        obs = jnp.asarray(observations, jnp.float32).reshape(-1)
+        if pred.shape != obs.shape:
+            raise ValueError(
+                f"predictions {pred.shape} vs observations {obs.shape}"
+            )
+        if pred.shape[0] == 0:
+            raise ValueError("empty input")
+        mse, mae, explained, r2 = _regression_stats(pred, obs)
+        self.mean_squared_error = float(mse)
+        self.root_mean_squared_error = float(np.sqrt(self.mean_squared_error))
+        self.mean_absolute_error = float(mae)
+        self.explained_variance = float(explained)
+        self.r2 = float(r2)
+
+
+# ---------------------------------------------------------------------------
+# Binary classification
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _binary_curves(scores, labels):
+    """Sorted-cumulative sufficient statistics for every threshold.
+
+    Returns per-position (score, cumTP, cumFP) where positions inside a tied
+    score group all carry the group-TAIL cumulative counts — the semantics of
+    the reference's per-distinct-threshold grouping, with static shapes.
+    """
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    pos = labels[order]
+    cum_tp = jnp.cumsum(pos)
+    cum_fp = jnp.cumsum(1.0 - pos)
+    idx = jnp.arange(n)
+    boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
+    group_end = jax.lax.cummin(
+        jnp.where(boundary, idx, n - 1), axis=0, reverse=True
+    )
+    return s, cum_tp[group_end], cum_fp[group_end], boundary
+
+
+@jax.jit
+def _trapezoid(x, y):
+    return jnp.sum((x[1:] - x[:-1]) * (y[1:] + y[:-1]) * 0.5)
+
+
+class BinaryClassificationMetrics:
+    """ROC / PR metrics over ``(score, label)`` arrays with 0/1 labels.
+
+    Mirrors [U] BinaryClassificationMetrics: ``area_under_roc``,
+    ``area_under_pr``, ``roc()``, ``pr()``, ``thresholds()``,
+    ``precision_by_threshold()``, ``recall_by_threshold()``,
+    ``f_measure_by_threshold(beta)``; ``num_bins`` downsamples the curves
+    (every ``ceil(groups/num_bins)``-th distinct threshold, group tails kept)
+    the way the reference's binning trades resolution for size.
+    """
+
+    def __init__(self, scores, labels, num_bins: int = 0):
+        scores = jnp.asarray(scores, jnp.float32).reshape(-1)
+        labels = jnp.asarray(labels, jnp.float32).reshape(-1)
+        if scores.shape != labels.shape:
+            raise ValueError(f"scores {scores.shape} vs labels {labels.shape}")
+        if scores.shape[0] == 0:
+            raise ValueError("empty input")
+        if num_bins < 0:
+            raise ValueError(f"num_bins must be >= 0, got {num_bins}")
+        s, cum_tp, cum_fp, boundary = _binary_curves(scores, labels)
+        self._num_pos = float(cum_tp[-1])
+        self._num_neg = float(cum_fp[-1])
+        if self._num_pos == 0 or self._num_neg == 0:
+            raise ValueError(
+                "labels must contain both classes "
+                f"(pos={self._num_pos}, neg={self._num_neg})"
+            )
+        # AUCs integrate the full per-position curve on device: tied
+        # positions duplicate their group-tail point, adding zero area.
+        tpr = cum_tp / self._num_pos
+        fpr = cum_fp / self._num_neg
+        prec = cum_tp / jnp.maximum(cum_tp + cum_fp, 1.0)
+        zero = jnp.zeros((1,), jnp.float32)
+        one = jnp.ones((1,), jnp.float32)
+        self.area_under_roc = float(
+            _trapezoid(
+                jnp.concatenate([zero, fpr]), jnp.concatenate([zero, tpr])
+            )
+        )
+        # The reference anchors PR at (0, precision of the top group).
+        self.area_under_pr = float(
+            _trapezoid(
+                jnp.concatenate([zero, tpr]),
+                jnp.concatenate([prec[:1], prec]),
+            )
+        )
+        # Curve getters work on the distinct-threshold (group-tail) points,
+        # materialized host-side once.
+        b = np.asarray(boundary)
+        self._thresholds = np.asarray(s)[b]
+        self._tp = np.asarray(cum_tp)[b]
+        self._fp = np.asarray(cum_fp)[b]
+        if num_bins > 0 and self._thresholds.size > num_bins:
+            stride = int(np.ceil(self._thresholds.size / num_bins))
+            keep = np.zeros(self._thresholds.size, bool)
+            keep[stride - 1 :: stride] = True
+            keep[-1] = True  # always keep the all-predicted-positive tail
+            self._thresholds = self._thresholds[keep]
+            self._tp = self._tp[keep]
+            self._fp = self._fp[keep]
+
+    def thresholds(self) -> np.ndarray:
+        return self._thresholds.copy()
+
+    def roc(self) -> np.ndarray:
+        """(FPR, TPR) points with the reference's (0,0) and (1,1) anchors."""
+        fpr = self._fp / self._num_neg
+        tpr = self._tp / self._num_pos
+        pts = np.stack([fpr, tpr], axis=1)
+        return np.concatenate([[[0.0, 0.0]], pts, [[1.0, 1.0]]])
+
+    def pr(self) -> np.ndarray:
+        """(recall, precision) points anchored at (0, first precision)."""
+        recall = self._tp / self._num_pos
+        precision = self._tp / np.maximum(self._tp + self._fp, 1.0)
+        pts = np.stack([recall, precision], axis=1)
+        return np.concatenate([[[0.0, pts[0, 1]]], pts])
+
+    def precision_by_threshold(self) -> np.ndarray:
+        p = self._tp / np.maximum(self._tp + self._fp, 1.0)
+        return np.stack([self._thresholds, p], axis=1)
+
+    def recall_by_threshold(self) -> np.ndarray:
+        return np.stack([self._thresholds, self._tp / self._num_pos], axis=1)
+
+    def f_measure_by_threshold(self, beta: float = 1.0) -> np.ndarray:
+        p = self._tp / np.maximum(self._tp + self._fp, 1.0)
+        r = self._tp / self._num_pos
+        b2 = beta * beta
+        denom = np.maximum(b2 * p + r, 1e-38)
+        f = (1 + b2) * p * r / denom
+        return np.stack([self._thresholds, f], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Multiclass
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _confusion(pred, obs, k):
+    flat = obs.astype(jnp.int32) * k + pred.astype(jnp.int32)
+    return (
+        jnp.zeros((k * k,), jnp.float32)
+        .at[flat]
+        .add(1.0, mode="drop")
+        .reshape(k, k)
+    )
+
+
+class MulticlassMetrics:
+    """Confusion-matrix metrics over ``(prediction, label)`` arrays.
+
+    Mirrors [U] MulticlassMetrics: ``confusion_matrix`` (rows = true label,
+    columns = prediction, like the reference), ``accuracy``,
+    per-label ``precision/recall/f_measure``, and the label-frequency
+    ``weighted_*`` aggregates.
+    """
+
+    def __init__(self, predictions, labels, num_classes: int = 0):
+        pred = np.asarray(predictions).reshape(-1)
+        obs = np.asarray(labels).reshape(-1)
+        if pred.shape != obs.shape:
+            raise ValueError(f"predictions {pred.shape} vs labels {obs.shape}")
+        if pred.shape[0] == 0:
+            raise ValueError("empty input")
+        k = int(num_classes) if num_classes > 0 else int(
+            max(pred.max(), obs.max())
+        ) + 1
+        self.num_classes = k
+        self.confusion_matrix = np.asarray(
+            _confusion(jnp.asarray(pred), jnp.asarray(obs), k)
+        )
+        self._n = float(pred.shape[0])
+
+    @property
+    def labels(self) -> np.ndarray:
+        return np.arange(self.num_classes, dtype=np.float64)
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.trace(self.confusion_matrix) / self._n)
+
+    def precision(self, label) -> float:
+        i = int(label)
+        col = self.confusion_matrix[:, i].sum()
+        return float(self.confusion_matrix[i, i] / col) if col else 0.0
+
+    def recall(self, label) -> float:
+        i = int(label)
+        row = self.confusion_matrix[i, :].sum()
+        return float(self.confusion_matrix[i, i] / row) if row else 0.0
+
+    def f_measure(self, label, beta: float = 1.0) -> float:
+        p, r = self.precision(label), self.recall(label)
+        b2 = beta * beta
+        return (1 + b2) * p * r / (b2 * p + r) if (p + r) else 0.0
+
+    def _weighted(self, per_label) -> float:
+        w = self.confusion_matrix.sum(axis=1) / self._n
+        return float(sum(w[i] * per_label(i) for i in range(self.num_classes)))
+
+    @property
+    def weighted_precision(self) -> float:
+        return self._weighted(self.precision)
+
+    @property
+    def weighted_recall(self) -> float:
+        return self._weighted(self.recall)
+
+    def weighted_f_measure(self, beta: float = 1.0) -> float:
+        return self._weighted(lambda i: self.f_measure(i, beta))

@@ -1,0 +1,100 @@
+"""Feature transformers.
+
+Reference parity: [U] mllib/feature/StandardScaler.scala (the transformer
+``GeneralizedLinearAlgorithm.useFeatureScaling`` instantiates internally —
+SURVEY.md §2 #5's harness owns a hidden scaling pass for the LBFGS-backed
+classifiers) and [U] mllib/stat/MultivariateOnlineSummarizer.scala (the
+column-statistics pass behind ``fit``).
+
+TPU-first design: the reference folds a streaming summarizer over the RDD
+(one JVM reduction per partition); here ``fit`` is ONE jitted pass over the
+device-resident matrix — the shared summarizer kernel in ``tpu_sgd/stat.py``
+(fused dense reduction / BCOO scatter-adds with both-coordinate sentinel
+masking).  ``transform`` is a broadcasted elementwise multiply that XLA
+fuses into whatever consumes it; BCOO features are scaled by value — never
+densified.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.ops.sparse import is_sparse
+
+
+class StandardScalerModel:
+    """Fitted column statistics + the transform rule.
+
+    ``factor`` is ``1/std`` where ``std > 0`` and ``0.0`` for constant
+    columns — the reference's convention, which silently zeroes features
+    that carry no information instead of dividing by zero."""
+
+    def __init__(self, mean, variance, with_mean: bool, with_std: bool):
+        self.mean = jnp.asarray(mean, jnp.float32)
+        self.variance = jnp.asarray(variance, jnp.float32)
+        self.with_mean = bool(with_mean)
+        self.with_std = bool(with_std)
+        std = jnp.sqrt(self.variance)
+        self.std = std
+        # A constant column's computed std is not exactly 0 in float32 — the
+        # mean carries a few-ulp error (~eps * |mean|), which the squared
+        # deviations inherit (measured ~0.7 eps*|mean| on a 500-row constant
+        # column).  8 eps*|mean| zeroes those while keeping any column whose
+        # real coefficient of variation exceeds ~1e-6 — the float32
+        # representational limit; below that the data itself cannot encode
+        # the variation, so no information is lost.
+        eps = jnp.float32(jnp.finfo(jnp.float32).eps)
+        noise_floor = 8.0 * eps * jnp.abs(self.mean)
+        self.factor = jnp.where(
+            std > noise_floor, 1.0 / jnp.maximum(std, 1e-38), 0.0
+        )
+
+    def transform(self, X):
+        """Scale a feature matrix, a single vector, or (the harness's trick,
+        same as the reference's) a WEIGHT vector back into original space."""
+        if is_sparse(X):
+            if self.with_mean:
+                # Centering densifies; the reference raises here too.
+                raise ValueError(
+                    "with_mean=True cannot be applied to sparse features "
+                    "without densifying; pass dense X or with_mean=False"
+                )
+            if not self.with_std:
+                return X
+            from jax.experimental.sparse import BCOO
+
+            cols = X.indices[:, -1]
+            scaled = X.data * self.factor[jnp.clip(cols, 0, self.factor.shape[0] - 1)]
+            return BCOO(
+                (scaled, X.indices),
+                shape=X.shape,
+                indices_sorted=True,
+                unique_indices=True,
+            )
+        X = jnp.asarray(X)
+        if self.with_mean:
+            X = X - self.mean
+        if self.with_std:
+            X = X * self.factor
+        return X
+
+
+class StandardScaler:
+    """``fit(X) -> StandardScalerModel``.  Defaults mirror the reference:
+    ``with_mean=False, with_std=True`` (unit variance, no centering — the
+    only combination that keeps sparse data sparse)."""
+
+    def __init__(self, with_mean: bool = False, with_std: bool = True):
+        if not (with_mean or with_std):
+            raise ValueError("at least one of with_mean/with_std must be set")
+        self.with_mean = bool(with_mean)
+        self.with_std = bool(with_std)
+
+    def fit(self, X) -> StandardScalerModel:
+        # Shared summarizer kernel (tpu_sgd/stat.py) — one home for the
+        # fused reductions AND the BCOO sentinel-masking invariant.
+        from tpu_sgd.stat import column_mean_variance
+
+        mean, var = column_mean_variance(X)
+        return StandardScalerModel(mean, var, self.with_mean, self.with_std)

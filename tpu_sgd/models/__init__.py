@@ -5,6 +5,7 @@ from tpu_sgd.models.regression import (
     LassoWithOWLQN,
     LassoWithSGD,
     LinearRegressionModel,
+    LinearRegressionWithLBFGS,
     LinearRegressionWithNormal,
     LinearRegressionWithSGD,
     RidgeRegressionModel,
@@ -30,6 +31,7 @@ __all__ = [
     "GeneralizedLinearAlgorithm",
     "GeneralizedLinearModel",
     "LinearRegressionModel",
+    "LinearRegressionWithLBFGS",
     "LinearRegressionWithNormal",
     "LinearRegressionWithSGD",
     "LassoModel",

@@ -279,6 +279,15 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
             if X.shape[0] == 0:
                 raise ValueError("empty input")
             d = X.shape[1]
+            scaler = None
+            if self.use_feature_scaling:
+                # Same scale->train->rescale pass as the harness ([U] GLA.run
+                # useFeatureScaling), applied before the bias column so each
+                # class's intercept slot stays unscaled.
+                from tpu_sgd.feature import StandardScaler
+
+                scaler = StandardScaler(with_mean=False, with_std=True).fit(X)
+                X = scaler.transform(X)
             X = append_bias_auto(X)
             self.num_features = X.shape[1]
             K = self.num_classes
@@ -294,11 +303,19 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
                         f"{(K - 1) * d} ((num_classes-1) * num_features)"
                     )
                 w0 = w0.reshape(K - 1, d)
+            if scaler is not None:
+                # User initial weights arrive in original space; the inverse
+                # of the weight-rescale below moves them into scaled space.
+                w0 = np.asarray(w0 * np.asarray(scaler.std)[None, :], np.float32)
             bias0 = np.full((K - 1, 1), float(initial_intercept), np.float32)
             w0 = np.concatenate([w0, bias0], axis=1).reshape(-1)
             if self.validate_data:
                 self.validators(X, y)
             weights = self.optimizer.optimize((X, np.asarray(y)), w0)
+            if scaler is not None:
+                W = np.array(weights, np.float32).reshape(K - 1, d + 1)
+                W[:, :d] = W[:, :d] * np.asarray(scaler.factor)[None, :]
+                weights = W.reshape(-1)
             return MultinomialLogisticRegressionModel(
                 weights, 0.0, self.num_classes, X.shape[1],
                 has_intercept_column=True,

@@ -161,6 +161,48 @@ class LassoWithOWLQN(GeneralizedLinearAlgorithm):
         return alg.run(data)
 
 
+class LinearRegressionWithLBFGS(GeneralizedLinearAlgorithm):
+    """Least squares via L-BFGS behind the same plugin boundary.
+
+    TPU-side extension beyond the reference's SGD-only regression surface
+    (upstream Spark's LBFGS optimizer, [U] mllib/optimization/LBFGS.scala
+    SURVEY.md §2 #18, is only wired to logistic regression in mllib): the
+    meshed CostFun + batched line search make quasi-Newton least squares a
+    drop-in, and it is the natural pairing for ``set_feature_scaling`` —
+    unit-variance columns condition the inverse-Hessian pairs.
+    """
+
+    _model_cls = LinearRegressionModel
+
+    def __init__(self, reg_param: float = 0.0,
+                 max_num_iterations: int = 100,
+                 convergence_tol: float = 1e-6):
+        super().__init__()
+        from tpu_sgd.optimize.lbfgs import LBFGS
+
+        self.optimizer = LBFGS(
+            LeastSquaresGradient(),
+            SquaredL2Updater(),
+            reg_param=reg_param,
+            max_num_iterations=max_num_iterations,
+            convergence_tol=convergence_tol,
+        )
+
+    def create_model(self, weights, intercept):
+        return self._model_cls(weights, intercept)
+
+    @classmethod
+    def train(cls, data, reg_param: float = 0.0,
+              max_num_iterations: int = 100, intercept: bool = False,
+              feature_scaling: bool = False, mesh=None):
+        alg = cls(reg_param, max_num_iterations)
+        alg.set_intercept(intercept)
+        alg.set_feature_scaling(feature_scaling)
+        if mesh is not None:
+            alg.optimizer.set_mesh(mesh)
+        return alg.run(data)
+
+
 class LinearRegressionWithNormal(GeneralizedLinearAlgorithm):
     """Exact least squares via the one-pass normal-equations solver.
 

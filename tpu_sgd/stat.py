@@ -1,0 +1,213 @@
+"""Summary statistics.
+
+Reference parity: [U] mllib/stat/Statistics.scala (``colStats``, ``corr``)
+and [U] mllib/stat/MultivariateOnlineSummarizer.scala — the column-summary
+surface the reference's users run over ``RDD[Vector]`` before training
+(SURVEY.md §2 #12's MLUtils sits next to it in the same util tier).
+
+TPU-first design: the reference folds a treeAggregate of summarizer objects
+(one JVM merge per partition); here ``col_stats`` is ONE jitted fused
+reduction over the device-resident matrix, and the correlation matrix is a
+single MXU Gram pass (``Xc^T @ Xc`` on centered columns) instead of the
+reference's pairwise column cogroup — O(n d^2) FLOPs the systolic array
+eats, with no shuffle.  Sparse (BCOO) inputs get the same statistics from
+scatter-adds over ``data``/``indices`` without densifying.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.ops.sparse import is_sparse
+
+
+class MultivariateStatisticalSummary:
+    """Value object mirroring [U] MultivariateStatisticalSummary: ``mean``,
+    ``variance`` (sample, n-1), ``count``, ``num_nonzeros``, ``max``,
+    ``min``, ``norm_l1``, ``norm_l2`` — all per column, host numpy."""
+
+    def __init__(self, mean, variance, count, num_nonzeros, mx, mn, l1, l2):
+        self.mean = np.asarray(mean)
+        self.variance = np.asarray(variance)
+        self.count = int(count)
+        self.num_nonzeros = np.asarray(num_nonzeros)
+        self.max = np.asarray(mx)
+        self.min = np.asarray(mn)
+        self.norm_l1 = np.asarray(l1)
+        self.norm_l2 = np.asarray(l2)
+
+
+@jax.jit
+def _dense_col_stats(X):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    var = jnp.sum((X - mean) ** 2, axis=0) / jnp.maximum(n - 1, 1)
+    nnz = jnp.sum(X != 0, axis=0)
+    return (
+        mean,
+        var,
+        nnz,
+        jnp.max(X, axis=0),
+        jnp.min(X, axis=0),
+        jnp.sum(jnp.abs(X), axis=0),
+        jnp.sqrt(jnp.sum(X * X, axis=0)),
+    )
+
+
+def _bcoo_col_stats(X):
+    """Same statistics without densifying.  Implicit zeros participate in
+    mean/variance/min/max exactly as the reference's summarizer counts them
+    (a column whose stored values are all positive still has min 0 when any
+    row lacks an entry)."""
+    n, d = X.shape
+    cols = X.indices[:, 1]
+    vals = X.data.astype(jnp.float32)
+    # jax's out-of-bounds nse sentinels (the ops/sparse.host_entries
+    # invariant) can be bad in EITHER coordinate; scatter mode='drop' only
+    # catches a bad destination column, so mask on both explicitly.
+    valid = (X.indices[:, 0] < n) & (cols < d)
+    vals = jnp.where(valid, vals, 0.0)
+    s1 = jnp.zeros((d,), jnp.float32).at[cols].add(vals, mode="drop")
+    s2 = jnp.zeros((d,), jnp.float32).at[cols].add(vals * vals, mode="drop")
+    l1 = jnp.zeros((d,), jnp.float32).at[cols].add(jnp.abs(vals), mode="drop")
+    nnz = (
+        jnp.zeros((d,), jnp.int32)
+        .at[cols]
+        .add(jnp.where((vals != 0) & valid, 1, 0), mode="drop")
+    )
+    # Stored-entry extrema; fold the implicit zeros in afterwards.
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
+    mx = jnp.full((d,), -big).at[cols].max(
+        jnp.where(valid, vals, -big), mode="drop"
+    )
+    mn = jnp.full((d,), big).at[cols].min(
+        jnp.where(valid, vals, big), mode="drop"
+    )
+    stored = jnp.zeros((d,), jnp.int32).at[cols].add(
+        jnp.where(valid, 1, 0), mode="drop"
+    )
+    has_zero = stored < n
+    mx = jnp.where(has_zero, jnp.maximum(mx, 0.0), mx)
+    mn = jnp.where(has_zero, jnp.minimum(mn, 0.0), mn)
+    mean = s1 / n
+    var = jnp.maximum((s2 - n * mean * mean) / max(n - 1, 1), 0.0)
+    return mean, var, nnz, mx, mn, l1, jnp.sqrt(s2)
+
+
+def column_mean_variance(X):
+    """(mean, sample variance) per column, dense or BCOO — the shared
+    summarizer kernel ``StandardScaler.fit`` and ``col_stats`` both use, so
+    the BCOO sentinel masking lives in exactly one place."""
+    if is_sparse(X):
+        if X.shape[0] == 0:
+            raise ValueError("empty input")
+        mean, var = _bcoo_col_stats(X)[:2]
+        return mean, var
+    X = jnp.asarray(X)
+    if X.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got {X.shape}")
+    if X.shape[0] == 0:
+        raise ValueError("empty input")
+    return _dense_col_stats(X)[:2]
+
+
+def col_stats(X) -> MultivariateStatisticalSummary:
+    """[U] ``Statistics.colStats(rdd)`` over a dense or BCOO matrix."""
+    if is_sparse(X):
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("empty input")
+        parts = _bcoo_col_stats(X)
+    else:
+        X = jnp.asarray(X)
+        if X.ndim != 2:
+            raise ValueError(f"col_stats expects a 2-D matrix, got {X.shape}")
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("empty input")
+        parts = _dense_col_stats(X)
+    mean, var, nnz, mx, mn, l1, l2 = parts
+    return MultivariateStatisticalSummary(mean, var, n, nnz, mx, mn, l1, l2)
+
+
+@jax.jit
+def _pearson(X):
+    n = X.shape[0]
+    Xc = X - jnp.mean(X, axis=0)
+    # One MXU Gram pass replaces the reference's pairwise column cogroup.
+    cov = (Xc.T @ Xc) / jnp.maximum(n - 1, 1)
+    sd = jnp.sqrt(jnp.diag(cov))
+    denom = jnp.outer(sd, sd)
+    corr = jnp.where(denom > 0, cov / jnp.maximum(denom, 1e-38), jnp.nan)
+    # Exact ones on the diagonal (the reference returns 1.0 there even for
+    # near-constant columns with defined variance).
+    eye = jnp.eye(X.shape[1], dtype=bool)
+    return jnp.where(eye & (sd > 0)[None, :], 1.0, corr)
+
+
+def _ranks(X):
+    """Average-tie column ranks (1-based), the Spearman prerequisite."""
+    X = np.asarray(X, np.float64)
+    n, d = X.shape
+    out = np.empty_like(X)
+    for j in range(d):  # host-side; ranking is a one-time O(n log n) per col
+        col = X[:, j]
+        order = np.argsort(col, kind="stable")
+        ranks = np.empty(n, np.float64)
+        ranks[order] = np.arange(1, n + 1, dtype=np.float64)
+        # average ties
+        uniq, inv, counts = np.unique(
+            col, return_inverse=True, return_counts=True
+        )
+        sums = np.zeros(uniq.size, np.float64)
+        np.add.at(sums, inv, ranks)
+        out[:, j] = sums[inv] / counts[inv]
+    return out
+
+
+def _pearson_bcoo(X):
+    """Pearson for BCOO without materializing the dense n x d matrix: the
+    raw Gram comes from a sparse-sparse ``X^T @ X`` (only the d x d result —
+    which IS the output size — goes dense), and centering folds in
+    analytically: cov = (G - n * outer(mean, mean)) / (n - 1)."""
+    n, d = X.shape
+    G = jnp.asarray((X.T @ X).todense(), jnp.float32)
+    mean, _ = column_mean_variance(X)
+    cov = (G - n * jnp.outer(mean, mean)) / max(n - 1, 1)
+    sd = jnp.sqrt(jnp.maximum(jnp.diag(cov), 0.0))
+    denom = jnp.outer(sd, sd)
+    corr_m = jnp.where(denom > 0, cov / jnp.maximum(denom, 1e-38), jnp.nan)
+    eye = jnp.eye(d, dtype=bool)
+    return jnp.where(eye & (sd > 0)[None, :], 1.0, corr_m)
+
+
+def corr(X, method: str = "pearson") -> np.ndarray:
+    """[U] ``Statistics.corr(rdd, method)``: full correlation matrix.
+
+    ``pearson`` is one jitted MXU Gram pass (sparse inputs use a
+    sparse-sparse Gram — only the d x d result, i.e. the output itself, is
+    ever dense); ``spearman`` ranks columns host-side (average ties, the
+    reference's convention) then reuses the same device pass on the ranks.
+    Spearman over BCOO would densify through the rank transform (implicit
+    zeros all get the same mid-rank), so it asks for an explicit dense
+    matrix instead of silently allocating one.
+    """
+    if is_sparse(X):
+        if method == "pearson":
+            return np.asarray(_pearson_bcoo(X))
+        if method == "spearman":
+            raise ValueError(
+                "spearman over sparse features requires the dense rank "
+                "transform; pass X.todense() explicitly if n x d fits"
+            )
+        raise ValueError(f"unknown correlation method {method!r}")
+    X = np.asarray(X, np.float32)
+    if X.ndim != 2:
+        raise ValueError(f"corr expects a 2-D matrix, got {X.shape}")
+    if method == "pearson":
+        return np.asarray(_pearson(jnp.asarray(X)))
+    if method == "spearman":
+        return np.asarray(_pearson(jnp.asarray(_ranks(X), dtype=jnp.float32)))
+    raise ValueError(f"unknown correlation method {method!r}")
