@@ -645,6 +645,29 @@ def main():
             "pallas": tpu.get("pallas"),
             "streamed": None,
         }
+        # A prior streamed capture is expensive to reproduce (20 GB host
+        # generation + ~25 min of tunnel-bound iterations) and must not be
+        # clobbered by a headline re-run, nor re-measured by default once
+        # captured (the end-of-round driver run must reach its stdout JSON
+        # line without a 25-minute detour).  BENCH_STREAM_REFRESH=1 forces a
+        # fresh measurement; BENCH_STREAMED=0 skips the leg entirely.  The
+        # prior capture is read unconditionally so that ANY outcome — skip,
+        # reuse, or a refresh attempt that dies mid-run — can fall back to
+        # it instead of destroying it.
+        prev_streamed = None
+        try:
+            with open(LAST_TPU_PATH) as f:
+                prev = json.load(f)
+            if prev.get("streamed") and "error" not in prev["streamed"]:
+                prev_streamed = prev["streamed"]
+                prev_streamed.setdefault("captured_at", prev.get("timestamp"))
+        except (OSError, ValueError):
+            pass
+        if (os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"
+                or os.environ.get("BENCH_STREAMED", "1") == "0"):
+            # Not refreshing — or refresh+skip, which is contradictory and
+            # resolves to "keep what we have".
+            record["streamed"] = prev_streamed
         with open(LAST_TPU_PATH, "w") as f:
             json.dump(record, f, indent=1)
         log(f"persisted TPU result to {LAST_TPU_PATH}")
@@ -659,11 +682,27 @@ def main():
         # link), per-iteration walls from the listener; persisted as an
         # update to the already-written record.
         if os.environ.get("BENCH_STREAMED", "1") != "0":
-            try:
-                record["streamed"] = _streamed_measure()
-            except Exception as e:
-                log(f"streamed measurement failed ({type(e).__name__}: {e})")
-                record["streamed"] = {"error": f"{type(e).__name__}: {e}"}
+            if record["streamed"] is not None:
+                log("streamed: reusing the captured measurement from "
+                    f"{record['streamed'].get('captured_at')} "
+                    "(BENCH_STREAM_REFRESH=1 forces a fresh run)")
+            else:
+                try:
+                    record["streamed"] = _streamed_measure()
+                except Exception as e:
+                    log("streamed measurement failed "
+                        f"({type(e).__name__}: {e})")
+                    if prev_streamed is not None:
+                        # A failed refresh must not destroy the prior good
+                        # capture; keep it and note the failed attempt.
+                        prev_streamed["refresh_error"] = (
+                            f"{type(e).__name__}: {e}"
+                        )
+                        record["streamed"] = prev_streamed
+                    else:
+                        record["streamed"] = {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
             with open(LAST_TPU_PATH, "w") as f:
                 json.dump(record, f, indent=1)
             log(f"updated {LAST_TPU_PATH} with the streamed measurement")

@@ -168,6 +168,14 @@ class TestMulticlassMetrics:
         assert m.confusion_matrix.shape == (5, 5)
         assert m.recall(4) == 0.0  # absent class: 0, not NaN
 
+    def test_out_of_range_rejected(self):
+        # silent scatter-drop would deflate accuracy; must raise instead
+        with pytest.raises(ValueError, match=r"\[0, 3\)"):
+            MulticlassMetrics([0.0, 1.0, 2.0], [0.0, 3.0, 1.0],
+                              num_classes=3)
+        with pytest.raises(ValueError):
+            MulticlassMetrics([-1.0, 1.0], [0.0, 1.0], num_classes=2)
+
 
 class TestModelIntegration:
     def test_logistic_scores_feed_binary_metrics(self, rng):

@@ -231,6 +231,15 @@ class MulticlassMetrics:
         k = int(num_classes) if num_classes > 0 else int(
             max(pred.max(), obs.max())
         ) + 1
+        bad = (pred < 0) | (pred >= k) | (obs < 0) | (obs >= k)
+        if bad.any():
+            # Silent scatter-drop would deflate accuracy while _n still
+            # counts the sample; the reference includes every observed
+            # label, so out-of-range input is a caller error here.
+            raise ValueError(
+                f"labels/predictions must lie in [0, {k}); found "
+                f"{np.unique(np.concatenate([pred[bad], obs[bad]]))[:5]}"
+            )
         self.num_classes = k
         self.confusion_matrix = np.asarray(
             _confusion(jnp.asarray(pred), jnp.asarray(obs), k)

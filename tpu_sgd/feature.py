@@ -69,8 +69,10 @@ class StandardScalerModel:
             return BCOO(
                 (scaled, X.indices),
                 shape=X.shape,
-                indices_sorted=True,
-                unique_indices=True,
+                # Value scaling does not reorder or merge entries: the
+                # input's layout promises carry over verbatim.
+                indices_sorted=X.indices_sorted,
+                unique_indices=X.unique_indices,
             )
         X = jnp.asarray(X)
         if self.with_mean:
