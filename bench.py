@@ -227,15 +227,18 @@ def tpu_measure(tpu_ok: bool) -> dict:
         # Pallas window floors the start to a tile boundary, so losses
         # differ slightly but must stay close on i.i.d. data — a silent
         # miscompile does not).
-        for tile in (1024, 2048):
+        for tile, wk in ((1024, "mxu"), (2048, "mxu"),
+                         (1024, "vpu"), (2048, "vpu")):
             if rows % tile:
                 continue
             try:
                 from tpu_sgd.ops.pallas_kernels import PallasGradient
 
+                label = f"pallas[{tile}]" if wk == "mxu" else f"vpu[{tile}]"
                 slope_p, fixed_p, losses_p = time_run_slope(
-                    f"pallas[{tile}]",
-                    PallasGradient(LeastSquaresGradient(), tile_m=tile),
+                    label,
+                    PallasGradient(LeastSquaresGradient(), tile_m=tile,
+                                   window_kernel=wk),
                     X, y, iters,
                 )
                 # Miscompile guard: trajectories must track XLA's.  atol
@@ -246,7 +249,7 @@ def tpu_measure(tpu_ok: bool) -> dict:
                     losses_p, losses_xla, rtol=0.1, atol=0.01
                 )
                 if not ok:
-                    log(f"pallas[{tile}] trajectory diverges from xla "
+                    log(f"{label} trajectory diverges from xla "
                         "(possible miscompile); recording, never selecting")
                 # Record EVERY tile's measurement — the persisted artifact
                 # must substantiate the XLA-vs-Pallas verdict either way;
@@ -255,6 +258,7 @@ def tpu_measure(tpu_ok: bool) -> dict:
                     out["pallas"] = []
                 out["pallas"].append({
                     "tile": tile,
+                    "kernel": wk,
                     "iter_ms": slope_p * 1e3,
                     "xla_iter_ms": xla_slope * 1e3,
                     "trajectory_ok": bool(ok),
@@ -263,7 +267,52 @@ def tpu_measure(tpu_ok: bool) -> dict:
                 if ok and slope_p < slope:
                     slope, fixed = slope_p, fixed_p
             except Exception as e:
-                log(f"pallas[{tile}] failed ({type(e).__name__}: {e}); "
+                log(f"pallas/vpu[{tile}] failed ({type(e).__name__}: {e}); "
+                    "skipping")
+        # One-read chunked schedule (round 3): lax.scan over row blocks,
+        # each block read once for BOTH matmuls — PROFILE_TPU.json puts the
+        # stock path at the two-read floor, so a collapsed read is worth up
+        # to ~2x.  Same guard as Pallas: only a trajectory-clean winner may
+        # take the headline.
+        out["chunked"] = None
+        chunks = os.environ.get("BENCH_CHUNKS", "8192,32768,131072")
+        try:
+            chunk_list = [int(c) for c in chunks.split(",") if c.strip()]
+        except ValueError:
+            # A malformed env var must not discard the minutes of
+            # measurements already taken above.
+            log(f"BENCH_CHUNKS={chunks!r} is not a comma-separated int "
+                "list; skipping the chunked sweep")
+            chunk_list = []
+        for chunk in chunk_list:
+            try:
+                from tpu_sgd.ops.gradients import ChunkedGradient
+
+                slope_c, fixed_c, losses_c = time_run_slope(
+                    f"chunked[{chunk}]",
+                    ChunkedGradient(LeastSquaresGradient(),
+                                    chunk_rows=chunk),
+                    X, y, iters,
+                )
+                ok = len(losses_c) == len(losses_xla) and np.allclose(
+                    losses_c, losses_xla, rtol=0.1, atol=0.01
+                )
+                if not ok:
+                    log(f"chunked[{chunk}] trajectory diverges from xla; "
+                        "recording, never selecting")
+                if not isinstance(out["chunked"], list):
+                    out["chunked"] = []
+                out["chunked"].append({
+                    "chunk_rows": chunk,
+                    "iter_ms": slope_c * 1e3,
+                    "xla_iter_ms": xla_slope * 1e3,
+                    "trajectory_ok": bool(ok),
+                    "wins": bool(ok and slope_c < xla_slope),
+                })
+                if ok and slope_c < slope:
+                    slope, fixed = slope_c, fixed_c
+            except Exception as e:
+                log(f"chunked[{chunk}] failed ({type(e).__name__}: {e}); "
                     "skipping")
     rows_per_sec = FRAC * rows / slope
     eps = rows_per_sec / TARGET_ROWS
@@ -643,6 +692,7 @@ def main():
             "steady_state_iter_ms": tpu.get("steady_state_iter_ms"),
             "fixed_launch_ms": tpu.get("fixed_launch_ms"),
             "pallas": tpu.get("pallas"),
+            "chunked": tpu.get("chunked"),
             "streamed": None,
         }
         # A prior streamed capture is expensive to reproduce (20 GB host
@@ -661,6 +711,13 @@ def main():
             if prev.get("streamed") and "error" not in prev["streamed"]:
                 prev_streamed = prev["streamed"]
                 prev_streamed.setdefault("captured_at", prev.get("timestamp"))
+            # Same clobber protection for the chunked sweep: a run that
+            # skipped it (BENCH_CHUNKS= empty) must not null out a prior
+            # capture.
+            if record.get("chunked") is None and prev.get("chunked"):
+                record["chunked"] = prev["chunked"]
+                for c in record["chunked"]:
+                    c.setdefault("captured_at", prev.get("timestamp"))
         except (OSError, ValueError):
             pass
         if (os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"

@@ -28,7 +28,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("variants", nargs="*",
                     default=["matvec", "grad", "ws", "pallas1024",
-                             "pallas2048", "vpu1024", "vpu2048"],
+                             "pallas2048", "vpu1024", "vpu2048",
+                             "scan8192", "scan32768"],
                     help="which paths to time (pallasN = MXU fused window "
                          "kernel at tile_m N; vpuN = the VPU-reduction "
                          "variant, see fused_window_sums_vpu; tiles over "
@@ -65,23 +66,30 @@ def main(argv=None):
         processes (the pallas variants floor the window to a tile multiple,
         so crediting them with the full m would inflate their GB/s).
 
-        Reps are CHAINED through a device scalar folded into the first
-        argument (the weight vector): independent dispatches let the async
-        runtime overlap reps and over-report bandwidth by orders of
-        magnitude (an early sweep printed 11 TB/s "effective" on a chip
-        with <1 TB/s of HBM)."""
+        Reps are CHAINED through a device scalar folded into BOTH the
+        weight vector and the window-start index: independent dispatches
+        let the async runtime overlap reps and over-report bandwidth by
+        orders of magnitude (an early sweep printed 11 TB/s "effective" on
+        a chip with <1 TB/s of HBM), and a weights-only chain proved
+        insufficient in round 3 — several variants still printed 2-3x the
+        chip's physical HBM bandwidth, so the start index (which decides
+        WHICH bytes are read) now carries the dependency too.  Numbers
+        above the HBM spec remain untrustworthy; the full-loop steady
+        state in bench.py is the authoritative comparison."""
         rows_done = m if rows_done is None else rows_done
         t0 = time.perf_counter()
         out = jax.block_until_ready(fn(*fargs))
         print(f"{name:28s} compile {time.perf_counter() - t0:5.1f}s",
               flush=True)
-        w0, rest = fargs[0], fargs[1:]
+        w0, start0, rest = fargs[0], fargs[1], fargs[2:]
         zero = jnp.zeros((), w0.dtype)
+        izero = jnp.zeros((), start0.dtype)
         t0 = time.perf_counter()
         for _ in range(args.reps):
-            out = fn(w0 + zero, *rest)
+            out = fn(w0 + zero, start0 + izero, *rest)
             # 0-valued, but data-dependent on the previous dispatch
             zero = out[0].ravel()[0] * 0.0
+            izero = zero.astype(start0.dtype)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / args.reps
         gb = rows_done * d * X.dtype.itemsize / 1e9
@@ -129,6 +137,23 @@ def main(argv=None):
                                jnp.int32(1024), X, y)
 
     for v in variants:
+        if v.startswith("scan"):
+            # One-read chunked schedule at the XLA level (ChunkedGradient):
+            # the same traffic shape the pallas kernels target, with the
+            # MXU mapping left to the compiler.
+            from tpu_sgd.ops.gradients import (ChunkedGradient,
+                                               LeastSquaresGradient)
+
+            chunk = int(v[len("scan"):])
+            cg = ChunkedGradient(LeastSquaresGradient(), chunk_rows=chunk)
+
+            @jax.jit
+            def scan_ws(w, start, X, y, cg=cg):
+                return cg.window_sums(X, y, w, start, m)
+
+            results[v] = timeit(f"scan chunk={chunk}", scan_ws, w,
+                                jnp.int32(1024), X, y)
+            continue
         if v.startswith("pallas") or v.startswith("vpu"):
             kind = "vpu" if v.startswith("vpu") else "pallas"
             tile = int(v[len(kind):])
@@ -160,7 +185,8 @@ def main(argv=None):
     if "ws" in results:
         base_dt, base_rows = results["ws"]
         for k, (dt, rows_done) in results.items():
-            if k.startswith("pallas") or k.startswith("vpu"):
+            if (k.startswith("pallas") or k.startswith("vpu")
+                    or k.startswith("scan")):
                 # Per-row comparison: the pallas window is floored to a tile
                 # multiple, so raw wall-clock would not be apples-to-apples.
                 ratio = (base_dt / base_rows) / (dt / rows_done)

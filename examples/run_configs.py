@@ -142,8 +142,16 @@ def config2():
         LogisticGradient(), X, y, model.weights,
         logistic_l2_oracle(X, y, reg), reg, "l2")
     verdict = "PASS" if gap < 0.01 else "FAIL"
+    # The evaluation surface a reference user scores this model with
+    # ([U] mllib/evaluation/BinaryClassificationMetrics)
+    from tpu_sgd.evaluation import BinaryClassificationMetrics
+
+    model.clear_threshold()
+    auc = BinaryClassificationMetrics(
+        np.asarray(model.predict(X)), y
+    ).area_under_roc
     print(f"config2: libsvm={os.path.basename(path)} ({kind}) "
-          f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} "
+          f"n={X.shape[0]} d={X.shape[1]} acc={acc:.4f} auc={auc:.4f} "
           f"oracle_gap={gap * 100:.2f}% [{verdict} <1%] "
           f"({time.perf_counter() - t0:.1f}s)")
 

@@ -1,0 +1,114 @@
+"""ChunkedGradient (one-read window schedule) parity tests.
+
+The wrapper must be trajectory-equivalent to the stock two-pass
+``window_sums`` (same window, same math, blocked f32 accumulation) for
+every pointwise family, including ragged tails, masks, and the full
+GradientDescent driver.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_sgd.ops.gradients import (ChunkedGradient, HingeGradient,
+                                   LeastSquaresGradient, LogisticGradient)
+
+
+def _data(rng, n=5000, d=32, dtype=np.float32):
+    X = rng.normal(size=(n, d)).astype(dtype)
+    w = rng.normal(size=(d,)).astype(np.float32)
+    y = (X.astype(np.float32) @ w > 0).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y), jnp.asarray(w)
+
+
+@pytest.mark.parametrize("base_cls", [LeastSquaresGradient, LogisticGradient,
+                                      HingeGradient])
+@pytest.mark.parametrize("m,chunk", [(1000, 256), (1000, 1000), (999, 256),
+                                     (100, 4096)])
+def test_window_sums_parity(rng, base_cls, m, chunk):
+    X, y, w = _data(rng)
+    base = base_cls()
+    chunked = ChunkedGradient(base, chunk_rows=chunk)
+    start = jnp.int32(123)
+    g0, l0, c0 = base.window_sums(X, y, w, start, m)
+    g1, l1, c1 = chunked.window_sums(X, y, w, start, m)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-5, atol=2e-4)
+    assert float(l1) == pytest.approx(float(l0), rel=2e-5)
+    assert float(c1) == float(c0) == m
+
+
+def test_window_sums_with_valid_mask(rng):
+    X, y, w = _data(rng, n=2000)
+    valid = jnp.asarray((np.arange(2000) % 3 != 0).astype(np.float32))
+    base = LeastSquaresGradient()
+    chunked = ChunkedGradient(base, chunk_rows=128)
+    g0, l0, c0 = base.window_sums(X, y, w, jnp.int32(40), 700, valid=valid)
+    g1, l1, c1 = chunked.window_sums(X, y, w, jnp.int32(40), 700, valid=valid)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-5, atol=2e-4)
+    assert float(c1) == float(c0)
+
+
+def test_delegation_surface(rng):
+    X, y, w = _data(rng, n=500)
+    base = LogisticGradient()
+    chunked = ChunkedGradient(base, chunk_rows=64)
+    g0, l0, c0 = base.batch_sums(X, y, w)
+    g1, l1, c1 = chunked.batch_sums(X, y, w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-6)
+    assert chunked.weight_dim(32) == 32
+    grad, loss = chunked.compute(X[0], y[0], w)
+    grad0, loss0 = base.compute(X[0], y[0], w)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(grad0))
+    W = jnp.stack([w, 0.5 * w])
+    s1, _ = chunked.loss_sweep(X, y, W)
+    s0, _ = base.loss_sweep(X, y, W)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=1e-6)
+
+
+def test_out_of_range_start_clamps_like_stock(rng):
+    """start beyond n-m must clamp ONCE to the stock path's window, not
+    per block (per-block clamping re-reads overlapping tail rows)."""
+    X, y, w = _data(rng, n=5000)
+    base = LeastSquaresGradient()
+    chunked = ChunkedGradient(base, chunk_rows=1024)
+    g0, l0, c0 = base.window_sums(X, y, w, jnp.int32(4000), 3000)
+    g1, l1, c1 = chunked.window_sums(X, y, w, jnp.int32(4000), 3000)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-5, atol=2e-4)
+    assert float(l1) == pytest.approx(float(l0), rel=2e-5)
+    assert float(c1) == float(c0)
+
+
+def test_bad_chunk_rejected():
+    with pytest.raises(ValueError, match="chunk_rows"):
+        ChunkedGradient(LeastSquaresGradient(), chunk_rows=0)
+
+
+def test_full_driver_trajectory_matches(rng):
+    """Same sliced-sampling SGD run, stock vs chunked gradient: the loss
+    trajectories must agree to fp-reordering tolerance."""
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+    from tpu_sgd.ops.updaters import SimpleUpdater
+
+    n, d = 8192, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d,)).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+
+    def run(gradient):
+        opt = (
+            GradientDescent(gradient, SimpleUpdater())
+            .set_step_size(0.5)
+            .set_num_iterations(12)
+            .set_mini_batch_fraction(0.25)
+            .set_sampling("sliced")
+        )
+        w = opt.optimize((X, y), np.zeros(d, np.float32))
+        return np.asarray(w), list(opt.loss_history)
+
+    w0, h0 = run(LeastSquaresGradient())
+    w1, h1 = run(ChunkedGradient(LeastSquaresGradient(), chunk_rows=1024))
+    np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h1, h0, rtol=1e-4, atol=1e-6)

@@ -1,4 +1,5 @@
 from tpu_sgd.ops.gradients import (
+    ChunkedGradient,
     Gradient,
     HingeGradient,
     LeastSquaresGradient,
@@ -24,6 +25,7 @@ from tpu_sgd.ops.updaters import (
 )
 
 __all__ = [
+    "ChunkedGradient",
     "Gradient",
     "LeastSquaresGradient",
     "LogisticGradient",

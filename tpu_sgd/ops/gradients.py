@@ -213,6 +213,97 @@ def _slice_window(X, y, valid, start, m):
     return Xb, yb, mask
 
 
+class ChunkedGradient(Gradient):
+    """One-HBM-read window schedule behind the same ``Gradient`` contract.
+
+    The default :meth:`Gradient.window_sums` lowers to two full passes over
+    the window (``X @ w`` then ``Xᵀ @ coeff``) — `PROFILE_TPU.json` puts the
+    whole fused loop at that two-read bandwidth floor.  This wrapper
+    restructures the window as a ``lax.scan`` over ``chunk_rows``-row
+    blocks: each block is sliced once and immediately serves BOTH matmuls
+    while it is resident, so a scheduler that keeps the block in VMEM pays
+    ONE HBM read of X per iteration — the same traffic shape the Pallas
+    fused kernel targets (SURVEY.md §2 #11), expressed at the XLA level
+    where the MXU mapping stays the compiler's problem.  Whether the
+    read actually collapses is an empirical, per-backend question; bench.py
+    measures it against the stock path on hardware and only a
+    trajectory-clean winner may take the headline.
+
+    Wraps any pointwise family (least-squares / logistic / hinge);
+    delegates everything except the window schedule.
+    """
+
+    def __init__(self, base: "Gradient", chunk_rows: int = 65536):
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+        self.base = base
+        self.chunk_rows = int(chunk_rows)
+
+    def pointwise(self, margin, label):
+        return self.base.pointwise(margin, label)
+
+    def weight_dim(self, num_features: int) -> int:
+        return self.base.weight_dim(num_features)
+
+    def compute(self, data, label, weights):
+        return self.base.compute(data, label, weights)
+
+    def batch_sums(self, X, y, weights, mask=None, margin_axis_name=None):
+        return self.base.batch_sums(
+            X, y, weights, mask, margin_axis_name=margin_axis_name
+        )
+
+    def loss_sweep(self, X, y, W, mask=None):
+        return self.base.loss_sweep(X, y, W, mask)
+
+    def window_sums(
+        self, X, y, weights, start, m, valid=None, margin_axis_name=None
+    ):
+        if _is_sparse(X):
+            raise NotImplementedError(
+                "sliced sampling needs a dense row layout; use bernoulli "
+                "sampling with sparse (BCOO) features"
+            )
+        if margin_axis_name is not None:
+            # Feature-sharded margins need a psum per block; the stock
+            # two-pass path already handles that correctly — use it.
+            return self.base.window_sums(
+                X, y, weights, start, m, valid,
+                margin_axis_name=margin_axis_name,
+            )
+        c = min(self.chunk_rows, m)
+        nblk, rem = divmod(m, c)
+        # Clamp ONCE, like the stock path's whole-window dynamic_slice:
+        # per-block clamping would re-read overlapping tail rows for an
+        # out-of-range start and diverge from the base implementation.
+        start = jnp.clip(start, 0, max(X.shape[0] - m, 0))
+        # Accumulate at the same dtype batch_sums returns (>= f32; f64
+        # under jax_enable_x64 with f64 data) so the scan carry matches.
+        cd = acc_dtype(matmul_dtype(X))
+
+        def block_sums(s, rows):
+            Xb, yb, maskb = _slice_window(X, y, valid, s, rows)
+            return self.base.batch_sums(Xb, yb, weights, maskb)
+
+        def body(carry, i):
+            g, ls, cnt = carry
+            gb, lb, cb = block_sums(start + i * c, c)
+            return (g + gb.astype(cd), ls + lb.astype(cd),
+                    cnt + cb.astype(cd)), None
+
+        init = (
+            jnp.zeros(jnp.shape(weights), cd),
+            jnp.asarray(0.0, cd),
+            jnp.asarray(0.0, cd),
+        )
+        (g, ls, cnt), _ = jax.lax.scan(body, init, jnp.arange(nblk))
+        if rem:
+            gb, lb, cb = block_sums(start + nblk * c, rem)
+            g, ls, cnt = g + gb.astype(cd), ls + lb.astype(cd), \
+                cnt + cb.astype(cd)
+        return g, ls, cnt
+
+
 class LeastSquaresGradient(Gradient):
     """Squared loss for linear regression: ``L = (x.w - y)^2 / 2``."""
 
