@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpu_sgd.feature import StandardScaler
+from tpu_sgd.feature import Normalizer, StandardScaler
 from tpu_sgd.models.classification import LogisticRegressionWithLBFGS
 from tpu_sgd.models.regression import LinearRegressionWithSGD
 from tpu_sgd.ops.sparse import sparse_data
@@ -84,6 +84,65 @@ class TestStandardScaler:
         w = rng.normal(size=(X.shape[1],)).astype(np.float32)
         back = np.asarray(model.transform(jnp.asarray(w) * model.std))
         np.testing.assert_allclose(back, w, rtol=1e-4)
+
+
+class TestNormalizer:
+    def test_l2_rows(self, rng):
+        X = rng.normal(size=(50, 8)).astype(np.float32)
+        Xn = np.asarray(Normalizer().transform(X))
+        np.testing.assert_allclose(
+            np.linalg.norm(Xn, axis=1), 1.0, rtol=1e-5
+        )
+        # direction preserved
+        i = 7
+        np.testing.assert_allclose(
+            Xn[i] * np.linalg.norm(X[i]), X[i], rtol=1e-4
+        )
+
+    def test_l1_and_inf(self, rng):
+        X = rng.normal(size=(30, 5)).astype(np.float32)
+        X1 = np.asarray(Normalizer(p=1.0).transform(X))
+        np.testing.assert_allclose(np.abs(X1).sum(axis=1), 1.0, rtol=1e-5)
+        Xi = np.asarray(Normalizer(p=float("inf")).transform(X))
+        np.testing.assert_allclose(np.abs(Xi).max(axis=1), 1.0, rtol=1e-5)
+
+    def test_zero_row_passthrough(self):
+        X = np.zeros((3, 4), np.float32)
+        X[1] = [1.0, 0.0, 0.0, 0.0]
+        Xn = np.asarray(Normalizer().transform(X))
+        np.testing.assert_allclose(Xn[0], 0.0)
+        np.testing.assert_allclose(Xn[1], X[1])
+
+    def test_single_vector(self):
+        v = np.array([3.0, 4.0], np.float32)
+        out = np.asarray(Normalizer().transform(v))
+        np.testing.assert_allclose(out, [0.6, 0.8], rtol=1e-6)
+
+    def test_sparse_matches_dense(self):
+        X, _, _ = sparse_data(100, 30, nnz_per_row=5, seed=8)
+        Xn_sp = np.asarray(Normalizer().transform(X).todense())
+        Xn_d = np.asarray(Normalizer().transform(np.asarray(X.todense())))
+        np.testing.assert_allclose(Xn_sp, Xn_d, rtol=1e-4, atol=1e-6)
+
+    def test_sparse_single_vector_matches_dense(self):
+        """1-D BCOO is ONE row — must match the dense single-vector path,
+        not treat each stored entry as its own row."""
+        import jax.numpy as jnp
+        from jax.experimental.sparse import BCOO
+
+        v = jnp.array([3.0, 0.0, 4.0], jnp.float32)
+        out = Normalizer().transform(BCOO.fromdense(v))
+        np.testing.assert_allclose(
+            np.asarray(out.todense()), [0.6, 0.0, 0.8], rtol=1e-6
+        )
+        out_inf = Normalizer(p=float("inf")).transform(BCOO.fromdense(v))
+        np.testing.assert_allclose(
+            np.asarray(out_inf.todense()), [0.75, 0.0, 1.0], rtol=1e-6
+        )
+
+    def test_bad_p_rejected(self):
+        with pytest.raises(ValueError):
+            Normalizer(p=0.0)
 
 
 class TestGLMFeatureScaling:

@@ -16,7 +16,7 @@ reference analysis this build follows.
 from tpu_sgd.config import MeshConfig, SGDConfig
 from tpu_sgd.evaluation import (BinaryClassificationMetrics,
                                 MulticlassMetrics, RegressionMetrics)
-from tpu_sgd.feature import StandardScaler, StandardScalerModel
+from tpu_sgd.feature import Normalizer, StandardScaler, StandardScalerModel
 from tpu_sgd.linalg import BLAS, DenseVector, SparseVector, Vectors
 from tpu_sgd.models import *  # noqa: F401,F403
 from tpu_sgd.models import __all__ as _models_all
@@ -37,7 +37,7 @@ __all__ = (
     + ["GradientDescent", "LBFGS", "NormalEquations", "OWLQN", "Optimizer",
        "run_mini_batch_sgd", "run_lbfgs",
        "data_mesh", "make_mesh",
-       "StandardScaler", "StandardScalerModel",
+       "Normalizer", "StandardScaler", "StandardScalerModel",
        "RegressionMetrics", "BinaryClassificationMetrics",
        "MulticlassMetrics",
        "col_stats", "corr", "MultivariateStatisticalSummary"]

@@ -82,6 +82,79 @@ class StandardScalerModel:
         return X
 
 
+class Normalizer:
+    """Row-wise p-norm normalization ([U] mllib/feature/Normalizer.scala —
+    the other stateless transformer in the reference's feature tier, the
+    standard preprocessing for hinge/logistic training on tfidf rows).
+
+    ``transform`` scales every example to unit p-norm (default p=2);
+    zero-norm rows pass through unchanged (the reference's convention).
+    Dense input is one fused elementwise pass; BCOO input computes row
+    norms by scatter-add over stored entries (implicit zeros contribute
+    nothing to any p-norm) and rescales ``data`` in place — never
+    densifies.
+    """
+
+    def __init__(self, p: float = 2.0):
+        if not (p > 0 or p == float("inf")):
+            raise ValueError(f"p must be in (0, inf], got {p}")
+        self.p = float(p)
+
+    def _norms_dense(self, X):
+        if self.p == float("inf"):
+            return jnp.max(jnp.abs(X), axis=-1)
+        return jnp.sum(jnp.abs(X) ** self.p, axis=-1) ** (1.0 / self.p)
+
+    def transform(self, X):
+        if is_sparse(X):
+            from jax.experimental.sparse import BCOO
+
+            if X.ndim == 1:
+                # A single sparse vector is one row: normalize its stored
+                # values by the whole-vector norm (the dense path's
+                # single-vector behavior, which indices[:, 0]-as-row-id
+                # would silently get wrong).
+                a = jnp.abs(X.data).astype(jnp.float32)
+                if self.p == float("inf"):
+                    norm = jnp.max(a) if a.shape[0] else jnp.float32(0.0)
+                else:
+                    norm = jnp.sum(a ** self.p) ** (1.0 / self.p)
+                inv = jnp.where(norm > 0, 1.0 / jnp.maximum(norm, 1e-38), 1.0)
+                return BCOO(
+                    (X.data * inv.astype(X.data.dtype), X.indices),
+                    shape=X.shape,
+                    indices_sorted=X.indices_sorted,
+                    unique_indices=X.unique_indices,
+                )
+            n = X.shape[0]
+            rows = X.indices[:, 0]
+            a = jnp.abs(X.data)
+            if self.p == float("inf"):
+                norms = jnp.zeros((n,), jnp.float32).at[rows].max(
+                    a.astype(jnp.float32), mode="drop"
+                )
+            else:
+                s = jnp.zeros((n,), jnp.float32).at[rows].add(
+                    a.astype(jnp.float32) ** self.p, mode="drop"
+                )
+                norms = s ** (1.0 / self.p)
+            inv = jnp.where(norms > 0, 1.0 / jnp.maximum(norms, 1e-38), 1.0)
+            scaled = X.data * inv[jnp.clip(rows, 0, n - 1)].astype(X.data.dtype)
+            return BCOO(
+                (scaled, X.indices),
+                shape=X.shape,
+                indices_sorted=X.indices_sorted,
+                unique_indices=X.unique_indices,
+            )
+        X = jnp.asarray(X)
+        single = X.ndim == 1
+        Xb = jnp.atleast_2d(X)
+        norms = self._norms_dense(Xb)
+        inv = jnp.where(norms > 0, 1.0 / jnp.maximum(norms, 1e-38), 1.0)
+        out = Xb * inv[:, None]
+        return out[0] if single else out
+
+
 class StandardScaler:
     """``fit(X) -> StandardScalerModel``.  Defaults mirror the reference:
     ``with_mean=False, with_std=True`` (unit variance, no centering — the

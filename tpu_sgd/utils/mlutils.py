@@ -141,6 +141,30 @@ def load_libsvm_file(
     return (vals.astype(dtype), cols, indptr), labels, d
 
 
+def _save_partitioned(path: str, n_items: int, num_partitions: int,
+                      write_slice) -> None:
+    """Shared ``saveAsTextFile`` directory layout: refuse an existing
+    output path, write ``part-NNNNN`` slices by even row bounds, then the
+    ``_SUCCESS`` marker.  ``write_slice(part_path, lo, hi)`` writes one
+    part file."""
+    if os.path.exists(path):
+        # Spark's saveAsTextFile refuses an existing output path: a
+        # rewrite with fewer partitions would otherwise leave stale part
+        # files that the directory loader silently mixes in.
+        raise FileExistsError(
+            f"output path {path!r} already exists; remove it first "
+            "(saveAsTextFile semantics)"
+        )
+    os.makedirs(path)
+    bounds = np.linspace(0, n_items, num_partitions + 1).astype(int)
+    for p in range(num_partitions):
+        write_slice(
+            os.path.join(path, f"part-{p:05d}"),
+            int(bounds[p]), int(bounds[p + 1]),
+        )
+    open(os.path.join(path, "_SUCCESS"), "w").close()
+
+
 def save_as_libsvm_file(path: str, X, y: np.ndarray,
                         num_partitions: int = 1) -> None:
     """Write ``(X, y)`` in 1-based LIBSVM text (parity with
@@ -156,22 +180,10 @@ def save_as_libsvm_file(path: str, X, y: np.ndarray,
 
     y = np.asarray(y)
     if num_partitions > 1:
-        if os.path.exists(path):
-            # Spark's saveAsTextFile refuses an existing output path: a
-            # rewrite with fewer partitions would otherwise leave stale
-            # part files that the directory loader silently mixes in.
-            raise FileExistsError(
-                f"output path {path!r} already exists; remove it first "
-                "(saveAsTextFile semantics)"
-            )
-        os.makedirs(path)
-        bounds = np.linspace(0, y.shape[0], num_partitions + 1).astype(int)
-        for p in range(num_partitions):
-            lo, hi = int(bounds[p]), int(bounds[p + 1])
-            save_as_libsvm_file(
-                os.path.join(path, f"part-{p:05d}"), X[lo:hi], y[lo:hi]
-            )
-        open(os.path.join(path, "_SUCCESS"), "w").close()
+        _save_partitioned(
+            path, y.shape[0], num_partitions,
+            lambda p, lo, hi: save_as_libsvm_file(p, X[lo:hi], y[lo:hi]),
+        )
         return
     if is_sparse(X):
         rows, cols, vals = host_entries(X)  # row-major sorted
@@ -204,6 +216,57 @@ def save_as_libsvm_file(path: str, X, y: np.ndarray,
             nz = np.nonzero(X[i])[0]
             feats = " ".join(f"{j + 1}:{X[i, j]:.6g}" for j in nz)
             f.write(f"{y[i]:.6g} {feats}\n")
+
+
+def load_labeled_points(path: str):
+    """Read ``LabeledPoint`` text lines — the reference's OTHER text
+    ingestion path ([U] MLUtils.loadLabeledPoints, reading the
+    ``LabeledPoint.toString`` forms ``(label,[f0,f1,...])`` and
+    ``(label,(size,[indices],[values]))``).  ``path`` may be one file, a
+    directory of part files, or a glob, exactly like ``load_libsvm_file``.
+    Returns a list of ``LabeledPoint`` (the ``RDD[LabeledPoint]``
+    analogue); feed it to ``models.to_arrays`` / any ``train()`` for
+    arrays."""
+    from tpu_sgd.models.labeled_point import LabeledPoint
+
+    points = []
+    for p in _resolve_input_paths(path):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    points.append(LabeledPoint.parse(line))
+    return points
+
+
+def save_labeled_points(path: str, points, num_partitions: int = 1) -> None:
+    """Write ``LabeledPoint``s in the reference's text form (the
+    ``RDD.saveAsTextFile(points.map(_.toString))`` counterpart that
+    ``loadLabeledPoints`` reads back): dense ``(label,[f0,f1,...])``,
+    sparse ``(label,(size,[i0,...],[v0,...]))``.  ``num_partitions > 1``
+    writes the part-file directory layout like ``save_as_libsvm_file``."""
+    from tpu_sgd.linalg import SparseVector
+
+    points = list(points)
+    if num_partitions > 1:
+        _save_partitioned(
+            path, len(points), num_partitions,
+            lambda p, lo, hi: save_labeled_points(p, points[lo:hi]),
+        )
+        return
+    with open(path, "w") as f:
+        for lp in points:
+            feats = lp.features
+            if isinstance(feats, SparseVector):
+                idx = ",".join(str(int(i)) for i in feats.indices)
+                val = ",".join(f"{float(v):.6g}" for v in feats.values)
+                f.write(f"({lp.label:.6g},({feats.size},[{idx}],[{val}]))\n")
+            else:
+                arr = np.asarray(
+                    feats.to_array() if hasattr(feats, "to_array") else feats
+                ).ravel()
+                body = ",".join(f"{float(v):.6g}" for v in arr)
+                f.write(f"({lp.label:.6g},[{body}])\n")
 
 
 def _take_rows(X, idx):
