@@ -28,6 +28,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # artifacts per alive-minute.
     echo "[$(date +%H:%M:%S)] sparse hardware check:"
     timeout 1800 python scripts/sparse_tpu_check.py 2>&1 | tee sparse_check_watch.log
+    echo "[$(date +%H:%M:%S)] quasi-newton/streaming hardware check:"
+    timeout 1800 python scripts/quasi_newton_tpu_check.py 2>&1 | tee qn_check_watch.log
     echo "[$(date +%H:%M:%S)] full bench (incl. streamed 10Mx1000 + pallas re-check):"
     BENCH_TPU_RETRIES=2 BENCH_TPU_BACKOFF=30 \
       timeout 3600 python bench.py 2>&1 | tee -a bench_logs/BENCH_STDERR_r03_tpu.txt
