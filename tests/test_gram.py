@@ -1,0 +1,365 @@
+"""GramLeastSquaresGradient (sufficient-statistics path) parity tests.
+
+The bound gradient must reproduce the stock two-pass results exactly (up to
+float summation order) for window sums at arbitrary offsets including
+partial-block edges and non-block-multiple tails, full-batch sums, the
+line-search sweep, and the whole GradientDescent / LBFGS trajectories —
+and must fall back (warning once) whenever it is called with anything but
+the bound dataset.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_sgd import GradientDescent, LBFGS, SimpleUpdater, SquaredL2Updater
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+
+def _data(rng, n=1000, d=16, noise=0.1):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, size=(d,)).astype(np.float32)
+    y = (X @ w + noise * rng.normal(size=(n,))).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y), jnp.asarray(w)
+
+
+@pytest.mark.parametrize("block", [64, 100, 1000, 2048])
+@pytest.mark.parametrize("start,m", [(0, 100), (37, 200), (123, 64),
+                                     (900, 100), (999, 1), (0, 1000)])
+def test_window_sums_parity(rng, block, start, m):
+    # n=1000 is NOT a multiple of 64 or 2048 -> exercises the tail backoff
+    X, y, w = _data(rng)
+    base = LeastSquaresGradient()
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=block)
+    g0, l0, c0 = base.window_sums(X, y, w, jnp.int32(start), m)
+    g1, l1, c1 = gram.window_sums(X, y, w, jnp.int32(start), m)
+    # Absolute tolerance scales with the f32 prefix cancellation: results
+    # are differences of [0, r) accumulations, so tiny windows (m=1) carry
+    # the full-prefix rounding noise while their own magnitude is O(1).
+    atol = 2e-3 if m >= 64 else 2e-2
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-4, atol=atol)
+    assert float(l1) == pytest.approx(float(l0), rel=1e-3, abs=atol)
+    assert float(c1) == float(c0) == min(m, 1000)
+
+
+def test_window_start_clamp_matches_stock(rng):
+    X, y, w = _data(rng, n=500)
+    base = LeastSquaresGradient()
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=128)
+    # out-of-range start: stock dynamic_slice clamps to n - m
+    g0, l0, _ = base.window_sums(X, y, w, jnp.int32(490), 100)
+    g1, l1, _ = gram.window_sums(X, y, w, jnp.int32(490), 100)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-4, atol=2e-3)
+    assert float(l1) == pytest.approx(float(l0), rel=1e-3, abs=2e-3)
+
+
+def test_batch_sums_and_loss_sweep_parity(rng):
+    X, y, w = _data(rng)
+    base = LeastSquaresGradient()
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=100)
+    g0, l0, c0 = base.batch_sums(X, y, w)
+    g1, l1, c1 = gram.batch_sums(X, y, w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-4, atol=2e-3)
+    assert float(l1) == pytest.approx(float(l0), rel=2e-4)
+    assert float(c1) == float(c0)
+
+    W = jnp.stack([w, 0.5 * w, jnp.zeros_like(w)])
+    s0, n0 = base.loss_sweep(X, y, W)
+    s1, n1 = gram.loss_sweep(X, y, W)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0),
+                               rtol=2e-4, atol=2e-3)
+    assert float(n1) == float(n0)
+
+
+def test_masked_paths_delegate_exactly(rng):
+    X, y, w = _data(rng, n=300)
+    base = LeastSquaresGradient()
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=64)
+    mask = jnp.asarray((np.arange(300) % 2 == 0).astype(np.float32))
+    g0, l0, c0 = base.batch_sums(X, y, w, mask)
+    g1, l1, c1 = gram.batch_sums(X, y, w, mask)
+    # delegation is the SAME code path -> bitwise equal
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+    assert float(l1) == float(l0) and float(c1) == float(c0)
+
+    valid = jnp.asarray(np.ones((300,), np.float32))
+    g0, l0, c0 = base.window_sums(X, y, w, jnp.int32(10), 50, valid=valid)
+    g1, l1, c1 = gram.window_sums(X, y, w, jnp.int32(10), 50, valid=valid)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+
+
+def test_unbound_matrix_falls_back_with_warning(rng):
+    X, y, w = _data(rng, n=200)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=64)
+    X2, y2, _ = _data(rng, n=150)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        g1, l1, c1 = gram.window_sums(X2, y2, w, jnp.int32(0), 50)
+        gram.window_sums(X2, y2, w, jnp.int32(0), 50)  # warns only once
+    assert sum(issubclass(r.category, RuntimeWarning) for r in rec) == 1
+    g0, l0, c0 = LeastSquaresGradient().window_sums(
+        X2, y2, w, jnp.int32(0), 50)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+
+
+def test_gd_trajectory_parity_sliced(rng):
+    X, y, _ = _data(rng, n=4096, d=24)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=512)
+
+    def run(gradient):
+        opt = (GradientDescent(gradient, SimpleUpdater())
+               .set_step_size(0.2).set_num_iterations(30)
+               .set_mini_batch_fraction(0.1).set_sampling("sliced")
+               .set_seed(7).set_convergence_tol(0.0))
+        return opt.optimize_with_history((X, y), jnp.zeros((24,)))
+
+    w0, h0 = run(LeastSquaresGradient())
+    w1, h1 = run(gram)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_gd_trajectory_parity_full_batch(rng):
+    X, y, _ = _data(rng, n=1500, d=12)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=256)
+
+    def run(gradient):
+        opt = (GradientDescent(gradient, SquaredL2Updater())
+               .set_step_size(0.3).set_num_iterations(25)
+               .set_reg_param(0.01).set_seed(3))
+        return opt.optimize_with_history((X, y), jnp.zeros((12,)))
+
+    w0, h0 = run(LeastSquaresGradient())
+    w1, h1 = run(gram)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_lbfgs_matches_stock_and_accelerated_cost(rng):
+    X, y, _ = _data(rng, n=2000, d=20)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=256)
+
+    def run(gradient):
+        opt = LBFGS(gradient, SquaredL2Updater(), reg_param=0.01,
+                    max_num_iterations=15)
+        return opt.optimize_with_history((X, y), jnp.zeros((20,)))
+
+    w0, h0 = run(LeastSquaresGradient())
+    w1, h1 = run(gram)
+    assert float(h1[-1]) == pytest.approx(float(h0[-1]), rel=1e-3)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0),
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_bf16_data_close_to_f32_truth(rng):
+    """With bf16 data the gram path computes at HIGHEST precision in f32
+    internally (the matmul_dtype bandwidth contract would amplify bf16
+    rounding by prefix/window magnitude — see the module docstring), so it
+    must track the f32 truth OF THE bf16 DATA tightly — tighter than the
+    stock bf16 two-pass tracks it."""
+    X, y, w = _data(rng, n=2048, d=16)
+    Xb = X.astype(jnp.bfloat16)
+    Xf = np.asarray(Xb, np.float32)  # the bf16 data, exactly, in f32
+    gram = GramLeastSquaresGradient.build(Xb, y, block_rows=256)
+    g1, l1, c1 = gram.window_sums(Xb, y, w, jnp.int32(100), 512)
+    win = slice(100, 612)
+    resid = Xf[win] @ np.asarray(w) - np.asarray(y)[win]
+    g_truth = Xf[win].T @ resid
+    l_truth = 0.5 * float(resid @ resid)
+    np.testing.assert_allclose(np.asarray(g1, np.float32), g_truth,
+                               rtol=1e-3, atol=5e-2)
+    assert float(l1) == pytest.approx(l_truth, rel=1e-3)
+
+
+def test_build_rejects_narrow_stats_and_empty(rng):
+    X, y, _ = _data(rng, n=64)
+    with pytest.raises(ValueError, match="f32"):
+        GramLeastSquaresGradient.build(X, y, stats_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="non-empty"):
+        GramLeastSquaresGradient.build(jnp.zeros((0, 4)), jnp.zeros((0,)))
+
+
+def test_int_features_build_and_match(rng):
+    Xi = (rng.integers(0, 2, size=(500, 8))).astype(np.int32)
+    y = rng.normal(size=(500,)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    gram = GramLeastSquaresGradient.build(Xi, y, block_rows=128)
+    # build() coerces int features to f32 internally; the accelerated path
+    # is reached through the GramData bundle (identity binding means a
+    # caller-side re-cast can never silently alias)
+    Xf = jnp.asarray(Xi).astype(jnp.float32)
+    g1, l1, c1 = gram.window_sums(gram.data, jnp.asarray(y), w,
+                                  jnp.int32(3), 200)
+    g0, l0, c0 = LeastSquaresGradient().window_sums(
+        Xf, jnp.asarray(y), w, jnp.int32(3), 200)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=2e-4, atol=2e-3)
+
+
+def test_gd_set_sufficient_stats_flag(rng):
+    X, y, _ = _data(rng, n=2048, d=16)
+
+    def make(flag):
+        opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+               .set_step_size(0.2).set_num_iterations(20)
+               .set_mini_batch_fraction(0.25).set_sampling("sliced")
+               .set_seed(5).set_convergence_tol(0.0))
+        return opt.set_sufficient_stats(flag)
+
+    w0, h0 = make(False).optimize_with_history((X, y), jnp.zeros((16,)))
+    opt = make(True)
+    w1, h1 = opt.optimize_with_history((X, y), jnp.zeros((16,)))
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+    assert opt._gram_entry is not None
+    # identity cache: same arrays -> same built gradient; gradient restored
+    built = opt._gram_entry[2]
+    opt.optimize_with_history((X, y), jnp.zeros((16,)))
+    assert opt._gram_entry[2] is built
+    assert type(opt.gradient) is LeastSquaresGradient
+
+
+def test_gd_sufficient_stats_noop_cases(rng):
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    X = jnp.asarray(rng.normal(size=(256, 8)).astype(np.float32))
+    y = jnp.asarray((rng.uniform(size=(256,)) > 0.5).astype(np.float32))
+    # non-least-squares gradient: flag must be a no-op
+    opt = (GradientDescent(LogisticGradient(), SimpleUpdater())
+           .set_num_iterations(3).set_sufficient_stats(True))
+    opt.optimize_with_history((X, y), jnp.zeros((8,)))
+    assert opt._gram_entry is None
+    # bernoulli sub-unit sampling: no gram either
+    opt2 = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_num_iterations(3).set_mini_batch_fraction(0.5)
+            .set_sufficient_stats(True))
+    opt2.optimize_with_history((X, y), jnp.zeros((8,)))
+    assert opt2._gram_entry is None
+
+
+def test_lbfgs_and_owlqn_sufficient_stats_flag(rng):
+    from tpu_sgd import OWLQN
+
+    X, y, _ = _data(rng, n=1500, d=12)
+
+    r0 = LBFGS(LeastSquaresGradient(), SquaredL2Updater(), reg_param=0.01,
+               max_num_iterations=12).optimize_with_history(
+                   (X, y), jnp.zeros((12,)))
+    lb = LBFGS(LeastSquaresGradient(), SquaredL2Updater(), reg_param=0.01,
+               max_num_iterations=12).set_sufficient_stats(True)
+    r1 = lb.optimize_with_history((X, y), jnp.zeros((12,)))
+    assert float(r1[1][-1]) == pytest.approx(float(r0[1][-1]), rel=1e-3)
+    assert lb._gram_entry is not None
+
+    o0 = OWLQN(LeastSquaresGradient(), reg_param=1e-3,
+               max_num_iterations=12).optimize_with_history(
+                   (X, y), jnp.zeros((12,)))
+    ow = OWLQN(LeastSquaresGradient(), reg_param=1e-3,
+               max_num_iterations=12).set_sufficient_stats(True)
+    o1 = ow.optimize_with_history((X, y), jnp.zeros((12,)))
+    assert float(o1[1][-1]) == pytest.approx(float(o0[1][-1]), rel=1e-3)
+    assert ow._gram_entry is not None
+
+
+def test_gramdata_argument_path_matches_plain(rng):
+    """Stats passed as the X argument (GramData pytree — the big-slab
+    plumbing) must give the same results as plain-array binding, and must
+    flow through a jitted make_run unchanged."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    X, y, w = _data(rng, n=2048, d=16)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=256)
+    g0, l0, c0 = gram.window_sums(X, y, w, jnp.int32(100), 512)
+    g1, l1, c1 = gram.window_sums(gram.data, y, w, jnp.int32(100), 512)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+    assert float(l1) == float(l0)
+
+    cfg = SGDConfig(step_size=0.2, num_iterations=10,
+                    mini_batch_fraction=0.25, convergence_tol=0.0,
+                    sampling="sliced")
+    run = jax.jit(make_run(gram, SimpleUpdater(), cfg))
+    w1, h1, nr1 = run(jnp.zeros((16,)), gram.data, y)
+    run0 = jax.jit(make_run(LeastSquaresGradient(), SimpleUpdater(), cfg))
+    w0, h0, nr0 = run0(jnp.zeros((16,)), X, y)
+    np.testing.assert_allclose(np.asarray(h1)[:int(nr1)],
+                               np.asarray(h0)[:int(nr0)],
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_gramdata_rejects_indexing():
+    import pytest as _pytest
+
+    X = jnp.ones((64, 4))
+    y = jnp.ones((64,))
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=16)
+    with _pytest.raises(TypeError, match="sliced"):
+        gram.data[0]
+
+
+def test_model_level_sufficient_stats(rng):
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(1024, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, size=(10,)).astype(np.float32)
+    y = X @ w + 0.05 * rng.normal(size=(1024,)).astype(np.float32)
+    m0 = LinearRegressionWithSGD.train((X, y), num_iterations=40,
+                                       step_size=0.3, intercept=True)
+    m1 = LinearRegressionWithSGD.train((X, y), num_iterations=40,
+                                       step_size=0.3, intercept=True,
+                                       sufficient_stats=True)
+    np.testing.assert_allclose(np.asarray(m1.weights),
+                               np.asarray(m0.weights),
+                               rtol=1e-3, atol=1e-3)
+    assert float(m1.intercept) == pytest.approx(float(m0.intercept),
+                                                abs=1e-3)
+
+
+def test_same_shape_different_matrix_never_binds(rng):
+    """Review finding: a DIFFERENT matrix with the same shape/dtype must
+    not silently train against stale statistics — identity binding."""
+    X, y, w = _data(rng, n=400, d=8)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=128)
+    X2 = jnp.asarray(np.asarray(X) + 1.0)  # same shape, same dtype
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        g1, l1, _ = gram.window_sums(X2, y, w, jnp.int32(0), 200)
+    assert any(issubclass(r.category, RuntimeWarning) for r in rec)
+    g0, l0, _ = LeastSquaresGradient().window_sums(
+        X2, y, w, jnp.int32(0), 200)
+    # fell back to the stock path ON X2 (not X's stats): bitwise equal
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+    assert float(l1) == float(l0)
+
+
+def test_prebuilt_gram_routes_gramdata_through_optimizer(rng):
+    """Passing a user-built gram gradient with its bound matrix must
+    accelerate (GramData routed into the traced program), not fall back."""
+    X, y, _ = _data(rng, n=2048, d=16)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=256)
+    opt = (GradientDescent(gram, SimpleUpdater())
+           .set_step_size(0.2).set_num_iterations(10)
+           .set_mini_batch_fraction(0.25).set_sampling("sliced")
+           .set_convergence_tol(0.0))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        w1, h1 = opt.optimize_with_history((X, y), jnp.zeros((16,)))
+    assert not any(issubclass(r.category, RuntimeWarning) for r in rec)
+    opt0 = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+            .set_step_size(0.2).set_num_iterations(10)
+            .set_mini_batch_fraction(0.25).set_sampling("sliced")
+            .set_convergence_tol(0.0))
+    w0, h0 = opt0.optimize_with_history((X, y), jnp.zeros((16,)))
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)

@@ -6,6 +6,7 @@ from tpu_sgd.ops.gradients import (
     LogisticGradient,
     MultinomialLogisticGradient,
 )
+from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
 from tpu_sgd.ops.pallas_kernels import PallasGradient, fused_gradient_sums
 from tpu_sgd.ops.sparse import (
     append_bias_auto,
@@ -31,6 +32,8 @@ __all__ = [
     "LogisticGradient",
     "HingeGradient",
     "MultinomialLogisticGradient",
+    "GramData",
+    "GramLeastSquaresGradient",
     "PallasGradient",
     "fused_gradient_sums",
     "is_sparse",

@@ -1,0 +1,355 @@
+"""Sufficient-statistics execution of the least-squares gradient.
+
+Reference frame: the reference's hot loop re-reads the sampled rows every
+iteration — per-example BLAS ``dot``/``axpy`` under ``treeAggregate``
+(SURVEY.md §3.1 inner hot loop); the stock TPU path here does the same two
+fused MXU passes over the window, which `PROFILE_TPU.json` shows is the
+two-HBM-read bandwidth floor (~1.64 ms/iter on the 3M-row slab).
+
+For the *quadratic* loss that floor is not fundamental: the window gradient
+is linear in the sufficient statistics
+
+    grad_sum = G_w @ w - b_w          G_w = X_wᵀ X_w,  b_w = X_wᵀ y_w
+    loss_sum = ½ (wᵀ G_w w - 2 bᵀ_w w + yyw)
+
+so a one-time pass over the data (the ``cache()`` analogue — SURVEY.md §2
+#13) can precompute *block-prefix* Grams, after which any contiguous-window
+(``sampling="sliced"``) gradient costs two (d, d) prefix matvecs plus two
+masked partial-block edge corrections — ~(8 MB + 2·B·d reads) of HBM
+traffic per iteration instead of two full window reads, and it is the SAME
+gradient (exact up to float summation order), not an approximation.  The
+full-batch gradient, the LBFGS ``CostFun`` objective, and the batched
+Armijo ``loss_sweep`` reduce to the same statistics, so quasi-Newton least
+squares accelerates identically.
+
+This is least-squares only by construction: logistic/hinge gradients are
+nonlinear in the margins and have no fixed-size sufficient statistics.
+
+Memory: the prefix stack is ``(n/block_rows + 1) · d² · 4`` bytes (f32 —
+differences of same-sign prefix accumulations would lose ~1% at bf16, so
+the stats dtype floor is f32).  For the 3M×1000 bench slab at the default
+``block_rows=8192`` that is ~1.5 GB next to the 6 GB bf16 slab.
+
+Precision: this path deliberately does NOT follow the hot-path
+``matmul_dtype`` bandwidth contract (`ops/gradients.py`).  Window results
+are *differences of whole-prefix accumulations*, so any matmul rounding is
+amplified by (prefix magnitude / window-gradient magnitude) — near
+convergence that ratio is huge, and bf16-pass matmuls (the TPU default for
+both bf16 AND f32 operands) turn a 0.4% product error into an O(1)
+gradient error.  Since the whole point of the path is to be compute-cheap
+rather than bandwidth-bound, every internal matmul runs in the stats dtype
+at ``lax.Precision.HIGHEST``; the precompute walks the data block-by-block
+(``lax.map``) so the f32 upcast never materializes more than one block.
+
+Plumbing: the statistics enter compiled programs as ARGUMENTS, never as
+closure constants — tracing GB-scale captured arrays into a jit program
+embeds them in the lowered module, which chokes compilation (observed:
+minutes of lowering through the remote-TPU path vs seconds with argument
+buffers).  :class:`GramData` is a registered pytree bundling the dense
+matrix with its statistics; pass it wherever ``X`` goes (``optimize``,
+``make_run``) and the bound :class:`GramLeastSquaresGradient` pulls the
+statistics out of the traced argument.  The optimizer-level
+``set_sufficient_stats`` flags do this wrapping automatically.
+"""
+
+from __future__ import annotations
+
+import warnings
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_sgd.ops.gradients import (LeastSquaresGradient, acc_dtype,
+                                   matmul_dtype)
+
+Array = jax.Array
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _dot_hi(a, b, dtype):
+    """Cancellation-safe matmul: both operands upcast to the stats dtype,
+    full-precision MXU passes (see the module docstring)."""
+    return jnp.dot(
+        a.astype(dtype), b.astype(dtype),
+        precision=_HI, preferred_element_type=dtype,
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+class GramData:
+    """A dense ``(n, d)`` matrix bundled with its block-prefix Gram
+    statistics, as a pytree — so the statistics ride into jit programs as
+    argument buffers.  Quacks like the wrapped array where the SGD driver
+    needs it (``shape``/``dtype``/``ndim``)."""
+
+    __slots__ = ("X", "PG", "Pb", "Pyy", "G_tot", "b_tot", "yy_tot",
+                 "block_rows")
+
+    def __init__(self, X, PG, Pb, Pyy, G_tot, b_tot, yy_tot, block_rows):
+        self.X = X
+        self.PG = PG
+        self.Pb = Pb
+        self.Pyy = Pyy
+        self.G_tot = G_tot
+        self.b_tot = b_tot
+        self.yy_tot = yy_tot
+        self.block_rows = block_rows
+
+    @property
+    def shape(self):
+        return self.X.shape
+
+    @property
+    def dtype(self):
+        return self.X.dtype
+
+    @property
+    def ndim(self):
+        return self.X.ndim
+
+    def __getitem__(self, idx):
+        raise TypeError(
+            "GramData supports sliced/full-batch execution only; use "
+            "sampling='sliced' (or mini_batch_fraction=1.0), or pass the "
+            "plain matrix for indexed/bernoulli sampling"
+        )
+
+    def tree_flatten(self):
+        return (
+            (self.X, self.PG, self.Pb, self.Pyy,
+             self.G_tot, self.b_tot, self.yy_tot),
+            self.block_rows,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, block_rows, children):
+        return cls(*children, block_rows)
+
+
+class GramLeastSquaresGradient(LeastSquaresGradient):
+    """``LeastSquaresGradient`` bound to precomputed block-prefix Grams.
+
+    Build with :meth:`build`; pass anywhere a ``Gradient`` goes
+    (``GradientDescent``, ``LBFGS``), giving the optimizer ``.data`` (the
+    :class:`GramData` bundle) as the feature matrix.  Accelerates:
+
+    * ``window_sums`` (sliced mini-batch sampling) — prefix difference +
+      edge corrections;
+    * ``batch_sums`` with no mask (full-batch GD, LBFGS CostFun) — total
+      statistics;
+    * ``loss_sweep`` with no mask (the batched line-search ladder) — one
+      (T, d) × (d, d) quadratic-form matmul.
+
+    The plain bound array also works in eager calls (identity-checked);
+    for anything traced/jitted pass ``.data`` — the optimizer
+    ``set_sufficient_stats`` flags do this automatically.  Bernoulli-
+    masked and indexed sampling, ``valid`` masks, feature-axis sharding,
+    and any ``X`` that is neither the ``GramData`` bundle nor (by
+    identity) the bound dataset all fall back to the stock exact
+    implementation — a same-shape different matrix can never silently
+    train against stale statistics.
+    """
+
+    def __init__(self, data: GramData):
+        self.data = data
+        self._X_shape = tuple(data.X.shape)
+        self._X_dtype = data.X.dtype
+        self.block_rows = data.block_rows
+        self._warned = False
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(cls, X, y, block_rows: int = 8192,
+              stats_dtype=jnp.float32) -> "GramLeastSquaresGradient":
+        """One pass over ``(X, y)`` → a bound gradient (stats in
+        ``.data``).
+
+        ``block_rows`` trades prefix memory (``n/B · d² · 4`` bytes)
+        against per-iteration edge-read traffic (``2 · B · d`` elements).
+        """
+        X = jnp.asarray(X)
+        if not jnp.issubdtype(X.dtype, jnp.inexact):
+            X = X.astype(jnp.float32)  # match optimize()'s coercion
+        y = jnp.asarray(y)
+        if not jnp.issubdtype(y.dtype, jnp.inexact):
+            y = y.astype(jnp.float32)
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"need a non-empty (n, d) matrix, got {X.shape}")
+        if jnp.issubdtype(stats_dtype, jnp.inexact) and (
+                jnp.finfo(stats_dtype).bits < 32):
+            raise ValueError(
+                "stats_dtype below f32 loses ~1% on prefix differences; "
+                "use float32 or wider"
+            )
+        n = X.shape[0]
+        B = max(1, min(int(block_rows), n))
+        stats = jax.jit(
+            partial(cls._precompute, B=B, stats_dtype=stats_dtype)
+        )(X, y)
+        return cls(GramData(X, *stats, B))
+
+    @staticmethod
+    def _precompute(X, y, *, B, stats_dtype):
+        n, d = X.shape
+        nbf = n // B
+        sd = stats_dtype
+
+        def block_stats(k):
+            Xb = jax.lax.dynamic_slice_in_dim(X, k * B, B, 0)
+            yb = jax.lax.dynamic_slice_in_dim(y, k * B, B, 0)
+            G = _dot_hi(Xb.T, Xb, sd)
+            b = _dot_hi(yb, Xb, sd)
+            yy = _dot_hi(yb, yb, sd)
+            return G, b, yy
+
+        # lax.map = sequential scan: one block's f32 upcast live at a time
+        G_blocks, b_blocks, yy_blocks = jax.lax.map(
+            block_stats, jnp.arange(nbf)
+        )
+
+        def prefix(blocks):
+            zero = jnp.zeros((1,) + blocks.shape[1:], sd)
+            return jnp.concatenate([zero, jnp.cumsum(blocks, axis=0)])
+
+        PG, Pb, Pyy = prefix(G_blocks), prefix(b_blocks), prefix(yy_blocks)
+        Xt = X[nbf * B:]  # static-shape tail (n % B rows)
+        yt = y[nbf * B:]
+        G_tot = PG[-1] + _dot_hi(Xt.T, Xt, sd)
+        b_tot = Pb[-1] + _dot_hi(yt, Xt, sd)
+        yy_tot = Pyy[-1] + _dot_hi(yt, yt, sd)
+        return PG, Pb, Pyy, G_tot, b_tot, yy_tot
+
+    # -- binding check -----------------------------------------------------
+    def _stats_for(self, X, mask_or_valid, margin_axis_name):
+        """``(dense_X, stats)`` — stats is the GramData to read from, or
+        None when this call must run the stock path."""
+        if isinstance(X, GramData):
+            if mask_or_valid is not None or margin_axis_name is not None:
+                return X.X, None  # masked/feature-sharded: stock is correct
+            return X.X, X
+        if mask_or_valid is not None or margin_axis_name is not None:
+            return X, None
+        # Plain arrays bind by IDENTITY only: a same-shape different matrix
+        # (a validation split, a regenerated batch) must never silently
+        # train against stale statistics, and a tracer (someone jitting
+        # around a plain X instead of passing ``.data``) can't be
+        # value-checked — both fall back to the stock exact path.  The
+        # optimizer flags wrap X into GramData before tracing, so the
+        # accelerated path is the traced one in normal use.
+        if X is self.data.X:
+            return X, self.data
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"GramLeastSquaresGradient is bound to a "
+                f"{self._X_shape} {self._X_dtype} matrix but was called "
+                f"with a different (or traced) {tuple(jnp.shape(X))} "
+                f"{getattr(X, 'dtype', '?')} array; running the exact "
+                "unaccelerated path (pass gradient.data as X — the "
+                "optimizer set_sufficient_stats flags do — or rebuild)",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+        return X, None
+
+    # -- accelerated entry points -----------------------------------------
+    def batch_sums(self, X, y, weights, mask=None, margin_axis_name=None):
+        Xd, st = self._stats_for(X, mask, margin_axis_name)
+        if st is None:
+            return super().batch_sums(
+                Xd, y, weights, mask, margin_axis_name=margin_axis_name
+            )
+        cd = acc_dtype(matmul_dtype(Xd))
+        sd = st.G_tot.dtype
+        w = weights.astype(sd)
+        Gw = _dot_hi(st.G_tot, w, sd)
+        b = st.b_tot
+        g_sum = (Gw - b).astype(cd)
+        loss_sum = (0.5 * (jnp.dot(w, Gw) - 2.0 * jnp.dot(w, b)
+                           + st.yy_tot)).astype(cd)
+        return g_sum, loss_sum, jnp.asarray(Xd.shape[0], cd)
+
+    def loss_sweep(self, X, y, W, mask=None):
+        Xd, st = self._stats_for(X, mask, None)
+        if st is None:
+            return super().loss_sweep(Xd, y, W, mask)
+        cd = acc_dtype(matmul_dtype(Xd))
+        sd = st.G_tot.dtype
+        Wc = W.astype(sd)  # (T, d)
+        GW = _dot_hi(Wc, st.G_tot, sd)  # (T, d) — G is symmetric
+        quad = jnp.sum(GW * Wc, axis=1)
+        lin = jnp.dot(Wc, st.b_tot)
+        losses = 0.5 * (quad - 2.0 * lin + st.yy_tot)
+        return losses.astype(cd), jnp.asarray(Xd.shape[0], cd)
+
+    def window_sums(
+        self,
+        X: Array,
+        y: Array,
+        weights: Array,
+        start: Array,
+        m: int,
+        valid: Optional[Array] = None,
+        margin_axis_name: Optional[str] = None,
+    ) -> Tuple[Array, Array, Array]:
+        Xd, st = self._stats_for(X, valid, margin_axis_name)
+        if st is None:
+            return super().window_sums(
+                Xd, y, weights, start, m, valid,
+                margin_axis_name=margin_axis_name,
+            )
+        cd = acc_dtype(matmul_dtype(Xd))
+        n = Xd.shape[0]
+        # Same effective clamp as the stock path's whole-window
+        # dynamic_slice.
+        start = jnp.clip(start, 0, max(n - m, 0))
+        end = start + m
+        Gw_s, b_s, yy_s = self._cum(st, Xd, y, weights, start, cd)
+        Gw_e, b_e, yy_e = self._cum(st, Xd, y, weights, end, cd)
+        Gw, b, yy = Gw_e - Gw_s, b_e - b_s, yy_e - yy_s
+        g_sum = Gw - b
+        wc = weights.astype(cd)
+        loss_sum = 0.5 * (jnp.dot(wc, g_sum) - jnp.dot(wc, b) + yy)
+        return g_sum, loss_sum, jnp.asarray(m, cd)
+
+    # -- internals ---------------------------------------------------------
+    def _cum(self, st, X, y, weights, r, cd):
+        """Statistics of rows ``[0, r)`` applied to ``weights``:
+        ``(G_[0,r) @ w, b_[0,r), yy_[0,r))`` — prefix entry ``r // B`` plus
+        a masked partial-block edge."""
+        B = st.block_rows
+        k = r // B
+        PGk = jax.lax.dynamic_slice_in_dim(st.PG, k, 1, 0)[0]
+        Pbk = jax.lax.dynamic_slice_in_dim(st.Pb, k, 1, 0)[0]
+        Pyyk = jax.lax.dynamic_slice_in_dim(st.Pyy, k, 1, 0)[0]
+        Gw_full = _dot_hi(PGk, weights, PGk.dtype)
+        e_gw, e_b, e_yy = self._edge(st, X, y, weights, r, k, cd)
+        return (
+            Gw_full.astype(cd) + e_gw,
+            Pbk.astype(cd) + e_b,
+            Pyyk.astype(cd) + e_yy,
+        )
+
+    def _edge(self, st, X, y, weights, r, k, cd):
+        """Contribution of the partial block ``[k·B, r)`` (``r − k·B < B``
+        rows), via masked matvecs on one B-row slice — never a (d, d)
+        intermediate.  The slice start backs off to ``n − B`` near the tail
+        so ``dynamic_slice`` never clamps behind our back; the mask is
+        expressed in slice-local coordinates to stay exact either way."""
+        B = st.block_rows
+        n = X.shape[0]
+        sd = st.PG.dtype
+        s = jnp.minimum(k * B, max(n - B, 0))
+        Xb = jax.lax.dynamic_slice_in_dim(X, s, B, 0)
+        yb = jax.lax.dynamic_slice_in_dim(y, s, B, 0)
+        j = jnp.arange(B)
+        msk = ((j >= k * B - s) & (j < r - s)).astype(sd)
+        margins = _dot_hi(Xb, weights, sd)  # (B,)
+        e_gw = _dot_hi(margins * msk, Xb, sd)
+        ybm = yb.astype(sd) * msk
+        e_b = _dot_hi(ybm, Xb, sd)
+        e_yy = jnp.dot(yb.astype(sd), ybm)
+        return e_gw.astype(cd), e_b.astype(cd), e_yy.astype(cd)

@@ -251,6 +251,8 @@ class GradientDescent(Optimizer):
         self.check_numerics = False
         self.checkpoint_manager = None
         self.checkpoint_every = 10
+        self.sufficient_stats = False
+        self._gram_entry = None
         self._loss_history = None
         self._run_cache = {}
 
@@ -337,6 +339,21 @@ class GradientDescent(Optimizer):
         unchanged window sequence (see ``optimize_host_streamed``)."""
         self.host_streaming = bool(flag)
         self.streaming_resident_rows = int(resident_rows)
+        return self
+
+    def set_sufficient_stats(self, flag: bool = True):
+        """Execute least-squares via precomputed block-prefix Gram
+        statistics (``ops/gram.py``): window/full-batch gradients become
+        two (d, d) matvecs plus masked edge blocks instead of two full
+        passes over the sampled rows — exact, and far below the two-read
+        HBM bandwidth floor the stock path sits at (PROFILE_TPU.json).
+
+        Applies when the gradient is exactly ``LeastSquaresGradient``, the
+        data is dense and device-resident (no mesh, no host streaming), and
+        sampling is ``sliced`` or full-batch; any other combination runs
+        unchanged.  The one-time build pass is cached per ``(X, y)`` array
+        identity."""
+        self.sufficient_stats = bool(flag)
         return self
 
     def set_checkpoint(self, manager, every: int = 10):
@@ -436,6 +453,23 @@ class GradientDescent(Optimizer):
             warnings.warn(
                 "The miniBatchFraction is too small", RuntimeWarning, stacklevel=2
             )
+        gram = self._maybe_gram(X, y, sparse_X)
+        if gram is not None:
+            # The stats ride as the X argument (GramData pytree) so they
+            # enter the jit program as buffers, not closure constants.
+            orig, self.gradient = self.gradient, gram
+            try:
+                return self._optimize_routed(gram.data, y, w0, sparse_X)
+            finally:
+                self.gradient = orig
+        return self._optimize_routed(X, y, w0, sparse_X)
+
+    def _optimize_routed(self, X, y, w0, sparse_X):
+        """Resident-data path routing (single-device / mesh / sparse /
+        stepwise), after input coercion and the optional sufficient-stats
+        substitution."""
+        import numpy as np
+
         if self.listener is not None or self.checkpoint_manager is not None:
             return self._optimize_stepwise(X, y, w0)
         if sparse_X and self.mesh is not None:
@@ -489,6 +523,41 @@ class GradientDescent(Optimizer):
         if self.check_numerics:
             _raise_if_nonfinite(self._loss_history)
         return w, self._loss_history
+
+    def _maybe_gram(self, X, y, sparse_X):
+        """The sufficient-stats substitution, when it applies (see
+        ``set_sufficient_stats``); identity-cached so the streaming mode's
+        repeated ``optimize`` calls on the same arrays build once."""
+        from tpu_sgd.ops.gradients import LeastSquaresGradient as _LS
+        from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+        cfg = self.config
+        if (sparse_X or self.mesh is not None or self.host_streaming
+                or (cfg.mini_batch_fraction < 1.0
+                    and cfg.sampling != "sliced")):
+            return None
+        if (isinstance(self.gradient, GramLeastSquaresGradient)
+                and self.gradient.data.X is X):
+            # user-built gram gradient on exactly this matrix: route its
+            # GramData through so the traced program accelerates
+            return self.gradient
+        if not self.sufficient_stats or type(self.gradient) is not _LS:
+            return None
+        entry = self._gram_entry
+        if entry is not None and entry[0] is X and entry[1] is y:
+            return entry[2]
+        if entry is not None:
+            # new dataset: drop compiled runners keyed on the superseded
+            # gram gradient so its GB-scale prefix stack can be freed
+            old = entry[2]
+            self._run_cache = {
+                k: v for k, v in self._run_cache.items()
+                if not any(part is old for part in k)
+            }
+        g = GramLeastSquaresGradient.build(X, y)
+        # keep the ORIGINAL arrays in the key: build() may re-coerce
+        self._gram_entry = (X, y, g)
+        return g
 
     def _optimize_stepwise(self, X, y, w0):
         """Observed path: jitted step per iteration with host round-trips.

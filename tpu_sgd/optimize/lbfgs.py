@@ -285,6 +285,8 @@ class LBFGS(Optimizer):
         self.max_num_iterations = max_num_iterations
         self.reg_param = reg_param
         self.mesh = None
+        self.sufficient_stats = False
+        self._gram_entry = None
         self._loss_history = None
 
     # fluent setters, reference parity
@@ -312,6 +314,16 @@ class LBFGS(Optimizer):
         self.reg_param = float(r)
         return self
 
+    def set_sufficient_stats(self, flag: bool = True):
+        """Run the least-squares CostFun and line-search sweep from
+        precomputed block-prefix Gram statistics (``ops/gram.py``): each
+        full-batch objective/gradient becomes an O(d²) matvec instead of
+        two passes over X.  Applies when the gradient is exactly
+        ``LeastSquaresGradient`` on dense unmeshed data; otherwise a
+        no-op."""
+        self.sufficient_stats = bool(flag)
+        return self
+
     def set_mesh(self, mesh):
         """Shard the cost function (and line-search sweep) row-wise over a
         1-D data mesh — the treeAggregate-CostFun analogue (SURVEY.md §2
@@ -331,6 +343,32 @@ class LBFGS(Optimizer):
     #: backtracking ladder length (t = 1, 1/2, ..., 2^-(N-1))
     _LS_TRIALS = 25
 
+    def _substitute_gram(self, gradient, X, y):
+        """Apply ``set_sufficient_stats`` when it fits (exactly
+        ``LeastSquaresGradient``, dense, unmeshed), identity-cached per
+        ``(X, y)``.  Shared with OWLQN (Lasso least squares).  Returns
+        ``(gradient, X)`` — on substitution, X becomes the ``GramData``
+        bundle so the stats enter jit programs as argument buffers."""
+        from tpu_sgd.ops.gradients import LeastSquaresGradient as _LS
+        from tpu_sgd.ops.gram import GramLeastSquaresGradient
+        from tpu_sgd.ops.sparse import is_sparse as _is_sp
+
+        if self.mesh is None and isinstance(
+                gradient, GramLeastSquaresGradient) and gradient.data.X is X:
+            # user-built gram gradient on exactly this matrix: route its
+            # GramData through so the traced cost/sweep accelerate
+            return gradient, gradient.data
+        if not (self.sufficient_stats and self.mesh is None
+                and not _is_sp(X) and type(gradient) is _LS):
+            return gradient, X
+        entry = self._gram_entry
+        if entry is not None and entry[0] is X and entry[1] is y:
+            g = entry[2]
+            return g, g.data
+        g = GramLeastSquaresGradient.build(X, y)
+        self._gram_entry = (X, y, g)
+        return g, g.data
+
     def optimize_with_history(self, data: Dataset, initial_weights: Array):
         import numpy as np
 
@@ -340,7 +378,7 @@ class LBFGS(Optimizer):
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
             return w, self._loss_history
-        gradient = self.gradient
+        gradient, X = self._substitute_gram(self.gradient, X, y)
         reg_value, reg_grad = _reg_terms(self.updater, self.reg_param)
 
         mesh = self.mesh

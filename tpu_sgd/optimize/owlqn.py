@@ -117,7 +117,7 @@ class OWLQN(LBFGS):
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
             return w, self._loss_history
-        gradient = self.gradient
+        gradient, X = self._substitute_gram(self.gradient, X, y)
         reg_vec = jnp.full(w.shape, self.reg_param, w.dtype)
         if not self.penalize_intercept:
             reg_vec = reg_vec.at[-1].set(0.0)
