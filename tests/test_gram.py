@@ -363,3 +363,116 @@ def test_prebuilt_gram_routes_gramdata_through_optimizer(rng):
     w0, h0 = opt0.optimize_with_history((X, y), jnp.zeros((16,)))
     np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_dp_mesh_sufficient_stats_trajectory_parity(rng):
+    """Gram over the 1-D data mesh (config 4's 8-way DP shape) must match
+    the stock mesh trajectory — per-shard prefix stats, same psums."""
+    from tpu_sgd import data_mesh
+
+    mesh = data_mesh()
+    X, y, _ = _data(rng, n=4096, d=24)  # divides the 8-way axis
+
+    def run(flag):
+        opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+               .set_step_size(0.2).set_num_iterations(25)
+               .set_mini_batch_fraction(0.2).set_sampling("sliced")
+               .set_seed(11).set_convergence_tol(0.0)
+               .set_mesh(mesh).set_sufficient_stats(flag))
+        return opt, opt.optimize_with_history((X, y), jnp.zeros((24,)))
+
+    _, (w0, h0) = run(False)
+    opt1, (w1, h1) = run(True)
+    assert opt1._gram_dp_entry is not None  # the dp path actually engaged
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w0),
+                               rtol=5e-4, atol=5e-4)
+    # identity cache: re-optimize on the same arrays reuses the stats
+    stats0 = opt1._gram_dp_entry[3]
+    opt1.optimize_with_history((X, y), jnp.zeros((24,)))
+    assert opt1._gram_dp_entry[3] is stats0
+
+
+def test_dp_mesh_full_batch_and_padding_fallback(rng):
+    from tpu_sgd import data_mesh
+
+    mesh = data_mesh()
+    # full batch, divisible
+    X, y, _ = _data(rng, n=2048, d=12)
+    o0 = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+          .set_step_size(0.3).set_num_iterations(15).set_reg_param(0.01)
+          .set_mesh(mesh))
+    w0, h0 = o0.optimize_with_history((X, y), jnp.zeros((12,)))
+    o1 = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+          .set_step_size(0.3).set_num_iterations(15).set_reg_param(0.01)
+          .set_mesh(mesh).set_sufficient_stats(True))
+    w1, h1 = o1.optimize_with_history((X, y), jnp.zeros((12,)))
+    assert o1._gram_dp_entry is not None
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+
+    # NON-divisible row count: padded -> valid mask -> gram must fall back
+    Xp, yp, _ = _data(rng, n=2049, d=12)
+    o2 = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+          .set_step_size(0.3).set_num_iterations(8).set_reg_param(0.01)
+          .set_mesh(mesh).set_sufficient_stats(True))
+    w2, h2 = o2.optimize_with_history((Xp, yp), jnp.zeros((12,)))
+    assert o2._gram_dp_entry is None  # fell back to the stock mesh path
+    o3 = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+          .set_step_size(0.3).set_num_iterations(8).set_reg_param(0.01)
+          .set_mesh(mesh))
+    w3, h3 = o3.optimize_with_history((Xp, yp), jnp.zeros((12,)))
+    np.testing.assert_array_equal(np.asarray(h2), np.asarray(h3))
+
+
+def test_unbound_executor_is_silent_on_plain_arrays(rng):
+    """An unbound executor (data=None, the DP-mesh internal) must treat
+    plain arrays as stock input with NO warning."""
+    X, y, w = _data(rng, n=256, d=8)
+    unbound = GramLeastSquaresGradient()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        g1, l1, c1 = unbound.window_sums(X, y, w, jnp.int32(0), 64)
+    assert not any(issubclass(r.category, RuntimeWarning) for r in rec)
+    g0, l0, c0 = LeastSquaresGradient().window_sums(
+        X, y, w, jnp.int32(0), 64)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+
+
+def test_meshed_listener_warns_sufficient_stats_not_applied(rng):
+    from tpu_sgd import data_mesh
+    from tpu_sgd.utils.events import CollectingListener
+
+    mesh = data_mesh()
+    X, y, _ = _data(rng, n=512, d=8)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_num_iterations(2).set_mesh(mesh)
+           .set_sufficient_stats(True)
+           .set_listener(CollectingListener()))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        opt.optimize_with_history((X, y), jnp.zeros((8,)))
+    assert any("sufficient_stats is not applied" in str(r.message)
+               for r in rec)
+
+
+def test_dp_stats_builder_memoized(rng):
+    from tpu_sgd import data_mesh
+    from tpu_sgd.parallel.gram_parallel import _stats_builder
+
+    mesh = data_mesh()
+    before = _stats_builder.cache_info().currsize
+
+    def run(Xr, yr):
+        opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+               .set_num_iterations(2).set_mesh(mesh)
+               .set_sufficient_stats(True))
+        opt.optimize_with_history((Xr, yr), jnp.zeros((8,)))
+
+    X1, y1, _ = _data(rng, n=512, d=8)
+    X2, y2, _ = _data(rng, n=512, d=8)  # different data, same shape
+    run(X1, y1)
+    run(X2, y2)
+    # one builder serves both datasets (jit caches per shape underneath)
+    assert _stats_builder.cache_info().currsize <= before + 1

@@ -153,11 +153,14 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
     train against stale statistics.
     """
 
-    def __init__(self, data: GramData):
+    def __init__(self, data: Optional[GramData] = None):
+        # data=None gives an UNBOUND executor: it accelerates GramData
+        # arguments (the DP-mesh path hands each shard its local bundle)
+        # and treats every plain array as unbound stock input.
         self.data = data
-        self._X_shape = tuple(data.X.shape)
-        self._X_dtype = data.X.dtype
-        self.block_rows = data.block_rows
+        self._X_shape = tuple(data.X.shape) if data is not None else None
+        self._X_dtype = data.X.dtype if data is not None else None
+        self.block_rows = data.block_rows if data is not None else None
         self._warned = False
 
     # -- construction ------------------------------------------------------
@@ -239,6 +242,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         # value-checked — both fall back to the stock exact path.  The
         # optimizer flags wrap X into GramData before tracing, so the
         # accelerated path is the traced one in normal use.
+        if self.data is None:
+            return X, None  # unbound executor: plain arrays are stock input
         if X is self.data.X:
             return X, self.data
         if not self._warned:

@@ -253,6 +253,7 @@ class GradientDescent(Optimizer):
         self.checkpoint_every = 10
         self.sufficient_stats = False
         self._gram_entry = None
+        self._gram_dp_entry = None
         self._loss_history = None
         self._run_cache = {}
 
@@ -471,6 +472,18 @@ class GradientDescent(Optimizer):
         import numpy as np
 
         if self.listener is not None or self.checkpoint_manager is not None:
+            if (self.sufficient_stats and self.mesh is not None
+                    and not sparse_X):
+                import warnings
+
+                warnings.warn(
+                    "sufficient_stats is not applied on the meshed "
+                    "listener/checkpoint path (the observed per-iteration "
+                    "stepper uses the stock DP step); detach the listener "
+                    "or run single-device to combine them",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
             return self._optimize_stepwise(X, y, w0)
         if sparse_X and self.mesh is not None:
             # Distributed sparse: equal-nse BCOO blocks per shard, same
@@ -511,11 +524,27 @@ class GradientDescent(Optimizer):
             from tpu_sgd.parallel.data_parallel import shard_dataset
 
             Xd, yd, valid = shard_dataset(self.mesh, X, y)
-            fn = self._runner(with_valid=valid is not None)
-            if valid is not None:
-                w, losses, n_rec = fn(w0, Xd, yd, valid)
+            stats = self._maybe_gram_dp(X, y, Xd, yd, valid)
+            if stats is not None:
+                stats_leaves, block_rows = stats
+                key = ("gram_dp_run", self.updater, self.config,
+                       self.mesh, block_rows)
+                fn = self._run_cache.get(key)
+                if fn is None:
+                    from tpu_sgd.parallel.gram_parallel import (
+                        dp_gram_run_fn,
+                    )
+
+                    fn = dp_gram_run_fn(self.updater, self.config,
+                                        self.mesh, block_rows)
+                    self._run_cache[key] = fn
+                w, losses, n_rec = fn(w0, Xd, yd, *stats_leaves)
             else:
-                w, losses, n_rec = fn(w0, Xd, yd)
+                fn = self._runner(with_valid=valid is not None)
+                if valid is not None:
+                    w, losses, n_rec = fn(w0, Xd, yd, valid)
+                else:
+                    w, losses, n_rec = fn(w0, Xd, yd)
         else:
             w, losses, n_rec = self._runner(with_valid=False)(w0, X, y)
         n_rec = int(n_rec)
@@ -558,6 +587,33 @@ class GradientDescent(Optimizer):
         # keep the ORIGINAL arrays in the key: build() may re-coerce
         self._gram_entry = (X, y, g)
         return g
+
+    def _maybe_gram_dp(self, X, y, Xd, yd, valid):
+        """The sufficient-stats substitution over a 1-D data mesh (see
+        ``parallel/gram_parallel.py``): per-shard prefix stats, identity-
+        cached per ``(X, y, mesh)``.  Returns ``(stats_leaves, block_rows)``
+        or None.  Padded datasets (``valid`` mask) fall back — the gram
+        window normalizes by the full window length, which would differ
+        from the stock path's realized valid count."""
+        from tpu_sgd.ops.gradients import LeastSquaresGradient as _LS
+
+        cfg = self.config
+        if (
+            not self.sufficient_stats
+            or valid is not None
+            or type(self.gradient) is not _LS
+            or (cfg.mini_batch_fraction < 1.0 and cfg.sampling != "sliced")
+        ):
+            return None
+        entry = getattr(self, "_gram_dp_entry", None)
+        if (entry is not None and entry[0] is X and entry[1] is y
+                and entry[2] is self.mesh):
+            return entry[3]
+        from tpu_sgd.parallel.gram_parallel import build_sharded_gram_stats
+
+        stats = build_sharded_gram_stats(self.mesh, Xd, yd)
+        self._gram_dp_entry = (X, y, self.mesh, stats)
+        return stats
 
     def _optimize_stepwise(self, X, y, w0):
         """Observed path: jitted step per iteration with host round-trips.

@@ -1,0 +1,105 @@
+"""Data-parallel sufficient-statistics (Gram) execution.
+
+Composes `tpu_sgd/ops/gram.py` with the 1-D data mesh: each shard owns the
+block-prefix Gram statistics of its LOCAL rows (built in one shard_map
+pass over the already-sharded dataset — the same one-time ``cache()``
+moment as ``shard_dataset``), and the unchanged ``make_run`` body then
+executes per-shard window gradients from those statistics with the usual
+``lax.psum`` combine over ICI.  Sampling semantics are identical to the
+stock DP sliced path (per-shard window starts from the axis-folded key),
+so the trajectory matches the stock mesh run the way the single-device
+gram path matches the single-device run.
+
+Config-4 frame (SURVEY.md, `BASELINE.json:10`): the north star names
+"8-way data-parallel all-reduce" — this module is what makes the ~20×
+sufficient-stats schedule (BASELINE.md round 3) available in exactly that
+shape.
+
+Restriction: the row count must divide the data axis (no padding).  The
+gram fast path normalizes windows by the full window length, while padded
+datasets carry a ``valid`` mask whose realized counts differ — rather
+than silently change normalization, non-divisible inputs fall back to the
+stock mesh path (the optimizer handles this automatically).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
+from tpu_sgd.ops.updaters import Updater
+from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+
+#: leading shard axis + per-element rank of each GramData stats leaf
+_STATS_SPECS = (
+    P(DATA_AXIS, None, None, None),  # PG      (k, nbf+1, d, d)
+    P(DATA_AXIS, None, None),        # Pb      (k, nbf+1, d)
+    P(DATA_AXIS, None),              # Pyy     (k, nbf+1)
+    P(DATA_AXIS, None, None),        # G_tot   (k, d, d)
+    P(DATA_AXIS, None),              # b_tot   (k, d)
+    P(DATA_AXIS,),                   # yy_tot  (k,)
+)
+
+
+def build_sharded_gram_stats(mesh, Xd, yd, block_rows: int = 8192):
+    """Per-shard block-prefix statistics for an already-sharded dataset.
+
+    ``Xd``/``yd`` come from ``shard_dataset`` with no padding (``valid is
+    None``).  Returns ``(stats_tuple, block_rows_local)`` where each stats
+    leaf carries a leading shard axis, sharded over 'data' — ready to pass
+    straight into :func:`dp_gram_run_fn`.
+    """
+    k = mesh.shape[DATA_AXIS]
+    n_local = Xd.shape[0] // k
+    B = max(1, min(int(block_rows), n_local))
+    fn = _stats_builder(mesh, B)
+    return fn(Xd, yd), B
+
+
+@functools.lru_cache(maxsize=8)
+def _stats_builder(mesh, B):
+    """Jitted per-shard stats builder, memoized per (mesh, block size) so
+    repeated builds on fresh same-shape datasets retrace nothing (the jit
+    itself caches per input shape/dtype)."""
+    def body(Xl, yl):
+        stats = GramLeastSquaresGradient._precompute(
+            Xl, yl, B=B, stats_dtype=jnp.float32
+        )
+        return tuple(s[None] for s in stats)
+
+    return jax.jit(shard_map_fn(
+        mesh, body, (P(DATA_AXIS, None), P(DATA_AXIS)), _STATS_SPECS
+    ))
+
+
+def dp_gram_run_fn(
+    updater: Updater,
+    config: SGDConfig,
+    mesh,
+    block_rows: int,
+):
+    """Jitted shard_map'ed full-loop runner over per-shard Gram stats.
+
+    Same ``make_run`` body as ``dp_run_fn``, driven by an unbound
+    :class:`GramLeastSquaresGradient` executor (least-squares semantics);
+    each shard reconstructs its local ``GramData`` from the stacked stats
+    leaves, so the accelerated window path runs per shard and only the
+    (grad, loss, count) psums ride the ICI."""
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    run = make_run(GramLeastSquaresGradient(), updater, config,
+                   axis_name=DATA_AXIS)
+
+    def body(w, Xl, yl, PG, Pb, Pyy, Gt, bt, yyt):
+        gd = GramData(Xl, PG[0], Pb[0], Pyy[0], Gt[0], bt[0], yyt[0],
+                      block_rows)
+        return run(w, gd, yl, None)
+
+    in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS)) + _STATS_SPECS
+    out_specs = (P(), P(), P())
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
