@@ -450,10 +450,38 @@ def _streamed_measure() -> dict:
     return _streamed_body()
 
 
+def streamed_host_dataset(rows, dim):
+    """The config-4 host-resident dataset: bf16 X, f32 y, fixed seeds —
+    shared by the streamed bench legs and the standalone streamed-gram
+    hardware check so every leg measures the same data."""
+    import ml_dtypes
+
+    bf16 = ml_dtypes.bfloat16
+    log(f"streamed: generating {rows}x{dim} bf16 host-resident "
+        f"({rows * dim * 2 / 1e9:.0f} GB)...")
+    t0 = time.perf_counter()
+    X = np.empty((rows, dim), dtype=bf16)
+    y = np.empty((rows,), np.float32)
+    w_true = np.random.default_rng(123).uniform(-1, 1, dim).astype(np.float32)
+    rng = np.random.default_rng(7)
+    chunk = 250_000
+    for s in range(0, rows, chunk):
+        e = min(s + chunk, rows)
+        # standard_normal(dtype=f32) draws f32 directly — ~2x faster on
+        # this 1-core host than normal()+astype for the 10^10-draw dataset
+        Xc = rng.standard_normal(size=(e - s, dim), dtype=np.float32)
+        y[s:e] = Xc @ w_true + 0.1 * rng.standard_normal(
+            size=e - s, dtype=np.float32
+        )
+        X[s:e] = Xc.astype(bf16)
+    gen_s = time.perf_counter() - t0
+    log(f"streamed: generated in {gen_s:.0f}s")
+    return X, y, gen_s
+
+
 def _streamed_body() -> dict:
     """Generation + the plain and partial-residency streamed runs (split
     from the transfer-probe front door so CPU smoke tests can skip it)."""
-    import ml_dtypes
 
     from tpu_sgd.config import SGDConfig
     from tpu_sgd.ops.gradients import LeastSquaresGradient
@@ -467,26 +495,7 @@ def _streamed_body() -> dict:
 
     rows = int(os.environ.get("BENCH_STREAM_ROWS", str(TARGET_ROWS)))
     iters = int(os.environ.get("BENCH_STREAM_ITERS", "12"))
-    bf16 = ml_dtypes.bfloat16
-    log(f"streamed: generating {rows}x{DIM} bf16 host-resident "
-        f"({rows * DIM * 2 / 1e9:.0f} GB)...")
-    t0 = time.perf_counter()
-    X = np.empty((rows, DIM), dtype=bf16)
-    y = np.empty((rows,), np.float32)
-    w_true = np.random.default_rng(123).uniform(-1, 1, DIM).astype(np.float32)
-    rng = np.random.default_rng(7)
-    chunk = 250_000
-    for s in range(0, rows, chunk):
-        e = min(s + chunk, rows)
-        # standard_normal(dtype=f32) draws f32 directly — ~2x faster on
-        # this 1-core host than normal()+astype for the 10^10-draw dataset
-        Xc = rng.standard_normal(size=(e - s, DIM), dtype=np.float32)
-        y[s:e] = Xc @ w_true + 0.1 * rng.standard_normal(
-            size=e - s, dtype=np.float32
-        )
-        X[s:e] = Xc.astype(bf16)
-    gen_s = time.perf_counter() - t0
-    log(f"streamed: generated in {gen_s:.0f}s")
+    X, y, gen_s = streamed_host_dataset(rows, DIM)
 
     cfg = SGDConfig(
         step_size=STEP_SIZE,

@@ -91,12 +91,18 @@ def main():
 
     mm = matmul_dtype(X)
 
+    def start_of(i):
+        """Per-iteration window-start draw, matching make_run's sliced
+        sampler bound (``randint`` high = max(1, n - m + 1), so n - m is
+        reachable) — ONE definition shared by the stock and gram rungs."""
+        return jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(42), i), (), 0,
+            max(1, rows - m + 1),
+        )
+
     def window(i, Xa, ya):
         """Same per-iteration window draw as make_run's sliced sampling."""
-        start = jax.random.randint(
-            jax.random.fold_in(jax.random.PRNGKey(42), i), (), 0,
-            max(rows - m, 1),
-        )
+        start = start_of(i)
         Xb = lax.dynamic_slice_in_dim(Xa, start, m, 0)
         yb = lax.dynamic_slice_in_dim(ya, start, m, 0)
         return Xb, yb
@@ -180,15 +186,19 @@ def main():
         jax.block_until_ready(fn(*args))
         return time.perf_counter() - t0
 
-    def slope_of(name, make_fn):
-        """Two-point fit over K and 4K iterations; launch cost cancels."""
-        f1 = make_fn(ITERS)
-        f4 = make_fn(4 * ITERS)
-        dt1 = time_fn(f"{name}[{ITERS}]", f1, w0, X, y)
-        dt4 = time_fn(f"{name}[{4 * ITERS}]", f4, w0, X, y)
-        slope = (dt4 - dt1) / (3 * ITERS)
+    def slope_of(name, make_fn, iters=None):
+        """Two-point fit over K and 4K iterations; launch cost cancels.
+        ``iters`` overrides the ladder length — the gram legs run 30x more
+        iterations because their per-iter cost (~0.1 ms and below) would
+        otherwise drown in the +-30 ms tunnel launch jitter."""
+        iters = ITERS if iters is None else iters
+        f1 = make_fn(iters)
+        f4 = make_fn(4 * iters)
+        dt1 = time_fn(f"{name}[{iters}]", f1, w0, X, y)
+        dt4 = time_fn(f"{name}[{4 * iters}]", f4, w0, X, y)
+        slope = (dt4 - dt1) / (3 * iters)
         if slope <= 0:
-            slope = dt4 / (4 * ITERS)
+            slope = dt4 / (4 * iters)
         log(f"{name}: {slope * 1e3:.3f} ms/iter steady-state")
         return slope
 
@@ -210,6 +220,67 @@ def main():
         "two_read_static", lambda k: loop_of(body_two_read_static, k)) * 1e3
     results["one_read_ms"] = slope_of(
         "one_read", lambda k: loop_of(body_one_read, k)) * 1e3
+
+    # ---- gram (sufficient-statistics) iteration decomposition ----------
+    # The round-3 headline schedule: where do its ~0.08 ms go?  Three
+    # rungs, stats always passed as ARGUMENTS (GramData pytree — closure
+    # constants at GB scale choke lowering):
+    #   gram_real    the actual make_run fused program on the gram path
+    #   gram_window  window_sums alone (prefix matvecs + edge blocks)
+    #   gram_prefix  prefix matvecs only (no edge reads)
+    # so edge cost = window − prefix and loop bookkeeping = real − window.
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    gram = GramLeastSquaresGradient.build(
+        X, y, block_rows=int(os.environ.get("PROFILE_GRAM_BLOCK", "4096"))
+    )
+    gd = gram.data
+    iters_g = 30 * ITERS
+
+    def loop_gram(body, iters):
+        @jax.jit
+        def run(w, g, ya):
+            return lax.fori_loop(
+                1, iters + 1, lambda i, wc: body(i, wc, g, ya), w
+            )
+        return lambda w, Xa, ya: run(w, gd, ya)
+
+    def body_gram_window(i, w, g, ya):
+        gs, _, c = gram.window_sums(g, ya, w, start_of(i), m)
+        return w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * gs / c
+
+    def body_gram_prefix(i, w, g, ya):
+        start = start_of(i)
+        B = g.block_rows
+        k1, k2 = start // B, (start + m) // B
+        PG1 = lax.dynamic_slice_in_dim(g.PG, k1, 1, 0)[0]
+        PG2 = lax.dynamic_slice_in_dim(g.PG, k2, 1, 0)[0]
+        hi = jax.lax.Precision.HIGHEST
+        gv = (jnp.dot(PG2, w, precision=hi) - jnp.dot(PG1, w, precision=hi))
+        return w - (STEP_SIZE / jnp.sqrt(i.astype(jnp.float32))) * gv / m
+
+    def make_gram_real(iters):
+        cfg = SGDConfig(step_size=STEP_SIZE, num_iterations=iters,
+                        mini_batch_fraction=FRAC, convergence_tol=0.0,
+                        sampling="sliced")
+        run = jax.jit(make_run(gram, SimpleUpdater(), cfg))
+        return lambda w, Xa, ya: run(w, gd, ya)
+
+    results["gram_real_ms"] = slope_of(
+        "gram_real", make_gram_real, iters_g) * 1e3
+    results["gram_window_ms"] = slope_of(
+        "gram_window", lambda k: loop_gram(body_gram_window, k),
+        iters_g) * 1e3
+    results["gram_prefix_ms"] = slope_of(
+        "gram_prefix", lambda k: loop_gram(body_gram_prefix, k),
+        iters_g) * 1e3
+    results["gram_block_rows"] = gd.block_rows
+    results["gram_edge_ms"] = (
+        results["gram_window_ms"] - results["gram_prefix_ms"]
+    )
+    results["gram_bookkeeping_ms"] = (
+        results["gram_real_ms"] - results["gram_window_ms"]
+    )
 
     bytes_per_read = m * DIM * (2 if dtype == jnp.bfloat16 else 4)
     results.update({
@@ -247,9 +318,11 @@ def main():
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "results": results,
     }
-    with open(OUT, "w") as f:
+    # A CPU (smoke/fallback) run must never clobber a hardware record.
+    out = OUT if platform != "cpu" else OUT.replace(".json", "_cpu.json")
+    with open(out, "w") as f:
         json.dump(record, f, indent=1)
-    log(f"wrote {OUT}")
+    log(f"wrote {out}")
     print(json.dumps(results))
 
 

@@ -476,3 +476,193 @@ def test_dp_stats_builder_memoized(rng):
     run(X2, y2)
     # one builder serves both datasets (jit caches per shape underneath)
     assert _stats_builder.cache_info().currsize <= before + 1
+
+
+def test_odd_dimensions_and_blocks(rng):
+    """Nothing in the math requires lane-friendly shapes: odd d, odd n,
+    odd block size must all agree with the stock path."""
+    n, d = 777, 37
+    X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    w = jnp.asarray(rng.uniform(-1, 1, size=(d,)).astype(np.float32))
+    y = jnp.asarray(
+        (np.asarray(X) @ np.asarray(w)
+         + 0.1 * rng.normal(size=(n,))).astype(np.float32))
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=53)
+    for start, m in [(0, 100), (51, 53), (700, 77), (123, 1)]:
+        g0, l0, c0 = LeastSquaresGradient().window_sums(
+            X, y, w, jnp.int32(start), m)
+        g1, l1, c1 = gram.window_sums(X, y, w, jnp.int32(start), m)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                                   rtol=2e-4, atol=2e-2)
+        assert float(c1) == float(c0)
+
+
+def test_f64_data_keeps_f64_stats():
+    """f64 data (jax_enable_x64) must get f64 statistics by default, not a
+    silent f32 downgrade relative to the stock f64 path.  x64 is a global
+    switch, so this runs in a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['XLA_FLAGS']=''; "
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        "jax.config.update('jax_enable_x64', True); "
+        "import jax.numpy as jnp, numpy as np; "
+        "from tpu_sgd.ops.gram import GramLeastSquaresGradient; "
+        "X = jnp.asarray(np.random.default_rng(0).normal(size=(64,4))); "
+        "y = jnp.asarray(np.random.default_rng(1).normal(size=(64,))); "
+        "assert X.dtype == jnp.float64, X.dtype; "
+        "g = GramLeastSquaresGradient.build(X, y, block_rows=16); "
+        "assert g.data.PG.dtype == jnp.float64, g.data.PG.dtype; "
+        "print('OK')"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], env=env, timeout=300,
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "OK" in r.stdout, r.stderr[-2000:]
+
+
+def test_gram_composes_with_listener_and_checkpoint(rng, tmp_path):
+    """Single-device observed path (listener / checkpoint) with the
+    sufficient-stats flag: the stepwise driver receives GramData and must
+    produce the same trajectory as the stock stepwise run."""
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+    from tpu_sgd.utils.events import CollectingListener
+
+    X, y, _ = _data(rng, n=1024, d=8)
+
+    def run(flag, subdir):
+        listener = CollectingListener()
+        opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+               .set_step_size(0.2).set_num_iterations(6)
+               .set_mini_batch_fraction(0.5).set_sampling("sliced")
+               .set_convergence_tol(0.0)
+               .set_listener(listener)
+               .set_checkpoint(CheckpointManager(str(tmp_path / subdir)), 2)
+               .set_sufficient_stats(flag))
+        w, h = opt.optimize_with_history((X, y), jnp.zeros((8,)))
+        return w, h, listener
+
+    w0, h0, _ = run(False, "a")
+    w1, h1, lis = run(True, "b")
+    assert len(lis.iterations) == 6
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=5e-4, atol=5e-4)
+
+
+# ---- streamed / virtual (beyond-HBM) mode --------------------------------
+
+def test_build_streamed_matches_resident_build(rng):
+    """Chunked host streaming must produce the SAME statistics as the
+    resident build on the block-truncated dataset."""
+    X = rng.normal(size=(1000, 12)).astype(np.float32)
+    y = (X @ rng.uniform(-1, 1, 12).astype(np.float32)).astype(np.float32)
+    gs = GramLeastSquaresGradient.build_streamed(X, y, block_rows=64,
+                                                 batch_rows=200)
+    n_use = (1000 // 64) * 64  # 960
+    g0 = GramLeastSquaresGradient.build(X[:n_use], y[:n_use], block_rows=64)
+    assert gs.data.X is None
+    assert gs.data.shape == (n_use, 12)
+    np.testing.assert_allclose(np.asarray(gs.data.PG),
+                               np.asarray(g0.data.PG), rtol=1e-6, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gs.data.Pb),
+                               np.asarray(g0.data.Pb), rtol=1e-6, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gs.data.G_tot),
+                               np.asarray(g0.data.G_tot),
+                               rtol=1e-6, atol=1e-3)
+
+
+def test_aligned_window_math_vs_numpy(rng):
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ w + 0.1 * rng.normal(size=512)).astype(np.float32)
+    B = 64
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=B)
+    m = 130  # rounds to 2 blocks = 128 rows
+    start = 70  # floors to block 1 -> rows [64, 192)
+    g1, l1, c1 = gram.window_sums(gram.data, jnp.asarray(y), jnp.asarray(w),
+                                  jnp.int32(start), m)
+    rows = slice(64, 192)
+    r = X[rows] @ w - y[rows]
+    np.testing.assert_allclose(np.asarray(g1), X[rows].T @ r,
+                               rtol=1e-4, atol=1e-2)
+    assert float(l1) == pytest.approx(0.5 * float(r @ r), rel=1e-4)
+    assert float(c1) == 128
+
+
+def test_virtual_full_batch_matches_stock_on_truncated(rng):
+    X = rng.normal(size=(960, 10)).astype(np.float32)
+    wt = rng.uniform(-1, 1, 10).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=960)).astype(np.float32)
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=64)
+
+    opt_v = GradientDescent(gram, SquaredL2Updater()) \
+        .set_step_size(0.3).set_num_iterations(20).set_reg_param(0.01)
+    wv, hv = opt_v.optimize_with_history((gram.data, y), np.zeros(10))
+    opt_s = GradientDescent(LeastSquaresGradient(), SquaredL2Updater()) \
+        .set_step_size(0.3).set_num_iterations(20).set_reg_param(0.01)
+    ws, hs = opt_s.optimize_with_history((X, y), np.zeros(10))
+    np.testing.assert_allclose(np.asarray(hv), np.asarray(hs),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(wv), np.asarray(ws),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_virtual_sliced_gd_converges(rng):
+    X = rng.normal(size=(8192, 16)).astype(np.float32)
+    wt = rng.uniform(-1, 1, 16).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=8192)).astype(np.float32)
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=256)
+    opt = (GradientDescent(gram, SimpleUpdater())
+           .set_step_size(0.3).set_num_iterations(60)
+           .set_mini_batch_fraction(0.125).set_sampling("sliced")
+           .set_convergence_tol(0.0))
+    w, hist = opt.optimize_with_history((gram.data, y), np.zeros(16))
+    werr = float(np.linalg.norm(np.asarray(w) - wt) / np.linalg.norm(wt))
+    assert werr < 0.05, werr
+    assert hist[-1] < hist[0] * 0.1
+
+
+def test_virtual_lbfgs_full_batch(rng):
+    X = rng.normal(size=(2048, 12)).astype(np.float32)
+    wt = rng.uniform(-1, 1, 12).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=2048)).astype(np.float32)
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=128)
+    opt = LBFGS(gram, SquaredL2Updater(), reg_param=0.001,
+                max_num_iterations=15)
+    w, hist = opt.optimize_with_history((gram.data, y), np.zeros(12))
+    werr = float(np.linalg.norm(np.asarray(w) - wt) / np.linalg.norm(wt))
+    assert werr < 0.02, werr
+
+
+def test_virtual_guards(rng):
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = rng.normal(size=256).astype(np.float32)
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=64)
+    # bernoulli sub-unit sampling: clear error
+    opt = (GradientDescent(gram, SimpleUpdater())
+           .set_num_iterations(2).set_mini_batch_fraction(0.5))
+    with pytest.raises(NotImplementedError, match="sliced"):
+        opt.optimize((gram.data, y), np.zeros(8))
+    # mesh: clear error
+    from tpu_sgd import data_mesh
+    opt2 = GradientDescent(gram, SimpleUpdater()).set_mesh(data_mesh())
+    with pytest.raises(NotImplementedError, match="single-device"):
+        opt2.optimize((gram.data, y), np.zeros(8))
+    # plain gradient with GramData input: clear error
+    opt3 = GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+    with pytest.raises(ValueError, match="GramLeastSquaresGradient"):
+        opt3.optimize((gram.data, y), np.zeros(8))
+    # masked call on virtual data: clear error
+    valid = jnp.ones((256,), jnp.float32)
+    with pytest.raises(NotImplementedError, match="virtual"):
+        gram.window_sums(gram.data, jnp.asarray(y), jnp.zeros(8),
+                         jnp.int32(0), 64, valid=valid)
+    # meshed LBFGS on GramData: clear error
+    lb = LBFGS(gram, SquaredL2Updater()).set_mesh(data_mesh())
+    with pytest.raises(NotImplementedError, match="unmeshed"):
+        lb.optimize_with_history((gram.data, y), np.zeros(8))

@@ -379,6 +379,44 @@ class GradientDescent(Optimizer):
         import numpy as np
 
         X, y = data
+        from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
+
+        if isinstance(X, GramData):
+            # Statistics-first input (build/build_streamed): the rows may
+            # be virtual (beyond-HBM datasets), so coerce only y/w0 and
+            # route straight to the resident single-device path.
+            if not isinstance(self.gradient, GramLeastSquaresGradient):
+                raise ValueError(
+                    "GramData input needs a GramLeastSquaresGradient "
+                    "(use GramLeastSquaresGradient.build/build_streamed "
+                    "and pass it as the gradient)"
+                )
+            if self.mesh is not None or self.host_streaming:
+                raise NotImplementedError(
+                    "GramData input supports the single-device resident "
+                    "path (stats are already on device); drop set_mesh/"
+                    "set_host_streaming"
+                )
+            cfg = self.config
+            if cfg.mini_batch_fraction < 1.0 and cfg.sampling != "sliced":
+                raise NotImplementedError(
+                    "GramData input supports sliced sampling or full "
+                    f"batch (got sampling={cfg.sampling!r})"
+                )
+            y = jnp.asarray(y)
+            if not jnp.issubdtype(y.dtype, jnp.inexact):
+                y = y.astype(jnp.float32)
+            w0 = jnp.asarray(initial_weights)
+            if not jnp.issubdtype(w0.dtype, jnp.inexact):
+                w0 = w0.astype(jnp.float32)
+            expect_dim = self.gradient.weight_dim(X.shape[1])
+            if w0.shape[-1] != expect_dim:
+                raise ValueError(
+                    f"initial_weights has length {w0.shape[-1]} but this "
+                    f"gradient needs {expect_dim} for {X.shape[1]}-feature "
+                    "data"
+                )
+            return self._optimize_routed(X, y, w0, sparse_X=False)
         sparse_X = is_sparse(X)
         if sparse_X:
             # BCOO feature path (VERDICT r1 missing #2; [U] SparseVector

@@ -62,9 +62,12 @@ def _reg_terms(updater: Updater, reg_param: float):
 
 def _coerce_inputs(X, y, w):
     """Shared (X, y, w) -> inexact jnp arrays coercion for the quasi-Newton
-    optimizers.  BCOO feature matrices pass through untouched (the fused
-    cost dispatches to the sparse matvec lowering)."""
-    if not is_sparse(X):
+    optimizers.  BCOO feature matrices and GramData statistics bundles
+    pass through untouched (the fused cost dispatches to the sparse
+    lowering / the sufficient-stats totals respectively)."""
+    from tpu_sgd.ops.gram import GramData
+
+    if not is_sparse(X) and not isinstance(X, GramData):
         X = jnp.asarray(X)
         if not jnp.issubdtype(X.dtype, jnp.inexact):
             X = X.astype(jnp.float32)
@@ -186,6 +189,13 @@ def _shard_for_mesh(mesh, X, y):
     sparse CostFun analogue.  Returns ``(X, y, valid, sparse_shape)`` where
     dense X keeps ``sparse_shape=None`` and sparse X becomes the component
     tuple ``(data, idx)``."""
+    from tpu_sgd.ops.gram import GramData
+
+    if isinstance(X, GramData):
+        raise NotImplementedError(
+            "GramData input supports unmeshed quasi-Newton runs (the "
+            "statistics already live on one device); drop set_mesh"
+        )
     if is_sparse(X):
         from tpu_sgd.parallel.sparse_parallel import shard_bcoo
 

@@ -57,18 +57,24 @@ def build_sharded_gram_stats(mesh, Xd, yd, block_rows: int = 8192):
     k = mesh.shape[DATA_AXIS]
     n_local = Xd.shape[0] // k
     B = max(1, min(int(block_rows), n_local))
-    fn = _stats_builder(mesh, B)
+    # f64 data keeps f64 statistics, matching the single-device build()
+    # default (prefix-difference cancellation would amplify a silent f32
+    # downgrade relative to the stock f64 mesh path).
+    sd = jnp.promote_types(jnp.float32, Xd.dtype)
+    fn = _stats_builder(mesh, B, jnp.dtype(sd).name)
     return fn(Xd, yd), B
 
 
 @functools.lru_cache(maxsize=8)
-def _stats_builder(mesh, B):
-    """Jitted per-shard stats builder, memoized per (mesh, block size) so
-    repeated builds on fresh same-shape datasets retrace nothing (the jit
-    itself caches per input shape/dtype)."""
+def _stats_builder(mesh, B, stats_dtype_name):
+    """Jitted per-shard stats builder, memoized per (mesh, block size,
+    stats dtype) so repeated builds on fresh same-shape datasets retrace
+    nothing (the jit itself caches per input shape/dtype)."""
+    sd = jnp.dtype(stats_dtype_name)
+
     def body(Xl, yl):
         stats = GramLeastSquaresGradient._precompute(
-            Xl, yl, B=B, stats_dtype=jnp.float32
+            Xl, yl, B=B, stats_dtype=sd
         )
         return tuple(s[None] for s in stats)
 
