@@ -372,6 +372,33 @@ def tpu_measure(tpu_ok: bool) -> dict:
                 })
                 if ok and slope_g < slope:
                     slope, fixed = slope_g, fixed_g
+                # Block-ALIGNED windows on the same stats: skips the edge
+                # corrections (71% of the exact gram iteration,
+                # PROFILE_TPU.json) by flooring window starts to block
+                # boundaries — the same sampling deviation the Pallas
+                # tiled kernel makes, under the same trajectory guard.
+                ga = GramLeastSquaresGradient(gg.data, aligned=True)
+                slope_a, fixed_a, losses_a = time_run_slope(
+                    f"gram_aligned[{block}]", ga, gg.data, y, 10 * iters
+                )
+                losses_a = losses_a[: len(losses_xla)]
+                ok_a = len(losses_a) == len(losses_xla) and np.allclose(
+                    losses_a, losses_xla, rtol=0.1, atol=0.01
+                )
+                if not ok_a:
+                    log(f"gram_aligned[{block}] trajectory diverges from "
+                        "xla; recording, never selecting")
+                out["gram"].append({
+                    "block_rows": block,
+                    "aligned": True,
+                    "iter_ms": slope_a * 1e3,
+                    "xla_iter_ms": xla_slope * 1e3,
+                    "build_s": build_s,
+                    "trajectory_ok": bool(ok_a),
+                    "wins": bool(ok_a and slope_a < xla_slope),
+                })
+                if ok_a and slope_a < slope:
+                    slope, fixed = slope_a, fixed_a
             except Exception as e:
                 log(f"gram[{block}] failed ({type(e).__name__}: {e}); "
                     "skipping")

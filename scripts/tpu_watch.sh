@@ -40,7 +40,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       | tee -a bench_logs/PROFILE_r03_tpu.txt
     echo "[$(date +%H:%M:%S)] streamed sufficient-stats 10Mx1000 (one-pass build, then device-speed iters):"
     timeout 4500 python scripts/stream_gram_tpu_check.py 2>&1 \
-      | tee stream_gram_watch.log
+      | tee -a bench_logs/STREAM_GRAM_r03_tpu.txt
     ran_bench=1
     echo "[$(date +%H:%M:%S)] capture set done (BENCH_LAST_TPU.json, SPARSE_TPU_CHECK.json, PROFILE_TPU.json)"
     # One successful capture is the deliverable; after that, re-check only

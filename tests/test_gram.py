@@ -666,3 +666,36 @@ def test_virtual_guards(rng):
     lb = LBFGS(gram, SquaredL2Updater()).set_mesh(data_mesh())
     with pytest.raises(NotImplementedError, match="unmeshed"):
         lb.optimize_with_history((gram.data, y), np.zeros(8))
+
+
+def test_resident_aligned_mode(rng):
+    """aligned=True on RESIDENT data: same prefix-only math as the
+    virtual path — results match the exact sums over the quantized
+    window, and converge like the exact mode on i.i.d. data."""
+    X, y, w = _data(rng, n=2048, d=16)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=128,
+                                          aligned=True)
+    g1, l1, c1 = gram.window_sums(X, y, w, jnp.int32(200), 300)
+    # start 200 floors to block 1 (128); 300 rows round to 2 blocks (256)
+    rows = slice(128, 384)
+    Xn, yn = np.asarray(X), np.asarray(y)
+    r = Xn[rows] @ np.asarray(w) - yn[rows]
+    np.testing.assert_allclose(np.asarray(g1), Xn[rows].T @ r,
+                               rtol=1e-4, atol=1e-2)
+    assert float(c1) == 256
+
+    opt = (GradientDescent(gram, SimpleUpdater())
+           .set_step_size(0.3).set_num_iterations(40)
+           .set_mini_batch_fraction(0.25).set_sampling("sliced")
+           .set_convergence_tol(0.0))
+    wv, hist = opt.optimize_with_history((X, y), jnp.zeros((16,)))
+    assert hist[-1] < hist[0] * 0.1
+
+
+def test_lbfgs_gramdata_with_stock_gradient_clear_error(rng):
+    X = jnp.asarray(rng.normal(size=(128, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=128).astype(np.float32))
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=32)
+    lb = LBFGS(LeastSquaresGradient(), SquaredL2Updater())
+    with pytest.raises(ValueError, match="GramLeastSquaresGradient"):
+        lb.optimize_with_history((gram.data, y), np.zeros(8))

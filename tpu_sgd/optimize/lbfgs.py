@@ -360,9 +360,16 @@ class LBFGS(Optimizer):
         ``(gradient, X)`` — on substitution, X becomes the ``GramData``
         bundle so the stats enter jit programs as argument buffers."""
         from tpu_sgd.ops.gradients import LeastSquaresGradient as _LS
-        from tpu_sgd.ops.gram import GramLeastSquaresGradient
+        from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
         from tpu_sgd.ops.sparse import is_sparse as _is_sp
 
+        if isinstance(X, GramData) and not isinstance(
+                gradient, GramLeastSquaresGradient):
+            raise ValueError(
+                "GramData input needs a GramLeastSquaresGradient "
+                "(use GramLeastSquaresGradient.build/build_streamed and "
+                "pass it as the gradient)"
+            )
         if self.mesh is None and isinstance(
                 gradient, GramLeastSquaresGradient) and gradient.data.X is X:
             # user-built gram gradient on exactly this matrix: route its
