@@ -38,7 +38,7 @@ else:
 import numpy as np, jax.numpy as jnp
 sys.path.insert(0, %(repo)r)
 from tpu_sgd import LBFGS, OWLQN, SquaredL2Updater
-from tpu_sgd.ops.gradients import (LogisticGradient,
+from tpu_sgd.ops.gradients import (LeastSquaresGradient, LogisticGradient,
                                    MultinomialLogisticGradient)
 from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
 
@@ -99,9 +99,36 @@ def leg_streaming():
             np.asarray(m.weights) - ws) / np.linalg.norm(ws)), 6))
     return errs
 
+# least-squares data for the sufficient-stats quasi-Newton legs
+r2 = np.random.default_rng(21)
+nls, dls = 30000, 400
+Xls = r2.normal(size=(nls, dls)).astype(np.float32)
+wls = r2.uniform(-1, 1, dls).astype(np.float32)
+yls = (Xls @ wls + 0.05 * r2.normal(size=nls)).astype(np.float32)
+
+def leg_gram_lbfgs():
+    opt = (LBFGS(LeastSquaresGradient(), SquaredL2Updater(),
+                 reg_param=1e-3, max_num_iterations=15)
+           .set_sufficient_stats(True))
+    w, hist = opt.optimize_with_history((Xls, yls), jnp.zeros((dls,)))
+    jax.block_until_ready(w)
+    assert opt._gram_entry is not None, "gram substitution did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
+def leg_gram_owlqn():
+    opt = (OWLQN(LeastSquaresGradient(), reg_param=1e-3,
+                 max_num_iterations=15)
+           .set_sufficient_stats(True))
+    w, hist = opt.optimize_with_history((Xls, yls), jnp.zeros((dls,)))
+    jax.block_until_ready(w)
+    assert opt._gram_entry is not None, "gram substitution did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
 for name, fn in [("lbfgs", leg_lbfgs), ("owlqn", leg_owlqn),
                  ("multinomial", leg_multinomial),
-                 ("streaming_w_err", leg_streaming)]:
+                 ("streaming_w_err", leg_streaming),
+                 ("gram_lbfgs", leg_gram_lbfgs),
+                 ("gram_owlqn", leg_gram_owlqn)]:
     vals, wall = timed(fn)
     out["legs"][name] = {"values": vals, "wall_s": wall}
     print(f"{name}: {wall}s final {vals[-1]}", file=sys.stderr, flush=True)

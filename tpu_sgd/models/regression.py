@@ -163,9 +163,12 @@ class LassoWithOWLQN(GeneralizedLinearAlgorithm):
 
     @classmethod
     def train(cls, data, reg_param: float = 0.01,
-              max_num_iterations: int = 100, intercept: bool = False):
+              max_num_iterations: int = 100, intercept: bool = False,
+              sufficient_stats: bool = False):
         alg = cls(reg_param, max_num_iterations)
         alg.set_intercept(intercept)
+        if sufficient_stats:
+            alg.optimizer.set_sufficient_stats(True)
         return alg.run(data)
 
 

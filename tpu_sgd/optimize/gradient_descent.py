@@ -852,9 +852,15 @@ def run_mini_batch_sgd(
     convergence_tol: float = 0.001,
     seed: int = 42,
     mesh=None,
+    sampling: str = None,
+    sufficient_stats: bool = False,
 ) -> Tuple[Array, "jnp.ndarray"]:
     """Functional entry point, signature-parity with the reference's
     ``object GradientDescent.runMiniBatchSGD`` (SURVEY.md §2 #2).
+    ``mesh``, ``sampling`` and ``sufficient_stats`` are the TPU-side
+    extensions; note ``sufficient_stats`` engages on sub-unit
+    mini-batch fractions only with ``sampling="sliced"`` (see
+    ``GradientDescent.set_sufficient_stats``).
 
     Returns ``(weights, loss_history)``.
     """
@@ -872,4 +878,8 @@ def run_mini_batch_sgd(
     )
     if mesh is not None:
         opt.set_mesh(mesh)
+    if sampling is not None:
+        opt.set_sampling(sampling)
+    if sufficient_stats:
+        opt.set_sufficient_stats(True)
     return opt.optimize_with_history(data, initial_weights)
