@@ -78,6 +78,20 @@ def _dot_hi(a, b, dtype):
     )
 
 
+def _running_sum(carry0, blocks):
+    """Inclusive running sum over the leading axis via ``lax.scan`` —
+    shared by the one-shot and the chunked-streaming prefix builders
+    (``jnp.cumsum`` is avoided deliberately: its reduce-window lowering
+    allocates multi-GB temporaries at (1200, d, d) scale)."""
+
+    def step(carry, blk):
+        c = carry + blk
+        return c, c
+
+    _, cums = jax.lax.scan(step, carry0, blocks)
+    return cums
+
+
 @jax.tree_util.register_pytree_node_class
 class GramData:
     """A dense ``(n, d)`` matrix bundled with its block-prefix Gram
@@ -259,23 +273,13 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
 
     @staticmethod
     def _prefix(blocks, sd):
-        """Per-block inclusive prefix with a leading zero entry.
-
-        Written as a ``lax.scan`` running sum, NOT ``jnp.cumsum``: cumsum
-        lowers to reduce-window whose temporaries at (1200, d, d) scale
-        exceed HBM (observed: 20.4 GB requested on a 15.75 GB chip for the
-        10M×1000 prefix); the scan keeps peak memory at input + output."""
+        """Per-block inclusive prefix with a leading zero entry (the
+        memory note on ``jnp.cumsum`` avoidance lives on
+        :func:`_running_sum`; observed: 20.4 GB requested on a 15.75 GB
+        chip for the 10M×1000 prefix before the rewrite)."""
         zero = jnp.zeros((1,) + blocks.shape[1:], sd)
         blocks2 = jnp.concatenate([zero, blocks.astype(sd)])
-
-        def step(carry, blk):
-            c = carry + blk
-            return c, c
-
-        _, cums = jax.lax.scan(
-            step, jnp.zeros(blocks.shape[1:], sd), blocks2
-        )
-        return cums
+        return _running_sum(jnp.zeros(blocks.shape[1:], sd), blocks2)
 
     @classmethod
     def _precompute(cls, X, y, *, B, stats_dtype):
@@ -344,14 +348,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         # form peaks at prefix + one chunk (~5.5 GB there).
         @jax.jit
         def chunk_prefix(cG, cb, cyy, Gc, bc, yyc):
-            def step(carry, blk):
-                c = carry + blk
-                return c, c
-
-            _, pG = jax.lax.scan(step, cG, Gc)
-            _, pb = jax.lax.scan(step, cb, bc)
-            _, pyy = jax.lax.scan(step, cyy, yyc)
-            return pG, pb, pyy
+            return (_running_sum(cG, Gc), _running_sum(cb, bc),
+                    _running_sum(cyy, yyc))
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def write(PG, Pb, Pyy, pG, pb, pyy, kb1):
@@ -361,12 +359,11 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                 jax.lax.dynamic_update_slice_in_dim(Pyy, pyy, kb1, 0),
             )
 
-        d_ = d
-        PG = jnp.zeros((nbf + 1, d_, d_), sd)
-        Pb = jnp.zeros((nbf + 1, d_), sd)
+        PG = jnp.zeros((nbf + 1, d, d), sd)
+        Pb = jnp.zeros((nbf + 1, d), sd)
         Pyy = jnp.zeros((nbf + 1,), sd)
-        cG = jnp.zeros((d_, d_), sd)
-        cb = jnp.zeros((d_,), sd)
+        cG = jnp.zeros((d, d), sd)
+        cb = jnp.zeros((d,), sd)
         cyy = jnp.zeros((), sd)
         s = 0
         while s < nbf * B:
