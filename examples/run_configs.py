@@ -233,6 +233,23 @@ def config4():
     print(f"config4: n={n} d={d} {dict(mesh.shape)}-way DP ({mode}) "
           f"w_err={float(np.linalg.norm(np.asarray(model.weights) - w_true)):.4f} "
           f"({time.perf_counter() - t0:.1f}s)")
+    if not streamed:
+        # The same shape through the sufficient-statistics schedule
+        # (round 3, ops/gram.py): per-shard prefix Grams + the same ICI
+        # psum; weights must agree with the stock DP run above.
+        t0 = time.perf_counter()
+        model_ss = LinearRegressionWithSGD.train(
+            (X, y), num_iterations=200, step_size=0.5,
+            mini_batch_fraction=0.1, sampling="sliced", mesh=mesh,
+            sufficient_stats=True,
+        )
+        drift = float(np.abs(np.asarray(model_ss.weights)
+                             - np.asarray(model.weights)).max())
+        w_err = float(np.linalg.norm(
+            np.asarray(model_ss.weights) - w_true))
+        print(f"config4-gram: sufficient_stats=True w_err={w_err:.4f} "
+              f"(|w-w_stock|max={drift:.1e}, sliced windows) "
+              f"({time.perf_counter() - t0:.1f}s)")
 
 
 def config5():

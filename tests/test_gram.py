@@ -699,3 +699,23 @@ def test_lbfgs_gramdata_with_stock_gradient_clear_error(rng):
     lb = LBFGS(LeastSquaresGradient(), SquaredL2Updater())
     with pytest.raises(ValueError, match="GramLeastSquaresGradient"):
         lb.optimize_with_history((gram.data, y), np.zeros(8))
+
+
+def test_virtual_gramdata_requires_logical_metadata():
+    from tpu_sgd.ops.gram import GramData
+
+    z = jnp.zeros((2, 4, 4))
+    with pytest.raises(ValueError, match="logical_shape"):
+        GramData(None, z, jnp.zeros((2, 4)), jnp.zeros((2,)),
+                 jnp.zeros((4, 4)), jnp.zeros((4,)), jnp.zeros(()), 4)
+
+
+def test_build_rejects_bad_rank_and_streamed_int_features(rng):
+    with pytest.raises(ValueError, match="non-empty"):
+        GramLeastSquaresGradient.build(jnp.zeros((8,)), jnp.zeros((8,)))
+    # int features through the streamed builder coerce to f32 stats
+    Xi = rng.integers(0, 3, size=(256, 6)).astype(np.int32)
+    yi = rng.normal(size=256).astype(np.float32)
+    g = GramLeastSquaresGradient.build_streamed(Xi, yi, block_rows=64)
+    assert g.data.dtype == jnp.float32
+    assert g.data.PG.dtype == jnp.float32
