@@ -719,3 +719,39 @@ def test_build_rejects_bad_rank_and_streamed_int_features(rng):
     g = GramLeastSquaresGradient.build_streamed(Xi, yi, block_rows=64)
     assert g.data.dtype == jnp.float32
     assert g.data.PG.dtype == jnp.float32
+
+
+def test_gramdata_save_load_round_trip(rng, tmp_path):
+    """Statistics persist (streamed builds are expensive) and load back
+    VIRTUAL — training from the loaded bundle matches training from the
+    original."""
+    from tpu_sgd.ops.gram import GramData
+
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    wt = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=512)).astype(np.float32)
+    g0 = GramLeastSquaresGradient.build_streamed(X, y, block_rows=64)
+    p = str(tmp_path / "stats")
+    g0.data.save(p)
+    data = GramData.load(p)
+    assert data.X is None and data.shape == g0.data.shape
+    g1 = GramLeastSquaresGradient(data)
+
+    def run(gg):
+        opt = (GradientDescent(gg, SimpleUpdater())
+               .set_step_size(0.3).set_num_iterations(20)
+               .set_mini_batch_fraction(0.25).set_sampling("sliced"))
+        return opt.optimize_with_history((gg.data, y), np.zeros(8))
+
+    w0, h0 = run(g0)
+    w1, h1 = run(g1)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0),
+                               rtol=1e-6, atol=1e-6)
+
+    # wrong-class / wrong-version guards
+    import json
+    meta = json.load(open(p + "/metadata.json"))
+    meta["class"] = "SomethingElse"
+    json.dump(meta, open(p + "/metadata.json", "w"))
+    with pytest.raises(ValueError, match="expected GramData"):
+        GramData.load(p)

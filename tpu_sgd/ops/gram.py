@@ -166,6 +166,63 @@ class GramData:
         return cls(*children, block_rows, logical_shape=shape,
                    logical_dtype=dtype_name)
 
+    # -- persistence (Saveable/Loader contract, like the models) -----------
+    _FORMAT_VERSION = "1.0"
+
+    def save(self, path: str) -> None:
+        """Persist the STATISTICS (never the rows) as a directory of
+        ``metadata.json`` + ``stats.npz`` — a streamed build over a slow
+        link is worth keeping.  Loads back as a VIRTUAL bundle."""
+        import json
+        import os
+
+        import numpy as np
+
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "class": "GramData",
+            "version": self._FORMAT_VERSION,
+            "block_rows": int(self.block_rows),
+            "logical_shape": list(self._logical_shape),
+            "logical_dtype": str(self._logical_dtype),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+        np.savez(
+            os.path.join(path, "stats.npz"),
+            PG=np.asarray(self.PG), Pb=np.asarray(self.Pb),
+            Pyy=np.asarray(self.Pyy), G_tot=np.asarray(self.G_tot),
+            b_tot=np.asarray(self.b_tot), yy_tot=np.asarray(self.yy_tot),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "GramData":
+        """Load statistics saved by :meth:`save` (virtual — no rows)."""
+        import json
+        import os
+
+        import numpy as np
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("class") != "GramData":
+            raise ValueError(
+                f"{path} holds a {meta.get('class')}, expected GramData"
+            )
+        if meta["version"] != cls._FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported GramData format version {meta['version']}"
+            )
+        z = np.load(os.path.join(path, "stats.npz"))
+        put = jax.device_put
+        return cls(
+            None, put(z["PG"]), put(z["Pb"]), put(z["Pyy"]),
+            put(z["G_tot"]), put(z["b_tot"]), put(z["yy_tot"]),
+            int(meta["block_rows"]),
+            logical_shape=tuple(meta["logical_shape"]),
+            logical_dtype=meta["logical_dtype"],
+        )
+
 
 class GramLeastSquaresGradient(LeastSquaresGradient):
     """``LeastSquaresGradient`` bound to precomputed block-prefix Grams.
