@@ -24,6 +24,11 @@ squares accelerates identically.
 
 This is least-squares only by construction: logistic/hinge gradients are
 nonlinear in the margins and have no fixed-size sufficient statistics.
+It is also a MODERATE-d technique: the statistics are O(d²) per prefix
+entry, so the very-wide-feature regime (the 2-D ``(data, model)`` mesh
+hook, `parallel/model_parallel.py`) is out of scope — at d=100k one Gram
+matrix alone is 40 GB.  The two accelerations are complementary, not
+composable: gram for many-rows × moderate-d, feature sharding for wide d.
 
 Memory: the prefix stack is ``(n/block_rows + 1) · d² · 4`` bytes (f32 —
 differences of same-sign prefix accumulations would lose ~1% at bf16, so
