@@ -755,3 +755,31 @@ def test_gramdata_save_load_round_trip(rng, tmp_path):
     json.dump(meta, open(p + "/metadata.json", "w"))
     with pytest.raises(ValueError, match="expected GramData"):
         GramData.load(p)
+
+
+def test_gram_random_shape_window_parity_sweep(rng):
+    """Randomized breadth: arbitrary (n, d, B, start, m) combinations must
+    reproduce the stock window sums — catches shape/edge interactions the
+    parametrized grid doesn't enumerate."""
+    for _ in range(12):
+        n = int(rng.integers(40, 1500))
+        d = int(rng.integers(2, 40))
+        B = int(rng.integers(8, n + 8))
+        X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        w = jnp.asarray(rng.uniform(-1, 1, d).astype(np.float32))
+        y = jnp.asarray(
+            (np.asarray(X) @ np.asarray(w)
+             + 0.1 * rng.normal(size=n)).astype(np.float32))
+        gram = GramLeastSquaresGradient.build(X, y, block_rows=B)
+        for _ in range(3):
+            m = int(rng.integers(1, n + 1))
+            start = int(rng.integers(0, n))
+            g0, l0, c0 = LeastSquaresGradient().window_sums(
+                X, y, w, jnp.int32(start), m)
+            g1, l1, c1 = gram.window_sums(X, y, w, jnp.int32(start), m)
+            scale = max(1.0, float(jnp.max(jnp.abs(g0))))
+            np.testing.assert_allclose(
+                np.asarray(g1), np.asarray(g0), rtol=5e-4,
+                atol=5e-3 * scale,
+                err_msg=f"n={n} d={d} B={B} start={start} m={m}")
+            assert float(c1) == float(c0)
