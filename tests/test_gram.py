@@ -783,3 +783,26 @@ def test_gram_random_shape_window_parity_sweep(rng):
                 atol=5e-3 * scale,
                 err_msg=f"n={n} d={d} B={B} start={start} m={m}")
             assert float(c1) == float(c0)
+
+
+def test_virtual_gramdata_with_listener_and_checkpoint(rng, tmp_path):
+    """Beyond-HBM stats + the observed per-iteration path: the stepwise
+    driver must accept a virtual GramData as X (listener events fire,
+    checkpoints save/restore weights)."""
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+    from tpu_sgd.utils.events import CollectingListener
+
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    wt = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=512)).astype(np.float32)
+    gram = GramLeastSquaresGradient.build_streamed(X, y, block_rows=64)
+    listener = CollectingListener()
+    opt = (GradientDescent(gram, SimpleUpdater())
+           .set_step_size(0.3).set_num_iterations(6)
+           .set_mini_batch_fraction(0.25).set_sampling("sliced")
+           .set_convergence_tol(0.0)
+           .set_listener(listener)
+           .set_checkpoint(CheckpointManager(str(tmp_path / "ck")), 2))
+    w, hist = opt.optimize_with_history((gram.data, y), np.zeros(8))
+    assert len(listener.iterations) == 6
+    assert len(hist) == 6 and hist[-1] < hist[0]
