@@ -806,3 +806,23 @@ def test_virtual_gramdata_with_listener_and_checkpoint(rng, tmp_path):
     w, hist = opt.optimize_with_history((gram.data, y), np.zeros(8))
     assert len(listener.iterations) == 6
     assert len(hist) == 6 and hist[-1] < hist[0]
+
+
+def test_feature_scaling_composes_with_sufficient_stats(rng):
+    """GLM feature scaling rescales the training matrix before the
+    optimizer sees it; the gram substitution must build on the SCALED
+    matrix and produce the same model as the unaccelerated scaled run."""
+    from tpu_sgd import LinearRegressionWithLBFGS
+
+    X = (rng.normal(size=(1024, 12)) * np.logspace(0, 3, 12)).astype(
+        np.float32)
+    wt = (rng.uniform(-1, 1, 12) / np.logspace(0, 3, 12)).astype(np.float32)
+    y = (X @ wt + 0.01 * rng.normal(size=1024)).astype(np.float32)
+    m0 = LinearRegressionWithLBFGS.train((X, y), feature_scaling=True,
+                                         intercept=True)
+    m1 = LinearRegressionWithLBFGS.train((X, y), feature_scaling=True,
+                                         intercept=True,
+                                         sufficient_stats=True)
+    np.testing.assert_allclose(np.asarray(m1.weights),
+                               np.asarray(m0.weights), rtol=1e-3,
+                               atol=1e-6)
