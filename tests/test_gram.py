@@ -516,6 +516,11 @@ def test_f64_data_keeps_f64_stats():
         "assert X.dtype == jnp.float64, X.dtype; "
         "g = GramLeastSquaresGradient.build(X, y, block_rows=16); "
         "assert g.data.PG.dtype == jnp.float64, g.data.PG.dtype; "
+        "gs = GramLeastSquaresGradient.build_streamed("
+        "    np.asarray(X), np.asarray(y), block_rows=16); "
+        "assert gs.data.Pb.dtype == jnp.float64, gs.data.Pb.dtype; "
+        "np.testing.assert_allclose(np.asarray(gs.data.Pb), "
+        "    np.asarray(g.data.Pb), rtol=1e-12); "
         "print('OK')"
     )
     env = dict(os.environ)
@@ -826,3 +831,65 @@ def test_feature_scaling_composes_with_sufficient_stats(rng):
     np.testing.assert_allclose(np.asarray(m1.weights),
                                np.asarray(m0.weights), rtol=1e-3,
                                atol=1e-6)
+
+
+def test_unbound_gram_gradient_runs_stock_in_optimizers(rng):
+    """ADVICE r3 (medium): an UNBOUND ``GramLeastSquaresGradient(data=None)``
+    — the documented DP-mesh constructor mode — handed to GradientDescent,
+    LBFGS, or OWLQN with a plain matrix must fall through to the stock
+    path bitwise, not crash the gram-substitution identity check with an
+    AttributeError on ``None.X``."""
+    from tpu_sgd.optimize.owlqn import OWLQN
+
+    X, y, _ = _data(rng, n=256, d=8)
+    w0 = jnp.zeros((8,))
+
+    def gd(gradient):
+        opt = (GradientDescent(gradient, SimpleUpdater())
+               .set_step_size(0.2).set_num_iterations(8)
+               .set_convergence_tol(0.0))
+        return opt.optimize_with_history((X, y), w0)
+
+    ws, hs = gd(LeastSquaresGradient())
+    wu, hu = gd(GramLeastSquaresGradient())
+    np.testing.assert_array_equal(np.asarray(wu), np.asarray(ws))
+    np.testing.assert_array_equal(np.asarray(hu), np.asarray(hs))
+
+    ws, hs = LBFGS(LeastSquaresGradient()).set_max_num_iterations(
+        5).optimize_with_history((X, y), w0)
+    wu, hu = LBFGS(GramLeastSquaresGradient()).set_max_num_iterations(
+        5).optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(wu), np.asarray(ws))
+    np.testing.assert_array_equal(np.asarray(hu), np.asarray(hs))
+
+    wu, hu = OWLQN(GramLeastSquaresGradient(), reg_param=0.01,
+                   max_num_iterations=5).optimize_with_history((X, y), w0)
+    assert np.all(np.isfinite(np.asarray(wu))) and len(hu) >= 1
+
+
+def test_release_sufficient_stats_frees_cache(rng):
+    """``release_sufficient_stats`` drops the identity-cached bundles (and
+    gram-keyed compiled runners); the next run rebuilds and reproduces the
+    same trajectory."""
+    X, y, _ = _data(rng, n=512, d=8)
+
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_step_size(0.2).set_num_iterations(6)
+           .set_convergence_tol(0.0).set_sufficient_stats(True))
+    w1, h1 = opt.optimize_with_history((X, y), jnp.zeros((8,)))
+    assert opt._gram_entry is not None
+    opt.release_sufficient_stats()
+    assert opt._gram_entry is None and opt._gram_dp_entry is None
+    assert not any(
+        isinstance(part, GramLeastSquaresGradient)
+        for k in opt._run_cache for part in k
+    )
+    w2, h2 = opt.optimize_with_history((X, y), jnp.zeros((8,)))
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w1))
+
+    lb = (LBFGS(LeastSquaresGradient()).set_max_num_iterations(5)
+          .set_sufficient_stats(True))
+    lb.optimize_with_history((X, y), jnp.zeros((8,)))
+    assert lb._gram_entry is not None
+    lb.release_sufficient_stats()
+    assert lb._gram_entry is None

@@ -433,7 +433,10 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
             if (e - s) % B:  # last partial chunk: shrink to whole blocks
                 e = s + ((e - s) // B) * B
             Xc = jax.device_put(Xh[s:e])
-            yc = jax.device_put(np.asarray(yh[s:e], np.float32))
+            # y rides at the RESOLVED stats dtype (>= f32): f64 data under
+            # jax_enable_x64 keeps f64 b/yy statistics, matching the
+            # resident build()'s _resolve_stats_dtype contract.
+            yc = jax.device_put(np.asarray(yh[s:e], np.dtype(sd)))
             Gc, bc, yyc = stats_fn(Xc, yc)
             pG, pb, pyy = chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
             cG, cb, cyy = pG[-1], pb[-1], pyy[-1]

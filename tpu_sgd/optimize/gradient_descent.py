@@ -353,9 +353,37 @@ class GradientDescent(Optimizer):
         data is dense and device-resident (no mesh, no host streaming), and
         sampling is ``sliced`` or full-batch; any other combination runs
         unchanged.  The one-time build pass is cached per ``(X, y)`` array
-        identity."""
+        identity — and RETAINED after ``optimize`` returns (the streaming
+        mode's repeated calls on the same arrays must not rebuild), which
+        pins the dataset plus the ~GB-scale prefix stack in HBM until a
+        different dataset is passed, the optimizer is dropped, or
+        :meth:`release_sufficient_stats` is called."""
         self.sufficient_stats = bool(flag)
         return self
+
+    def release_sufficient_stats(self):
+        """Drop the cached sufficient-statistics bundles (single-device and
+        DP-mesh) and the compiled runners keyed on them, so the bound
+        dataset plus the prefix stacks can be freed from HBM.  Call after a
+        one-shot ``optimize`` when the statistics are no longer needed;
+        the next ``set_sufficient_stats`` run rebuilds from scratch.
+        (The DP-mesh runner takes its stats as call arguments, so clearing
+        the entry alone frees them; only the single-device gram gradient
+        appears in run-cache keys.)"""
+        if self._gram_entry is not None:
+            self._purge_run_cache_for(self._gram_entry[2])
+        self._gram_entry = None
+        self._gram_dp_entry = None
+        return self
+
+    def _purge_run_cache_for(self, obj):
+        """Drop compiled runners whose cache key contains ``obj`` (by
+        identity) so a superseded gram gradient's GB-scale prefix stack is
+        not pinned by a closure."""
+        self._run_cache = {
+            k: v for k, v in self._run_cache.items()
+            if not any(part is obj for part in k)
+        }
 
     def set_checkpoint(self, manager, every: int = 10):
         """Attach a ``CheckpointManager``; optimizer state is saved every
@@ -604,6 +632,7 @@ class GradientDescent(Optimizer):
                     and cfg.sampling != "sliced")):
             return None
         if (isinstance(self.gradient, GramLeastSquaresGradient)
+                and self.gradient.data is not None
                 and self.gradient.data.X is X):
             # user-built gram gradient on exactly this matrix: route its
             # GramData through so the traced program accelerates
@@ -616,11 +645,7 @@ class GradientDescent(Optimizer):
         if entry is not None:
             # new dataset: drop compiled runners keyed on the superseded
             # gram gradient so its GB-scale prefix stack can be freed
-            old = entry[2]
-            self._run_cache = {
-                k: v for k, v in self._run_cache.items()
-                if not any(part is old for part in k)
-            }
+            self._purge_run_cache_for(entry[2])
         g = GramLeastSquaresGradient.build(X, y)
         # keep the ORIGINAL arrays in the key: build() may re-coerce
         self._gram_entry = (X, y, g)

@@ -330,8 +330,20 @@ class LBFGS(Optimizer):
         full-batch objective/gradient becomes an O(d²) matvec instead of
         two passes over X.  Applies when the gradient is exactly
         ``LeastSquaresGradient`` on dense unmeshed data; otherwise a
-        no-op."""
+        no-op.
+
+        The last built ``(X, y, GramData)`` is retained by identity so
+        repeated calls on the same arrays (the streaming mode) never
+        rebuild; call :meth:`release_sufficient_stats` to free the
+        dataset plus its prefix stack from HBM after a one-shot run."""
         self.sufficient_stats = bool(flag)
+        return self
+
+    def release_sufficient_stats(self):
+        """Drop the cached sufficient-statistics bundle so the bound
+        dataset plus the GB-scale prefix stack can be freed from HBM
+        (``set_sufficient_stats`` retains the last build by design)."""
+        self._gram_entry = None
         return self
 
     def set_mesh(self, mesh):
@@ -370,8 +382,9 @@ class LBFGS(Optimizer):
                 "(use GramLeastSquaresGradient.build/build_streamed and "
                 "pass it as the gradient)"
             )
-        if self.mesh is None and isinstance(
-                gradient, GramLeastSquaresGradient) and gradient.data.X is X:
+        if (self.mesh is None
+                and isinstance(gradient, GramLeastSquaresGradient)
+                and gradient.data is not None and gradient.data.X is X):
             # user-built gram gradient on exactly this matrix: route its
             # GramData through so the traced cost/sweep accelerate
             return gradient, gradient.data
