@@ -182,6 +182,13 @@ def main() -> int:
 
     record = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "note": (
+            "correctness check, not a perf claim: the legs are tiny "
+            "workloads whose walls are dominated by the remote-TPU "
+            "tunnel's ~65 ms per-program dispatch tax (quasi-Newton "
+            "iterates sync the host every iteration), so the TPU walls "
+            "may read slower than CPU here"
+        ),
         "tpu": tpu,
         "cpu": cpu,
         "finals": legs,

@@ -90,6 +90,12 @@ def main() -> int:
                              rtol=2e-2, atol=1e-3))
     record = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "note": (
+            "correctness check, not a perf claim: walls are launch-tax-"
+            "dominated tiny workloads through the remote-TPU tunnel "
+            "(~65 ms fixed dispatch tax per program) and CPU may read "
+            "faster than TPU here"
+        ),
         "workload": {"n": N, "d": D, "nnz_per_row": NNZ, "iters": ITERS},
         "tpu": tpu,
         "cpu": cpu,

@@ -60,6 +60,29 @@ def _reg_terms(updater: Updater, reg_param: float):
     return (lambda w: jnp.zeros((), w.dtype), lambda w: jnp.zeros_like(w))
 
 
+def _warn_sequential_line_search(gradient, n_trials):
+    """Tell the user their gradient lacks the ``loss_sweep`` protocol, so
+    the Armijo backtracking runs one device call + host sync PER TRIAL (up
+    to ``n_trials`` per iteration) instead of one fused multi-weight pass
+    with a single sync — ruinous over a high-latency device link.  Every
+    shipped gradient implements the sweep; this fires only for
+    user-supplied exotics (cf. [U] LBFGS.scala's one-treeAggregate-per-
+    iteration CostFun economy, SURVEY.md §2 #18)."""
+    import warnings
+
+    warnings.warn(
+        f"{type(gradient).__name__} has no loss_sweep(X, y, W, mask) "
+        "method, so the line search falls back to SEQUENTIAL trials — up "
+        f"to {n_trials} device calls + host syncs per iteration instead "
+        "of one batched sweep.  Implement loss_sweep (losses of a (T, d) "
+        "stack of trial weights in one pass — see "
+        "tpu_sgd.ops.gradients.LeastSquaresGradient.loss_sweep) to fuse "
+        "the ladder.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _coerce_inputs(X, y, w):
     """Shared (X, y, w) -> inexact jnp arrays coercion for the quasi-Newton
     optimizers.  BCOO feature matrices and GramData statistics bundles
@@ -436,6 +459,7 @@ class LBFGS(Optimizer):
                 return w[None, :] + ladder[:, None] * direction[None, :]
 
         else:  # exotic gradients without a sweep rule: sequential trials
+            _warn_sequential_line_search(gradient, self._LS_TRIALS)
             loss_only = _build_loss_only(
                 gradient, reg_value, mesh, with_valid, sparse_shape
             )

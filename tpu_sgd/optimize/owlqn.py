@@ -38,6 +38,7 @@ from tpu_sgd.optimize.lbfgs import (
     _push_correction,
     _shard_for_mesh,
     _two_loop,
+    _warn_sequential_line_search,
 )
 from tpu_sgd.optimize.optimizer import Dataset
 
@@ -167,6 +168,7 @@ class OWLQN(LBFGS):
                 return W, preds
 
         else:  # exotic gradients without a sweep rule
+            _warn_sequential_line_search(gradient, n_ls)
             # loss-only compile: XLA drops the gradient matmul per trial
             _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
                                      sparse_shape)
