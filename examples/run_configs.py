@@ -220,20 +220,32 @@ def config4():
     mesh = data_mesh()
     t0 = time.perf_counter()
     # Full scale is 10M x 1000 f32 = 40 GB — beyond any single chip's HBM
-    # (SURVEY.md §7 hard parts): keep the dataset host-resident and stream
-    # double-buffered per-iteration batches instead of device_put'ing the
-    # slab.  Threshold overridable for smoke tests.
-    budget = float(os.environ.get("CONFIG4_RESIDENT_BYTES", 8e9))
-    streamed = bool(X.nbytes > budget)
-    model = LinearRegressionWithSGD.train(
-        (X, y), num_iterations=200, step_size=0.5, mini_batch_fraction=0.1,
-        mesh=mesh, host_streaming=streamed,
-    )
-    mode = "host-streamed" if streamed else "device-resident"
-    print(f"config4: n={n} d={d} {dict(mesh.shape)}-way DP ({mode}) "
+    # (SURVEY.md §7 hard parts).  The EXECUTION PLANNER (tpu_sgd/plan.py,
+    # round 4) owns the residency decision now: train() probes free device
+    # memory and picks resident / partial-residency / host-streamed
+    # itself; CONFIG4_FREE_HBM overrides the probe for smoke tests.
+    free_hbm = os.environ.get("CONFIG4_FREE_HBM")
+    alg = LinearRegressionWithSGD(0.5, 200, None, 0.1)
+    alg.optimizer.set_mesh(mesh)
+    if free_hbm is not None:
+        # pin the budget by planning explicitly, then run with the result
+        import tpu_sgd.plan as _plan_mod
+
+        p = _plan_mod.plan(
+            n, d, itemsize=X.dtype.itemsize, gram_able=True,
+            sampling=alg.optimizer.config.sampling,
+            mini_batch_fraction=0.1, num_iterations=200,
+            n_devices=mesh.shape["data"], free_hbm=float(free_hbm),
+        )
+        p.apply(alg.optimizer)
+        alg.set_schedule("off")
+    model = alg.run((X, y))
+    last = alg.optimizer.last_plan
+    mode = last.schedule if last is not None else "unplanned"
+    print(f"config4: n={n} d={d} {dict(mesh.shape)}-way DP (plan: {mode}) "
           f"w_err={float(np.linalg.norm(np.asarray(model.weights) - w_true)):.4f} "
           f"({time.perf_counter() - t0:.1f}s)")
-    if not streamed:
+    if mode.startswith("resident"):
         # The same shape through the sufficient-statistics schedule
         # (round 3, ops/gram.py): per-shard prefix Grams + the same ICI
         # psum; weights must agree with the stock DP run above.

@@ -893,3 +893,93 @@ def test_release_sufficient_stats_frees_cache(rng):
     assert lb._gram_entry is not None
     lb.release_sufficient_stats()
     assert lb._gram_entry is None
+
+
+# ---- streamed statistics composed with the data mesh (round 4) -----------
+
+def test_build_streamed_sharded_stats_match_per_shard_resident(rng):
+    """Each shard's streamed-from-host statistics must equal the resident
+    build of that shard's (block-truncated) row slice — uneven row counts
+    drop the n % k remainder plus per-shard tails, like the single-device
+    build_streamed."""
+    from tpu_sgd import data_mesh
+    from tpu_sgd.parallel.gram_parallel import (
+        build_streamed_sharded_gram_stats,
+    )
+
+    mesh = data_mesh()
+    k = mesh.shape["data"]
+    n, d, B = k * 300 + 5, 6, 64
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    stats, Bout, n_used = build_streamed_sharded_gram_stats(
+        mesh, X, y, block_rows=B, batch_rows=128)
+    n_local = n // k
+    assert Bout == B and n_used == (n_local // B) * B
+    PG, Pb, _, Gt, bt, yyt = (np.asarray(s) for s in stats)
+    for i in range(k):
+        s = i * n_local
+        g = GramLeastSquaresGradient.build(
+            X[s:s + n_used], y[s:s + n_used], block_rows=B)
+        np.testing.assert_allclose(PG[i], np.asarray(g.data.PG),
+                                   rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(Pb[i], np.asarray(g.data.Pb),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(Gt[i], np.asarray(g.data.G_tot),
+                                   rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(yyt[i], float(g.data.yy_tot),
+                                   rtol=1e-5)
+
+
+def test_streamed_stats_mesh_matches_resident_aligned_dp(rng):
+    """Meshed set_streamed_stats (per-shard VIRTUAL stats built from host
+    row streams, zero rows on device) must reproduce the meshed RESIDENT
+    aligned-gram trajectory: same per-shard block-floored windows, same
+    statistics math (VERDICT r3 #2)."""
+    from tpu_sgd import data_mesh
+
+    mesh = data_mesh()
+    k = mesh.shape["data"]
+    n, d, B = k * 512, 8, 64  # divisible everywhere: no truncation
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    wt = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ wt + 0.05 * rng.normal(size=n)).astype(np.float32)
+
+    def mk():
+        return (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+                .set_step_size(0.3).set_num_iterations(20)
+                .set_mini_batch_fraction(0.25).set_sampling("sliced")
+                .set_convergence_tol(0.0).set_seed(9).set_mesh(mesh)
+                .set_gram_options(block_rows=B))
+
+    opt_v = mk().set_streamed_stats(True)
+    w_v, h_v = opt_v.optimize_with_history((X, y), jnp.zeros((d,)))
+    assert opt_v._streamed_gram_dp_entry is not None
+
+    opt_r = mk().set_sufficient_stats(True).set_gram_options(aligned=True)
+    w_r, h_r = opt_r.optimize_with_history((X, y), jnp.zeros((d,)))
+    assert opt_r._gram_dp_entry is not None
+
+    np.testing.assert_allclose(np.asarray(h_v), np.asarray(h_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_v), np.asarray(w_r),
+                               rtol=1e-5, atol=1e-6)
+    assert h_v[-1] < h_v[0]  # and it actually optimizes
+
+
+def test_streamed_stats_mesh_build_is_identity_cached(rng):
+    from tpu_sgd import data_mesh
+
+    mesh = data_mesh()
+    n, d = 8 * 128, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_num_iterations(3).set_convergence_tol(0.0)
+           .set_mesh(mesh).set_streamed_stats(True, block_rows=32))
+    opt.optimize((X, y), jnp.zeros((d,)))
+    entry1 = opt._streamed_gram_dp_entry
+    opt.optimize((X, y), jnp.zeros((d,)))
+    assert opt._streamed_gram_dp_entry is entry1  # no rebuild
+    opt.release_sufficient_stats()
+    assert opt._streamed_gram_dp_entry is None

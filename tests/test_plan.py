@@ -1,0 +1,421 @@
+"""Execution-planner decision boundaries + train() auto-planning.
+
+The planner (``tpu_sgd/plan.py``) is the DAGScheduler/``cache()`` analogue
+(SURVEY.md §2 #16): ``train()`` with zero schedule flags must land on the
+measured-best schedule.  These tests pin the decision boundaries with an
+explicit ``free_hbm`` (the probe is environment-dependent) and then drive
+the wired-up model layer end to end.
+"""
+
+import logging
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_sgd.plan import (CostModel, Plan, SCHEDULES,  # noqa: F401
+                          choose_block_rows, device_budget, plan, plan_for)
+
+
+def test_plan_module_attribute_not_shadowed():
+    """`import tpu_sgd.plan as m` must resolve to the MODULE: the package
+    must not re-export the bare `plan` function under the same name
+    (regression: `tpu_sgd.plan.plan(...)` raised AttributeError)."""
+    import types
+
+    import tpu_sgd
+    import tpu_sgd.plan as m
+
+    assert isinstance(tpu_sgd.plan, types.ModuleType)
+    assert isinstance(m, types.ModuleType) and callable(m.plan)
+
+GB = 1e9
+
+
+# ---- pure decision boundaries --------------------------------------------
+
+def test_resident_gram_for_big_least_squares_full_batch():
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+             mini_batch_fraction=1.0, num_iterations=5000,
+             free_hbm=12 * GB)
+    assert p.schedule == "resident_gram"
+    assert not p.aligned  # exact mode is the default
+    assert p.block_rows is not None
+    assert p.estimates["build_amortize_iters"] < 5000
+    assert "fits" in p.reason and "B=" in p.reason
+
+
+def test_short_run_amortization_keeps_stock():
+    """The one-time statistics build must pay for itself inside the run
+    (VERDICT r3 #1: warn/avoid when build_amortize_iters > iterations)."""
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+             mini_batch_fraction=1.0, num_iterations=50,
+             free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+    assert "amortize" in p.reason
+    assert p.estimates["build_amortize_iters"] > 50
+
+
+def test_small_problem_keeps_stock():
+    """Tiny datasets stay on the bitwise round-2 stock path — the build
+    overhead dominates any per-iteration saving."""
+    p = plan(100_000, 100, gram_able=True, num_iterations=100,
+             free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+
+
+def test_non_least_squares_never_grams():
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=False,
+             num_iterations=10_000, free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+
+
+def test_bernoulli_sampling_is_honored():
+    """The planner never changes the user's sampling semantics: bernoulli
+    mini-batches disqualify gram (sliced windows only)."""
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+             sampling="bernoulli", mini_batch_fraction=0.1,
+             num_iterations=10_000, free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+    assert "sampling" in p.reason
+
+
+def test_sliced_sampling_qualifies_gram():
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+             sampling="sliced", mini_batch_fraction=0.1,
+             num_iterations=10_000, free_hbm=12 * GB)
+    assert p.schedule == "resident_gram"
+
+
+def test_beyond_hbm_least_squares_goes_virtual_gram():
+    """The 10Mx1000 config-4 shape: rows exceed HBM, statistics fit —
+    one streaming build pass, then zero-transfer iterations."""
+    p = plan(10_000_000, 1000, itemsize=2, gram_able=True,
+             sampling="sliced", mini_batch_fraction=0.1,
+             num_iterations=1000, free_hbm=12 * GB)
+    assert p.schedule == "streamed_virtual_gram"
+    assert p.aligned  # virtual stats are aligned by construction...
+    assert "ALIGNED" in p.reason  # ...and the plan says so loudly
+    assert p.estimates["stack_bytes"] < 12 * GB
+
+
+def test_beyond_hbm_non_gram_partial_residency():
+    """Sliced non-LS (or bernoulli-excluded) data just beyond HBM keeps a
+    resident prefix."""
+    p = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+             sampling="sliced", mini_batch_fraction=0.1,
+             num_iterations=1000, free_hbm=12 * GB)
+    assert p.schedule == "partial_residency"
+    assert p.resident_rows > 0
+    assert p.estimates["resident_window_p"] >= 0.05
+
+
+def test_beyond_hbm_bernoulli_streams():
+    p = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+             sampling="bernoulli", mini_batch_fraction=0.1,
+             num_iterations=1000, free_hbm=12 * GB)
+    assert p.schedule == "host_streamed"
+
+
+def test_beyond_hbm_meshed_goes_virtual_gram():
+    """Virtual gram composes with the mesh (round 4): per-shard statistics
+    streamed to each device — config 4's 8-way shape at 8x-beyond-HBM
+    scale picks it."""
+    p = plan(80_000_000, 1000, itemsize=2, gram_able=True,
+             sampling="sliced", mini_batch_fraction=0.1,
+             num_iterations=1000, n_devices=8, free_hbm=12 * GB)
+    assert p.schedule == "streamed_virtual_gram"
+    # non-gram data at the same scale still streams
+    p2 = plan(80_000_000, 1000, itemsize=2, gram_able=False,
+              sampling="sliced", mini_batch_fraction=0.1,
+              num_iterations=1000, n_devices=8, free_hbm=12 * GB)
+    assert p2.schedule == "host_streamed"
+
+
+def test_mesh_divides_rows_for_fit():
+    """8 devices hold 8x the rows: a dataset that streams on one chip is
+    resident on the mesh."""
+    one = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+               num_iterations=100, free_hbm=12 * GB)
+    eight = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+                 num_iterations=100, n_devices=8, free_hbm=12 * GB)
+    assert one.schedule == "host_streamed"
+    assert eight.schedule == "resident_stock"
+
+
+def test_device_committed_data_never_streams():
+    p = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+             num_iterations=100, free_hbm=12 * GB,
+             host_resident_ok=False)
+    assert p.schedule == "resident_stock"
+    assert "device-committed" in p.reason
+
+
+def test_huge_d_disqualifies_gram():
+    """Very wide features break the gram economics two ways (ops/gram.py
+    module docs): beyond-HBM, no block size makes the O(d²) stack fit;
+    resident, the per-iteration d² prefix matvec costs more than the row
+    reads it replaces.  Both must fall back."""
+    # 200 GB of rows, 40 GB per Gram matrix: nothing fits -> streams
+    p = plan(1_000_000, 100_000, itemsize=2, gram_able=True,
+             num_iterations=10_000, free_hbm=12 * GB)
+    assert p.schedule == "host_streamed"
+    # 0.4 GB of rows fit, but reading two (20k, 20k) prefix entries per
+    # iteration exceeds the two-pass row traffic -> negative saving
+    p = plan(10_000, 20_000, itemsize=2, gram_able=True,
+             num_iterations=10_000, free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+
+
+def test_force_overrides_with_warning():
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+                 mini_batch_fraction=1.0, num_iterations=50,
+                 free_hbm=12 * GB, force="resident_gram")
+    assert p.schedule == "resident_gram"
+    assert any("NET LOSS" in str(r.message) for r in rec)
+    assert "forced by caller" in p.reason
+
+
+def test_force_rejects_unknown_schedule():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        plan(1000, 10, force="warp_drive")
+
+
+def test_choose_block_rows_doubles_to_fit():
+    # 1M x 1000: stack at B=4096 is ~(245)*4MB ~ 1GB; at a 0.2GB budget
+    # the block must grow
+    b_small = choose_block_rows(1_000_000, 1000, 0.2 * GB)
+    b_big = choose_block_rows(1_000_000, 1000, 4 * GB)
+    assert b_big == 4096
+    assert b_small is not None and b_small > b_big
+    assert choose_block_rows(1_000_000, 1000, 1e6) is None  # nothing fits
+
+
+def test_estimates_are_recorded():
+    p = plan(3_000_000, 1000, itemsize=2, gram_able=True,
+             num_iterations=5000, free_hbm=12 * GB)
+    for key in ("n", "d", "free_hbm", "stock_iter_s", "gram_iter_s",
+                "gram_build_s", "build_amortize_iters", "fits_resident"):
+        assert key in p.estimates, key
+
+
+def test_device_budget_returns_positive():
+    free, source = device_budget()
+    assert free > 0
+    assert source in ("memory_stats", "fallback")
+
+
+# ---- plan_for probing -----------------------------------------------------
+
+def test_plan_for_probes_optimizer(rng):
+    from tpu_sgd import GradientDescent
+
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    y = rng.normal(size=(512,)).astype(np.float32)
+    opt = GradientDescent()
+    p = plan_for(opt, X, y)
+    assert p is not None and p.schedule == "resident_stock"
+    p.apply(opt)
+    assert opt.last_plan is p
+
+
+def test_plan_for_skips_sparse_and_non_gd(rng):
+    from tpu_sgd import GradientDescent, LBFGS
+    from tpu_sgd.ops.sparse import sparse_data
+
+    Xs, ys, _ = sparse_data(64, 32, nnz_per_row=4, seed=0)
+    assert plan_for(GradientDescent(), Xs, ys) is None
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.normal(size=(64,)).astype(np.float32)
+    assert plan_for(LBFGS(), X, y) is None
+
+
+def test_apply_clears_previous_schedule(rng):
+    from tpu_sgd import GradientDescent
+
+    opt = GradientDescent().set_host_streaming(True, resident_rows=100)
+    Plan("resident_stock", "test").apply(opt)
+    assert not opt.host_streaming and opt.streaming_resident_rows == 0
+    Plan("resident_gram", "test", block_rows=64).apply(opt)
+    assert opt.sufficient_stats and opt.gram_block_rows == 64
+    Plan("streamed_virtual_gram", "test", block_rows=32,
+         aligned=True).apply(opt)
+    assert opt.streamed_stats and not opt.sufficient_stats
+
+
+# ---- wired into the model layer ------------------------------------------
+
+def test_train_zero_flags_plans_and_logs(rng, caplog):
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(2048, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, 16).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=2048)).astype(np.float32)
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        model = LinearRegressionWithSGD.train((X, y), num_iterations=100,
+                                              step_size=1.0)
+    assert any(r.message.startswith("plan: ") for r in caplog.records)
+    err = float(np.linalg.norm(np.asarray(model.weights) - w))
+    assert err < 0.1
+
+
+def test_train_schedule_off_keeps_legacy_path(rng):
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = rng.normal(size=(256,)).astype(np.float32)
+    alg = LinearRegressionWithSGD(0.2, 10)
+    alg.set_schedule("off")
+    alg.run((X, y))
+    assert alg.optimizer.last_plan is None
+
+
+def test_train_manual_flags_win_over_auto(rng):
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(2048, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    alg = LinearRegressionWithSGD(1.0, 100)
+    alg.optimizer.set_sufficient_stats(True)
+    model = alg.run((X, y))
+    # the planner did not run (it would have cleared/chosen itself)
+    assert alg.optimizer.last_plan is None
+    assert alg.optimizer.sufficient_stats
+    assert np.linalg.norm(np.asarray(model.weights) - w) < 0.1
+
+
+def test_forced_streamed_virtual_gram_trains(rng):
+    """schedule='streamed_virtual_gram' exercises set_streamed_stats end
+    to end on a small dataset: build from host rows, iterate from virtual
+    statistics, converge."""
+    from tpu_sgd import LinearRegressionWithSGD
+
+    n, d = 4096, 12
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w + 0.01 * rng.normal(size=n)).astype(np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # net-loss warn ok
+        model = LinearRegressionWithSGD.train(
+            (X, y), num_iterations=60, step_size=0.3,
+            mini_batch_fraction=0.25, sampling="sliced",
+            schedule="streamed_virtual_gram",
+        )
+    assert np.linalg.norm(np.asarray(model.weights) - w) < 0.1
+
+
+def test_forced_schedule_validates_name():
+    from tpu_sgd import LinearRegressionWithSGD
+
+    with pytest.raises(ValueError, match="schedule must be one of"):
+        LinearRegressionWithSGD.train(
+            (np.zeros((4, 2), np.float32), np.zeros(4, np.float32)),
+            schedule="warp_drive",
+        )
+
+
+def test_set_streamed_stats_guards(rng):
+    from tpu_sgd import GradientDescent
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = rng.normal(size=(256,)).astype(np.float32)
+    w0 = jnp.zeros((8,))
+    with pytest.raises(NotImplementedError, match="least squares"):
+        GradientDescent(LogisticGradient()).set_streamed_stats(True) \
+            .optimize((X, np.abs(np.sign(y))), w0)
+    from tpu_sgd import make_mesh
+
+    with pytest.raises(NotImplementedError, match="1-D 'data' mesh"):
+        GradientDescent().set_streamed_stats(True) \
+            .set_mesh(make_mesh(n_data=4, n_model=2)) \
+            .optimize((X, y), w0)
+    with pytest.raises(ValueError, match="alternative"):
+        GradientDescent().set_streamed_stats(True) \
+            .set_host_streaming(True).optimize((X, y), w0)
+    with pytest.raises(NotImplementedError, match="sliced"):
+        GradientDescent().set_streamed_stats(True) \
+            .set_mini_batch_fraction(0.5).optimize((X, y), w0)
+
+
+def test_streamed_stats_matches_manual_virtual_run(rng):
+    """set_streamed_stats must reproduce the manual build_streamed +
+    GramData-input flow exactly (same build, same aligned windows)."""
+    from tpu_sgd import GradientDescent, SimpleUpdater
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    n, d = 2048, 8
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
+
+    def mk():
+        return (GradientDescent(updater=SimpleUpdater())
+                .set_step_size(0.3).set_num_iterations(25)
+                .set_mini_batch_fraction(0.25).set_sampling("sliced")
+                .set_convergence_tol(0.0).set_seed(5))
+
+    opt1 = mk().set_streamed_stats(True, block_rows=256)
+    w1, h1 = opt1.optimize_with_history((X, y), jnp.zeros((d,)))
+
+    g = GramLeastSquaresGradient.build_streamed(X, y, block_rows=256)
+    opt2 = mk()
+    opt2.set_gradient(g)
+    w2, h2 = opt2.optimize_with_history(
+        (g.data, y[:g.data.shape[0]]), jnp.zeros((d,)))
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_schedule_names_stable():
+    """The public schedule vocabulary (docs, train(schedule=...), bench)
+    must not drift silently."""
+    assert SCHEDULES == ("resident_stock", "resident_gram",
+                         "partial_residency", "host_streamed",
+                         "streamed_virtual_gram")
+
+
+def test_gram_options_rebuild_on_change(rng):
+    """Changing block size between runs on the SAME arrays must rebuild
+    (the identity cache keys on the options too)."""
+    from tpu_sgd import GradientDescent
+
+    X = rng.normal(size=(1024, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    opt = (GradientDescent().set_num_iterations(5)
+           .set_sufficient_stats(True).set_gram_options(block_rows=128))
+    opt.optimize((X, y), jnp.zeros((8,)))
+    g1 = opt._gram_entry[2]
+    assert g1.data.block_rows == 128
+    opt.set_gram_options(block_rows=256)
+    opt.optimize((X, y), jnp.zeros((8,)))
+    g2 = opt._gram_entry[2]
+    assert g2 is not g1 and g2.data.block_rows == 256
+
+
+def test_second_run_replans_on_new_dataset(rng, caplog):
+    """Planner-set flags must not masquerade as manual flags: a second
+    run() on the same algorithm re-plans for the new dataset instead of
+    reusing the stale schedule (review r4 finding)."""
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X1 = rng.normal(size=(256, 8)).astype(np.float32)
+    y1 = rng.normal(size=(256,)).astype(np.float32)
+    X2 = rng.normal(size=(512, 8)).astype(np.float32)
+    y2 = rng.normal(size=(512,)).astype(np.float32)
+    alg = LinearRegressionWithSGD(0.2, 5)
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        alg.run((X1, y1))
+        first = alg.optimizer.last_plan
+        alg.run((X2, y2))
+        second = alg.optimizer.last_plan
+    assert first is not None and second is not None and second is not first
+    assert sum(r.message.startswith("plan: ")
+               for r in caplog.records) == 2

@@ -26,6 +26,11 @@ from tpu_sgd.optimize import (GradientDescent, LBFGS, NormalEquations,
                               OWLQN, Optimizer, run_lbfgs,
                               run_mini_batch_sgd)
 from tpu_sgd.parallel import data_mesh, make_mesh
+# NOTE: the bare `plan` FUNCTION is deliberately not re-exported here —
+# `from tpu_sgd.plan import x` would still work, but the package attribute
+# `tpu_sgd.plan` must keep naming the MODULE (an `import tpu_sgd.plan as m`
+# resolves the package attribute and would get the function instead).
+from tpu_sgd.plan import CostModel, Plan, device_budget, plan_for
 from tpu_sgd.stat import MultivariateStatisticalSummary, col_stats, corr
 
 __version__ = "0.1.0"
@@ -37,6 +42,7 @@ __all__ = (
     + ["GradientDescent", "LBFGS", "NormalEquations", "OWLQN", "Optimizer",
        "run_mini_batch_sgd", "run_lbfgs",
        "data_mesh", "make_mesh",
+       "CostModel", "Plan", "device_budget", "plan_for",
        "Normalizer", "StandardScaler", "StandardScalerModel",
        "RegressionMetrics", "BinaryClassificationMetrics",
        "MulticlassMetrics",

@@ -128,6 +128,7 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
         sampling: str = None,
         host_streaming: bool = False,
         streaming_resident_rows: int = 0,
+        schedule: str = None,
     ):
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -141,6 +142,10 @@ class _BinaryClassifierWithSGD(GeneralizedLinearAlgorithm):
             alg.optimizer.set_host_streaming(
                 True, resident_rows=streaming_resident_rows
             )
+        if schedule is not None:
+            # execution-schedule policy (tpu_sgd/plan.py): "auto" is the
+            # default; a schedule name forces it, "off" disables planning
+            alg.set_schedule(schedule)
         return alg.run(data, initial_weights)
 
 

@@ -104,6 +104,7 @@ class GeneralizedLinearAlgorithm:
         self.validate_data = True
         self.num_features = -1
         self.use_feature_scaling = False
+        self.schedule = "auto"
 
     # -- fluent config, parity with the reference's setters ----------------
     def set_intercept(self, flag: bool):
@@ -130,6 +131,59 @@ class GeneralizedLinearAlgorithm:
     def set_num_features(self, n: int):
         self.num_features = int(n)
         return self
+
+    def set_schedule(self, mode: str):
+        """Execution-schedule policy (``tpu_sgd/plan.py`` — the scheduler
+        analogue of the reference's DAGScheduler + ``cache()``, SURVEY.md
+        §2 #16).  ``"auto"`` (default): when no manual schedule flag is
+        set on the optimizer, ``run`` probes (shape, dtype, gradient
+        family, sampling, free device memory) and picks the measured-best
+        schedule, logging one ``plan: ...`` line on the
+        ``tpu_sgd.plan`` logger.  A schedule name
+        (``resident_stock`` / ``resident_gram`` / ``partial_residency`` /
+        ``host_streamed`` / ``streamed_virtual_gram``) forces that
+        schedule (with a warning when the estimate says it loses).
+        ``"off"``: never plan — the optimizer runs exactly as configured.
+        Manual optimizer flags (``set_host_streaming``,
+        ``set_sufficient_stats``, ``set_streamed_stats``) always win over
+        ``"auto"``."""
+        valid = ("auto", "off")
+        from tpu_sgd.plan import SCHEDULES
+
+        if mode not in valid + SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {valid + SCHEDULES}, got {mode!r}"
+            )
+        self.schedule = mode
+        return self
+
+    def _auto_plan(self, X, y) -> None:
+        """Apply the execution planner per ``set_schedule``; called by
+        ``run`` on the exact matrix the optimizer will see (post scaling
+        and intercept append)."""
+        if self.schedule == "off":
+            return
+        opt = self.optimizer
+        manual = bool(
+            getattr(opt, "host_streaming", False)
+            or getattr(opt, "sufficient_stats", False)
+            or getattr(opt, "streamed_stats", False)
+        )
+        # Flags set by a PREVIOUS plan (last_plan is not None) are the
+        # planner's own and must not block re-planning for a new dataset;
+        # only user-set flags win.
+        if (self.schedule == "auto" and manual
+                and getattr(opt, "last_plan", None) is None):
+            return  # explicit optimizer flags win
+        from tpu_sgd.plan import logger, plan_for
+
+        p = plan_for(
+            opt, X, y,
+            force=None if self.schedule == "auto" else self.schedule,
+        )
+        if p is not None:
+            p.apply(opt)
+            logger.info(p.describe())
 
     # -- hooks -------------------------------------------------------------
     def create_model(self, weights, intercept) -> GeneralizedLinearModel:
@@ -179,10 +233,12 @@ class GeneralizedLinearAlgorithm:
             # SURVEY.md §3.1 intercept prepend/split).
             Xb = append_bias_auto(X)
             w0 = np.concatenate([w0, np.asarray([initial_intercept], np.float32)])
+            self._auto_plan(Xb, y)
             weights = self.optimizer.optimize((Xb, y), w0)
             intercept = float(weights[-1])
             weights = weights[:-1]
         else:
+            self._auto_plan(X, y)
             weights = self.optimizer.optimize((X, y), w0)
             intercept = 0.0
         if scaler is not None:

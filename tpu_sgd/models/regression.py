@@ -82,8 +82,18 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         host_streaming: bool = False,
         streaming_resident_rows: int = 0,
         sufficient_stats: bool = False,
+        schedule: str = None,
     ):
         """Static train() parity with the reference's object methods.
+
+        With no schedule-related arguments, the execution planner
+        (``tpu_sgd/plan.py`` — the DAGScheduler/``cache()`` analogue,
+        SURVEY.md §2 #16) probes (shape, dtype, gradient family, sampling,
+        free device memory) and picks the measured-best schedule
+        automatically, logging one ``plan: ...`` line; ``schedule=`` forces
+        a named schedule ("resident_stock" / "resident_gram" /
+        "partial_residency" / "host_streamed" / "streamed_virtual_gram")
+        or disables planning ("off").
 
         ``mesh``, ``sampling`` and ``host_streaming`` are the TPU-side
         extensions: a device mesh for data parallelism, the mini-batch
@@ -96,7 +106,7 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
         precomputed block-prefix Gram statistics (exact; ~20x on resident
         slabs — see ``GradientDescent.set_sufficient_stats``); it builds
         on the post-intercept-append matrix, so it composes with
-        ``intercept=True``.
+        ``intercept=True``.  Manual flags always win over the planner.
         """
         alg = cls(step_size, num_iterations, reg_param, mini_batch_fraction)
         alg.set_intercept(intercept)
@@ -110,6 +120,8 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
             )
         if sufficient_stats:
             alg.optimizer.set_sufficient_stats(True)
+        if schedule is not None:
+            alg.set_schedule(schedule)
         return alg.run(data, initial_weights)
 
 

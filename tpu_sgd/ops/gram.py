@@ -60,7 +60,7 @@ statistics out of the traced argument.  The optimizer-level
 from __future__ import annotations
 
 import warnings
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -227,6 +227,37 @@ class GramData:
             logical_shape=tuple(meta["logical_shape"]),
             logical_dtype=meta["logical_dtype"],
         )
+
+
+@jax.jit
+def _chunk_prefix(cG, cb, cyy, Gc, bc, yyc):
+    """Inclusive prefix of one chunk's block stats, continued from the
+    running-sum carries (streaming build helper; placement follows the
+    committed inputs, so per-shard builds run on their own devices)."""
+    return (_running_sum(cG, Gc), _running_sum(cb, bc),
+            _running_sum(cyy, yyc))
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _write_prefix(PG, Pb, Pyy, pG, pb, pyy, kb1):
+    """In-place (donated) insert of one chunk's prefix rows into the
+    full stacks at block offset ``kb1``."""
+    return (
+        jax.lax.dynamic_update_slice_in_dim(PG, pG, kb1, 0),
+        jax.lax.dynamic_update_slice_in_dim(Pb, pb, kb1, 0),
+        jax.lax.dynamic_update_slice_in_dim(Pyy, pyy, kb1, 0),
+    )
+
+
+@lru_cache(maxsize=16)
+def _streamed_stats_fn(B, sd_name):
+    """Jitted per-chunk block-stats kernel, memoized per (block size,
+    stats dtype) so the per-shard mesh builder compiles once, not once
+    per shard."""
+    return jax.jit(partial(
+        GramLeastSquaresGradient._block_stats,
+        B=B, stats_dtype=jnp.dtype(sd_name),
+    ))
 
 
 class GramLeastSquaresGradient(LeastSquaresGradient):
@@ -396,53 +427,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         sd = cls._resolve_stats_dtype(data_dtype, stats_dtype)
         chunk_blocks = max(1, int(batch_rows) // B) if batch_rows else 64
         chunk = chunk_blocks * B
-
-        stats_fn = jax.jit(
-            partial(cls._block_stats, B=B, stats_dtype=sd)
-        )
-
-        # Truly streaming assembly: the prefix stack is ONE clean device
-        # allocation, updated in place chunk-by-chunk (donated through
-        # `write`), with a running-sum carry threading the chunks.  An
-        # earlier bulk-assembly version (stack all block stats, concat,
-        # prefix in one program) peaked at ~3x the prefix size and died
-        # RESOURCE_EXHAUSTED at 10Mx1000 on a fragmented 16 GB chip; this
-        # form peaks at prefix + one chunk (~5.5 GB there).
-        @jax.jit
-        def chunk_prefix(cG, cb, cyy, Gc, bc, yyc):
-            return (_running_sum(cG, Gc), _running_sum(cb, bc),
-                    _running_sum(cyy, yyc))
-
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def write(PG, Pb, Pyy, pG, pb, pyy, kb1):
-            return (
-                jax.lax.dynamic_update_slice_in_dim(PG, pG, kb1, 0),
-                jax.lax.dynamic_update_slice_in_dim(Pb, pb, kb1, 0),
-                jax.lax.dynamic_update_slice_in_dim(Pyy, pyy, kb1, 0),
-            )
-
-        PG = jnp.zeros((nbf + 1, d, d), sd)
-        Pb = jnp.zeros((nbf + 1, d), sd)
-        Pyy = jnp.zeros((nbf + 1,), sd)
-        cG = jnp.zeros((d, d), sd)
-        cb = jnp.zeros((d,), sd)
-        cyy = jnp.zeros((), sd)
-        s = 0
-        while s < nbf * B:
-            e = min(s + chunk, nbf * B)
-            if (e - s) % B:  # last partial chunk: shrink to whole blocks
-                e = s + ((e - s) // B) * B
-            Xc = jax.device_put(Xh[s:e])
-            # y rides at the RESOLVED stats dtype (>= f32): f64 data under
-            # jax_enable_x64 keeps f64 b/yy statistics, matching the
-            # resident build()'s _resolve_stats_dtype contract.
-            yc = jax.device_put(np.asarray(yh[s:e], np.dtype(sd)))
-            Gc, bc, yyc = stats_fn(Xc, yc)
-            pG, pb, pyy = chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
-            cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
-            PG, Pb, Pyy = write(PG, Pb, Pyy, pG, pb, pyy,
-                                jnp.asarray(s // B + 1, jnp.int32))
-            s = e
+        PG, Pb, Pyy = cls._streamed_prefix(Xh, yh, B, sd, chunk)
         jax.block_until_ready((PG, Pb, Pyy))
         data = GramData(
             None, PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1], B,
@@ -450,6 +435,53 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
             logical_dtype=data_dtype,
         )
         return cls(data)
+
+    @classmethod
+    def _streamed_prefix(cls, Xh, yh, B, sd, chunk, device=None):
+        """Chunked host->device streaming prefix build on ``device``
+        (default placement when None) — shared by :meth:`build_streamed`
+        and the per-shard mesh builder (``parallel/gram_parallel.py``).
+
+        Truly streaming assembly: the prefix stack is ONE clean device
+        allocation, updated in place chunk-by-chunk (donated through
+        ``_write_prefix``), with a running-sum carry threading the chunks.
+        An earlier bulk-assembly version (stack all block stats, concat,
+        prefix in one program) peaked at ~3x the prefix size and died
+        RESOURCE_EXHAUSTED at 10Mx1000 on a fragmented 16 GB chip; this
+        form peaks at prefix + one chunk (~5.5 GB there)."""
+        import numpy as np
+
+        n_used = (Xh.shape[0] // B) * B
+        nbf = n_used // B
+        d = Xh.shape[1]
+        stats_fn = _streamed_stats_fn(B, jnp.dtype(sd).name)
+
+        def put(a):
+            return jax.device_put(a, device)
+
+        PG = put(jnp.zeros((nbf + 1, d, d), sd))
+        Pb = put(jnp.zeros((nbf + 1, d), sd))
+        Pyy = put(jnp.zeros((nbf + 1,), sd))
+        cG = put(jnp.zeros((d, d), sd))
+        cb = put(jnp.zeros((d,), sd))
+        cyy = put(jnp.zeros((), sd))
+        s = 0
+        while s < n_used:
+            e = min(s + chunk, n_used)
+            if (e - s) % B:  # last partial chunk: shrink to whole blocks
+                e = s + ((e - s) // B) * B
+            Xc = put(Xh[s:e])
+            # y rides at the RESOLVED stats dtype (>= f32): f64 data under
+            # jax_enable_x64 keeps f64 b/yy statistics, matching the
+            # resident build()'s _resolve_stats_dtype contract.
+            yc = put(np.asarray(yh[s:e], np.dtype(sd)))
+            Gc, bc, yyc = stats_fn(Xc, yc)
+            pG, pb, pyy = _chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
+            cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
+            PG, Pb, Pyy = _write_prefix(PG, Pb, Pyy, pG, pb, pyy,
+                                        jnp.asarray(s // B + 1, jnp.int32))
+            s = e
+        return PG, Pb, Pyy
 
     # -- binding check -----------------------------------------------------
     def _stats_for(self, X, mask_or_valid, margin_axis_name):

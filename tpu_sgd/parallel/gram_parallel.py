@@ -88,6 +88,7 @@ def dp_gram_run_fn(
     config: SGDConfig,
     mesh,
     block_rows: int,
+    aligned: bool = False,
 ):
     """Jitted shard_map'ed full-loop runner over per-shard Gram stats.
 
@@ -95,11 +96,13 @@ def dp_gram_run_fn(
     :class:`GramLeastSquaresGradient` executor (least-squares semantics);
     each shard reconstructs its local ``GramData`` from the stacked stats
     leaves, so the accelerated window path runs per shard and only the
-    (grad, loss, count) psums ride the ICI."""
+    (grad, loss, count) psums ride the ICI.  ``aligned`` floors per-shard
+    window starts to block boundaries (edge corrections skipped — the
+    documented sampling deviation; see ``set_gram_options``)."""
     from tpu_sgd.optimize.gradient_descent import make_run
 
-    run = make_run(GramLeastSquaresGradient(), updater, config,
-                   axis_name=DATA_AXIS)
+    run = make_run(GramLeastSquaresGradient(aligned=aligned), updater,
+                   config, axis_name=DATA_AXIS)
 
     def body(w, Xl, yl, PG, Pb, Pyy, Gt, bt, yyt):
         gd = GramData(Xl, PG[0], Pb[0], Pyy[0], Gt[0], bt[0], yyt[0],
@@ -107,5 +110,113 @@ def dp_gram_run_fn(
         return run(w, gd, yl, None)
 
     in_specs = (P(), P(DATA_AXIS, None), P(DATA_AXIS)) + _STATS_SPECS
+    out_specs = (P(), P(), P())
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
+def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = 8192,
+                                      batch_rows=None):
+    """Per-shard VIRTUAL statistics from HOST-resident rows — the
+    beyond-HBM statistics build composed with the data mesh (config 4's
+    literal "8-way data-parallel" shape at full 10M×1000 scale,
+    BASELINE.json:10; the treeAggregate-over-partitions analogue,
+    SURVEY.md §3.5).
+
+    Each shard's host row slice streams chunk-by-chunk to ITS OWN device
+    (``GramLeastSquaresGradient._streamed_prefix`` with per-device
+    placement), so no device ever holds more than one chunk of rows plus
+    its own prefix stack; the per-shard stacks are then assembled into
+    globally-sharded stats arrays via
+    ``jax.make_array_from_single_device_arrays`` — zero cross-device row
+    movement, zero host-side concatenation.
+
+    Rows are split evenly: shard ``i`` owns host rows
+    ``[i*n_local, i*n_local + nbf*B)`` where ``n_local = n // k`` — the
+    ``n % k`` remainder plus each shard's ``n_local % B`` tail are dropped
+    (the same block-truncation deviation as the single-device
+    ``build_streamed``, <0.1% of rows at scale).  Single-process only
+    (every mesh device must be addressable); on a multi-host pod each
+    process would run this over its local shard slice.
+
+    Returns ``(stats_leaves, B, n_used_local)``.
+    """
+    import numpy as np
+
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+    from jax.sharding import NamedSharding
+
+    k = mesh.shape[DATA_AXIS]
+    if set(mesh.shape) != {DATA_AXIS}:
+        raise NotImplementedError(
+            "streamed statistics compose with a 1-D 'data' mesh; "
+            f"got axes {tuple(mesh.shape)}"
+        )
+    n, d = Xh.shape
+    n_local = n // k
+    if n_local < 1:
+        raise ValueError(f"{n} rows cannot shard {k} ways")
+    B = max(1, min(int(block_rows), n_local))
+    nbf = n_local // B
+    n_used = nbf * B
+    data_dtype = (Xh.dtype if jnp.issubdtype(Xh.dtype, jnp.inexact)
+                  else jnp.float32)
+    sd = GramLeastSquaresGradient._resolve_stats_dtype(data_dtype, None)
+    chunk_blocks = max(1, int(batch_rows) // B) if batch_rows else 64
+    chunk = chunk_blocks * B
+
+    devices = list(mesh.devices.reshape(-1))
+    per_dev = []
+    for i, dev in enumerate(devices):
+        s = i * n_local
+        PG, Pb, Pyy = GramLeastSquaresGradient._streamed_prefix(
+            Xh[s:s + n_used], np.asarray(yh[s:s + n_used]), B, sd, chunk,
+            device=dev,
+        )
+        per_dev.append((PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1]))
+    jax.block_until_ready(per_dev)
+
+    shapes = ((nbf + 1, d, d), (nbf + 1, d), (nbf + 1,),
+              (d, d), (d,), ())
+    leaves = []
+    for leaf_i, (shape, spec) in enumerate(zip(shapes, _STATS_SPECS)):
+        bufs = [
+            jax.device_put(per_dev[i][leaf_i][None], devices[i])
+            for i in range(k)
+        ]
+        leaves.append(jax.make_array_from_single_device_arrays(
+            (k,) + shape, NamedSharding(mesh, spec), bufs
+        ))
+    return tuple(leaves), B, n_used
+
+
+def dp_virtual_gram_run_fn(
+    updater: Updater,
+    config: SGDConfig,
+    mesh,
+    block_rows: int,
+    n_local: int,
+    d: int,
+    data_dtype_name: str,
+):
+    """Jitted shard_map'ed full-loop runner over per-shard VIRTUAL stats
+    (no rows on device at all): each shard reconstructs a rows-free
+    ``GramData`` carrying its logical ``(n_local, d)`` shape, so windows
+    run block-aligned from the prefix stacks and only the (grad, loss,
+    count) psums ride the ICI.  Signature:
+    ``fn(w0, yd, *stats_leaves) -> (w, losses, n_rec)`` (``yd`` is the
+    tiny label vector, sharded for shape parity — the virtual window path
+    never reads it)."""
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    run = make_run(GramLeastSquaresGradient(), updater, config,
+                   axis_name=DATA_AXIS)
+
+    def body(w, yl, PG, Pb, Pyy, Gt, bt, yyt):
+        gd = GramData(None, PG[0], Pb[0], Pyy[0], Gt[0], bt[0], yyt[0],
+                      block_rows, logical_shape=(n_local, d),
+                      logical_dtype=data_dtype_name)
+        return run(w, gd, yl, None)
+
+    in_specs = (P(), P(DATA_AXIS)) + _STATS_SPECS
     out_specs = (P(), P(), P())
     return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
