@@ -98,3 +98,182 @@ def test_streaming_logistic():
     acc = np.mean(np.asarray(alg.latest_model().predict(Xt)) == yt)
     bayes = np.mean((Xt @ w_true > 0).astype(np.float32) == yt)
     assert acc > bayes - 0.03
+
+
+# ---- driver recovery: checkpoint / resume (SURVEY.md §5.4c) ---------------
+
+def _replayable_stream(d=12, batches=10, rows=500):
+    w_true = np.linspace(-1, 1, d).astype(np.float32)
+    out = []
+    for i in range(batches):
+        r = np.random.default_rng(100 + i)
+        X = r.normal(size=(rows, d)).astype(np.float32)
+        y = (X @ w_true + 0.05 * r.normal(size=rows)).astype(np.float32)
+        out.append((X, y))
+    return out, w_true
+
+
+def test_streaming_checkpoint_resume_reproduces_run(tmp_path):
+    """Kill the stream after batch j, resume from the checkpoint directory,
+    replay: weights AND loss history must equal the uninterrupted run's
+    bitwise (each micro-batch update is deterministic in (warm weights,
+    batch))."""
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    stream, w_true = _replayable_stream()
+    kwargs = dict(step_size=0.3, num_iterations=20)
+
+    full = StreamingLinearRegressionWithSGD(**kwargs)
+    full.set_initial_weights(np.zeros(12, np.float32))
+    full.set_checkpoint(str(tmp_path / "full"), every=1)
+    full.train_on(stream)
+
+    # interrupted driver: consumes only the first 4 batches, then "dies"
+    part = StreamingLinearRegressionWithSGD(**kwargs)
+    part.set_initial_weights(np.zeros(12, np.float32))
+    part.set_checkpoint(str(tmp_path / "resume"), every=1)
+    part.train_on(stream[:4])
+    del part
+
+    # restarted driver: resume + replay the SAME stream from the start
+    res = StreamingLinearRegressionWithSGD.resume_from(
+        str(tmp_path / "resume"), **kwargs)
+    assert res._batch_count == 4
+    res.train_on(stream)
+    assert res._batch_count == 10
+
+    np.testing.assert_array_equal(
+        np.asarray(res.latest_model().weights),
+        np.asarray(full.latest_model().weights))
+    assert res.latest_model().intercept == full.latest_model().intercept
+    np.testing.assert_array_equal(np.asarray(res.loss_history),
+                                  np.asarray(full.loss_history))
+    assert len(res.loss_history) == 10
+
+
+def test_streaming_resume_preserves_intercept(tmp_path):
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    stream, _ = _replayable_stream(batches=3)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=10)
+    alg.algorithm.set_intercept(True)
+    alg.set_initial_weights(np.zeros(12, np.float32), intercept=0.5)
+    alg.set_checkpoint(str(tmp_path), every=1)
+    alg.train_on(stream)
+    want = alg.latest_model().intercept
+
+    res = StreamingLinearRegressionWithSGD.resume_from(
+        str(tmp_path), step_size=0.3, num_iterations=10)
+    res.algorithm.set_intercept(True)
+    assert res.latest_model().intercept == want
+
+
+def test_streaming_resume_empty_dir_raises(tmp_path):
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        StreamingLinearRegressionWithSGD.resume_from(str(tmp_path / "x"))
+
+
+def test_streaming_checkpoint_every_k(tmp_path):
+    import glob as _glob
+
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+
+    stream, _ = _replayable_stream(batches=6)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(12, np.float32))
+    alg.set_checkpoint(CheckpointManager(str(tmp_path), keep=10), every=2)
+    alg.train_on(stream)
+    files = sorted(_glob.glob(str(tmp_path / "ckpt_*.npz")))
+    # every=2 over 6 batches -> checkpoints at batch 2, 4, 6
+    assert [int(f[-12:-4]) for f in files] == [2, 4, 6]
+
+
+def test_streaming_resume_live_stream_skip_zero(tmp_path):
+    """A live stream yields only NEW batches: skip=0 must train them all
+    instead of dropping the first batch_count."""
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    stream, _ = _replayable_stream(batches=6)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(12, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    alg.train_on(stream[:3])
+
+    res = StreamingLinearRegressionWithSGD.resume_from(
+        str(tmp_path), step_size=0.3, num_iterations=5)
+    res.train_on(stream[3:], skip=0)  # live continuation
+    assert res._batch_count == 6
+    # and the result matches the replayed-resume path on the same data
+    res2 = StreamingLinearRegressionWithSGD.resume_from(
+        str(tmp_path), step_size=0.3, num_iterations=5)
+    res2.train_on(stream)  # replay: default skip drops first 3
+    np.testing.assert_array_equal(
+        np.asarray(res.latest_model().weights),
+        np.asarray(res2.latest_model().weights))
+
+
+def test_streaming_resume_empty_batches_stay_aligned(tmp_path):
+    """An empty micro-batch advances the stream position (no update), so
+    a resumed replay's skip cannot double-train the batch after it
+    (review r4 finding)."""
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+
+    stream, _ = _replayable_stream(batches=5)
+    d = stream[0][0].shape[1]
+    empty = (np.zeros((0, d), np.float32), np.zeros((0,), np.float32))
+    stream = [stream[0], empty] + stream[1:]  # empty at position 1
+    kwargs = dict(step_size=0.3, num_iterations=10)
+
+    full = StreamingLinearRegressionWithSGD(**kwargs)
+    full.set_initial_weights(np.zeros(d, np.float32))
+    full.train_on(stream)
+
+    part = StreamingLinearRegressionWithSGD(**kwargs)
+    part.set_initial_weights(np.zeros(d, np.float32))
+    part.set_checkpoint(str(tmp_path), every=1)
+    part.train_on(stream[:3])  # consumes batch0, empty, batch1
+    assert part._batch_count == 3  # stream POSITION, empties included
+
+    res = StreamingLinearRegressionWithSGD.resume_from(str(tmp_path),
+                                                       **kwargs)
+    res.train_on(stream)
+    np.testing.assert_array_equal(
+        np.asarray(res.latest_model().weights),
+        np.asarray(full.latest_model().weights))
+    np.testing.assert_array_equal(np.asarray(res.loss_history),
+                                  np.asarray(full.loss_history))
+
+
+def test_streaming_resume_rejects_non_streaming_checkpoint(tmp_path):
+    from tpu_sgd.models.streaming import StreamingLinearRegressionWithSGD
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+
+    CheckpointManager(str(tmp_path)).save(
+        5, np.zeros(4, np.float32), 0.0, np.zeros(5), config_key="sgd:cfg")
+    with pytest.raises(ValueError, match="non-streaming checkpoint"):
+        StreamingLinearRegressionWithSGD.resume_from(str(tmp_path))
+
+
+def test_streaming_resume_family_mismatch_warns(tmp_path):
+    import warnings as _warnings
+
+    from tpu_sgd.models.streaming import (
+        StreamingLinearRegressionWithSGD,
+        StreamingLogisticRegressionWithSGD,
+    )
+
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(6, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    X = np.random.default_rng(0).normal(size=(64, 6)).astype(np.float32)
+    y = (X @ np.ones(6, np.float32)).astype(np.float32)
+    alg.train_on_batch(X, y)
+
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        StreamingLogisticRegressionWithSGD.resume_from(str(tmp_path))
+    assert any("construct the same streaming" in str(r.message)
+               for r in rec)

@@ -8,6 +8,16 @@ micro-batch, warm-started with the latest weights — there is no separate
 online-SGD code path.  The TPU build reuses the batch step the same way
 (config 5, BASELINE.json:11): a "DStream" is any iterator of ``(X, y)``
 micro-batches, and ``train_on`` folds the model through it.
+
+Driver recovery (SURVEY.md §5.4c): the reference rides DStream
+checkpointing — a restarted driver resumes from the latest model and
+stream position.  The analogue here is ``set_checkpoint`` (persist the
+latest model + batch index every K micro-batches through the shared
+``CheckpointManager``) and ``resume_from`` (reconstruct the algorithm
+mid-stream from the newest checkpoint); with a replayable stream the
+resumed run reproduces the uninterrupted run's weights and loss history
+exactly, because each micro-batch update is deterministic in
+``(warm-start weights, batch)``.
 """
 
 from __future__ import annotations
@@ -30,6 +40,10 @@ class StreamingLinearAlgorithm:
         self.algorithm = algorithm
         self.model: Optional[GeneralizedLinearModel] = None
         self._batch_count = 0
+        self.loss_history: list = []
+        self.checkpoint_manager = None
+        self.checkpoint_every = 1
+        self._resume_skip = 0
 
     def latest_model(self) -> GeneralizedLinearModel:
         if self.model is None:
@@ -45,22 +59,124 @@ class StreamingLinearAlgorithm:
         )
         return self
 
+    def set_checkpoint(self, manager_or_directory, every: int = 1):
+        """Persist (latest model, batch index, cumulative loss history)
+        every ``every`` micro-batches — the DStream-checkpointing analogue
+        (SURVEY.md §5.4c): kill the driver mid-stream and
+        :meth:`resume_from` restarts from the newest checkpoint.  Accepts
+        a ``CheckpointManager`` or a directory path."""
+        from tpu_sgd.utils.checkpoint import CheckpointManager
+
+        if isinstance(manager_or_directory, str):
+            manager_or_directory = CheckpointManager(manager_or_directory)
+        self.checkpoint_manager = manager_or_directory
+        self.checkpoint_every = max(1, int(every))
+        return self
+
+    @classmethod
+    def resume_from(cls, directory: str, every: int = 1, **init_kwargs):
+        """Reconstruct a streaming algorithm mid-stream from the newest
+        checkpoint in ``directory`` (written by :meth:`set_checkpoint`):
+        latest model, batch index, and loss history are restored, and
+        checkpointing continues into the same directory.  Construct with
+        the SAME hyper-parameters as the interrupted run
+        (``init_kwargs``) — they are not stored in the checkpoint.
+
+        With a stream replayed from the beginning, the next
+        :meth:`train_on` skips the already-consumed micro-batches and the
+        run reproduces the uninterrupted weights/history exactly; a LIVE
+        stream that only yields new batches should be consumed with
+        ``train_on(stream, skip=0)``."""
+        from tpu_sgd.utils.checkpoint import CheckpointManager
+
+        import warnings
+
+        self = cls(**init_kwargs)
+        manager = CheckpointManager(directory)
+        ck = manager.restore()
+        if ck is None:
+            raise FileNotFoundError(
+                f"no checkpoint to resume from in {directory!r}"
+            )
+        if "intercept" not in ck["extras"]:
+            raise ValueError(
+                f"{directory!r} holds a non-streaming checkpoint "
+                f"(config_key={ck['config_key']!r}); streaming resume "
+                "needs one written by set_checkpoint"
+            )
+        expect_key = f"stream:{type(self.algorithm).__name__}"
+        if ck["config_key"] != expect_key:
+            warnings.warn(
+                f"resuming a checkpoint written by {ck['config_key']!r} "
+                f"with {expect_key!r} — construct the same streaming "
+                "family/hyper-parameters as the interrupted run",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        self.set_checkpoint(manager, every=every)
+        self.model = self.algorithm.create_model(
+            ck["weights"], float(ck["extras"]["intercept"])
+        )
+        self._batch_count = int(ck["iteration"])
+        self.loss_history = [float(v) for v in ck["loss_history"]]
+        self._resume_skip = self._batch_count
+        return self
+
+    def _maybe_checkpoint(self):
+        if (self.checkpoint_manager is not None
+                and self.model is not None
+                and self._batch_count % self.checkpoint_every == 0):
+            m = self.model
+            self.checkpoint_manager.save(
+                self._batch_count,  # = batches consumed (stream position)
+                np.asarray(m.weights),
+                0.0,
+                np.asarray(self.loss_history, np.float64),
+                config_key=f"stream:{type(self.algorithm).__name__}",
+                extras={
+                    "intercept": np.asarray(m.intercept, np.float64),
+                },
+            )
+
     def train_on_batch(self, X, y) -> GeneralizedLinearModel:
         """One micro-batch update (the body of the reference's foreachRDD);
-        accepts dense or sparse (BCOO) feature batches."""
+        accepts dense or sparse (BCOO) feature batches.  EVERY batch —
+        including an empty one, whose update is skipped like the
+        reference skips empty RDDs — advances ``_batch_count``, so the
+        count is the STREAM POSITION and a resumed replay's skip stays
+        aligned with the consumed prefix."""
         from tpu_sgd.ops.sparse import is_sparse
 
         if not is_sparse(X):
             X = np.asarray(X)
-        if X.shape[0] == 0:  # reference skips empty RDDs
+        if X.shape[0] == 0:  # reference skips empty RDDs (no update)
+            self._batch_count += 1
+            self._maybe_checkpoint()
             return self.model
         self.model = self.algorithm.run_warm((X, np.asarray(y)), self.model)
         self._batch_count += 1
+        hist = getattr(self.algorithm.optimizer, "loss_history", None)
+        if hist is not None and len(hist):
+            self.loss_history.append(float(hist[-1]))
+        self._maybe_checkpoint()
         return self.model
 
-    def train_on(self, stream: Iterable[Batch]) -> GeneralizedLinearModel:
-        """Consume an entire stream (parity with ``trainOn(DStream)``)."""
-        for X, y in stream:
+    def train_on(self, stream: Iterable[Batch],
+                 skip: Optional[int] = None) -> GeneralizedLinearModel:
+        """Consume an entire stream (parity with ``trainOn(DStream)``).
+
+        ``skip``: leading micro-batches to drop before training — defaults
+        to the number already consumed when this instance was resumed via
+        :meth:`resume_from` (so a stream replayed from the beginning
+        continues where the interrupted run stopped); pass ``0`` for a
+        live stream that only yields new batches.  The resume skip is
+        consumed by the first ``train_on`` call."""
+        if skip is None:
+            skip = self._resume_skip
+        self._resume_skip = 0
+        for i, (X, y) in enumerate(stream):
+            if i < skip:
+                continue
             self.train_on_batch(X, y)
         return self.model
 

@@ -39,7 +39,12 @@ class CheckpointManager:
         reg_val: float,
         loss_history,
         config_key: str = "",
+        extras: Optional[dict] = None,
     ) -> str:
+        """``extras``: optional named arrays saved alongside the core
+        state (``x_``-prefixed in the npz so they can never collide with
+        the versioned schema) — the streaming driver persists its
+        ``intercept``/``batch_count`` through this."""
         path = self._path(iteration)
         # Temp prefix must NOT match the ckpt_*.npz glob, or a truncated
         # file left by a crash mid-write would be picked up by latest_path.
@@ -52,6 +57,7 @@ class CheckpointManager:
             reg_val=np.asarray(reg_val, np.float64),
             loss_history=np.asarray(loss_history, np.float64),
             config_key=np.asarray(config_key),
+            **{f"x_{k}": np.asarray(v) for k, v in (extras or {}).items()},
         )
         os.replace(tmp, path)
         self._prune()
@@ -80,4 +86,7 @@ class CheckpointManager:
                 "reg_val": float(z["reg_val"]),
                 "loss_history": z["loss_history"],
                 "config_key": str(z["config_key"]),
+                "extras": {
+                    k[2:]: z[k] for k in z.files if k.startswith("x_")
+                },
             }
