@@ -65,10 +65,13 @@ class StreamingLinearAlgorithm:
         (SURVEY.md §5.4c): kill the driver mid-stream and
         :meth:`resume_from` restarts from the newest checkpoint.  Accepts
         a ``CheckpointManager`` or a directory path."""
+        import os
+
         from tpu_sgd.utils.checkpoint import CheckpointManager
 
-        if isinstance(manager_or_directory, str):
-            manager_or_directory = CheckpointManager(manager_or_directory)
+        if isinstance(manager_or_directory, (str, os.PathLike)):
+            manager_or_directory = CheckpointManager(
+                str(manager_or_directory))
         self.checkpoint_manager = manager_or_directory
         self.checkpoint_every = max(1, int(every))
         return self

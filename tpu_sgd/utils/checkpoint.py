@@ -44,7 +44,8 @@ class CheckpointManager:
         """``extras``: optional named arrays saved alongside the core
         state (``x_``-prefixed in the npz so they can never collide with
         the versioned schema) — the streaming driver persists its
-        ``intercept``/``batch_count`` through this."""
+        ``intercept`` through this (its stream position rides the core
+        ``iteration`` field)."""
         path = self._path(iteration)
         # Temp prefix must NOT match the ckpt_*.npz glob, or a truncated
         # file left by a crash mid-write would be picked up by latest_path.
