@@ -89,24 +89,27 @@ def fit_steady_state(points):
         "iters": [int(i) for i in its],
         "wall_s": [round(float(w), 4) for w in walls],
     }
+    # record the TRUE lstsq line first (even when the fallback replaces
+    # the reported numbers): the artifact must always show what was fitted
+    fit["slope_fitted_ms"] = round(float(slope) * 1e3, 5)
+    fit["fixed_s_fitted"] = round(float(fixed), 4)
     if slope <= 0:
+        # jitter-inverted fit: report the longest run's launch-cost-
+        # inclusive mean; residuals are vs that reported line, and no
+        # error bar is claimed (there is no fitted slope to put one on)
         slope = walls[-1] / its[-1]
         fixed = 0.0
         fit["fallback"] = "non-positive fitted slope; longest-run mean"
-    # the fit dict records the UNCLAMPED intercept its residuals belong
-    # to; the returned fixed is clamped to 0 for reporting
-    fit["fixed_s_fitted"] = round(float(fixed), 4)
     resid = walls - (fixed + slope * its)
     fit["residual_ms"] = [round(float(r) * 1e3, 2) for r in resid]
     # slope standard error (per-point jitter propagated through the fit);
-    # meaningful for >= 3 points, recorded as a fraction of the slope
+    # meaningful for >= 3 genuinely fitted points
     n = len(pts)
-    if n >= 3:
+    if n >= 3 and "fallback" not in fit:
         dof = n - 2
         s2 = float(resid @ resid) / dof
         var_slope = s2 / float(((its - its.mean()) ** 2).sum())
-        fit["slope_rel_err"] = round(
-            float(np.sqrt(var_slope)) / slope, 4) if slope > 0 else None
+        fit["slope_rel_err"] = round(float(np.sqrt(var_slope)) / slope, 4)
     return float(slope), max(float(fixed), 0.0), fit
 
 
@@ -879,6 +882,7 @@ def main():
             "matched": matched,
             "steady_state_iter_ms": tpu.get("steady_state_iter_ms"),
             "fixed_launch_ms": tpu.get("fixed_launch_ms"),
+            "xla_fit": tpu.get("xla_fit"),
             "pallas": tpu.get("pallas"),
             "chunked": tpu.get("chunked"),
             "gram": tpu.get("gram"),
