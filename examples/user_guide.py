@@ -114,6 +114,22 @@ def main():
     print(f"5c. streamed stats (virtual rows): |w_v - w| = {drift_v:.2e} "
           "(block-aligned windows)")
 
+    # --- 5d. Execution planning (round 4): train() picks the schedule ----
+    # With zero schedule flags the planner (tpu_sgd/plan.py — the
+    # DAGScheduler/cache() analogue) probes shape/dtype/sampling/free HBM
+    # and picks resident/gram/partial/streamed itself, logging one
+    # "plan: ..." line; schedule="..." forces one, manual flags always win.
+    alg = LinearRegressionWithSGD(0.5, 80)
+    alg.run((X, y))
+    lp = alg.optimizer.last_plan
+    from tpu_sgd.plan import plan as plan_fn
+
+    big = plan_fn(10_000_000, 1000, itemsize=2, gram_able=True,
+                  sampling="sliced", mini_batch_fraction=0.1,
+                  num_iterations=1000, free_hbm=12e9)
+    print(f"5d. auto-plan here: {lp.schedule}; the 10Mx1000 config-4 "
+          f"shape would plan: {big.schedule}")
+
     # --- 6. Classify + evaluate (BinaryClassificationMetrics) ------------
     Xc, yc, _ = logistic_data(4_000, 15, seed=5)
     clf = LogisticRegressionWithSGD.train((Xc, yc), num_iterations=60)
@@ -148,6 +164,25 @@ def main():
         np.asarray(stream.latest_model().weights) - w_true
     ).max())
     print(f"8. streaming: w_err {w_err:.3f} after 5 micro-batches")
+
+    # --- 8b. Streaming driver recovery (round 4): checkpoint + resume ----
+    # The DStream-checkpointing analogue: persist (model, stream position)
+    # every K micro-batches; a restarted driver resumes mid-stream and a
+    # replayed stream reproduces the uninterrupted run bitwise.
+    ckdir = os.path.join(tmp, "stream_ck")
+    batches = [(X[t * 1000:(t + 1) * 1000], y[t * 1000:(t + 1) * 1000])
+               for t in range(5)]
+    s1 = StreamingLinearRegressionWithSGD(
+        step_size=0.5, num_iterations=20
+    ).set_initial_weights(np.zeros(20, np.float32)).set_checkpoint(ckdir)
+    s1.train_on(batches[:3])  # ... driver "dies" here ...
+    s2 = StreamingLinearRegressionWithSGD.resume_from(
+        ckdir, step_size=0.5, num_iterations=20)
+    s2.train_on(batches)  # replay: already-consumed batches are skipped
+    match = np.array_equal(np.asarray(s2.latest_model().weights),
+                           np.asarray(stream.latest_model().weights))
+    print(f"8b. resumed mid-stream at batch {3}; replay reproduces the "
+          f"uninterrupted run: {match}")
     print("user guide complete")
 
 

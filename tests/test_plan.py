@@ -419,3 +419,160 @@ def test_second_run_replans_on_new_dataset(rng, caplog):
     assert first is not None and second is not None and second is not first
     assert sum(r.message.startswith("plan: ")
                for r in caplog.records) == 2
+
+
+# ---- quasi-Newton planning (round 4 extension) ---------------------------
+
+class _ShapeOnly:
+    """Shape/dtype carrier for boundary tests — np.shape reads .shape
+    without materializing, so huge logical datasets cost nothing here."""
+
+    def __init__(self, shape, dtype=np.float32):
+        self.shape = shape
+        self.dtype = np.dtype(dtype)
+
+
+def test_plan_quasi_newton_boundaries():
+    from tpu_sgd import LBFGS, plan_quasi_newton
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    y = None  # unused by the decision
+
+    # big resident least squares: ~4 full passes/iter -> gram amortizes
+    big = _ShapeOnly((3_000_000, 1000), np.float16)  # 2-byte rows
+    p = plan_quasi_newton(LBFGS(), big, y, free_hbm=12 * GB)
+    assert p.schedule == "resident_gram"
+    assert p.block_rows is not None
+    assert p.estimates["build_amortize_iters"] < 100
+
+    # small data: build overhead dominates -> stock
+    small = _ShapeOnly((10_000, 50))
+    p = plan_quasi_newton(LBFGS(), small, y, free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+    assert "amortize" in p.reason
+
+    # beyond HBM: quasi-Newton has no streaming schedule -> stock + hint
+    huge = _ShapeOnly((100_000_000, 1000), np.float16)
+    p = plan_quasi_newton(LBFGS(), huge, y, free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+    assert "build_streamed" in p.reason
+
+    # non-least-squares gradient: nothing to plan
+    assert plan_quasi_newton(LBFGS(LogisticGradient()), big, y,
+                             free_hbm=12 * GB) is None
+
+    # streaming schedules cannot be forced behind LBFGS
+    with pytest.raises(ValueError, match="does not exist behind"):
+        plan_quasi_newton(LBFGS(), big, y, free_hbm=12 * GB,
+                          force="host_streamed")
+
+    # forcing gram on a short run warns
+    opt = LBFGS(max_num_iterations=3)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        p = plan_quasi_newton(opt, big, y, free_hbm=12 * GB,
+                              force="resident_gram")
+    assert p.schedule == "resident_gram"
+    assert any("NET LOSS" in str(r.message) for r in rec)
+
+
+def test_lbfgs_train_auto_plans_and_forced_gram(rng, caplog):
+    from tpu_sgd import LinearRegressionWithLBFGS
+
+    X = rng.normal(size=(2048, 12)).astype(np.float32)
+    w = rng.uniform(-1, 1, 12).astype(np.float32)
+    y = (X @ w + 0.01 * rng.normal(size=2048)).astype(np.float32)
+
+    # zero flags: small data -> stock, but the plan ran and logged
+    alg = LinearRegressionWithLBFGS()
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        m0 = alg.run((X, y))
+    assert alg.optimizer.last_plan is not None
+    assert alg.optimizer.last_plan.schedule == "resident_stock"
+    assert not alg.optimizer.sufficient_stats
+    assert any(r.message.startswith("plan: ") for r in caplog.records)
+
+    # forced gram engages the substitution and reproduces the solution
+    alg2 = LinearRegressionWithLBFGS().set_schedule("resident_gram")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        m1 = alg2.run((X, y))
+    assert alg2.optimizer.sufficient_stats
+    assert alg2.optimizer._gram_entry is not None
+    np.testing.assert_allclose(np.asarray(m1.weights),
+                               np.asarray(m0.weights), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_owlqn_forced_gram_plans(rng):
+    from tpu_sgd.models.regression import LassoWithOWLQN
+
+    X = rng.normal(size=(1024, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, 10).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    alg = LassoWithOWLQN(reg_param=1e-4).set_schedule("resident_gram")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        m = alg.run((X, y))
+    assert alg.optimizer.sufficient_stats
+    assert alg.optimizer._gram_entry is not None
+    assert np.all(np.isfinite(np.asarray(m.weights)))
+
+
+def test_manual_flag_after_auto_plan_wins(rng):
+    """A user setter called AFTER an auto-planned run must win on the next
+    run — the setters clear last_plan, so the planner steps aside (review
+    r4 finding: the planner used to clobber the user's choice)."""
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X1 = rng.normal(size=(256, 8)).astype(np.float32)
+    y1 = rng.normal(size=(256,)).astype(np.float32)
+    X2 = rng.normal(size=(300, 8)).astype(np.float32)
+    y2 = rng.normal(size=(300,)).astype(np.float32)
+    alg = LinearRegressionWithSGD(0.2, 5)
+    alg.run((X1, y1))
+    assert alg.optimizer.last_plan is not None  # auto-planned
+    alg.optimizer.set_sufficient_stats(True)    # user takes the wheel
+    assert alg.optimizer.last_plan is None
+    alg.run((X2, y2))
+    assert alg.optimizer.sufficient_stats       # NOT clobbered
+    assert alg.optimizer.last_plan is None      # planner stayed out
+
+
+def test_forced_schedule_on_unplanned_input_raises_clearly(rng):
+    """Forcing a schedule on an input the planner declines (sparse) must
+    raise a clear error, not a quasi-Newton-flavored one."""
+    from tpu_sgd import LinearRegressionWithSGD
+    from tpu_sgd.ops.sparse import sparse_data
+
+    Xs, ys, _ = sparse_data(64, 16, nnz_per_row=4, seed=0)
+    with pytest.raises(ValueError, match="cannot be applied here"):
+        LinearRegressionWithSGD.train((Xs, ys), num_iterations=3,
+                                      schedule="host_streamed")
+
+
+def test_forced_partial_residency_messages():
+    # data fits: accurate "already fits" error
+    with pytest.raises(ValueError, match="already fits"):
+        plan(1000, 8, sampling="sliced", mini_batch_fraction=0.1,
+             free_hbm=1 * GB, force="partial_residency")
+    # beyond HBM but bernoulli: accurate requirements error
+    with pytest.raises(ValueError, match="sliced sampling"):
+        plan(10_000_000, 1000, itemsize=2, sampling="bernoulli",
+             mini_batch_fraction=0.1, free_hbm=1 * GB,
+             force="partial_residency")
+
+
+def test_repeat_runs_skip_replanning(rng, caplog):
+    """Identically-shaped repeat runs (the streaming micro-batch loop)
+    plan once, not per batch."""
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = rng.normal(size=(256,)).astype(np.float32)
+    alg = LinearRegressionWithSGD(0.2, 5)
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        for _ in range(4):
+            alg.run((X, y))
+    assert sum(r.message.startswith("plan: ")
+               for r in caplog.records) == 1

@@ -30,7 +30,8 @@ from tpu_sgd.parallel import data_mesh, make_mesh
 # `from tpu_sgd.plan import x` would still work, but the package attribute
 # `tpu_sgd.plan` must keep naming the MODULE (an `import tpu_sgd.plan as m`
 # resolves the package attribute and would get the function instead).
-from tpu_sgd.plan import CostModel, Plan, device_budget, plan_for
+from tpu_sgd.plan import (CostModel, Plan, device_budget, plan_for,
+                          plan_quasi_newton)
 from tpu_sgd.stat import MultivariateStatisticalSummary, col_stats, corr
 
 __version__ = "0.1.0"
@@ -43,6 +44,7 @@ __all__ = (
        "run_mini_batch_sgd", "run_lbfgs",
        "data_mesh", "make_mesh",
        "CostModel", "Plan", "device_budget", "plan_for",
+       "plan_quasi_newton",
        "Normalizer", "StandardScaler", "StandardScalerModel",
        "RegressionMetrics", "BinaryClassificationMetrics",
        "MulticlassMetrics",

@@ -171,19 +171,49 @@ class GeneralizedLinearAlgorithm:
         )
         # Flags set by a PREVIOUS plan (last_plan is not None) are the
         # planner's own and must not block re-planning for a new dataset;
-        # only user-set flags win.
+        # the manual setters clear last_plan, so user-set flags — whenever
+        # set, including after an auto-planned run — always win.
         if (self.schedule == "auto" and manual
                 and getattr(opt, "last_plan", None) is None):
             return  # explicit optimizer flags win
-        from tpu_sgd.plan import logger, plan_for
+        import numpy as np
 
-        p = plan_for(
-            opt, X, y,
-            force=None if self.schedule == "auto" else self.schedule,
-        )
+        from tpu_sgd.plan import logger, plan_for, plan_quasi_newton
+        from tpu_sgd.optimize.lbfgs import LBFGS as _LBFGS
+
+        force = None if self.schedule == "auto" else self.schedule
+        # Identically-shaped repeat runs (the streaming mode's thousands
+        # of micro-batches) skip the probe + plan + log entirely.
+        key = (np.shape(X), str(getattr(X, "dtype", "")), force,
+               getattr(opt, "config", None), opt.mesh,
+               getattr(opt, "max_num_iterations", None))
+        if (getattr(opt, "last_plan", None) is not None
+                and getattr(opt, "_plan_key", None) == key):
+            return
+        if isinstance(opt, _LBFGS):
+            # quasi-Newton optimizers plan a narrower menu: stock
+            # full-batch passes vs the sufficient-stats substitution
+            p = plan_quasi_newton(opt, X, y, force=force)
+            if p is not None:
+                opt.sufficient_stats = p.schedule == "resident_gram"
+                if p.block_rows and hasattr(opt, "set_gram_options"):
+                    opt.set_gram_options(block_rows=p.block_rows)
+                opt.last_plan = p
+        else:
+            p = plan_for(opt, X, y, force=force)
+            if p is not None:
+                p.apply(opt)
         if p is not None:
-            p.apply(opt)
+            opt._plan_key = key
             logger.info(p.describe())
+        elif force is not None:
+            raise ValueError(
+                f"schedule={force!r} cannot be applied here: this "
+                "optimizer/input is not planned (sparse/BCOO or GramData "
+                "input, a 2-D data x model mesh, or an optimizer without "
+                "schedules) — configure it directly with the optimizer "
+                "setters instead"
+            )
 
     # -- hooks -------------------------------------------------------------
     def create_model(self, weights, intercept) -> GeneralizedLinearModel:

@@ -256,6 +256,7 @@ class GradientDescent(Optimizer):
         self.gram_block_rows = 8192
         self.gram_aligned = False
         self.last_plan = None
+        self._plan_key = None
         self._gram_entry = None
         self._gram_dp_entry = None
         self._streamed_gram_entry = None
@@ -346,7 +347,16 @@ class GradientDescent(Optimizer):
         unchanged window sequence (see ``optimize_host_streamed``)."""
         self.host_streaming = bool(flag)
         self.streaming_resident_rows = int(resident_rows)
+        self._mark_manual_schedule()
         return self
+
+    def _mark_manual_schedule(self):
+        """A user-called schedule setter invalidates any auto-plan: the
+        planner's 'manual flags win' contract keys on ``last_plan is
+        None`` (tpu_sgd/models/glm.py), so clear it (and the repeat-run
+        plan cache key) whenever the user takes the wheel."""
+        self.last_plan = None
+        self._plan_key = None
 
     def set_sufficient_stats(self, flag: bool = True):
         """Execute least-squares via precomputed block-prefix Gram
@@ -365,6 +375,7 @@ class GradientDescent(Optimizer):
         different dataset is passed, the optimizer is dropped, or
         :meth:`release_sufficient_stats` is called."""
         self.sufficient_stats = bool(flag)
+        self._mark_manual_schedule()
         return self
 
     def set_gram_options(self, block_rows: int = None, aligned: bool = None):
@@ -404,6 +415,7 @@ class GradientDescent(Optimizer):
         self.streamed_stats = bool(flag)
         if block_rows is not None:
             self.gram_block_rows = int(block_rows)
+        self._mark_manual_schedule()
         return self
 
     def release_sufficient_stats(self):

@@ -319,6 +319,9 @@ class LBFGS(Optimizer):
         self.reg_param = reg_param
         self.mesh = None
         self.sufficient_stats = False
+        self.gram_block_rows = 8192
+        self.last_plan = None
+        self._plan_key = None
         self._gram_entry = None
         self._loss_history = None
 
@@ -360,6 +363,9 @@ class LBFGS(Optimizer):
         rebuild; call :meth:`release_sufficient_stats` to free the
         dataset plus its prefix stack from HBM after a one-shot run."""
         self.sufficient_stats = bool(flag)
+        # user-set flags invalidate any auto-plan (see glm._auto_plan)
+        self.last_plan = None
+        self._plan_key = None
         return self
 
     def release_sufficient_stats(self):
@@ -367,6 +373,18 @@ class LBFGS(Optimizer):
         dataset plus the GB-scale prefix stack can be freed from HBM
         (``set_sufficient_stats`` retains the last build by design)."""
         self._gram_entry = None
+        return self
+
+    def set_gram_options(self, block_rows: int = None):
+        """Block size of the sufficient-statistics build (prefix-stack
+        memory vs edge traffic — see ``ops/gram.py``; set by the
+        execution planner)."""
+        if block_rows is not None:
+            if int(block_rows) < 1:
+                raise ValueError(
+                    f"block_rows must be positive, got {block_rows}"
+                )
+            self.gram_block_rows = int(block_rows)
         return self
 
     def set_mesh(self, mesh):
@@ -415,11 +433,13 @@ class LBFGS(Optimizer):
                 and not _is_sp(X) and type(gradient) is _LS):
             return gradient, X
         entry = self._gram_entry
-        if entry is not None and entry[0] is X and entry[1] is y:
+        if (entry is not None and entry[0] is X and entry[1] is y
+                and entry[3:] == (self.gram_block_rows,)):
             g = entry[2]
             return g, g.data
-        g = GramLeastSquaresGradient.build(X, y)
-        self._gram_entry = (X, y, g)
+        g = GramLeastSquaresGradient.build(
+            X, y, block_rows=self.gram_block_rows)
+        self._gram_entry = (X, y, g, self.gram_block_rows)
         return g, g.data
 
     def optimize_with_history(self, data: Dataset, initial_weights: Array):

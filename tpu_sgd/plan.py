@@ -420,11 +420,133 @@ def plan(
             estimates=est,
         )
         if force == "partial_residency" and not forced.resident_rows:
+            if fits:
+                raise ValueError(
+                    "partial_residency cannot be forced here: the data "
+                    f"({_fmt_gb(data_bytes_local)}/device) already fits "
+                    "HBM — run resident, or shrink free_hbm to test the "
+                    "beyond-HBM ladder"
+                )
             raise ValueError(
-                "partial_residency cannot be forced here: no rows fit "
-                "the device budget (or sampling is not sliced)"
+                "partial_residency cannot be forced here: it needs "
+                "sliced sampling with mini_batch_fraction < 1 on a "
+                "single device, and at least one window of rows must "
+                f"fit the budget (sampling={sampling!r}, frac={frac}, "
+                f"n_devices={n_devices})"
             )
         return forced
+    return chosen
+
+
+def plan_quasi_newton(optimizer, X, y,
+                      cost_model: Optional[CostModel] = None,
+                      free_hbm: Optional[float] = None,
+                      force: Optional[str] = None) -> Optional[Plan]:
+    """Schedule decision for the quasi-Newton optimizers (LBFGS/OWL-QN):
+    enable the sufficient-statistics substitution when the one-time build
+    amortizes inside ``max_num_iterations``.
+
+    Each quasi-Newton iteration is several FULL-batch passes over ``X``
+    (cost+gradient at the current and accepted points, plus the batched
+    line-search sweep — ~4 row reads), so the break-even comes much
+    earlier than for mini-batch SGD.  Only the resident regime is
+    decided here: beyond-HBM quasi-Newton least squares is the user's
+    explicit ``build_streamed`` + GramData-input flow.  ``force`` accepts
+    ``resident_stock`` / ``resident_gram`` only (the streaming schedules
+    do not exist behind this optimizer)."""
+    import numpy as np
+
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.gram import GramData
+    from tpu_sgd.ops.sparse import is_sparse
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    if (not isinstance(optimizer, LBFGS) or is_sparse(X)
+            or isinstance(X, GramData)
+            or optimizer.mesh is not None
+            or type(optimizer.gradient) is not LeastSquaresGradient):
+        return None
+    if force is not None and force not in ("resident_stock",
+                                           "resident_gram"):
+        raise ValueError(
+            f"schedule {force!r} does not exist behind a quasi-Newton "
+            "optimizer; choose resident_stock or resident_gram (or use "
+            "GramLeastSquaresGradient.build_streamed for beyond-HBM runs)"
+        )
+    shape = np.shape(X)
+    if len(shape) != 2 or shape[0] == 0:
+        return None
+    n, d = (int(shape[0]), int(shape[1]))
+    dt = np.dtype(getattr(X, "dtype", np.float32))
+    itemsize = dt.itemsize if np.issubdtype(dt, np.inexact) else 4
+    cm = cost_model or DEFAULT_COST_MODEL
+    if free_hbm is None:
+        free_hbm, budget_source = device_budget(cost_model=cm)
+    else:
+        budget_source = "caller"
+    iters = int(optimizer.max_num_iterations)
+    data_bytes = n * d * itemsize + n * 4.0
+    est = {
+        "n": n, "d": d, "itemsize": int(itemsize),
+        "free_hbm": float(free_hbm), "budget_source": budget_source,
+        "max_num_iterations": iters,
+    }
+    if data_bytes > free_hbm:
+        return Plan(
+            "resident_stock",
+            f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
+            f"({_fmt_gb(free_hbm)} free); quasi-Newton beyond-HBM runs "
+            "need an explicit build_streamed + GramData-input flow",
+            estimates=est,
+        )
+    B = choose_block_rows(n, d, free_hbm - data_bytes)
+    chosen = None
+    if B is not None:
+        # ~4 full row reads per iteration vs O(d^2) stats matvecs (the
+        # 25-trial sweep's (T,d)x(d,d) matmul reads G once per chunk)
+        stock_iter_s = 4.0 * n * d * itemsize / (cm.hbm_gb_s * 1e9)
+        gram_iter_s = (cm.gram_iter_overhead_s
+                       + 8.0 * d * d * 4.0 / (cm.hbm_gb_s * 1e9))
+        build_s = (cm.build_overhead_s
+                   + n * d * itemsize / (cm.hbm_gb_s * 1e9)
+                   + 2.0 * n * d * d / cm.mxu_f32_flops)
+        saving = stock_iter_s - gram_iter_s
+        amortize = math.inf if saving <= 0 else build_s / saving
+        est.update(block_rows=B, stock_iter_s=stock_iter_s,
+                   gram_iter_s=gram_iter_s, gram_build_s=build_s,
+                   build_amortize_iters=amortize)
+        if amortize <= iters:
+            chosen = Plan(
+                "resident_gram",
+                f"quasi-Newton least squares on a resident "
+                f"({_fmt_gb(data_bytes)}) dataset: full-batch "
+                f"cost/sweep from statistics (B={B}; build amortizes "
+                f"in ~{amortize:.0f} of {iters} iterations)",
+                block_rows=B, estimates=est,
+            )
+        elif force == "resident_gram":
+            warnings.warn(
+                "forced resident_gram is estimated a NET LOSS here: the "
+                f"statistics build (~{build_s:.2f}s) amortizes in "
+                f"~{amortize:.0f} iterations but max_num_iterations is "
+                f"{iters}",
+                RuntimeWarning, stacklevel=3,
+            )
+    if chosen is None:
+        why = f"data ({_fmt_gb(data_bytes)}) fits; stock full-batch passes"
+        if "build_amortize_iters" in est:
+            why += (
+                f" (statistics build would amortize in "
+                f"~{est['build_amortize_iters']:.0f} iters > {iters})"
+            )
+        chosen = Plan("resident_stock", why, estimates=est)
+    if force is not None and force != chosen.schedule:
+        return Plan(
+            force,
+            f"forced by caller (planner would pick {chosen.schedule}: "
+            + chosen.reason + ")",
+            block_rows=est.get("block_rows"), estimates=est,
+        )
     return chosen
 
 
