@@ -120,6 +120,21 @@ def main():
         LeastSquaresGradient(), SimpleUpdater(), max_num_iterations=10
     ).set_mesh(mesh).optimize_with_history((X_local, y_local), w0)
 
+    # sufficient-statistics (gram) DP over the multi-host mesh: per-shard
+    # block-prefix stats built by the shard_map'ed builder across the two
+    # processes, window/total gradients from statistics, psum over the
+    # global mesh.  EQUAL aligned splits (48/48 over 4 local devices each)
+    # so the assembly returns no padding mask — the layout the gram DP
+    # path requires; the dense leg above covers the uneven/padded case.
+    Xg, yg = global_dataset(n=96, seed=321)
+    lo_g, hi_g = (0, 48) if proc_id == 0 else (48, 96)
+    opt_g = (make_gd().set_mesh(mesh).set_sufficient_stats(True)
+             .set_gram_options(block_rows=4))
+    w_gram, hist_gram = opt_g.optimize_with_history(
+        (Xg[lo_g:hi_g], yg[lo_g:hi_g]), w0
+    )
+    assert opt_g._gram_dp_entry is not None, "gram DP path did not engage"
+
     # outputs are replicated (P() specs) -> every process holds full values
     json.dump(
         {
@@ -132,6 +147,8 @@ def main():
             "sparse_hist": np.asarray(hist_sparse).tolist(),
             "lbfgs_w": np.asarray(w_lbfgs).tolist(),
             "lbfgs_hist": np.asarray(hist_lbfgs).tolist(),
+            "gram_w": np.asarray(w_gram).tolist(),
+            "gram_hist": np.asarray(hist_gram).tolist(),
         },
         open(out_path, "w"),
     )

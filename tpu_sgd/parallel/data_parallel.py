@@ -82,8 +82,10 @@ def _shard_dataset_multihost(mesh: Mesh, Xh, yh):
     another host's shard.  Per-process row counts may be uneven (the
     analogue of Spark's arbitrary-size input splits): a process allgather
     agrees on one common padded per-process length, so every process infers
-    the SAME global shape; padding rows are masked out via the always-on
-    ``valid`` mask.
+    the SAME global shape; padding rows are masked out via the ``valid``
+    mask.  Equal, locally-aligned splits need no padding and return
+    ``valid=None`` like the single-process path, keeping the no-mask fast
+    paths (incl. gram DP) available.
     """
     from jax.experimental import multihost_utils
 
@@ -107,6 +109,12 @@ def _shard_dataset_multihost(mesh: Mesh, Xh, yh):
         NamedSharding(mesh, P(DATA_AXIS, None)), Xh
     )
     yd = jax.make_array_from_process_local_data(row_sharding, yh)
+    if int(counts.min()) == target:
+        # every process arrived equal AND locally aligned — no padding
+        # anywhere, so return valid=None like the single-process path and
+        # keep the no-mask fast paths (incl. gram DP) available; the
+        # decision is identical on every process (counts is allgathered)
+        return Xd, yd, None
     vd = jax.make_array_from_process_local_data(row_sharding, valid)
     return Xd, yd, vd
 
