@@ -576,3 +576,30 @@ def test_repeat_runs_skip_replanning(rng, caplog):
             alg.run((X, y))
     assert sum(r.message.startswith("plan: ")
                for r in caplog.records) == 1
+
+
+def test_device_budget_probe_shapes():
+    """memory_stats-reporting devices are probed; zero/absent stats (the
+    axon remote-TPU case) fall back to the cost model's default."""
+
+    class Dev:
+        def memory_stats(self):
+            return {"bytes_limit": 16e9, "bytes_in_use": 4e9}
+
+    free, source = device_budget(Dev())
+    assert source == "memory_stats"
+    assert free == pytest.approx(12e9 * 0.8)
+
+    class DevZeros:  # axon reports zeros
+        def memory_stats(self):
+            return {"bytes_limit": 0, "bytes_in_use": 0}
+
+    free, source = device_budget(DevZeros())
+    assert source == "fallback" and free > 0
+
+    class DevRaises:
+        def memory_stats(self):
+            raise RuntimeError("no stats")
+
+    free, source = device_budget(DevRaises())
+    assert source == "fallback" and free > 0
