@@ -451,11 +451,19 @@ def test_plan_quasi_newton_boundaries():
     assert p.schedule == "resident_stock"
     assert "amortize" in p.reason
 
-    # beyond HBM: quasi-Newton has no streaming schedule -> stock + hint
+    # beyond HBM: the statistics are the only viable schedule — one
+    # streaming build pass, then O(d^2) full-batch evaluations
     huge = _ShapeOnly((100_000_000, 1000), np.float16)
     p = plan_quasi_newton(LBFGS(), huge, y, free_hbm=12 * GB)
+    assert p.schedule == "streamed_virtual_gram"
+    assert p.block_rows is not None
+    assert p.estimates["stack_bytes"] < 12 * GB
+
+    # beyond HBM with an impossible stack (huge d): nothing fits
+    huge_d = _ShapeOnly((1_000_000, 100_000), np.float16)
+    p = plan_quasi_newton(LBFGS(), huge_d, y, free_hbm=12 * GB)
     assert p.schedule == "resident_stock"
-    assert "build_streamed" in p.reason
+    assert "no schedule fits" in p.reason
 
     # non-least-squares gradient: nothing to plan
     assert plan_quasi_newton(LBFGS(LogisticGradient()), big, y,
@@ -603,3 +611,99 @@ def test_device_budget_probe_shapes():
 
     free, source = device_budget(DevRaises())
     assert source == "fallback" and free > 0
+
+
+def test_lbfgs_streamed_stats_matches_manual_virtual_flow(rng):
+    """LBFGS.set_streamed_stats must reproduce the manual build_streamed +
+    GramData-input flow exactly, for both LBFGS and OWL-QN."""
+    from tpu_sgd import LBFGS
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+    from tpu_sgd.optimize.owlqn import OWLQN
+
+    n, d = 2048, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w + 0.01 * rng.normal(size=n)).astype(np.float32)
+    w0 = np.zeros((d,), np.float32)
+
+    opt1 = LBFGS(max_num_iterations=10).set_streamed_stats(
+        True, block_rows=256)
+    w1, h1 = opt1.optimize_with_history((X, y), w0)
+    assert opt1._streamed_gram_entry is not None
+
+    g = GramLeastSquaresGradient.build_streamed(X, y, block_rows=256)
+    opt2 = LBFGS(g, max_num_iterations=10)
+    w2, h2 = opt2.optimize_with_history((g.data, y[:g.data.shape[0]]), w0)
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+
+    # repeat call hits the identity cache (no rebuild)
+    entry = opt1._streamed_gram_entry
+    opt1.optimize_with_history((X, y), w0)
+    assert opt1._streamed_gram_entry is entry
+    opt1.release_sufficient_stats()
+    assert opt1._streamed_gram_entry is None
+
+    # OWL-QN through the same flag
+    ow = OWLQN(reg_param=1e-4, max_num_iterations=8).set_streamed_stats(
+        True, block_rows=256)
+    w3, h3 = ow.optimize_with_history((X, y), w0)
+    assert ow._streamed_gram_entry is not None
+    assert np.all(np.isfinite(np.asarray(w3))) and h3[-1] <= h3[0]
+
+
+def test_lbfgs_streamed_stats_guards(rng):
+    from tpu_sgd import LBFGS, data_mesh
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    X = rng.normal(size=(128, 6)).astype(np.float32)
+    y = rng.normal(size=(128,)).astype(np.float32)
+    w0 = np.zeros((6,), np.float32)
+    with pytest.raises(NotImplementedError, match="least squares"):
+        LBFGS(LogisticGradient()).set_streamed_stats(True) \
+            .optimize_with_history((X, np.abs(np.sign(y))), w0)
+    with pytest.raises(NotImplementedError, match="single-device"):
+        LBFGS().set_streamed_stats(True).set_mesh(data_mesh()) \
+            .optimize_with_history((X, y), w0)
+
+
+def test_choose_streamed_build_budgets_chunk():
+    """The streamed build's device footprint is stack + in-flight chunk;
+    both must fit (review r4: the 64-block default chunk at a
+    stack-forced large B exceeded the budget by itself)."""
+    from tpu_sgd.plan import _stack_bytes, choose_streamed_build
+
+    B, batch = choose_streamed_build(100_000_000, 1000, 2, 12 * GB)
+    assert B is not None and batch is not None
+    stack = _stack_bytes(100_000_000, B, 1000)
+    chunk = batch * (1000 * 2 + 4)
+    assert stack + chunk <= 12 * GB
+    assert batch >= B  # at least one whole block per transfer
+    # impossible O(d^2) stack: nothing fits
+    assert choose_streamed_build(1_000_000, 100_000, 2,
+                                 12 * GB) == (None, None)
+
+
+def test_forced_gram_infeasible_budget_warns():
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        p = plan(1_000_000, 100_000, itemsize=2, gram_able=True,
+                 sampling="sliced", mini_batch_fraction=0.1,
+                 num_iterations=1000, free_hbm=12 * GB,
+                 force="streamed_virtual_gram")
+    assert p.schedule == "streamed_virtual_gram"
+    assert p.block_rows is None
+    assert any("NO feasible block size" in str(r.message) for r in rec)
+
+
+def test_plan_batch_rows_plumbs_to_optimizer():
+    from tpu_sgd import GradientDescent
+
+    p = plan(10_000_000, 1000, itemsize=2, gram_able=True,
+             sampling="sliced", mini_batch_fraction=0.1,
+             num_iterations=1000, free_hbm=12 * GB)
+    assert p.schedule == "streamed_virtual_gram"
+    assert p.batch_rows is not None and p.batch_rows >= p.block_rows
+    opt = p.apply(GradientDescent())
+    assert opt.gram_batch_rows == p.batch_rows
+    assert opt.gram_block_rows == p.block_rows

@@ -192,12 +192,16 @@ class GeneralizedLinearAlgorithm:
             return
         if isinstance(opt, _LBFGS):
             # quasi-Newton optimizers plan a narrower menu: stock
-            # full-batch passes vs the sufficient-stats substitution
+            # full-batch passes, the sufficient-stats substitution, or
+            # (beyond HBM) the streamed-virtual-statistics schedule
             p = plan_quasi_newton(opt, X, y, force=force)
             if p is not None:
                 opt.sufficient_stats = p.schedule == "resident_gram"
+                opt.streamed_stats = p.schedule == "streamed_virtual_gram"
                 if p.block_rows and hasattr(opt, "set_gram_options"):
                     opt.set_gram_options(block_rows=p.block_rows)
+                if p.batch_rows and hasattr(opt, "set_gram_options"):
+                    opt.set_gram_options(batch_rows=p.batch_rows)
                 opt.last_plan = p
         else:
             p = plan_for(opt, X, y, force=force)

@@ -254,6 +254,7 @@ class GradientDescent(Optimizer):
         self.sufficient_stats = False
         self.streamed_stats = False
         self.gram_block_rows = 8192
+        self.gram_batch_rows = None
         self.gram_aligned = False
         self.last_plan = None
         self._plan_key = None
@@ -378,7 +379,8 @@ class GradientDescent(Optimizer):
         self._mark_manual_schedule()
         return self
 
-    def set_gram_options(self, block_rows: int = None, aligned: bool = None):
+    def set_gram_options(self, block_rows: int = None, aligned: bool = None,
+                         batch_rows: int = None):
         """Tuning knobs for the sufficient-statistics schedules.
 
         ``block_rows`` trades prefix-stack memory (``n/B · d² · 4`` bytes)
@@ -386,9 +388,12 @@ class GradientDescent(Optimizer):
         ``aligned=True`` floors window starts to block boundaries, skipping
         the edge corrections (~71% of the exact iteration) at the cost of
         the same floored-window sampling deviation the Pallas tiled kernel
-        makes — fine on shuffled rows, not on sorted/grouped data.  The
-        execution planner (``tpu_sgd/plan.py``) sets ``block_rows``
-        automatically; ``aligned`` stays opt-in."""
+        makes — fine on shuffled rows, not on sorted/grouped data.
+        ``batch_rows`` caps the streamed build's host→device chunk (the
+        chunk is co-resident with the growing prefix stack, so a tight
+        device budget needs a smaller chunk than the 64-block default).
+        The execution planner (``tpu_sgd/plan.py``) sets ``block_rows``/
+        ``batch_rows`` automatically; ``aligned`` stays opt-in."""
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
@@ -397,6 +402,12 @@ class GradientDescent(Optimizer):
             self.gram_block_rows = int(block_rows)
         if aligned is not None:
             self.gram_aligned = bool(aligned)
+        if batch_rows is not None:
+            if int(batch_rows) < 1:
+                raise ValueError(
+                    f"batch_rows must be positive, got {batch_rows}"
+                )
+            self.gram_batch_rows = int(batch_rows)
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -767,14 +778,15 @@ class GradientDescent(Optimizer):
         Xh = np.asarray(X)
         d = Xh.shape[1]
         entry = getattr(self, "_streamed_gram_dp_entry", None)
+        opts = (self.gram_block_rows, self.gram_batch_rows)
         if (entry is not None and entry[0] is X and entry[1] is y
-                and entry[2] is self.mesh
-                and entry[4] == self.gram_block_rows):
+                and entry[2] is self.mesh and entry[4] == opts):
             stats, B, n_used, yd = entry[3]
         else:
             stats, B, n_used = build_streamed_sharded_gram_stats(
                 self.mesh, Xh, np.asarray(y),
                 block_rows=self.gram_block_rows,
+                batch_rows=self.gram_batch_rows,
             )
             k = self.mesh.shape[DATA_AXIS]
             n_local_host = Xh.shape[0] // k
@@ -790,8 +802,7 @@ class GradientDescent(Optimizer):
                 NamedSharding(self.mesh, P(DATA_AXIS)),
             )
             self._streamed_gram_dp_entry = (
-                X, y, self.mesh, (stats, B, n_used, yd),
-                self.gram_block_rows,
+                X, y, self.mesh, (stats, B, n_used, yd), opts,
             )
         w0 = jnp.asarray(initial_weights)
         if not jnp.issubdtype(w0.dtype, jnp.inexact):
@@ -824,8 +835,9 @@ class GradientDescent(Optimizer):
         from tpu_sgd.ops.gram import GramLeastSquaresGradient
 
         entry = self._streamed_gram_entry
+        opts = (self.gram_block_rows, self.gram_batch_rows)
         if (entry is not None and entry[0] is X and entry[1] is y
-                and entry[3] == self.gram_block_rows):
+                and entry[3] == opts):
             return entry[2]
         if entry is not None:
             self._purge_run_cache_for(entry[2])
@@ -834,8 +846,9 @@ class GradientDescent(Optimizer):
         g = GramLeastSquaresGradient.build_streamed(
             np.asarray(X), np.asarray(y),
             block_rows=self.gram_block_rows,
+            batch_rows=self.gram_batch_rows,
         )
-        self._streamed_gram_entry = (X, y, g, self.gram_block_rows)
+        self._streamed_gram_entry = (X, y, g, opts)
         return g
 
     def _maybe_gram(self, X, y, sparse_X):

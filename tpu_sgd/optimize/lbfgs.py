@@ -319,10 +319,13 @@ class LBFGS(Optimizer):
         self.reg_param = reg_param
         self.mesh = None
         self.sufficient_stats = False
+        self.streamed_stats = False
         self.gram_block_rows = 8192
+        self.gram_batch_rows = None
         self.last_plan = None
         self._plan_key = None
         self._gram_entry = None
+        self._streamed_gram_entry = None
         self._loss_history = None
 
     # fluent setters, reference parity
@@ -371,20 +374,47 @@ class LBFGS(Optimizer):
     def release_sufficient_stats(self):
         """Drop the cached sufficient-statistics bundle so the bound
         dataset plus the GB-scale prefix stack can be freed from HBM
-        (``set_sufficient_stats`` retains the last build by design)."""
+        (``set_sufficient_stats``/``set_streamed_stats`` retain the last
+        build by design)."""
         self._gram_entry = None
+        self._streamed_gram_entry = None
         return self
 
-    def set_gram_options(self, block_rows: int = None):
-        """Block size of the sufficient-statistics build (prefix-stack
-        memory vs edge traffic — see ``ops/gram.py``; set by the
-        execution planner)."""
+    def set_gram_options(self, block_rows: int = None,
+                         batch_rows: int = None):
+        """Sufficient-statistics build knobs (set by the execution
+        planner): ``block_rows`` sizes the prefix stack (memory vs edge
+        traffic — see ``ops/gram.py``); ``batch_rows`` caps the streamed
+        build's host→device chunk, co-resident with the stack."""
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
                     f"block_rows must be positive, got {block_rows}"
                 )
             self.gram_block_rows = int(block_rows)
+        if batch_rows is not None:
+            if int(batch_rows) < 1:
+                raise ValueError(
+                    f"batch_rows must be positive, got {batch_rows}"
+                )
+            self.gram_batch_rows = int(batch_rows)
+        return self
+
+    def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
+        """Beyond-HBM quasi-Newton least squares: ONE host-streaming pass
+        builds the block-prefix statistics on device
+        (``GramLeastSquaresGradient.build_streamed``), after which every
+        full-batch cost/gradient/sweep evaluation is an O(d²) statistics
+        read — the rows never live on the device at all.  Full-batch
+        sums are EXACT from the totals; the only deviation is the
+        dropped ``n % block_rows`` tail rows (<0.1% at scale).  Applies
+        to exactly ``LeastSquaresGradient`` on dense single-device data;
+        the build is identity-cached per ``(X, y)``."""
+        self.streamed_stats = bool(flag)
+        if block_rows is not None:
+            self.gram_block_rows = int(block_rows)
+        self.last_plan = None
+        self._plan_key = None
         return self
 
     def set_mesh(self, mesh):
@@ -402,6 +432,65 @@ class LBFGS(Optimizer):
     def optimize(self, data: Dataset, initial_weights: Array) -> Array:
         w, _ = self.optimize_with_history(data, initial_weights)
         return w
+
+    def _maybe_streamed_reentry(self, X, y, initial_weights):
+        """``set_streamed_stats`` front door, shared by LBFGS and the
+        OWLQN override: build the virtual statistics once from the host
+        rows BEFORE any device coercion, swap the gradient, and re-enter
+        ``optimize_with_history`` with the virtual GramData as X (the
+        flow the manual build_streamed + GramData-input path takes).
+        Returns None when the flag is off or X is already statistics."""
+        import numpy as np
+
+        from tpu_sgd.ops.gram import GramData
+
+        if not self.streamed_stats or isinstance(X, GramData):
+            return None
+        g = self._maybe_streamed_gram(X, y)
+        orig, self.gradient = self.gradient, g
+        try:
+            return self.optimize_with_history(
+                (g.data, np.asarray(y)[:g.data.shape[0]]),
+                initial_weights,
+            )
+        finally:
+            self.gradient = orig
+
+    def _maybe_streamed_gram(self, X, y):
+        """Guards + identity-cached build for ``set_streamed_stats``."""
+        import numpy as np
+
+        from tpu_sgd.ops.gradients import LeastSquaresGradient as _LS
+        from tpu_sgd.ops.gram import GramLeastSquaresGradient
+        from tpu_sgd.ops.sparse import is_sparse as _is_sp
+
+        if _is_sp(X):
+            raise NotImplementedError(
+                "streamed statistics need dense rows; BCOO features are "
+                "~1000x smaller and stay device-resident instead"
+            )
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "quasi-Newton streamed statistics run single-device; "
+                "drop set_mesh (the meshed CostFun reads resident shards)"
+            )
+        if type(self.gradient) is not _LS:
+            raise NotImplementedError(
+                "streamed statistics exist for least squares only (the "
+                f"quadratic loss); got {type(self.gradient).__name__}"
+            )
+        entry = self._streamed_gram_entry
+        opts = (self.gram_block_rows, self.gram_batch_rows)
+        if (entry is not None and entry[0] is X and entry[1] is y
+                and entry[3] == opts):
+            return entry[2]
+        g = GramLeastSquaresGradient.build_streamed(
+            np.asarray(X), np.asarray(y),
+            block_rows=self.gram_block_rows,
+            batch_rows=self.gram_batch_rows,
+        )
+        self._streamed_gram_entry = (X, y, g, opts)
+        return g
 
     #: backtracking ladder length (t = 1, 1/2, ..., 2^-(N-1))
     _LS_TRIALS = 25
@@ -446,6 +535,9 @@ class LBFGS(Optimizer):
         import numpy as np
 
         X, y = data
+        streamed = self._maybe_streamed_reentry(X, y, initial_weights)
+        if streamed is not None:
+            return streamed
         X, y, w = _coerce_inputs(X, y, initial_weights)
         n = X.shape[0]
         if n == 0:

@@ -113,6 +113,9 @@ class OWLQN(LBFGS):
         import numpy as np
 
         X, y = data
+        streamed = self._maybe_streamed_reentry(X, y, initial_weights)
+        if streamed is not None:
+            return streamed
         X, y, w = _coerce_inputs(X, y, initial_weights)
         n = X.shape[0]
         if n == 0:

@@ -134,6 +134,7 @@ class Plan:
     schedule: str
     reason: str
     block_rows: Optional[int] = None
+    batch_rows: Optional[int] = None
     aligned: bool = False
     resident_rows: int = 0
     estimates: dict = dataclasses.field(default_factory=dict)
@@ -161,6 +162,8 @@ class Plan:
             optimizer.set_host_streaming(True)
         elif self.schedule == "streamed_virtual_gram":
             optimizer.set_streamed_stats(True, block_rows=self.block_rows)
+            if self.batch_rows:
+                optimizer.set_gram_options(batch_rows=self.batch_rows)
         elif self.schedule != "resident_stock":
             raise ValueError(f"unknown schedule {self.schedule!r}")
         optimizer.last_plan = self
@@ -186,6 +189,26 @@ def choose_block_rows(n_local: int, d: int, stats_budget: float,
             return None
         B *= 2
     return B
+
+
+def choose_streamed_build(n_local: int, d: int, itemsize: int,
+                          budget: float, start: int = 4096):
+    """``(block_rows, batch_rows)`` for a STREAMED statistics build whose
+    whole device footprint fits ``budget`` — the prefix stack PLUS the
+    in-flight host→device chunk that is co-resident during the build
+    (``build_streamed`` defaults the chunk to 64 blocks, which at the
+    large block sizes a tight stack budget forces can exceed the stack
+    itself).  The stack gets ~2/3 of the budget; the chunk is capped to
+    the remainder (never above the builder's 64-block default).  Returns
+    ``(None, None)`` when no split fits."""
+    B = choose_block_rows(n_local, d, budget * 2.0 / 3.0, start=start)
+    if B is None:
+        return None, None
+    chunk_budget = budget - _stack_bytes(n_local, B, d)
+    rows = int(chunk_budget // max(1, d * itemsize + 4))
+    if rows < B:  # cannot hold even one block alongside the stack
+        return None, None
+    return B, int(min(rows, 64 * B))
 
 
 def _fmt_gb(b: float) -> str:
@@ -336,7 +359,8 @@ def plan(
         streamed_iter_s = window_rows * d * itemsize / feed
         est["streamed_iter_s"] = streamed_iter_s
         if gram_eligible:
-            B = choose_block_rows(n_local, d, free_hbm)
+            B, batch_rows = choose_streamed_build(n_local, d, itemsize,
+                                                  free_hbm)
             if B is not None:
                 gram_iter_s, _ = _gram_terms(B, aligned=True)
                 build_s = (cm.build_overhead_s
@@ -344,7 +368,8 @@ def plan(
                 saving = streamed_iter_s - gram_iter_s
                 amortize = (math.inf if saving <= 0
                             else build_s / saving)
-                est.update(block_rows=B, gram_iter_s=gram_iter_s,
+                est.update(block_rows=B, batch_rows=batch_rows,
+                           gram_iter_s=gram_iter_s,
                            gram_build_s=build_s,
                            build_amortize_iters=amortize,
                            stack_bytes=_stack_bytes(n_local, B, d))
@@ -353,15 +378,16 @@ def plan(
                         "streamed_virtual_gram",
                         f"data ({_fmt_gb(data_bytes_local)}) exceeds HBM "
                         f"({_fmt_gb(free_hbm)} free) but its statistics "
-                        f"({_fmt_gb(est['stack_bytes'])}, B={B}) fit: one "
-                        f"streaming build pass (~{build_s:.0f}s at "
-                        f"{cm.host_feed_gb_s} GB/s), then iterations "
-                        "touch no rows.  NOTE: uses ALIGNED "
-                        "(block-floored) windows — a sampling deviation "
-                        "(fine on shuffled rows, not on sorted/grouped "
-                        "data); pass schedule='host_streamed' to keep "
-                        "exact windows",
-                        block_rows=B, aligned=True, estimates=est,
+                        f"({_fmt_gb(est['stack_bytes'])}, B={B}) fit "
+                        "beside the build chunk: one streaming build "
+                        f"pass (~{build_s:.0f}s at {cm.host_feed_gb_s} "
+                        "GB/s), then iterations touch no rows.  NOTE: "
+                        "uses ALIGNED (block-floored) windows — a "
+                        "sampling deviation (fine on shuffled rows, not "
+                        "on sorted/grouped data); pass "
+                        "schedule='host_streamed' to keep exact windows",
+                        block_rows=B, batch_rows=batch_rows,
+                        aligned=True, estimates=est,
                     )
                 elif force == "streamed_virtual_gram":
                     warnings.warn(
@@ -410,11 +436,21 @@ def plan(
         )
 
     if force is not None and force != chosen.schedule:
+        if (force in ("resident_gram", "streamed_virtual_gram")
+                and est.get("block_rows") is None):
+            warnings.warn(
+                f"forced {force} has NO feasible block size at this "
+                f"budget ({_fmt_gb(free_hbm)} free vs O(d²) statistics); "
+                "the build will run at the default block size and may "
+                "exhaust device memory",
+                RuntimeWarning, stacklevel=3,
+            )
         forced = Plan(
             force,
             f"forced by caller (planner would pick {chosen.schedule}: "
             + chosen.reason + ")",
             block_rows=est.get("block_rows"),
+            batch_rows=est.get("batch_rows"),
             aligned=force == "streamed_virtual_gram",
             resident_rows=est.get("resident_rows", 0),
             estimates=est,
@@ -449,11 +485,13 @@ def plan_quasi_newton(optimizer, X, y,
     Each quasi-Newton iteration is several FULL-batch passes over ``X``
     (cost+gradient at the current and accepted points, plus the batched
     line-search sweep — ~4 row reads), so the break-even comes much
-    earlier than for mini-batch SGD.  Only the resident regime is
-    decided here: beyond-HBM quasi-Newton least squares is the user's
-    explicit ``build_streamed`` + GramData-input flow.  ``force`` accepts
-    ``resident_stock`` / ``resident_gram`` only (the streaming schedules
-    do not exist behind this optimizer)."""
+    earlier than for mini-batch SGD.  Beyond HBM, the statistics ARE the
+    only viable schedule (full-batch passes over host-streamed rows would
+    pay the feed per evaluation): when the stack fits, the plan is
+    ``streamed_virtual_gram`` (one streaming build pass, then O(d²)
+    evaluations; full-batch sums are exact from the totals — the only
+    deviation is the dropped ``n % block_rows`` tail).  ``force`` accepts
+    ``resident_stock`` / ``resident_gram`` / ``streamed_virtual_gram``."""
     import numpy as np
 
     from tpu_sgd.ops.gradients import LeastSquaresGradient
@@ -466,12 +504,12 @@ def plan_quasi_newton(optimizer, X, y,
             or optimizer.mesh is not None
             or type(optimizer.gradient) is not LeastSquaresGradient):
         return None
-    if force is not None and force not in ("resident_stock",
-                                           "resident_gram"):
+    if force is not None and force not in (
+            "resident_stock", "resident_gram", "streamed_virtual_gram"):
         raise ValueError(
             f"schedule {force!r} does not exist behind a quasi-Newton "
-            "optimizer; choose resident_stock or resident_gram (or use "
-            "GramLeastSquaresGradient.build_streamed for beyond-HBM runs)"
+            "optimizer; choose resident_stock, resident_gram, or "
+            "streamed_virtual_gram"
         )
     shape = np.shape(X)
     if len(shape) != 2 or shape[0] == 0:
@@ -492,13 +530,46 @@ def plan_quasi_newton(optimizer, X, y,
         "max_num_iterations": iters,
     }
     if data_bytes > free_hbm:
-        return Plan(
-            "resident_stock",
-            f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
-            f"({_fmt_gb(free_hbm)} free); quasi-Newton beyond-HBM runs "
-            "need an explicit build_streamed + GramData-input flow",
-            estimates=est,
-        )
+        B, batch_rows = choose_streamed_build(n, d, itemsize, free_hbm)
+        if B is not None:
+            est.update(block_rows=B, batch_rows=batch_rows,
+                       stack_bytes=_stack_bytes(n, B, d))
+            chosen = Plan(
+                "streamed_virtual_gram",
+                f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
+                f"({_fmt_gb(free_hbm)} free) but its statistics "
+                f"({_fmt_gb(est['stack_bytes'])}, B={B}) fit beside the "
+                "build chunk: one streaming build pass, then every "
+                "full-batch cost/sweep is an O(d²) statistics read "
+                f"(exact totals; the n % {B} tail rows are dropped)",
+                block_rows=B, batch_rows=batch_rows, estimates=est,
+            )
+        else:
+            chosen = Plan(
+                "resident_stock",
+                f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
+                f"({_fmt_gb(free_hbm)} free) and so does its O(d²) "
+                "statistics stack; no schedule fits this device",
+                estimates=est,
+            )
+        if force is not None and force != chosen.schedule:
+            if (force in ("resident_gram", "streamed_virtual_gram")
+                    and est.get("block_rows") is None):
+                warnings.warn(
+                    f"forced {force} has NO feasible block size at this "
+                    f"budget ({_fmt_gb(free_hbm)} free vs O(d²) "
+                    "statistics); the build will run at the default "
+                    "block size and may exhaust device memory",
+                    RuntimeWarning, stacklevel=3,
+                )
+            return Plan(
+                force,
+                f"forced by caller (planner would pick {chosen.schedule}: "
+                + chosen.reason + ")",
+                block_rows=est.get("block_rows"),
+                batch_rows=est.get("batch_rows"), estimates=est,
+            )
+        return chosen
     B = choose_block_rows(n, d, free_hbm - data_bytes)
     chosen = None
     if B is not None:
