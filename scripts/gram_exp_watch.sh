@@ -1,0 +1,21 @@
+#!/bin/bash
+# Light watcher for the gram-scan decomposition experiment: probe the
+# tunnel every ~7 min from a killable subprocess; the first time it
+# answers, run scripts/gram_scan_experiment.py once and exit.  Bounded at
+# 18 attempts (~2.5 h) so it cannot contend with the end-of-round bench.
+set -u
+cd "$(dirname "$0")/.."
+for i in $(seq 1 18); do
+  if timeout 240 python -c 'import jax; assert jax.devices()[0].platform != "cpu"' 2>/dev/null; then
+    echo "[$(date +%H:%M:%S)] tunnel alive; running gram scan experiment"
+    # ONE attempt, stop either way: only a wedged probe retries — a run
+    # that failed must not re-hold the TPU for every remaining attempt
+    timeout 1500 python scripts/gram_scan_experiment.py
+    echo "[$(date +%H:%M:%S)] experiment attempt finished (rc=$?)"
+    break
+  else
+    echo "[$(date +%H:%M:%S)] tunnel wedged (attempt $i)"
+  fi
+  sleep 420
+done
+echo "[$(date +%H:%M:%S)] gram-exp watcher done"

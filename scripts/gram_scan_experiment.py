@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Where does the 0.024 ms aligned-gram iteration go — and what's under it?
+
+Round-4 captures pin the block-aligned sufficient-statistics iteration at
+0.0243–0.0246 ms (two cycles, ±0.2%).  Its HBM traffic floor is two
+(d, d) f32 prefix reads ≈ 8 MB ≈ 0.011 ms at the measured ~730 GB/s — so
+roughly HALF the iteration is something else (while_loop bookkeeping:
+loss-history scatter, convergence norms, carry threading).  This
+experiment measures, on hardware, three variants of the SAME aligned
+window-gradient math driven by the SAME per-iteration key sequence:
+
+  a) full      — the shipped ``make_run`` contract (loss history, realized
+                 counts, convergence check): the baseline the bench quotes.
+  b) bare      — a ``fori_loop`` carrying only ``w``: the window math with
+                 zero bookkeeping.  The floor the driver could approach if
+                 history/convergence were opt-out.
+  c) chunked   — two-level: an outer scan gathers k iterations' prefix
+                 slices into one (k, d, d) buffer per endpoint, an inner
+                 fori runs k updates from the gathered stats.  Same bytes,
+                 amortized dispatch.
+
+All three must land on the SAME final weights (the window sequence is
+identical; (b)/(c) reproduce ``make_step``'s fold_in/randint stream).
+Writes GRAM_SCAN_EXPERIMENT.json.  Purely exploratory — the product path
+is untouched; a winning variant becomes a round-5 product change.
+
+Run when the tunnel is up:  python scripts/gram_scan_experiment.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "GRAM_SCAN_EXPERIMENT.json")
+
+ROWS = int(os.environ.get("EXP_ROWS", "2998272"))  # bench slab, 2048-aligned
+DIM = int(os.environ.get("EXP_DIM", "1000"))
+BLOCK = int(os.environ.get("EXP_BLOCK", "4096"))
+FRAC = 0.1
+STEP = 0.5
+SEED = 42
+K_CHUNK = int(os.environ.get("EXP_K", "16"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    from tpu_sgd.utils.platform import honor_cpu_env
+
+    honor_cpu_env()
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    log(f"device: {jax.devices()[0].device_kind} ({platform})")
+
+    from bench import fit_steady_state
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import make_run
+
+    # device-side data generation (no transfer), then one resident build
+    key = jax.random.PRNGKey(0)
+    kx, kw, kn = jax.random.split(key, 3)
+
+    @jax.jit
+    def gen():
+        X = jax.random.normal(kx, (ROWS, DIM), jnp.bfloat16)
+        w_true = jax.random.uniform(kw, (DIM,), jnp.float32, -1.0, 1.0)
+        y = (X.astype(jnp.float32) @ w_true
+             + 0.1 * jax.random.normal(kn, (ROWS,), jnp.float32))
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+    t0 = time.perf_counter()
+    gg = GramLeastSquaresGradient.build(X, y, block_rows=BLOCK, aligned=True)
+    jax.block_until_ready(gg.data.PG)
+    log(f"stats built in {time.perf_counter() - t0:.1f}s "
+        f"(prefix {gg.data.PG.nbytes / 1e9:.2f} GB)")
+    # Re-bundle as a VIRTUAL GramData (X=None) so the ~6 GB row slab can
+    # actually be freed — every variant below is row-free (aligned windows
+    # read only the prefix stacks), and GramData otherwise pins the rows.
+    from tpu_sgd.ops.gram import GramData
+
+    d0 = gg.data
+    st = GramData(None, d0.PG, d0.Pb, d0.Pyy, d0.G_tot, d0.b_tot,
+                  d0.yy_tot, BLOCK,
+                  logical_shape=(ROWS, DIM), logical_dtype="bfloat16")
+    gg = GramLeastSquaresGradient(st)
+    del X, d0
+    PG, Pb = st.PG, st.Pb
+    nbf = ROWS // BLOCK
+    m = max(1, round(FRAC * ROWS))
+    mb = max(1, min(nbf, round(m / BLOCK)))
+    count = float(mb * BLOCK)
+    base_key = jax.random.PRNGKey(SEED)
+
+    def k1_of(i):
+        # EXACTLY make_step's sliced-window stream: fold_in(key, i) ->
+        # randint start -> clip to block index (ops/gram.py aligned mode)
+        k = jax.random.fold_in(base_key, i)
+        start = jax.random.randint(k, (), 0, max(1, ROWS - m + 1))
+        start = jnp.clip(start, 0, max(ROWS - m, 0))
+        return jnp.clip(start // BLOCK, 0, nbf - mb)
+
+    def update(w, i, Gw_minus_b):
+        # SimpleUpdater: w - step/sqrt(t) * grad_mean
+        lr = STEP / jnp.sqrt(i.astype(jnp.float32))
+        return w - lr * (Gw_minus_b / count)
+
+    def window_terms(w, k1, PGa, Pba):
+        # stats arrive as ARGUMENTS, never closure constants — GB-scale
+        # captured arrays choke remote lowering (ops/gram.py plumbing note)
+        k2 = k1 + mb
+        PG1 = jax.lax.dynamic_slice_in_dim(PGa, k1, 1, 0)[0]
+        PG2 = jax.lax.dynamic_slice_in_dim(PGa, k2, 1, 0)[0]
+        Pb1 = jax.lax.dynamic_slice_in_dim(Pba, k1, 1, 0)[0]
+        Pb2 = jax.lax.dynamic_slice_in_dim(Pba, k2, 1, 0)[0]
+        Gw = jnp.dot((PG2 - PG1), w, precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+        return Gw - (Pb2 - Pb1)
+
+    # ---- (a) full shipped contract --------------------------------------
+    def run_full(iters):
+        cfg = SGDConfig(step_size=STEP, num_iterations=iters,
+                        mini_batch_fraction=FRAC, convergence_tol=0.0,
+                        sampling="sliced", seed=SEED)
+        run = jax.jit(make_run(gg, SimpleUpdater(), cfg))
+        w0 = jnp.zeros((DIM,), jnp.float32)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(w0, st, y))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        w, losses, n_rec = jax.block_until_ready(run(w0, st, y))
+        return time.perf_counter() - t0, compile_s, w
+
+    # ---- (b) bare fori_loop: w-only carry, DYNAMIC trip count -----------
+    # (one compile serves the whole ladder — compile minutes through the
+    # remote tunnel dominate this experiment's wall otherwise)
+    @jax.jit
+    def run_bare(w0, n, PGa, Pba):
+        def body(t, w):
+            i = t + 1
+            return update(w, i, window_terms(w, k1_of(i), PGa, Pba))
+
+        return jax.lax.fori_loop(0, n, body, w0)
+
+    # ---- (c) chunked gather: outer fori over chunks of K ----------------
+    @jax.jit
+    def run_chunked(w0, n_chunks, PGa, Pba):
+        K = K_CHUNK
+
+        def chunk(c, w):
+            idx = c * K + jnp.arange(1, K + 1)  # iteration numbers
+            k1s = jax.vmap(k1_of)(idx)
+            G1 = jnp.take(PGa, k1s, axis=0)       # (K, d, d) gathers
+            G2 = jnp.take(PGa, k1s + mb, axis=0)
+            b1 = jnp.take(Pba, k1s, axis=0)
+            b2 = jnp.take(Pba, k1s + mb, axis=0)
+            Gd = G2 - G1
+            bd = b2 - b1
+
+            def inner(t, w):
+                Gw = jnp.dot(Gd[t], w,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+                return update(w, idx[t], Gw - bd[t])
+
+            return jax.lax.fori_loop(0, K, inner, w)
+
+        return jax.lax.fori_loop(0, n_chunks, chunk, w0)
+
+    def time_variant(name, run, iters_list, iters_to_arg):
+        pts = []
+        w_last = None
+        w0 = jnp.zeros((DIM,), jnp.float32)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(w0, iters_to_arg(iters_list[0]), PG, Pb))
+        compile_total = time.perf_counter() - t0
+        log(f"{name}: compile+first {compile_total:.1f}s")
+        for iters in iters_list:
+            t0 = time.perf_counter()
+            w_last = jax.block_until_ready(
+                run(w0, iters_to_arg(iters), PG, Pb))
+            pts.append((iters, time.perf_counter() - t0))
+        slope, fixed, fit = fit_steady_state(pts)
+        log(f"{name}: {slope * 1e3:.4f} ms/iter (+{fixed * 1e3:.0f} ms "
+            f"launch; residuals {fit['residual_ms']} ms)")
+        return slope, fit, np.asarray(w_last)
+
+    ladder = (1200, 3600, 14400)
+    assert all(n % K_CHUNK == 0 for n in ladder), (
+        f"ladder {ladder} must divide K_CHUNK={K_CHUNK} or the chunked "
+        "variant silently drops iterations"
+    )
+    dt_full, compile_full, w_full = run_full(ladder[0])
+    log(f"full: compile+first {compile_full:.1f}s")
+    pts_full = [(ladder[0], dt_full)]
+    for it in ladder[1:]:
+        dt, _, w_full = run_full(it)
+        pts_full.append((it, dt))
+    slope_a, fixed_a, fit_a = fit_steady_state(pts_full)
+    log(f"full: {slope_a * 1e3:.4f} ms/iter (residuals "
+        f"{fit_a['residual_ms']} ms)")
+    w_a = np.asarray(w_full)
+
+    slope_b, fit_b, w_b = time_variant(
+        "bare", run_bare, ladder, lambda n: jnp.asarray(n, jnp.int32))
+    slope_c, fit_c, w_c = time_variant(
+        "chunked", run_chunked, ladder,
+        lambda n: jnp.asarray(n // K_CHUNK, jnp.int32))
+
+    # trajectory agreement: same window stream + same math -> same weights
+    agree_b = bool(np.allclose(w_b, w_a, rtol=1e-4, atol=1e-5))
+    agree_c = bool(np.allclose(w_c, w_a, rtol=1e-4, atol=1e-5))
+    log(f"weights agree: bare={agree_b} chunked={agree_c} "
+        f"(max|dw| bare {np.abs(w_b - w_a).max():.2e}, chunked "
+        f"{np.abs(w_c - w_a).max():.2e})")
+
+    record = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "platform": platform,
+        "note": (
+            "exploratory decomposition of the aligned-gram iteration; "
+            "the product path is untouched — a clean winner here is a "
+            "candidate product change for the next round"
+        ),
+        "workload": {"rows": ROWS, "dim": DIM, "block_rows": BLOCK,
+                     "frac": FRAC, "k_chunk": K_CHUNK},
+        "full_contract_ms": slope_a * 1e3,
+        "full_fit": fit_a,
+        "bare_ms": slope_b * 1e3,
+        "bare_fit": fit_b,
+        "chunked_ms": slope_c * 1e3,
+        "chunked_fit": fit_c,
+        "bookkeeping_ms": (slope_a - slope_b) * 1e3,
+        "weights_agree": {"bare": agree_b, "chunked": agree_c},
+    }
+    if platform == "cpu":
+        log("CPU fallback: not persisting")
+        print(json.dumps(record))
+        return 1
+    with open(OUT, "w") as f:
+        json.dump(record, f, indent=1)
+    log(f"wrote {OUT}")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
