@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-5 TPU tunnel watcher (VERDICT r4 #2/#7: front-load hardware windows;
+# the aligned-gram decomposition experiment lost its round-4 window to a
+# wedged tunnel and runs FIRST here).
+#
+# Probes the axon tunnel from a timeout-wrapped child process; the moment it
+# answers, runs (in order):
+#   1. scripts/gram_scan_experiment.py  — the pending decomposition capture
+#   2. bench.py                         — live headline capture (persists to
+#                                         BENCH_LAST_TPU.json immediately)
+#   3. quasi-newton + sparse + streamed-stats correctness checks
+# then keeps watching hourly so a later, healthier tunnel can refresh.
+#
+# Usage: nohup bash scripts/tpu_watch_r5.sh >> tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-240}"
+SLEEP_BETWEEN="${SLEEP_BETWEEN:-420}"
+MAX_HOURS="${MAX_HOURS:-11}"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+ran_capture=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if timeout "$PROBE_TIMEOUT" python -c \
+      "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "[$(date +%H:%M:%S)] TUNNEL ALIVE"
+    if [ ! -f GRAM_SCAN_EXPERIMENT.json ]; then
+      echo "[$(date +%H:%M:%S)] gram decomposition experiment (round-4 pending):"
+      timeout 3600 python scripts/gram_scan_experiment.py 2>&1 \
+        | tee -a gram_exp_watch.log
+    fi
+    echo "[$(date +%H:%M:%S)] full bench:"
+    BENCH_TPU_RETRIES=2 BENCH_TPU_BACKOFF=30 BENCH_PALLAS=0 BENCH_CHUNKS= \
+      timeout 3600 python bench.py 2>&1 | tee -a bench_logs/BENCH_STDERR_r05_tpu.txt
+    echo "[$(date +%H:%M:%S)] quasi-newton/streaming hardware check:"
+    timeout 1800 python scripts/quasi_newton_tpu_check.py 2>&1 | tee qn_check_watch.log
+    echo "[$(date +%H:%M:%S)] sparse hardware check:"
+    timeout 1800 python scripts/sparse_tpu_check.py 2>&1 | tee sparse_check_watch.log
+    echo "[$(date +%H:%M:%S)] streamed sufficient-stats 10Mx1000:"
+    timeout 4500 python scripts/stream_gram_tpu_check.py 2>&1 \
+      | tee -a bench_logs/STREAM_GRAM_r05_tpu.txt
+    if [ -f scripts/streamed_costfun_tpu_check.py ]; then
+      echo "[$(date +%H:%M:%S)] streamed-CostFun hardware check:"
+      timeout 1800 python scripts/streamed_costfun_tpu_check.py 2>&1 \
+        | tee costfun_check_watch.log
+    fi
+    ran_capture=1
+    echo "[$(date +%H:%M:%S)] capture set done"
+    sleep 3600
+  else
+    echo "[$(date +%H:%M:%S)] tunnel wedged (probe >${PROBE_TIMEOUT}s or failed)"
+    sleep "$SLEEP_BETWEEN"
+  fi
+done
+echo "[$(date +%H:%M:%S)] watcher deadline reached (ran_capture=$ran_capture)"

@@ -931,6 +931,25 @@ def test_build_streamed_sharded_stats_match_per_shard_resident(rng):
                                    rtol=1e-5)
 
 
+def test_sharded_build_rejects_dataless_mesh(rng):
+    """A mesh WITHOUT a 'data' axis must raise the intended
+    NotImplementedError, not a bare KeyError from reading
+    mesh.shape['data'] before the axes check (ADVICE r4)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from tpu_sgd.parallel.gram_parallel import (
+        build_streamed_sharded_gram_stats,
+    )
+    from tpu_sgd.parallel.mesh import MODEL_AXIS
+
+    mesh = Mesh(np.array(jax.devices()[:2]), (MODEL_AXIS,))
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64,)).astype(np.float32)
+    with pytest.raises(NotImplementedError, match="1-D 'data' mesh"):
+        build_streamed_sharded_gram_stats(mesh, X, y, block_rows=16)
+
+
 def test_streamed_stats_mesh_matches_resident_aligned_dp(rng):
     """Meshed set_streamed_stats (per-shard VIRTUAL stats built from host
     row streams, zero rows on device) must reproduce the meshed RESIDENT

@@ -246,6 +246,88 @@ def test_apply_clears_previous_schedule(rng):
     assert opt.streamed_stats and not opt.sufficient_stats
 
 
+def test_apply_always_resets_plan_owned_knobs(rng):
+    """A previous dataset's gram knobs (block size, streamed-build chunk
+    cap, aligned mode) must not leak into the next plan's build — the
+    gram identity caches key on them, so stale values silently rebuild
+    with the wrong geometry (ADVICE r4)."""
+    from tpu_sgd import GradientDescent
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
+
+    opt = GradientDescent()
+    Plan("streamed_virtual_gram", "small-data plan", block_rows=32,
+         batch_rows=64, aligned=True).apply(opt)
+    assert opt.gram_batch_rows == 64
+    assert opt.gram_block_rows == 32 and opt.gram_aligned
+    Plan("resident_stock", "new-data plan").apply(opt)
+    assert opt.gram_batch_rows is None
+    assert opt.gram_block_rows == DEFAULT_BLOCK_ROWS
+    assert not opt.gram_aligned
+
+
+def test_apply_preserves_user_set_gram_knobs(rng):
+    """Knob fields the USER set via set_gram_options survive auto-
+    planning: a tight-device batch_rows cap must not be clobbered by a
+    plan that carries none (plans only own what the user didn't set)."""
+    from tpu_sgd import GradientDescent
+
+    opt = GradientDescent().set_gram_options(batch_rows=256)
+    Plan("resident_gram", "auto plan", block_rows=4096).apply(opt)
+    assert opt.gram_batch_rows == 256  # user knob preserved
+    assert opt.gram_block_rows == 4096  # plan-owned field applied
+    opt2 = GradientDescent().set_gram_options(block_rows=64, aligned=True)
+    Plan("streamed_virtual_gram", "auto plan", block_rows=4096,
+         batch_rows=8192, aligned=False).apply(opt2)
+    assert opt2.gram_block_rows == 64 and opt2.gram_aligned
+    assert opt2.gram_batch_rows == 8192
+
+
+def test_knob_setter_keeps_replanning_alive(rng, caplog):
+    """set_gram_options is a KNOB, not a schedule choice: after an auto-
+    planned run, tweaking a knob must invalidate the plan cache (so the
+    next run re-plans, honoring the knob) WITHOUT tripping the manual
+    gate that disables planning — a plan-set schedule flag must never
+    masquerade as user-set (code-review r5)."""
+    from tpu_sgd import LinearRegressionWithSGD
+
+    X = rng.normal(size=(2048, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, 16).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=2048)).astype(np.float32)
+    alg = LinearRegressionWithSGD()
+    alg.optimizer.set_step_size(1.0)
+    alg.run((X, y))
+    assert alg.optimizer.last_plan is not None
+    alg.optimizer.set_gram_options(batch_rows=256)
+    assert alg.optimizer._plan_key is None  # cache invalidated...
+    assert alg.optimizer.last_plan is not None  # ...but not the gate
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        alg.run((X, y))
+    # re-planning DID run (a fresh plan: line logged, key repopulated)
+    assert any(r.message.startswith("plan: ") for r in caplog.records)
+    assert alg.optimizer._plan_key is not None
+    assert alg.optimizer.gram_batch_rows == 256  # user knob survived
+
+
+def test_force_resident_beyond_hbm_warns():
+    """Forcing a resident_* schedule onto beyond-HBM data must warn that
+    the slab does not fit — the no-feasible-block guard alone misses this
+    case because the streamed builder DID find a block size (ADVICE r4)."""
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        p = plan(10_000_000, 1000, itemsize=2, gram_able=True,
+                 mini_batch_fraction=1.0, num_iterations=100_000,
+                 free_hbm=12 * GB, force="resident_gram")
+    assert p.schedule == "resident_gram"
+    assert any("does not fit" in str(r.message) for r in rec)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        p = plan(10_000_000, 1000, itemsize=2, gram_able=False,
+                 mini_batch_fraction=1.0, num_iterations=100,
+                 free_hbm=12 * GB, force="resident_stock")
+    assert p.schedule == "resident_stock"
+    assert any("does not fit" in str(r.message) for r in rec)
+
+
 # ---- wired into the model layer ------------------------------------------
 
 def test_train_zero_flags_plans_and_logs(rng, caplog):

@@ -196,12 +196,13 @@ class GeneralizedLinearAlgorithm:
             # (beyond HBM) the streamed-virtual-statistics schedule
             p = plan_quasi_newton(opt, X, y, force=force)
             if p is not None:
+                from tpu_sgd.plan import apply_gram_knobs
+
                 opt.sufficient_stats = p.schedule == "resident_gram"
                 opt.streamed_stats = p.schedule == "streamed_virtual_gram"
-                if p.block_rows and hasattr(opt, "set_gram_options"):
-                    opt.set_gram_options(block_rows=p.block_rows)
-                if p.batch_rows and hasattr(opt, "set_gram_options"):
-                    opt.set_gram_options(batch_rows=p.batch_rows)
+                # direct assignment, user-set knobs preserved (the
+                # setters record user intent — see Plan.apply)
+                apply_gram_knobs(opt, p)
                 opt.last_plan = p
         else:
             p = plan_for(opt, X, y, force=force)

@@ -73,6 +73,12 @@ Array = jax.Array
 
 _HI = jax.lax.Precision.HIGHEST
 
+#: default prefix block size — the round-3 hardware captures' sweet spot;
+#: shared by the builders, the optimizers' knob defaults, and the
+#: planner's reset (so "plan carries no block size" means THIS, not
+#: whatever a previous dataset's plan left behind)
+DEFAULT_BLOCK_ROWS = 8192
+
 
 def _dot_hi(a, b, dtype):
     """Cancellation-safe matmul: both operands upcast to the stats dtype,
@@ -303,7 +309,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
 
     # -- construction ------------------------------------------------------
     @classmethod
-    def build(cls, X, y, block_rows: int = 8192,
+    def build(cls, X, y, block_rows: int = DEFAULT_BLOCK_ROWS,
               stats_dtype=None,
               aligned: bool = False) -> "GramLeastSquaresGradient":
         """One pass over ``(X, y)`` → a bound gradient (stats in
@@ -392,7 +398,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         return PG, Pb, Pyy, G_tot, b_tot, yy_tot
 
     @classmethod
-    def build_streamed(cls, X, y, block_rows: int = 8192,
+    def build_streamed(cls, X, y, block_rows: int = DEFAULT_BLOCK_ROWS,
                        batch_rows: Optional[int] = None,
                        stats_dtype=None) -> "GramLeastSquaresGradient":
         """Statistics for a HOST-resident dataset too large for HBM.
@@ -459,12 +465,18 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         def put(a):
             return jax.device_put(a, device)
 
-        PG = put(jnp.zeros((nbf + 1, d, d), sd))
-        Pb = put(jnp.zeros((nbf + 1, d), sd))
-        Pyy = put(jnp.zeros((nbf + 1,), sd))
-        cG = put(jnp.zeros((d, d), sd))
-        cb = put(jnp.zeros((d,), sd))
-        cyy = put(jnp.zeros((), sd))
+        # Stack + carries are created ON the target device (jnp.zeros'
+        # device kwarg): a default-placement jnp.zeros would stage each
+        # shard's ~GB stack through device 0 first, shrinking its headroom
+        # in exactly the beyond-HBM regime this path serves.
+        zeros_fn = partial(jnp.zeros, device=device)
+
+        PG = zeros_fn((nbf + 1, d, d), sd)
+        Pb = zeros_fn((nbf + 1, d), sd)
+        Pyy = zeros_fn((nbf + 1,), sd)
+        cG = zeros_fn((d, d), sd)
+        cb = zeros_fn((d,), sd)
+        cyy = zeros_fn((), sd)
         s = 0
         while s < n_used:
             e = min(s + chunk, n_used)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from tpu_sgd.config import SGDConfig
 from tpu_sgd.ops.gradients import Gradient, LeastSquaresGradient
+from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
 from tpu_sgd.ops.sparse import is_sparse
 from tpu_sgd.ops.updaters import SimpleUpdater, Updater
 from tpu_sgd.optimize.optimizer import Dataset, Optimizer
@@ -253,9 +254,13 @@ class GradientDescent(Optimizer):
         self.checkpoint_every = 10
         self.sufficient_stats = False
         self.streamed_stats = False
-        self.gram_block_rows = 8192
+        self.gram_block_rows = DEFAULT_BLOCK_ROWS
         self.gram_batch_rows = None
         self.gram_aligned = False
+        #: gram-knob fields the USER set via set_gram_options /
+        #: set_streamed_stats — the planner preserves these and resets
+        #: only plan-owned fields (Plan.apply)
+        self._user_gram_opts = frozenset()
         self.last_plan = None
         self._plan_key = None
         self._gram_entry = None
@@ -394,20 +399,30 @@ class GradientDescent(Optimizer):
         device budget needs a smaller chunk than the 64-block default).
         The execution planner (``tpu_sgd/plan.py``) sets ``block_rows``/
         ``batch_rows`` automatically; ``aligned`` stays opt-in."""
+        provided = set()
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
                     f"block_rows must be positive, got {block_rows}"
                 )
             self.gram_block_rows = int(block_rows)
+            provided.add("block_rows")
         if aligned is not None:
             self.gram_aligned = bool(aligned)
+            provided.add("aligned")
         if batch_rows is not None:
             if int(batch_rows) < 1:
                 raise ValueError(
                     f"batch_rows must be positive, got {batch_rows}"
                 )
             self.gram_batch_rows = int(batch_rows)
+            provided.add("batch_rows")
+        # user-set knobs survive auto-planning (Plan.apply skips them).
+        # Only the plan CACHE key is cleared — not last_plan: knobs are
+        # not a schedule choice, so re-planning must still run (the
+        # manual gate in glm._auto_plan keys on last_plan is None).
+        self._user_gram_opts = self._user_gram_opts | provided
+        self._plan_key = None
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -426,6 +441,7 @@ class GradientDescent(Optimizer):
         self.streamed_stats = bool(flag)
         if block_rows is not None:
             self.gram_block_rows = int(block_rows)
+            self._user_gram_opts = self._user_gram_opts | {"block_rows"}
         self._mark_manual_schedule()
         return self
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_sgd.ops.gradients import Gradient
+from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
 from tpu_sgd.ops.sparse import is_sparse
 from tpu_sgd.ops.updaters import (
     L1Updater,
@@ -320,8 +321,11 @@ class LBFGS(Optimizer):
         self.mesh = None
         self.sufficient_stats = False
         self.streamed_stats = False
-        self.gram_block_rows = 8192
+        self.gram_block_rows = DEFAULT_BLOCK_ROWS
         self.gram_batch_rows = None
+        #: gram-knob fields the USER set (planner preserves these; see
+        #: GradientDescent._user_gram_opts)
+        self._user_gram_opts = frozenset()
         self.last_plan = None
         self._plan_key = None
         self._gram_entry = None
@@ -386,18 +390,27 @@ class LBFGS(Optimizer):
         planner): ``block_rows`` sizes the prefix stack (memory vs edge
         traffic — see ``ops/gram.py``); ``batch_rows`` caps the streamed
         build's host→device chunk, co-resident with the stack."""
+        provided = set()
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
                     f"block_rows must be positive, got {block_rows}"
                 )
             self.gram_block_rows = int(block_rows)
+            provided.add("block_rows")
         if batch_rows is not None:
             if int(batch_rows) < 1:
                 raise ValueError(
                     f"batch_rows must be positive, got {batch_rows}"
                 )
             self.gram_batch_rows = int(batch_rows)
+            provided.add("batch_rows")
+        # user-set knobs survive auto-planning (glm._auto_plan skips
+        # them).  Only the plan CACHE key is cleared — not last_plan:
+        # knobs are not a schedule choice, so re-planning must still run
+        # (the manual gate in glm._auto_plan keys on last_plan is None).
+        self._user_gram_opts = self._user_gram_opts | provided
+        self._plan_key = None
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -413,6 +426,7 @@ class LBFGS(Optimizer):
         self.streamed_stats = bool(flag)
         if block_rows is not None:
             self.gram_block_rows = int(block_rows)
+            self._user_gram_opts = self._user_gram_opts | {"block_rows"}
         self.last_plan = None
         self._plan_key = None
         return self

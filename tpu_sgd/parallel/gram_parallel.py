@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpu_sgd.config import SGDConfig
-from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
+from tpu_sgd.ops.gram import (DEFAULT_BLOCK_ROWS, GramData,
+                              GramLeastSquaresGradient)
 from tpu_sgd.ops.updaters import Updater
 from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
 
@@ -46,7 +47,7 @@ _STATS_SPECS = (
 )
 
 
-def build_sharded_gram_stats(mesh, Xd, yd, block_rows: int = 8192):
+def build_sharded_gram_stats(mesh, Xd, yd, block_rows: int = DEFAULT_BLOCK_ROWS):
     """Per-shard block-prefix statistics for an already-sharded dataset.
 
     ``Xd``/``yd`` come from ``shard_dataset`` with no padding (``valid is
@@ -114,7 +115,7 @@ def dp_gram_run_fn(
     return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
 
 
-def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = 8192,
+def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BLOCK_ROWS,
                                       batch_rows=None):
     """Per-shard VIRTUAL statistics from HOST-resident rows — the
     beyond-HBM statistics build composed with the data mesh (config 4's
@@ -145,12 +146,12 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = 8192,
     from tpu_sgd.ops.gram import GramLeastSquaresGradient
     from jax.sharding import NamedSharding
 
-    k = mesh.shape[DATA_AXIS]
     if set(mesh.shape) != {DATA_AXIS}:
         raise NotImplementedError(
             "streamed statistics compose with a 1-D 'data' mesh; "
             f"got axes {tuple(mesh.shape)}"
         )
+    k = mesh.shape[DATA_AXIS]
     n, d = Xh.shape
     n_local = n // k
     if n_local < 1:
