@@ -144,30 +144,44 @@ class Plan:
 
     def apply(self, optimizer):
         """Configure ``optimizer`` (a ``GradientDescent``) for this
-        schedule.  Clears the schedule flags it owns first, so re-planning
-        an optimizer between datasets never leaks the previous choice."""
-        optimizer.host_streaming = False
-        optimizer.streaming_resident_rows = 0
-        optimizer.sufficient_stats = False
-        optimizer.streamed_stats = False
-        if self.schedule == "resident_gram":
-            optimizer.set_sufficient_stats(True)
-            optimizer.set_gram_options(block_rows=self.block_rows,
-                                       aligned=self.aligned)
-        elif self.schedule == "partial_residency":
-            optimizer.set_host_streaming(
-                True, resident_rows=self.resident_rows
-            )
-        elif self.schedule == "host_streamed":
-            optimizer.set_host_streaming(True)
-        elif self.schedule == "streamed_virtual_gram":
-            optimizer.set_streamed_stats(True, block_rows=self.block_rows)
-            if self.batch_rows:
-                optimizer.set_gram_options(batch_rows=self.batch_rows)
-        elif self.schedule != "resident_stock":
+        schedule.  Clears the schedule flags and plan-owned gram knobs
+        first, so re-planning an optimizer between datasets never leaks
+        the previous choice.  Attributes are assigned DIRECTLY, not
+        through the fluent setters: the setters record USER intent
+        (``_user_gram_opts``, ``last_plan`` invalidation) and the planner
+        must not masquerade as the user — knob fields the user set via
+        ``set_gram_options`` are preserved (user flags win)."""
+        if self.schedule not in SCHEDULES:
             raise ValueError(f"unknown schedule {self.schedule!r}")
+        apply_gram_knobs(optimizer, self)
+        optimizer.host_streaming = self.schedule in (
+            "partial_residency", "host_streamed")
+        optimizer.streaming_resident_rows = (
+            self.resident_rows if self.schedule == "partial_residency"
+            else 0)
+        optimizer.sufficient_stats = self.schedule == "resident_gram"
+        optimizer.streamed_stats = self.schedule == "streamed_virtual_gram"
         optimizer.last_plan = self
         return optimizer
+
+
+def apply_gram_knobs(optimizer, p: "Plan") -> None:
+    """Write a plan's gram build knobs onto ``optimizer``, preserving any
+    field the USER set via ``set_gram_options``/``set_streamed_stats``
+    (recorded in ``_user_gram_opts``).  Plan-owned fields are always
+    reset — a previous dataset's block size or streamed-build chunk cap
+    must not leak into this build (the gram identity caches key on them).
+    Shared by :meth:`Plan.apply` (GradientDescent) and the quasi-Newton
+    plan application (``models/glm.py``)."""
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
+
+    user = getattr(optimizer, "_user_gram_opts", frozenset())
+    if "block_rows" not in user:
+        optimizer.gram_block_rows = p.block_rows or DEFAULT_BLOCK_ROWS
+    if "batch_rows" not in user:
+        optimizer.gram_batch_rows = p.batch_rows or None
+    if "aligned" not in user and hasattr(optimizer, "gram_aligned"):
+        optimizer.gram_aligned = bool(p.aligned)
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
@@ -443,6 +457,14 @@ def plan(
                 f"budget ({_fmt_gb(free_hbm)} free vs O(d²) statistics); "
                 "the build will run at the default block size and may "
                 "exhaust device memory",
+                RuntimeWarning, stacklevel=3,
+            )
+        if force.startswith("resident_") and not fits:
+            warnings.warn(
+                f"forced {force} commits the {_fmt_gb(data_bytes_local)} "
+                f"slab to a device with only {_fmt_gb(free_hbm)} in the "
+                "probed budget — it does not fit and will likely exhaust "
+                "device memory",
                 RuntimeWarning, stacklevel=3,
             )
         forced = Plan(
