@@ -124,11 +124,25 @@ def leg_gram_owlqn():
     assert opt._gram_entry is not None, "gram substitution did not engage"
     return [round(float(x), 6) for x in np.asarray(hist)]
 
+def leg_costfun_lbfgs():
+    # Round 5: host-streamed chunked CostFun — beyond-HBM quasi-Newton
+    # for a NON-least-squares loss (VERDICT r4 #1).  Forced onto the
+    # resident-sized slab with a small chunk so the chunked accumulation
+    # (5 chunks/evaluation, double-buffered feed) actually exercises.
+    opt = (LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+                 max_num_iterations=10)
+           .set_host_streaming(True, batch_rows=4096))
+    w, hist = opt.optimize_with_history((Xb, yb), jnp.zeros((d,)))
+    jax.block_until_ready(w)
+    assert opt._stream_costfun_entry is not None, "CostFun did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
 for name, fn in [("lbfgs", leg_lbfgs), ("owlqn", leg_owlqn),
                  ("multinomial", leg_multinomial),
                  ("streaming_w_err", leg_streaming),
                  ("gram_lbfgs", leg_gram_lbfgs),
-                 ("gram_owlqn", leg_gram_owlqn)]:
+                 ("gram_owlqn", leg_gram_owlqn),
+                 ("costfun_lbfgs", leg_costfun_lbfgs)]:
     vals, wall = timed(fn)
     out["legs"][name] = {"values": vals, "wall_s": wall}
     print(f"{name}: {wall}s final {vals[-1]}", file=sys.stderr, flush=True)

@@ -39,7 +39,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     echo "[$(date +%H:%M:%S)] streamed sufficient-stats 10Mx1000:"
     timeout 4500 python scripts/stream_gram_tpu_check.py 2>&1 \
       | tee -a bench_logs/STREAM_GRAM_r05_tpu.txt
-    if [ -f scripts/streamed_costfun_tpu_check.py ]; then
+    if [ -f scripts/streamed_costfun_tpu_check.py ]; then  # optional extra
       echo "[$(date +%H:%M:%S)] streamed-CostFun hardware check:"
       timeout 1800 python scripts/streamed_costfun_tpu_check.py 2>&1 \
         | tee costfun_check_watch.log

@@ -1002,3 +1002,131 @@ def test_streamed_stats_mesh_build_is_identity_cached(rng):
     assert opt._streamed_gram_dp_entry is entry1  # no rebuild
     opt.release_sufficient_stats()
     assert opt._streamed_gram_dp_entry is None
+
+
+# ---- resumable streamed build (round 5: VERDICT r4 #4) ---------------------
+
+def test_build_streamed_resumable_bitwise(rng, tmp_path):
+    """A streamed build killed after chunk j must resume from its
+    high-water block and produce BITWISE-identical statistics — RDD
+    lineage replay semantics for the one expensive pass (a 278 s build
+    through this environment's tunnel restarts from zero otherwise)."""
+    from tpu_sgd.ops import gram as gram_mod
+
+    n, d, B = 1000, 6, 32
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+
+    ref = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=B, batch_rows=128)
+
+    # kill the build partway: the 3rd per-chunk prefix computation dies
+    resume_dir = str(tmp_path / "ckpt")
+    calls = {"n": 0}
+    real = gram_mod._chunk_prefix
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("simulated tunnel wedge")
+        return real(*args)
+
+    gram_mod._chunk_prefix = dying
+    try:
+        with pytest.raises(RuntimeError, match="wedge"):
+            GramLeastSquaresGradient.build_streamed(
+                X, y, block_rows=B, batch_rows=128,
+                resume_dir=resume_dir)
+    finally:
+        gram_mod._chunk_prefix = real
+    import json
+    import os
+
+    with open(os.path.join(resume_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert 0 < meta["high_water_rows"] < (n // B) * B  # mid-pass state
+
+    resumed = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=B, batch_rows=128, resume_dir=resume_dir)
+    for leaf in ("PG", "Pb", "Pyy", "G_tot", "b_tot", "yy_tot"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resumed.data, leaf)),
+            np.asarray(getattr(ref.data, leaf)), err_msg=leaf)
+    assert not os.path.exists(resume_dir)  # finalized: parts cleaned up
+
+
+def test_build_streamed_resume_rejects_mismatched_geometry(rng, tmp_path):
+    X = rng.normal(size=(256, 4)).astype(np.float32)
+    y = rng.normal(size=(256,)).astype(np.float32)
+    resume_dir = str(tmp_path / "ckpt")
+    from tpu_sgd.ops.gram import _PrefixBuildCheckpoint
+
+    ck = _PrefixBuildCheckpoint(resume_dir, n_used=256, d=4, B=32,
+                                sd_name="float32", chunk=64)
+    ck.save_part(0, np.zeros((2, 4, 4), np.float32),
+                 np.zeros((2, 4), np.float32),
+                 np.zeros((2,), np.float32), high_water_rows=64)
+    with pytest.raises(ValueError, match="different build"):
+        GramLeastSquaresGradient.build_streamed(
+            X, y, block_rows=16, resume_dir=resume_dir)
+
+
+def test_sharded_streamed_build_resumable(rng, tmp_path):
+    """The per-shard mesh builder checkpoints each shard independently
+    (resume_dir/shard_i) and a full re-run from checkpoints matches the
+    uninterrupted build."""
+    from tpu_sgd import data_mesh
+    from tpu_sgd.parallel.gram_parallel import (
+        build_streamed_sharded_gram_stats,
+    )
+
+    mesh = data_mesh()
+    k = mesh.shape["data"]
+    n, d, B = k * 160, 5, 32
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    ref, Bout, n_used = build_streamed_sharded_gram_stats(
+        mesh, X, y, block_rows=B, batch_rows=64)
+    resume_dir = str(tmp_path / "shards")
+    # first pass persists per-shard parts; second pass resumes (and since
+    # the first completed+finalized, it rebuilds — both must agree with
+    # the checkpoint-free build bitwise)
+    got, _, _ = build_streamed_sharded_gram_stats(
+        mesh, X, y, block_rows=B, batch_rows=64, resume_dir=resume_dir)
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_build_streamed_resume_rejects_different_dataset(rng, tmp_path):
+    """A stale resume_dir from a DIFFERENT same-shaped dataset must be
+    rejected (dataset fingerprint in the meta) — replaying another
+    dataset's chunks would silently corrupt the statistics
+    (code-review r5)."""
+    from tpu_sgd.ops import gram as gram_mod
+
+    n, d, B = 512, 5, 32
+    XA = rng.normal(size=(n, d)).astype(np.float32)
+    XB = rng.normal(size=(n, d)).astype(np.float32)  # same shape/dtype
+    y = rng.normal(size=(n,)).astype(np.float32)
+    resume_dir = str(tmp_path / "ckpt")
+
+    calls = {"n": 0}
+    real = gram_mod._chunk_prefix
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated wedge")
+        return real(*args)
+
+    gram_mod._chunk_prefix = dying
+    try:
+        with pytest.raises(RuntimeError, match="wedge"):
+            GramLeastSquaresGradient.build_streamed(
+                XA, y, block_rows=B, batch_rows=64,
+                resume_dir=resume_dir)
+    finally:
+        gram_mod._chunk_prefix = real
+    with pytest.raises(ValueError, match="different build"):
+        GramLeastSquaresGradient.build_streamed(
+            XB, y, block_rows=B, batch_rows=64, resume_dir=resume_dir)

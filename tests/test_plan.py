@@ -547,14 +547,26 @@ def test_plan_quasi_newton_boundaries():
     assert p.schedule == "resident_stock"
     assert "no schedule fits" in p.reason
 
-    # non-least-squares gradient: nothing to plan
-    assert plan_quasi_newton(LBFGS(LogisticGradient()), big, y,
-                             free_hbm=12 * GB) is None
+    # non-least-squares gradient, resident: stock full-batch passes
+    p = plan_quasi_newton(LBFGS(LogisticGradient()), big, y,
+                          free_hbm=12 * GB)
+    assert p.schedule == "resident_stock"
+    assert "no fixed-size statistics" in p.reason
 
-    # streaming schedules cannot be forced behind LBFGS
+    # non-least-squares gradient, beyond HBM: the chunked treeAggregate
+    # CostFun (round 5, VERDICT r4 #1) — host_streamed with a chunk cap
+    p = plan_quasi_newton(LBFGS(LogisticGradient()), huge, y,
+                          free_hbm=12 * GB)
+    assert p.schedule == "host_streamed"
+    assert p.batch_rows is not None
+    # two in-flight chunks fit in half the budget
+    assert 2 * p.batch_rows * 1000 * 2 <= 12 * GB
+    assert "treeAggregate" in p.reason
+
+    # schedules outside the quasi-Newton menu still reject
     with pytest.raises(ValueError, match="does not exist behind"):
         plan_quasi_newton(LBFGS(), big, y, free_hbm=12 * GB,
-                          force="host_streamed")
+                          force="partial_residency")
 
     # forcing gram on a short run warns
     opt = LBFGS(max_num_iterations=3)
@@ -564,6 +576,132 @@ def test_plan_quasi_newton_boundaries():
                               force="resident_gram")
     assert p.schedule == "resident_gram"
     assert any("NET LOSS" in str(r.message) for r in rec)
+
+
+def test_train_auto_plans_host_streamed_costfun(rng, caplog, monkeypatch):
+    """Zero-flag quasi-Newton train() on beyond-HBM NON-least-squares
+    data lands on the chunked-CostFun schedule and still converges — the
+    reference's any-size-any-loss CostFun contract (VERDICT r4 #1)."""
+    import tpu_sgd.plan as plan_mod
+    from tpu_sgd.models import LogisticRegressionWithLBFGS
+
+    monkeypatch.setattr(plan_mod, "device_budget",
+                        lambda *a, **k: (8e3, "test"))  # 8 KB "HBM"
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        alg = LogisticRegressionWithLBFGS()
+        model = alg.run((X, y))
+    msgs = [r.message for r in caplog.records
+            if r.message.startswith("plan: ")]
+    assert msgs and "host_streamed" in msgs[0]
+    assert alg.optimizer.host_streaming
+    assert alg.optimizer.stream_batch_rows is not None
+    acc = float((np.asarray(model.predict(X)) == y).mean())
+    assert acc > 0.9
+
+
+def test_stale_plan_flags_reset_on_unplannable_input(rng, monkeypatch):
+    """A later run on an un-plannable input (BCOO) must not crash on the
+    PREVIOUS plan's host_streaming flag — plan-owned flags reset when the
+    planner has nothing to say (code-review r5)."""
+    import tpu_sgd.plan as plan_mod
+    from tpu_sgd.models import LogisticRegressionWithLBFGS
+    from tpu_sgd.ops.sparse import sparse_data
+
+    monkeypatch.setattr(plan_mod, "device_budget",
+                        lambda *a, **k: (8e3, "test"))
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, 8).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    alg = LogisticRegressionWithLBFGS(max_num_iterations=5)
+    alg.run((X, y))
+    assert alg.optimizer.host_streaming  # planner picked the CostFun
+    Xs, ys, _ = sparse_data(64, 8, nnz_per_row=3, seed=0)
+    ys = np.abs(np.sign(np.asarray(ys)))
+    model = alg.run((Xs, ys))  # must not raise "needs dense rows"
+    assert not alg.optimizer.host_streaming  # stale flag was reset
+    assert model is not None
+
+
+def test_force_gram_rejected_for_non_ls_gradient():
+    """Forcing a statistics schedule onto a loss with no fixed-size
+    statistics must raise a clear error naming the loss family, not warn
+    about block sizes and silently run stock (code-review r5)."""
+    from tpu_sgd import LBFGS, plan_quasi_newton
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    big = _ShapeOnly((3_000_000, 1000), np.float16)
+    for force in ("resident_gram", "streamed_virtual_gram"):
+        with pytest.raises(ValueError, match="LogisticGradient"):
+            plan_quasi_newton(LBFGS(LogisticGradient()), big, None,
+                              free_hbm=12 * GB, force=force)
+
+
+def test_meshed_coercion_defers_device_commit(rng):
+    """Meshed quasi-Newton inputs stay HOST arrays through coercion: a
+    jnp.asarray there would stage the whole beyond-one-HBM matrix through
+    the default device before sharding (code-review r5)."""
+    import jax
+
+    from tpu_sgd.optimize.lbfgs import _coerce_inputs
+
+    X = rng.normal(size=(64, 4)).astype(np.float64)
+    y = rng.integers(0, 2, 64)
+    w0 = np.zeros(4, np.float32)
+    Xc, yc, wc = _coerce_inputs(X, y, w0, defer_commit=True)
+    assert isinstance(Xc, np.ndarray) and not isinstance(Xc, jax.Array)
+    assert isinstance(yc, np.ndarray) and not isinstance(yc, jax.Array)
+    assert yc.dtype == np.float32  # int labels still coerce
+    assert isinstance(wc, jax.Array)
+    # unmeshed coercion commits as before
+    Xc2, _, _ = _coerce_inputs(X, y, w0)
+    assert isinstance(Xc2, jax.Array)
+
+
+def test_plan_quasi_newton_meshed_boundaries():
+    """VERDICT r4 #5: the quasi-Newton planner divides the HBM budget by
+    the data-shard count like the GD planner, and plans the per-shard
+    statistics substitution."""
+    from tpu_sgd import LBFGS, data_mesh, plan_quasi_newton
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    y = None
+    mesh = data_mesh()  # 8-way
+
+    # 8 devices hold 8x the rows: a dataset that must stream on one chip
+    # is resident (and gram-able) on the mesh
+    mid = _ShapeOnly((40_000_000, 1000), np.float16)  # ~80 GB total
+    one = plan_quasi_newton(LBFGS(), mid, y, free_hbm=12 * GB)
+    eight = plan_quasi_newton(LBFGS().set_mesh(mesh), mid, y,
+                              free_hbm=12 * GB)
+    assert one.schedule == "streamed_virtual_gram"
+    assert eight.schedule == "resident_gram"
+    assert eight.estimates["n_devices"] == 8
+    assert "per-shard totals" in eight.reason
+
+    # beyond even the meshed budget: per-shard streamed TOTALS builds
+    # (exact — no dropped tail, unlike the single-device prefix build)
+    huge = _ShapeOnly((800_000_000, 1000), np.float16)
+    p = plan_quasi_newton(LBFGS().set_mesh(mesh), huge, y,
+                          free_hbm=12 * GB)
+    assert p.schedule == "streamed_virtual_gram"
+    assert "EXACT totals" in p.reason
+
+    # meshed non-LS beyond HBM: the chunked CostFun composes with the
+    # mesh (per-shard chunk streams + psum)
+    p = plan_quasi_newton(LBFGS(LogisticGradient()).set_mesh(mesh),
+                          huge, y, free_hbm=12 * GB)
+    assert p.schedule == "host_streamed"
+    assert p.batch_rows is not None
+
+    # a model-sharded mesh is left alone
+    from tpu_sgd import make_mesh
+
+    opt = LBFGS()
+    opt.mesh = make_mesh(n_data=4, n_model=2)  # bypass the setter guard
+    assert plan_quasi_newton(opt, mid, y, free_hbm=12 * GB) is None
 
 
 def test_lbfgs_train_auto_plans_and_forced_gram(rng, caplog):
@@ -744,9 +882,13 @@ def test_lbfgs_streamed_stats_guards(rng):
     with pytest.raises(NotImplementedError, match="least squares"):
         LBFGS(LogisticGradient()).set_streamed_stats(True) \
             .optimize_with_history((X, np.abs(np.sign(y))), w0)
-    with pytest.raises(NotImplementedError, match="single-device"):
-        LBFGS().set_streamed_stats(True).set_mesh(data_mesh()) \
-            .optimize_with_history((X, y), w0)
+    # meshed streamed statistics are SUPPORTED since round 5 (per-shard
+    # totals builds — tests/test_lbfgs.py) — the old single-device guard
+    # is gone; the remaining mesh guard is the model-axis rejection
+    from tpu_sgd import make_mesh
+
+    with pytest.raises(ValueError, match="data-only mesh"):
+        LBFGS().set_mesh(make_mesh(n_data=4, n_model=2))
 
 
 def test_choose_streamed_build_budgets_chunk():
@@ -789,3 +931,48 @@ def test_plan_batch_rows_plumbs_to_optimizer():
     opt = p.apply(GradientDescent())
     assert opt.gram_batch_rows == p.batch_rows
     assert opt.gram_block_rows == p.block_rows
+
+
+def test_manual_setter_clears_planned_sibling_flags(rng):
+    """A manual schedule setter after an auto-planned run must clear the
+    PLAN's sibling flags — the mutual-exclusion guards must never blame
+    the user for a flag the planner set (code-review r5)."""
+    from tpu_sgd import GradientDescent, LBFGS
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+
+    opt = GradientDescent()
+    Plan("host_streamed", "auto plan").apply(opt)
+    assert opt.host_streaming
+    opt.set_streamed_stats(True)
+    assert not opt.host_streaming  # plan-set sibling cleared
+    assert opt.streamed_stats
+
+    lb = LBFGS(LeastSquaresGradient(), max_num_iterations=3)
+    lb.host_streaming = True  # as the QN planner leaves it...
+    lb.last_plan = Plan("host_streamed", "auto plan")  # ...with last_plan
+    lb.set_streamed_stats(True, block_rows=32)
+    assert not lb.host_streaming
+    # and the run proceeds without the exclusion guard firing
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    y = rng.normal(size=(256,)).astype(np.float32)
+    w, h = lb.optimize_with_history((X, y), np.zeros(6, np.float32))
+    assert np.all(np.isfinite(np.asarray(w)))
+    # USER-set flags (last_plan is None) are never cleared by a sibling
+    lb2 = LBFGS().set_host_streaming(True)
+    with pytest.raises(ValueError, match="alternative"):
+        lb2.set_streamed_stats(True).optimize_with_history(
+            (X, y), np.zeros(6, np.float32))
+
+
+def test_meshed_resident_gram_skips_stack_feasibility():
+    """Meshed quasi-Newton resident gram carries O(d²) totals, not a
+    prefix stack: slim headroom that forbids a stack must not push the
+    planner back to stock (code-review r5)."""
+    from tpu_sgd import LBFGS, data_mesh, plan_quasi_newton
+
+    # per-device slab ~11.9 GB of 12 GB: no prefix stack fits, but the
+    # 3*d² totals carry (12 MB) does
+    tight = _ShapeOnly((47_500_000, 1000), np.float16)
+    p = plan_quasi_newton(LBFGS().set_mesh(data_mesh()), tight, None,
+                          free_hbm=12 * GB)
+    assert p.schedule == "resident_gram"

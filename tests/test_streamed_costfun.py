@@ -1,0 +1,295 @@
+"""Host-streamed chunked CostFun: beyond-HBM quasi-Newton for ANY loss.
+
+VERDICT r4 #1: the reference's LBFGS CostFun does a full-batch
+treeAggregate over an RDD of ANY size for ANY gradient ([U]
+mllib/optimization/LBFGS.scala); `optimize/streamed_costfun.py` is the
+chunked host-streaming analogue.  These tests pin (a) sum-level
+equivalence of the chunked accumulation vs the one-pass resident kernels,
+(b) trajectory parity of host-streamed LBFGS/OWL-QN vs the resident runs
+for logistic, hinge, least-squares, and multinomial losses, (c) the mesh
+composition (per-shard chunks + psum), and (d) the guard rails.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_sgd.ops.gradients import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+    MultinomialLogisticGradient,
+)
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.lbfgs import LBFGS
+from tpu_sgd.optimize.owlqn import OWLQN
+from tpu_sgd.optimize.streamed_costfun import (
+    StreamedCostFun,
+    default_stream_batch_rows,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _binary_data(rng, n=2048, d=12):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _ls_data(rng, n=2048, d=12):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w + 0.05 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+# ---- sum-level equivalence -------------------------------------------------
+
+@pytest.mark.parametrize("gradient", [
+    LeastSquaresGradient(), LogisticGradient(), HingeGradient(),
+])
+def test_chunked_sums_match_one_pass(rng, gradient):
+    """cost/loss/sweep sums over a non-divisible chunk grid must equal the
+    single fused pass (up to summation reassociation)."""
+    X, y = _binary_data(rng, n=1000, d=8)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    scf = StreamedCostFun(gradient, X, y, batch_rows=192)  # 1000 % 192 != 0
+    assert scf.n_chunks == 6
+    gs, ls, c = (np.asarray(v) for v in scf.cost_sums(w))
+    g_ref, l_ref, c_ref = (np.asarray(v) for v in
+                           gradient.batch_sums(jnp.asarray(X),
+                                               jnp.asarray(y),
+                                               jnp.asarray(w)))
+    assert c == c_ref == 1000
+    np.testing.assert_allclose(gs, g_ref, rtol=2e-5, atol=2e-4)
+    np.testing.assert_allclose(ls, l_ref, rtol=2e-5, atol=2e-4)
+    ls2, c2 = (np.asarray(v) for v in scf.loss_sums(w))
+    np.testing.assert_allclose(ls2, l_ref, rtol=2e-5, atol=2e-4)
+    assert c2 == 1000
+    W = np.stack([w, 0.5 * w, np.zeros_like(w)]).astype(np.float32)
+    sw, c3 = (np.asarray(v) for v in scf.sweep_sums(jnp.asarray(W)))
+    sw_ref, _ = gradient.loss_sweep(jnp.asarray(X), jnp.asarray(y),
+                                    jnp.asarray(W))
+    np.testing.assert_allclose(sw, np.asarray(sw_ref), rtol=2e-5, atol=2e-4)
+    assert c3 == 1000
+
+
+def test_default_batch_rows_scales_with_row_bytes():
+    assert default_stream_batch_rows(1000, 4) == 64000
+    assert default_stream_batch_rows(1000, 2) == 128000
+    assert default_stream_batch_rows(10_000_000, 4) == 1024  # floor
+
+
+# ---- trajectory parity: LBFGS ---------------------------------------------
+
+@pytest.mark.parametrize("gradient,updater", [
+    (LogisticGradient(), SquaredL2Updater()),
+    (HingeGradient(), SquaredL2Updater()),
+    (LeastSquaresGradient(), SimpleUpdater()),
+])
+def test_lbfgs_host_streamed_matches_resident(rng, gradient, updater):
+    """Host-streamed LBFGS must reproduce the resident trajectory — the
+    beyond-HBM CostFun is the same math, chunked."""
+    X, y = (_binary_data(rng) if not isinstance(gradient,
+                                                LeastSquaresGradient)
+            else _ls_data(rng))
+    w0 = np.zeros((X.shape[1],), np.float32)
+
+    def make():
+        return LBFGS(gradient, updater, max_num_iterations=15,
+                     convergence_tol=0.0, reg_param=0.01)
+
+    w_res, h_res = make().optimize_with_history((X, y), w0)
+    opt = make().set_host_streaming(True, batch_rows=300)
+    w_str, h_str = opt.optimize_with_history((X, y), w0)
+    # Once the loss is flat at machine precision, the Armijo accept can
+    # flip on last-ulp differences between chunked and fused sums (one
+    # path stops, the other keeps re-accepting no-op steps) — so compare
+    # the common prefix, which must cover the whole descent.
+    L = min(len(h_res), len(h_str))
+    assert L >= 8
+    np.testing.assert_allclose(np.asarray(h_str)[:L],
+                               np.asarray(h_res)[:L],
+                               rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_str), np.asarray(w_res),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_lbfgs_host_streamed_multinomial(rng):
+    """Matrix-weight (flattened) multinomial: the chunked sweep must feed
+    the same ladder economy as the resident run."""
+    n, d, K = 1536, 10, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Wt = rng.normal(size=(K - 1, d)).astype(np.float32)
+    logits = np.concatenate([np.zeros((n, 1)), X @ Wt.T], axis=1)
+    y = logits.argmax(axis=1).astype(np.float32)
+    g = MultinomialLogisticGradient(K)
+    w0 = np.zeros((g.weight_dim(d),), np.float32)
+
+    def make():
+        return LBFGS(g, SquaredL2Updater(), max_num_iterations=10,
+                     convergence_tol=0.0, reg_param=0.01)
+
+    w_res, h_res = make().optimize_with_history((X, y), w0)
+    w_str, h_str = make().set_host_streaming(True, batch_rows=500) \
+        .optimize_with_history((X, y), w0)
+    assert len(h_res) == len(h_str)
+    np.testing.assert_allclose(np.asarray(h_str), np.asarray(h_res),
+                               rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_str), np.asarray(w_res),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_lbfgs_host_streamed_sequential_fallback(rng):
+    """A gradient without loss_sweep falls back to sequential trials with
+    the documented warning; the chunked loss-only evaluation still
+    reproduces the resident fallback trajectory."""
+
+    class NoSweep(LogisticGradient):
+        pass
+
+    NoSweep.loss_sweep = property()  # hides the attribute (AttributeError)
+    X, y = _binary_data(rng, n=800, d=6)
+    w0 = np.zeros((6,), np.float32)
+    g = NoSweep()
+    assert not hasattr(g, "loss_sweep")
+
+    def make():
+        return LBFGS(g, SquaredL2Updater(), max_num_iterations=8,
+                     convergence_tol=0.0, reg_param=0.01)
+
+    with pytest.warns(RuntimeWarning, match="SEQUENTIAL"):
+        w_res, h_res = make().optimize_with_history((X, y), w0)
+    with pytest.warns(RuntimeWarning, match="SEQUENTIAL"):
+        w_str, h_str = make().set_host_streaming(True, batch_rows=300) \
+            .optimize_with_history((X, y), w0)
+    assert len(h_res) == len(h_str)
+    np.testing.assert_allclose(np.asarray(w_str), np.asarray(w_res),
+                               rtol=5e-4, atol=5e-5)
+
+
+# ---- trajectory parity: OWL-QN --------------------------------------------
+
+def test_owlqn_host_streamed_matches_resident(rng):
+    X, y = _binary_data(rng)
+    w0 = np.zeros((X.shape[1],), np.float32)
+
+    def make():
+        return OWLQN(LogisticGradient(), max_num_iterations=12,
+                     convergence_tol=0.0, reg_param=0.005)
+
+    w_res, h_res = make().optimize_with_history((X, y), w0)
+    w_str, h_str = make().set_host_streaming(True, batch_rows=300) \
+        .optimize_with_history((X, y), w0)
+    assert len(h_res) == len(h_str)
+    np.testing.assert_allclose(np.asarray(h_str), np.asarray(h_res),
+                               rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_str), np.asarray(w_res),
+                               rtol=5e-4, atol=5e-5)
+    # L1 actually sparsifies on both paths identically
+    assert (np.asarray(w_str) == 0).sum() == (np.asarray(w_res) == 0).sum()
+
+
+# ---- mesh composition ------------------------------------------------------
+
+def test_lbfgs_host_streamed_mesh_matches_single(rng):
+    """Per-shard chunk streams + psum must reproduce the single-device
+    host-streamed run (and so the resident run) — the multi-executor
+    treeAggregate shape."""
+    from tpu_sgd import data_mesh
+
+    X, y = _binary_data(rng, n=2048, d=12)
+    w0 = np.zeros((12,), np.float32)
+
+    def make():
+        return LBFGS(LogisticGradient(), SquaredL2Updater(),
+                     max_num_iterations=12, convergence_tol=0.0,
+                     reg_param=0.01)
+
+    w_one, h_one = make().set_host_streaming(True, batch_rows=512) \
+        .optimize_with_history((X, y), w0)
+    w_mesh, h_mesh = make().set_host_streaming(True, batch_rows=512) \
+        .set_mesh(data_mesh()).optimize_with_history((X, y), w0)
+    assert len(h_one) == len(h_mesh)
+    np.testing.assert_allclose(np.asarray(h_mesh), np.asarray(h_one),
+                               rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_mesh), np.asarray(w_one),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_mesh_chunk_cap_padding(rng):
+    """A chunk cap that does not divide the mesh is padded up and masked
+    — sums stay exact."""
+    from tpu_sgd import data_mesh
+
+    mesh = data_mesh()
+    X, y = _binary_data(rng, n=700, d=8)
+    g = LogisticGradient()
+    w = rng.normal(size=(8,)).astype(np.float32)
+    scf = StreamedCostFun(g, X, y, batch_rows=250, mesh=mesh)
+    assert scf.cap % mesh.shape["data"] == 0
+    gs, ls, c = (np.asarray(v) for v in scf.cost_sums(w))
+    g_ref, l_ref, _ = (np.asarray(v) for v in
+                       g.batch_sums(jnp.asarray(X), jnp.asarray(y),
+                                    jnp.asarray(w)))
+    assert c == 700
+    np.testing.assert_allclose(gs, g_ref, rtol=2e-5, atol=2e-4)
+    np.testing.assert_allclose(ls, l_ref, rtol=2e-5, atol=2e-4)
+
+
+# ---- guards ----------------------------------------------------------------
+
+def test_host_streaming_guards(rng):
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+    from tpu_sgd.ops.sparse import sparse_data
+
+    X, y = _ls_data(rng, n=256, d=8)
+    w0 = np.zeros((8,), np.float32)
+    Xs, ys, _ = sparse_data(64, 8, nnz_per_row=3, seed=0)
+    with pytest.raises(NotImplementedError, match="dense rows"):
+        LBFGS().set_host_streaming(True).optimize_with_history(
+            (Xs, ys), w0)
+    g = GramLeastSquaresGradient.build(X, y, block_rows=64)
+    with pytest.raises(ValueError, match="statistics"):
+        LBFGS(g).set_host_streaming(True).optimize_with_history(
+            (g.data, y), w0)
+    with pytest.raises(ValueError, match="alternative"):
+        LBFGS().set_host_streaming(True).set_streamed_stats(True) \
+            .optimize_with_history((X, y), w0)
+    with pytest.raises(ValueError, match="device-resident"):
+        LBFGS().set_host_streaming(True).set_sufficient_stats(True) \
+            .optimize_with_history((X, y), w0)
+    with pytest.raises(ValueError, match="batch_rows must be positive"):
+        LBFGS().set_host_streaming(True, batch_rows=0)
+
+
+def test_streamed_costfun_identity_cache(rng):
+    """Repeat optimize() calls on the same arrays must reuse the compiled
+    CostFun (identity cache), and release_sufficient_stats drops it."""
+    X, y = _binary_data(rng, n=512, d=8)
+    w0 = np.zeros((8,), np.float32)
+    opt = LBFGS(LogisticGradient(), SquaredL2Updater(),
+                max_num_iterations=3, convergence_tol=0.0) \
+        .set_host_streaming(True, batch_rows=256)
+    opt.optimize_with_history((X, y), w0)
+    entry = opt._stream_costfun_entry
+    assert entry is not None
+    opt.optimize_with_history((X, y), w0)
+    assert opt._stream_costfun_entry is entry  # reused, not rebuilt
+    opt.release_sufficient_stats()
+    assert opt._stream_costfun_entry is None
+
+
+def test_empty_input_falls_through(rng):
+    w0 = np.zeros((4,), np.float32)
+    X = np.zeros((0, 4), np.float32)
+    y = np.zeros((0,), np.float32)
+    w, h = LBFGS().set_host_streaming(True).optimize_with_history(
+        (X, y), w0)
+    assert h.shape == (0,)

@@ -200,6 +200,12 @@ class GeneralizedLinearAlgorithm:
 
                 opt.sufficient_stats = p.schedule == "resident_gram"
                 opt.streamed_stats = p.schedule == "streamed_virtual_gram"
+                opt.host_streaming = p.schedule == "host_streamed"
+                if "stream_batch_rows" not in getattr(
+                        opt, "_user_gram_opts", frozenset()):
+                    opt.stream_batch_rows = (
+                        p.batch_rows if p.schedule == "host_streamed"
+                        else None)
                 # direct assignment, user-set knobs preserved (the
                 # setters record user intent — see Plan.apply)
                 apply_gram_knobs(opt, p)
@@ -211,7 +217,24 @@ class GeneralizedLinearAlgorithm:
         if p is not None:
             opt._plan_key = key
             logger.info(p.describe())
-        elif force is not None:
+        elif getattr(opt, "last_plan", None) is not None:
+            # Un-plannable input (sparse/BCOO, GramData, model mesh) after
+            # a planned run: the PREVIOUS plan's schedule flags are the
+            # planner's own and must not leak onto this dataset (e.g. a
+            # stale host_streaming=True would crash a zero-flag user on
+            # BCOO input) — reset to stock.
+            opt.host_streaming = False
+            opt.sufficient_stats = False
+            opt.streamed_stats = False
+            if hasattr(opt, "streaming_resident_rows"):
+                opt.streaming_resident_rows = 0
+            if (hasattr(opt, "stream_batch_rows")
+                    and "stream_batch_rows" not in getattr(
+                        opt, "_user_gram_opts", frozenset())):
+                opt.stream_batch_rows = None
+            opt.last_plan = None
+            opt._plan_key = None
+        if p is None and force is not None:
             raise ValueError(
                 f"schedule={force!r} cannot be applied here: this "
                 "optimizer/input is not planned (sparse/BCOO or GramData "

@@ -255,6 +255,122 @@ def _write_prefix(PG, Pb, Pyy, pG, pb, pyy, kb1):
     )
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _acc_totals(G, b, yy, dG, db, dyy):
+    """In-place (donated) accumulate of one chunk's totals."""
+    return G + dG, b + db, yy + dyy
+
+
+class _PrefixBuildCheckpoint:
+    """Per-chunk persistence for the streamed prefix build (VERDICT r4
+    #4): each part file holds one chunk's inclusive prefix rows (f32
+    device→host readback), written atomically (tmp+rename); ``meta.json``
+    records the build geometry and the high-water row mark.  A restart
+    validates the geometry, replays the persisted parts into the fresh
+    device stack, and continues from the high-water block — the carry is
+    the last persisted prefix row, so the resumed build is BITWISE
+    identical to an uninterrupted one."""
+
+    def __init__(self, path, *, n_used, d, B, sd_name, chunk,
+                 fingerprint=""):
+        import json
+        import os
+
+        self.path = path
+        self.meta = {
+            "class": "PrefixBuildCheckpoint",
+            "n_used": int(n_used), "d": int(d), "B": int(B),
+            "stats_dtype": sd_name, "chunk": int(chunk),
+            "fingerprint": fingerprint,
+            "high_water_rows": 0,
+        }
+        os.makedirs(path, exist_ok=True)
+        self._meta_path = os.path.join(path, "meta.json")
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                on_disk = json.load(f)
+            # geometry AND dataset identity: a stale resume_dir from a
+            # different same-shaped dataset would otherwise silently mix
+            # two datasets' statistics
+            geometry = {k: on_disk.get(k) for k in
+                        ("class", "n_used", "d", "B", "stats_dtype",
+                         "fingerprint")}
+            want = {k: self.meta[k] for k in geometry}
+            if geometry != want:
+                raise ValueError(
+                    f"resume_dir {path!r} holds a different build "
+                    f"({geometry} != {want}); point resume_dir at a "
+                    "fresh directory or delete the stale one"
+                )
+            self.meta["high_water_rows"] = int(
+                on_disk.get("high_water_rows", 0))
+
+    def _part_path(self, start_block: int) -> str:
+        import os
+
+        return os.path.join(self.path, f"part_{start_block:08d}.npz")
+
+    def restore(self):
+        """``(resume_row, parts)``: the row offset to continue from plus
+        the persisted ``(start_block, (pG, pb, pyy))`` chunks in order.
+        Part files past the recorded high-water mark (a crash between
+        part write and meta write) are replayed too — they are valid
+        completed chunks."""
+        import glob
+        import os
+
+        import numpy as np
+
+        parts = []
+        resume_row = 0
+        for fp in sorted(glob.glob(os.path.join(self.path, "part_*.npz"))):
+            start_block = int(os.path.basename(fp)[5:-4])
+            if start_block * self.meta["B"] != resume_row:
+                break  # a gap: earlier part missing — stop replay here
+            z = np.load(fp)
+            parts.append((start_block, (z["pG"], z["pb"], z["pyy"])))
+            resume_row += z["pG"].shape[0] * self.meta["B"]
+        return resume_row, parts
+
+    def save_part(self, start_block: int, pG, pb, pyy,
+                  high_water_rows: int) -> None:
+        import json
+        import os
+
+        import numpy as np
+
+        fp = self._part_path(start_block)
+        tmp = fp + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, pG=np.asarray(pG), pb=np.asarray(pb),
+                     pyy=np.asarray(pyy))
+        os.replace(tmp, fp)  # atomic: a part either exists whole or not
+        self.meta["high_water_rows"] = int(high_water_rows)
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.meta, f)
+        os.replace(tmp, self._meta_path)
+
+    def finalize(self) -> None:
+        """Drop the part files once the build completed (the caller holds
+        the finished stacks; `GramData.save` is the durable format)."""
+        import shutil
+
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+@lru_cache(maxsize=16)
+def _streamed_totals_fn(B, sd_name):
+    """Jitted per-chunk TOTALS kernel, memoized per (block size, stats
+    dtype) so the per-shard mesh builder compiles once, not once per
+    device per build (compile stalls are a real cost on the remote-TPU
+    tunnel)."""
+    return jax.jit(partial(
+        GramLeastSquaresGradient._total_stats,
+        B=B, stats_dtype=jnp.dtype(sd_name),
+    ))
+
+
 @lru_cache(maxsize=16)
 def _streamed_stats_fn(B, sd_name):
     """Jitted per-chunk block-stats kernel, memoized per (block size,
@@ -380,6 +496,72 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         blocks2 = jnp.concatenate([zero, blocks.astype(sd)])
         return _running_sum(jnp.zeros(blocks.shape[1:], sd), blocks2)
 
+    @staticmethod
+    def _total_stats(X, y, *, B, stats_dtype, valid=None):
+        """TOTAL statistics ``(G, b, yy)`` of ``(X, y)`` by blockwise
+        accumulation — one block's stats-dtype upcast live at a time with
+        an O(d²) carry (no prefix stack: the quasi-Newton CostFun reads
+        only totals, so meshed/combined builds skip the window machinery
+        entirely).  ``valid`` masks padded rows exactly (zeroing one
+        matmul operand's rows: Σ m·x xᵀ).  The ``n % B`` tail is a
+        static-shape extra block, so totals are EXACT."""
+        sd = stats_dtype
+        n = X.shape[0]
+        nbf = n // B
+
+        def masked(Xb, yb, vb):
+            if vb is None:
+                return Xb.astype(sd), yb.astype(sd)
+            m = vb.astype(sd)
+            return Xb.astype(sd) * m[:, None], yb.astype(sd) * m
+
+        def step(carry, k):
+            G, b, yy = carry
+            Xb = jax.lax.dynamic_slice_in_dim(X, k * B, B, 0)
+            yb = jax.lax.dynamic_slice_in_dim(y, k * B, B, 0)
+            vb = (None if valid is None else
+                  jax.lax.dynamic_slice_in_dim(valid, k * B, B, 0))
+            Xm, ym = masked(Xb, yb, vb)
+            return (
+                G + _dot_hi(Xm.T, Xb, sd),
+                b + _dot_hi(ym, Xb, sd),
+                yy + jnp.dot(ym, yb.astype(sd)),
+            ), None
+
+        d = X.shape[1]
+        init = (jnp.zeros((d, d), sd), jnp.zeros((d,), sd),
+                jnp.zeros((), sd))
+        (G, b, yy), _ = jax.lax.scan(step, init, jnp.arange(nbf))
+        Xt = X[nbf * B:]  # static-shape tail
+        yt = y[nbf * B:]
+        vt = None if valid is None else valid[nbf * B:]
+        Xm, ym = masked(Xt, yt, vt)
+        return (G + _dot_hi(Xm.T, Xt, sd), b + _dot_hi(ym, Xt, sd),
+                yy + jnp.dot(ym, yt.astype(sd)))
+
+    @staticmethod
+    def totals_only_data(G_tot, b_tot, yy_tot, n: int, d: int,
+                         data_dtype) -> "GramData":
+        """A VIRTUAL :class:`GramData` carrying ONLY totals (a trivial
+        one-block prefix stack) — sufficient for the quasi-Newton
+        CostFun's full-batch sums and line-search sweeps, which never
+        read windows.  Window-based execution (GD sliced sampling) sees
+        every window as the full batch and must not use this."""
+        sd = G_tot.dtype
+        zero_G = jnp.zeros_like(G_tot)
+        zero_b = jnp.zeros_like(b_tot)
+        zero_yy = jnp.zeros_like(yy_tot)
+        return GramData(
+            None,
+            jnp.stack([zero_G, G_tot]),
+            jnp.stack([zero_b, b_tot]),
+            jnp.stack([zero_yy, yy_tot]),
+            G_tot, b_tot, yy_tot,
+            int(n),
+            logical_shape=(int(n), int(d)),
+            logical_dtype=data_dtype,
+        )
+
     @classmethod
     def _precompute(cls, X, y, *, B, stats_dtype):
         sd = stats_dtype
@@ -400,7 +582,9 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
     @classmethod
     def build_streamed(cls, X, y, block_rows: int = DEFAULT_BLOCK_ROWS,
                        batch_rows: Optional[int] = None,
-                       stats_dtype=None) -> "GramLeastSquaresGradient":
+                       stats_dtype=None,
+                       resume_dir: Optional[str] = None,
+                       ) -> "GramLeastSquaresGradient":
         """Statistics for a HOST-resident dataset too large for HBM.
 
         Streams ``(X, y)`` through the device batch-by-batch, accumulating
@@ -415,7 +599,10 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         The trailing ``n % block_rows`` rows are dropped (windows are
         block-aligned anyway; document-level deviation, <0.1% of rows).
         ``batch_rows`` (default 64 blocks) is the host→device transfer
-        granularity.
+        granularity.  ``resume_dir`` (opt-in) makes the pass RESUMABLE:
+        each chunk's prefix rows persist to atomic part files so a build
+        killed mid-stream (a wedged host link) restarts from its
+        high-water block, bitwise identical (see ``_streamed_prefix``).
         """
         import numpy as np
 
@@ -433,7 +620,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         sd = cls._resolve_stats_dtype(data_dtype, stats_dtype)
         chunk_blocks = max(1, int(batch_rows) // B) if batch_rows else 64
         chunk = chunk_blocks * B
-        PG, Pb, Pyy = cls._streamed_prefix(Xh, yh, B, sd, chunk)
+        PG, Pb, Pyy = cls._streamed_prefix(Xh, yh, B, sd, chunk,
+                                           resume_dir=resume_dir)
         jax.block_until_ready((PG, Pb, Pyy))
         data = GramData(
             None, PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1], B,
@@ -443,7 +631,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         return cls(data)
 
     @classmethod
-    def _streamed_prefix(cls, Xh, yh, B, sd, chunk, device=None):
+    def _streamed_prefix(cls, Xh, yh, B, sd, chunk, device=None,
+                         resume_dir=None):
         """Chunked host->device streaming prefix build on ``device``
         (default placement when None) — shared by :meth:`build_streamed`
         and the per-shard mesh builder (``parallel/gram_parallel.py``).
@@ -454,7 +643,18 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         An earlier bulk-assembly version (stack all block stats, concat,
         prefix in one program) peaked at ~3x the prefix size and died
         RESOURCE_EXHAUSTED at 10Mx1000 on a fragmented 16 GB chip; this
-        form peaks at prefix + one chunk (~5.5 GB there)."""
+        form peaks at prefix + one chunk (~5.5 GB there).
+
+        ``resume_dir`` (opt-in): after each chunk, persist that chunk's
+        prefix rows to an atomic part file (plus a meta record), so a
+        build killed mid-pass — this environment's host link has wedged
+        for hours at a time — restarts from the high-water block instead
+        of from zero, BITWISE identical (the resumed carry is the last
+        persisted f32 prefix row; the per-chunk math is deterministic).
+        The analogue of RDD lineage replay resuming from persisted
+        partitions (SURVEY.md §5.3).  Costs one device→host readback of
+        each chunk's prefix rows — enable it when the feed is flaky, not
+        by default."""
         import numpy as np
 
         n_used = (Xh.shape[0] // B) * B
@@ -478,6 +678,30 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         cb = zeros_fn((d,), sd)
         cyy = zeros_fn((), sd)
         s = 0
+        ckpt = None
+        if resume_dir is not None:
+            import hashlib
+
+            # cheap dataset identity: first/last used row + a label head
+            # (the geometry check alone cannot tell two same-shaped
+            # datasets apart)
+            h = hashlib.sha1()
+            h.update(np.ascontiguousarray(Xh[0]).tobytes())
+            h.update(np.ascontiguousarray(Xh[n_used - 1]).tobytes())
+            h.update(np.ascontiguousarray(
+                np.asarray(yh[:min(64, n_used)], np.float64)).tobytes())
+            ckpt = _PrefixBuildCheckpoint(
+                resume_dir, n_used=n_used, d=d, B=B,
+                sd_name=jnp.dtype(sd).name, chunk=chunk,
+                fingerprint=h.hexdigest(),
+            )
+            s, parts = ckpt.restore()
+            for start_block, (pGh, pbh, pyyh) in parts:
+                pG, pb, pyy = put(pGh), put(pbh), put(pyyh)
+                PG, Pb, Pyy = _write_prefix(
+                    PG, Pb, Pyy, pG, pb, pyy,
+                    jnp.asarray(start_block + 1, jnp.int32))
+                cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
         while s < n_used:
             e = min(s + chunk, n_used)
             if (e - s) % B:  # last partial chunk: shrink to whole blocks
@@ -492,8 +716,37 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
             cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
             PG, Pb, Pyy = _write_prefix(PG, Pb, Pyy, pG, pb, pyy,
                                         jnp.asarray(s // B + 1, jnp.int32))
+            if ckpt is not None:
+                ckpt.save_part(s // B, pG, pb, pyy, high_water_rows=e)
             s = e
+        if ckpt is not None:
+            ckpt.finalize()
         return PG, Pb, Pyy
+
+    @classmethod
+    def _streamed_totals(cls, Xh, yh, B, sd, chunk, device=None):
+        """Chunked host→device streaming TOTALS accumulation on
+        ``device`` — like :meth:`_streamed_prefix` but with an O(d²)
+        carry instead of a prefix stack (the quasi-Newton CostFun reads
+        only totals), and EXACT: every row contributes (the tail chunk
+        is a second static shape, not a drop)."""
+        import numpy as np
+
+        n, d = Xh.shape
+        zeros_fn = partial(jnp.zeros, device=device)
+        G = zeros_fn((d, d), sd)
+        b = zeros_fn((d,), sd)
+        yy = zeros_fn((), sd)
+        tot_fn = _streamed_totals_fn(B, jnp.dtype(sd).name)
+        s = 0
+        while s < n:
+            e = min(s + chunk, n)
+            Xc = jax.device_put(Xh[s:e], device)
+            yc = jax.device_put(np.asarray(yh[s:e]), device)
+            dG, db, dyy = tot_fn(Xc, yc)
+            G, b, yy = _acc_totals(G, b, yy, dG, db, dyy)
+            s = e
+        return G, b, yy
 
     # -- binding check -----------------------------------------------------
     def _stats_for(self, X, mask_or_valid, margin_axis_name):

@@ -351,10 +351,23 @@ class GradientDescent(Optimizer):
         and windows inside that prefix are sliced on-device, cutting
         per-epoch host->device traffic by ~``resident_rows/n`` with an
         unchanged window sequence (see ``optimize_host_streamed``)."""
+        self._clear_planned_schedule()
         self.host_streaming = bool(flag)
         self.streaming_resident_rows = int(resident_rows)
         self._mark_manual_schedule()
         return self
+
+    def _clear_planned_schedule(self):
+        """A manual schedule setter taking the wheel AFTER an auto-planned
+        run: the previous plan's sibling flags are the PLANNER's, not the
+        user's — reset them so the schedule-exclusion guards never blame
+        the user for a flag a plan set (user-set flags always come with
+        ``last_plan is None``)."""
+        if self.last_plan is not None:
+            self.host_streaming = False
+            self.streaming_resident_rows = 0
+            self.sufficient_stats = False
+            self.streamed_stats = False
 
     def _mark_manual_schedule(self):
         """A user-called schedule setter invalidates any auto-plan: the
@@ -380,6 +393,7 @@ class GradientDescent(Optimizer):
         pins the dataset plus the ~GB-scale prefix stack in HBM until a
         different dataset is passed, the optimizer is dropped, or
         :meth:`release_sufficient_stats` is called."""
+        self._clear_planned_schedule()
         self.sufficient_stats = bool(flag)
         self._mark_manual_schedule()
         return self
@@ -438,6 +452,7 @@ class GradientDescent(Optimizer):
         streaming.  Applies to exactly ``LeastSquaresGradient`` on dense
         single-device data with sliced or full-batch sampling; the build is
         identity-cached per ``(X, y)`` like ``set_sufficient_stats``."""
+        self._clear_planned_schedule()
         self.streamed_stats = bool(flag)
         if block_rows is not None:
             self.gram_block_rows = int(block_rows)

@@ -84,20 +84,36 @@ def _warn_sequential_line_search(gradient, n_trials):
     )
 
 
-def _coerce_inputs(X, y, w):
-    """Shared (X, y, w) -> inexact jnp arrays coercion for the quasi-Newton
+def _coerce_inputs(X, y, w, defer_commit: bool = False):
+    """Shared (X, y, w) -> inexact arrays coercion for the quasi-Newton
     optimizers.  BCOO feature matrices and GramData statistics bundles
     pass through untouched (the fused cost dispatches to the sparse
-    lowering / the sufficient-stats totals respectively)."""
+    lowering / the sufficient-stats totals respectively).
+
+    ``defer_commit`` (meshed runs): leave dense host (X, y) as
+    dtype-coerced NUMPY arrays — ``jnp.asarray`` would commit the whole
+    matrix to the DEFAULT device first, which OOMs for data larger than
+    one device's HBM, exactly the regime the mesh serves.  The sharded
+    placement (``shard_dataset`` / the per-shard statistics builders)
+    then transfers each shard straight to its own device.  Already-
+    committed ``jax.Array`` inputs keep their placement either way."""
+    import numpy as np
+
     from tpu_sgd.ops.gram import GramData
 
+    def to_inexact(a):
+        # ONE dtype policy for both namespaces: deferred host arrays
+        # stay numpy, everything else commits via jnp
+        xp = (np if defer_commit and not isinstance(a, jax.Array)
+              else jnp)
+        a = xp.asarray(a)
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            a = a.astype(xp.float32)
+        return a
+
     if not is_sparse(X) and not isinstance(X, GramData):
-        X = jnp.asarray(X)
-        if not jnp.issubdtype(X.dtype, jnp.inexact):
-            X = X.astype(jnp.float32)
-    y = jnp.asarray(y)
-    if not jnp.issubdtype(y.dtype, jnp.inexact):
-        y = y.astype(jnp.float32)
+        X = to_inexact(X)
+    y = to_inexact(y)
     w = jnp.asarray(w)
     if not jnp.issubdtype(w.dtype, jnp.inexact):
         w = w.astype(jnp.float32)
@@ -321,6 +337,8 @@ class LBFGS(Optimizer):
         self.mesh = None
         self.sufficient_stats = False
         self.streamed_stats = False
+        self.host_streaming = False
+        self.stream_batch_rows = None
         self.gram_block_rows = DEFAULT_BLOCK_ROWS
         self.gram_batch_rows = None
         #: gram-knob fields the USER set (planner preserves these; see
@@ -330,6 +348,7 @@ class LBFGS(Optimizer):
         self._plan_key = None
         self._gram_entry = None
         self._streamed_gram_entry = None
+        self._stream_costfun_entry = None
         self._loss_history = None
 
     # fluent setters, reference parity
@@ -357,6 +376,17 @@ class LBFGS(Optimizer):
         self.reg_param = float(r)
         return self
 
+    def _clear_planned_schedule(self):
+        """A manual schedule setter taking the wheel AFTER an auto-planned
+        run: the previous plan's sibling flags are the PLANNER's, not the
+        user's — reset them so the mutual-exclusion guards never blame
+        the user for a flag a plan set (user-set flags are untouched:
+        they always come with ``last_plan is None``)."""
+        if self.last_plan is not None:
+            self.host_streaming = False
+            self.sufficient_stats = False
+            self.streamed_stats = False
+
     def set_sufficient_stats(self, flag: bool = True):
         """Run the least-squares CostFun and line-search sweep from
         precomputed block-prefix Gram statistics (``ops/gram.py``): each
@@ -369,6 +399,7 @@ class LBFGS(Optimizer):
         repeated calls on the same arrays (the streaming mode) never
         rebuild; call :meth:`release_sufficient_stats` to free the
         dataset plus its prefix stack from HBM after a one-shot run."""
+        self._clear_planned_schedule()
         self.sufficient_stats = bool(flag)
         # user-set flags invalidate any auto-plan (see glm._auto_plan)
         self.last_plan = None
@@ -379,9 +410,11 @@ class LBFGS(Optimizer):
         """Drop the cached sufficient-statistics bundle so the bound
         dataset plus the GB-scale prefix stack can be freed from HBM
         (``set_sufficient_stats``/``set_streamed_stats`` retain the last
-        build by design)."""
+        build by design).  Also drops the host-streamed CostFun entry
+        (its compiled kernels and host array references)."""
         self._gram_entry = None
         self._streamed_gram_entry = None
+        self._stream_costfun_entry = None
         return self
 
     def set_gram_options(self, block_rows: int = None,
@@ -423,10 +456,43 @@ class LBFGS(Optimizer):
         dropped ``n % block_rows`` tail rows (<0.1% at scale).  Applies
         to exactly ``LeastSquaresGradient`` on dense single-device data;
         the build is identity-cached per ``(X, y)``."""
+        self._clear_planned_schedule()
         self.streamed_stats = bool(flag)
         if block_rows is not None:
             self.gram_block_rows = int(block_rows)
             self._user_gram_opts = self._user_gram_opts | {"block_rows"}
+        self.last_plan = None
+        self._plan_key = None
+        return self
+
+    def set_host_streaming(self, flag: bool = True,
+                           batch_rows: int = None):
+        """Beyond-HBM quasi-Newton for ANY loss: keep the dataset in host
+        RAM and evaluate every full-batch cost/gradient/line-search sweep
+        by streaming the rows through the device in fixed-size chunks
+        with a device-resident accumulator — the chunked treeAggregate
+        CostFun (``optimize/streamed_costfun.py``; [U]
+        mllib/optimization/LBFGS.scala CostFun, SURVEY.md §2 #18).
+
+        Unlike ``set_streamed_stats`` (least squares only, one build
+        pass then O(d²) evaluations), this works for logistic, hinge,
+        and multinomial losses — at the cost of re-reading the dataset
+        through the host feed per evaluation (~3 reads per iteration).
+        Composes with ``set_mesh``: each chunk is row-sharded across the
+        data mesh and per-chunk sums psum over ICI.
+
+        ``batch_rows`` caps the chunk size (default ~256 MB of rows;
+        the execution planner sets it from the probed HBM budget)."""
+        self._clear_planned_schedule()
+        self.host_streaming = bool(flag)
+        if batch_rows is not None:
+            if int(batch_rows) < 1:
+                raise ValueError(
+                    f"batch_rows must be positive, got {batch_rows}"
+                )
+            self.stream_batch_rows = int(batch_rows)
+            self._user_gram_opts = (
+                self._user_gram_opts | {"stream_batch_rows"})
         self.last_plan = None
         self._plan_key = None
         return self
@@ -458,10 +524,19 @@ class LBFGS(Optimizer):
 
         from tpu_sgd.ops.gram import GramData
 
+        if self.streamed_stats and self.host_streaming:
+            raise ValueError(
+                "set_streamed_stats and set_host_streaming are "
+                "alternative beyond-HBM schedules; enable exactly one"
+            )
         if not self.streamed_stats or isinstance(X, GramData):
             return None
         g = self._maybe_streamed_gram(X, y)
         orig, self.gradient = self.gradient, g
+        # The statistics are replicated/device-local after the build, so
+        # the re-entered run executes UNMESHED — full-batch sums are the
+        # exact totals; the mesh's job (dividing the rows) is done.
+        orig_mesh, self.mesh = self.mesh, None
         try:
             return self.optimize_with_history(
                 (g.data, np.asarray(y)[:g.data.shape[0]]),
@@ -469,6 +544,7 @@ class LBFGS(Optimizer):
             )
         finally:
             self.gradient = orig
+            self.mesh = orig_mesh
 
     def _maybe_streamed_gram(self, X, y):
         """Guards + identity-cached build for ``set_streamed_stats``."""
@@ -483,26 +559,40 @@ class LBFGS(Optimizer):
                 "streamed statistics need dense rows; BCOO features are "
                 "~1000x smaller and stay device-resident instead"
             )
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "quasi-Newton streamed statistics run single-device; "
-                "drop set_mesh (the meshed CostFun reads resident shards)"
-            )
         if type(self.gradient) is not _LS:
             raise NotImplementedError(
                 "streamed statistics exist for least squares only (the "
-                f"quadratic loss); got {type(self.gradient).__name__}"
+                f"quadratic loss); got {type(self.gradient).__name__}; "
+                "use set_host_streaming for beyond-HBM non-LS losses"
             )
         entry = self._streamed_gram_entry
-        opts = (self.gram_block_rows, self.gram_batch_rows)
+        opts = (self.gram_block_rows, self.gram_batch_rows, self.mesh)
         if (entry is not None and entry[0] is X and entry[1] is y
                 and entry[3] == opts):
             return entry[2]
-        g = GramLeastSquaresGradient.build_streamed(
-            np.asarray(X), np.asarray(y),
-            block_rows=self.gram_block_rows,
-            batch_rows=self.gram_batch_rows,
-        )
+        if self.mesh is not None:
+            # Per-shard streamed TOTALS on each device, combined once:
+            # the quasi-Newton CostFun reads only totals, so the mesh
+            # matters only for the BUILD (each device digests its own
+            # host row slice in parallel); evaluations then run O(d²)
+            # from the replicated statistics — EXACT totals, no dropped
+            # tail (parallel/gram_parallel.py).
+            from tpu_sgd.parallel.gram_parallel import (
+                build_streamed_total_stats,
+            )
+
+            data = build_streamed_total_stats(
+                self.mesh, np.asarray(X), np.asarray(y),
+                block_rows=self.gram_block_rows,
+                batch_rows=self.gram_batch_rows,
+            )
+            g = GramLeastSquaresGradient(data)
+        else:
+            g = GramLeastSquaresGradient.build_streamed(
+                np.asarray(X), np.asarray(y),
+                block_rows=self.gram_block_rows,
+                batch_rows=self.gram_batch_rows,
+            )
         self._streamed_gram_entry = (X, y, g, opts)
         return g
 
@@ -532,18 +622,114 @@ class LBFGS(Optimizer):
             # user-built gram gradient on exactly this matrix: route its
             # GramData through so the traced cost/sweep accelerate
             return gradient, gradient.data
-        if not (self.sufficient_stats and self.mesh is None
-                and not _is_sp(X) and type(gradient) is _LS):
+        if not (self.sufficient_stats and not _is_sp(X)
+                and type(gradient) is _LS
+                and not isinstance(X, GramData)):
             return gradient, X
         entry = self._gram_entry
         if (entry is not None and entry[0] is X and entry[1] is y
-                and entry[3:] == (self.gram_block_rows,)):
+                and entry[3:] == (self.gram_block_rows, self.mesh)):
             g = entry[2]
             return g, g.data
-        g = GramLeastSquaresGradient.build(
-            X, y, block_rows=self.gram_block_rows)
-        self._gram_entry = (X, y, g, self.gram_block_rows)
-        return g, g.data
+        if self.mesh is not None:
+            # Meshed substitution: per-shard blockwise TOTALS + one psum
+            # (the quasi-Newton CostFun reads only totals — no prefix
+            # stacks), replicated; the caller then runs the iteration
+            # loop unmeshed from the tiny (d, d) statistics.  EXACT for
+            # any row count (padded rows are masked in the build).
+            from tpu_sgd.parallel.gram_parallel import (
+                build_sharded_total_stats,
+            )
+
+            data = build_sharded_total_stats(
+                self.mesh, X, y, block_rows=self.gram_block_rows)
+            g = GramLeastSquaresGradient(data)
+        else:
+            g = GramLeastSquaresGradient.build(
+                X, y, block_rows=self.gram_block_rows)
+            data = g.data
+        self._gram_entry = (X, y, g, self.gram_block_rows, self.mesh)
+        return g, data
+
+    def _host_streamed_costfun(self, X, y):
+        """Guards + identity-cached :class:`StreamedCostFun` for
+        ``set_host_streaming`` (shared with the OWLQN override)."""
+        from tpu_sgd.ops.gram import GramData
+        from tpu_sgd.optimize.streamed_costfun import StreamedCostFun
+
+        if isinstance(X, GramData):
+            raise ValueError(
+                "GramData input already runs beyond-HBM from its "
+                "statistics; drop set_host_streaming"
+            )
+        if is_sparse(X):
+            raise NotImplementedError(
+                "host streaming needs dense rows; BCOO features are "
+                "~1000x smaller and stay device-resident instead"
+            )
+        if self.streamed_stats:
+            raise ValueError(
+                "set_streamed_stats and set_host_streaming are "
+                "alternative beyond-HBM schedules; enable exactly one"
+            )
+        if self.sufficient_stats:
+            raise ValueError(
+                "set_sufficient_stats needs device-resident data; it "
+                "cannot combine with set_host_streaming"
+            )
+        entry = self._stream_costfun_entry
+        opts = (self.stream_batch_rows, self.mesh)
+        if (entry is not None and entry[0] is X and entry[1] is y
+                and entry[3] == opts and entry[2].gradient is self.gradient):
+            return entry[2]
+        scf = StreamedCostFun(
+            self.gradient, X, y,
+            batch_rows=self.stream_batch_rows, mesh=self.mesh,
+        )
+        self._stream_costfun_entry = (X, y, scf, opts)
+        return scf
+
+    def _host_streamed_evaluators(self, X, y, initial_weights):
+        """``(w0, cost1, sweep1, loss1)`` closures over the chunked
+        streaming CostFun, in the exact shape :meth:`_qn_loop` consumes;
+        None for empty input (the resident path's early return covers
+        it)."""
+        import numpy as np
+
+        if int(np.shape(X)[0]) == 0:
+            return None
+        scf = self._host_streamed_costfun(X, y)
+        w = jnp.asarray(initial_weights)
+        if not jnp.issubdtype(w.dtype, jnp.inexact):
+            w = w.astype(jnp.float32)
+        reg_value, reg_grad = _reg_terms(self.updater, self.reg_param)
+
+        @jax.jit
+        def _finish_cost(gs, ls, c, wv):
+            return ls / c + reg_value(wv), gs / c + reg_grad(wv)
+
+        @jax.jit
+        def _finish_sweep(ls, c, W):
+            return ls / c + jax.vmap(reg_value)(W)
+
+        @jax.jit
+        def _finish_loss(ls, c, wv):
+            return ls / c + reg_value(wv)
+
+        def cost1(wv):
+            return _finish_cost(*scf.cost_sums(wv), wv)
+
+        if hasattr(self.gradient, "loss_sweep"):
+            def sweep1(W):
+                return _finish_sweep(*scf.sweep_sums(W), W)
+
+            return w, cost1, sweep1, None
+        _warn_sequential_line_search(self.gradient, self._LS_TRIALS)
+
+        def loss1(wv):
+            return _finish_loss(*scf.loss_sums(wv), wv)
+
+        return w, cost1, None, loss1
 
     def optimize_with_history(self, data: Dataset, initial_weights: Array):
         import numpy as np
@@ -552,15 +738,30 @@ class LBFGS(Optimizer):
         streamed = self._maybe_streamed_reentry(X, y, initial_weights)
         if streamed is not None:
             return streamed
-        X, y, w = _coerce_inputs(X, y, initial_weights)
+        if self.host_streaming:
+            # BEFORE _coerce_inputs: jnp.asarray would commit the
+            # beyond-HBM matrix to the device
+            ev = self._host_streamed_evaluators(X, y, initial_weights)
+            if ev is not None:
+                return self._qn_loop(*ev)
+        X, y, w = _coerce_inputs(X, y, initial_weights,
+                                 defer_commit=self.mesh is not None)
         n = X.shape[0]
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
             return w, self._loss_history
+        from tpu_sgd.ops.gram import GramData as _GramData
+
+        was_gram_input = isinstance(X, _GramData)
         gradient, X = self._substitute_gram(self.gradient, X, y)
         reg_value, reg_grad = _reg_terms(self.updater, self.reg_param)
 
         mesh = self.mesh
+        if isinstance(X, _GramData) and not was_gram_input:
+            # internally substituted statistics are replicated: the
+            # iteration loop runs unmeshed from exact totals (user-passed
+            # GramData with a mesh still raises in _shard_for_mesh)
+            mesh = None
         valid = None
         sparse_shape = None
         if mesh is not None:
@@ -571,27 +772,46 @@ class LBFGS(Optimizer):
         cost = _build_cost(gradient, reg_value, reg_grad, mesh, with_valid,
                            sparse_shape)
 
+        def cost1(wv):
+            return cost(wv, *data_args)
+
+        if hasattr(gradient, "loss_sweep"):
+            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid,
+                                      sparse_shape)
+
+            def sweep1(W):
+                return sweep(W, *data_args)
+
+            return self._qn_loop(w, cost1, sweep1, None)
+        # exotic gradients without a sweep rule: sequential trials
+        _warn_sequential_line_search(gradient, self._LS_TRIALS)
+        loss_only = _build_loss_only(
+            gradient, reg_value, mesh, with_valid, sparse_shape
+        )
+
+        def loss1(wv):
+            return loss_only(wv, *data_args)
+
+        return self._qn_loop(w, cost1, None, loss1)
+
+    def _qn_loop(self, w, cost1, sweep1, loss1):
+        """The L-BFGS iteration loop over abstract FULL-BATCH evaluators:
+        ``cost1(w) -> (f, g)``, ``sweep1(W_trials) -> (T,)`` trial
+        objectives (None for gradients without a sweep rule), ``loss1(w)
+        -> f`` (the sequential fallback).  Both the device-resident and
+        the host-streamed CostFun paths drive this same loop — the
+        evaluators are the only thing that differs."""
+        import numpy as np
+
         n_ls = self._LS_TRIALS
         ladder = jnp.asarray(
             0.5 ** np.arange(n_ls), jnp.float32
         )  # trial step sizes, largest first
-        swept = hasattr(gradient, "loss_sweep")
+        swept = sweep1 is not None
         if swept:
-            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid,
-                                      sparse_shape)
-
             @jax.jit
             def make_trials(w, direction):
                 return w[None, :] + ladder[:, None] * direction[None, :]
-
-        else:  # exotic gradients without a sweep rule: sequential trials
-            _warn_sequential_line_search(gradient, self._LS_TRIALS)
-            loss_only = _build_loss_only(
-                gradient, reg_value, mesh, with_valid, sparse_shape
-            )
-
-            def cost_loss(wt):
-                return loss_only(wt, *data_args)
 
         m = self.num_corrections
         d = w.shape[0]
@@ -600,7 +820,7 @@ class LBFGS(Optimizer):
         rho = jnp.zeros((m,), w.dtype)
         k = 0  # valid corrections
 
-        f, g = cost(w, *data_args)
+        f, g = cost1(w)
         losses: List[float] = [float(f)]
         for _ in range(self.max_num_iterations):
             direction = -_two_loop(g, s_stack, y_stack, rho, jnp.asarray(k))
@@ -612,9 +832,7 @@ class LBFGS(Optimizer):
             f0 = float(f)
             if swept:
                 # whole ladder in one device pass + ONE host sync
-                f_trials = np.asarray(
-                    sweep(make_trials(w, direction), *data_args)
-                )
+                f_trials = np.asarray(sweep1(make_trials(w, direction)))
                 ok = f_trials <= f0 + 1e-4 * np.asarray(ladder) * g_dot_d
                 j = int(np.argmax(ok)) if ok.any() else -1
                 accepted = j >= 0
@@ -626,14 +844,14 @@ class LBFGS(Optimizer):
                 accepted = False
                 for _ls in range(n_ls):
                     w_new = w + t * direction
-                    f_new = cost_loss(w_new)
+                    f_new = loss1(w_new)
                     if float(f_new) <= f0 + 1e-4 * t * g_dot_d:
                         accepted = True
                         break
                     t *= 0.5
             if not accepted:
                 break  # cannot make progress
-            f_new, g_new = cost(w_new, *data_args)  # gradient at accepted pt
+            f_new, g_new = cost1(w_new)  # gradient at accepted pt
             s = w_new - w
             yv = g_new - g
             sy = float(jnp.dot(s, yv))

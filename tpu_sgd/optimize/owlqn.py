@@ -109,6 +109,52 @@ class OWLQN(LBFGS):
         self.penalize_intercept = bool(flag)
         return self
 
+    def _host_streamed_evaluators(self, X, y, initial_weights):
+        """OWL-QN shape of the host-streamed chunked CostFun (see
+        ``LBFGS._host_streamed_evaluators``): ``(w0, reg, smooth_cost1,
+        sweep1, full_loss1)`` where the sweep/loss closures return the
+        FULL objective (smooth + L1) and the smooth cost returns the
+        smooth part only — exactly what :meth:`_owlqn_loop` consumes."""
+        import numpy as np
+
+        if int(np.shape(X)[0]) == 0:
+            return None
+        scf = self._host_streamed_costfun(X, y)
+        w = jnp.asarray(initial_weights)
+        if not jnp.issubdtype(w.dtype, jnp.inexact):
+            w = w.astype(jnp.float32)
+        reg = jnp.full(w.shape, self.reg_param, w.dtype)
+        if not self.penalize_intercept:
+            reg = reg.at[-1].set(0.0)
+        l1_value = lambda wv: jnp.sum(reg * jnp.abs(wv))
+
+        @jax.jit
+        def _finish_smooth(gs, ls, c):
+            return ls / c, gs / c
+
+        @jax.jit
+        def _finish_sweep(ls, c, W):
+            return ls / c + jax.vmap(l1_value)(W)
+
+        @jax.jit
+        def _finish_loss(ls, c, wv):
+            return ls / c + l1_value(wv)
+
+        def smooth_cost1(wv):
+            return _finish_smooth(*scf.cost_sums(wv))
+
+        if hasattr(self.gradient, "loss_sweep"):
+            def sweep1(W):
+                return _finish_sweep(*scf.sweep_sums(W), W)
+
+            return w, reg, smooth_cost1, sweep1, None
+        _warn_sequential_line_search(self.gradient, self._LS_TRIALS)
+
+        def full_loss1(wv):
+            return _finish_loss(*scf.loss_sums(wv), wv)
+
+        return w, reg, smooth_cost1, None, full_loss1
+
     def optimize_with_history(self, data: Dataset, initial_weights: Array):
         import numpy as np
 
@@ -116,19 +162,32 @@ class OWLQN(LBFGS):
         streamed = self._maybe_streamed_reentry(X, y, initial_weights)
         if streamed is not None:
             return streamed
-        X, y, w = _coerce_inputs(X, y, initial_weights)
+        if self.host_streaming:
+            # BEFORE _coerce_inputs: jnp.asarray would commit the
+            # beyond-HBM matrix to the device
+            ev = self._host_streamed_evaluators(X, y, initial_weights)
+            if ev is not None:
+                return self._owlqn_loop(*ev)
+        X, y, w = _coerce_inputs(X, y, initial_weights,
+                                 defer_commit=self.mesh is not None)
         n = X.shape[0]
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
             return w, self._loss_history
+        from tpu_sgd.ops.gram import GramData as _GramData
+
+        was_gram_input = isinstance(X, _GramData)
         gradient, X = self._substitute_gram(self.gradient, X, y)
         reg_vec = jnp.full(w.shape, self.reg_param, w.dtype)
         if not self.penalize_intercept:
             reg_vec = reg_vec.at[-1].set(0.0)
-        penalized = reg_vec > 0
         reg = reg_vec  # per-coordinate, broadcast through the helpers
 
         mesh = self.mesh
+        if isinstance(X, _GramData) and not was_gram_input:
+            # internally substituted statistics are replicated: run
+            # unmeshed from exact totals (see LBFGS.optimize_with_history)
+            mesh = None
         valid = None
         sparse_shape = None
         if mesh is not None:
@@ -144,20 +203,47 @@ class OWLQN(LBFGS):
         _smooth = _build_cost(gradient, zero, zero_grad, mesh, with_valid,
                               sparse_shape)
 
-        def smooth_cost(wv):
+        def smooth_cost1(wv):
             return _smooth(wv, *data_args)
 
+        if hasattr(gradient, "loss_sweep"):
+            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid,
+                                      sparse_shape)
+
+            def sweep1(W):
+                return sweep(W, *data_args)
+
+            return self._owlqn_loop(w, reg, smooth_cost1, sweep1, None)
+        # exotic gradients without a sweep rule
+        _warn_sequential_line_search(gradient, self._LS_TRIALS)
+        # loss-only compile: XLA drops the gradient matmul per trial
+        _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
+                                 sparse_shape)
+
+        def full_loss1(wv):
+            return _loss(wv, *data_args)
+
+        return self._owlqn_loop(w, reg, smooth_cost1, None, full_loss1)
+
+    def _owlqn_loop(self, w, reg, smooth_cost1, sweep1, full_loss1):
+        """The orthant-wise iteration loop over abstract FULL-BATCH
+        evaluators: ``smooth_cost1(w) -> (f_smooth, g_smooth)``,
+        ``sweep1(W_trials) -> (T,)`` FULL objectives (None for gradients
+        without a sweep rule), ``full_loss1(w) -> F`` (the sequential
+        fallback).  Device-resident and host-streamed CostFun paths both
+        drive this loop."""
+        import numpy as np
+
+        penalized = reg > 0
         any_penalty = self.reg_param > 0
         n_ls = self._LS_TRIALS  # inherited ladder-length knob (see LBFGS)
         ladder = np.asarray(0.5 ** np.arange(n_ls), np.float32)
-        swept = hasattr(gradient, "loss_sweep")
+        swept = sweep1 is not None
         if swept:
             # Whole orthant-projected backtracking ladder in ONE fused
             # multi-weight pass (X read once, one host sync) — same sweep
             # machinery as LBFGS, plus the per-trial predicted decrease
             # pg . (w_trial - w) the Armijo test needs.
-            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid,
-                                      sparse_shape)
             ladder_j = jnp.asarray(ladder)
 
             @jax.jit
@@ -170,15 +256,6 @@ class OWLQN(LBFGS):
                 preds = (W - wv[None, :]) @ pg
                 return W, preds
 
-        else:  # exotic gradients without a sweep rule
-            _warn_sequential_line_search(gradient, n_ls)
-            # loss-only compile: XLA drops the gradient matmul per trial
-            _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
-                                     sparse_shape)
-
-            def full_loss(wv):
-                return _loss(wv, *data_args)
-
         m = self.num_corrections
         d_dim = w.shape[0]
         s_stack = jnp.zeros((m, d_dim), w.dtype)
@@ -186,7 +263,7 @@ class OWLQN(LBFGS):
         rho = jnp.zeros((m,), w.dtype)
         k = 0
 
-        f_s, g = smooth_cost(w)
+        f_s, g = smooth_cost1(w)
         F = float(f_s) + float(jnp.sum(reg * jnp.abs(w)))
         losses: List[float] = [F]
         for _ in range(self.max_num_iterations):
@@ -210,7 +287,7 @@ class OWLQN(LBFGS):
             # every halving.
             if swept:
                 W_trials, preds = make_trials(w, direction, xi, pg)
-                F_trials = np.asarray(sweep(W_trials, *data_args))
+                F_trials = np.asarray(sweep1(W_trials))
                 preds_h = np.asarray(preds)
                 ok = (F_trials <= F + 1e-4 * preds_h) & (preds_h < 0)
                 j = int(np.argmax(ok)) if ok.any() else -1
@@ -225,7 +302,7 @@ class OWLQN(LBFGS):
                     w_new = w + t * direction
                     if any_penalty:
                         w_new = _project_orthant(w_new, xi, penalized)
-                    F_new = float(full_loss(w_new))
+                    F_new = float(full_loss1(w_new))
                     pred = float(jnp.dot(pg, w_new - w))
                     if F_new <= F + 1e-4 * pred and pred < 0:
                         accepted = True
@@ -233,7 +310,7 @@ class OWLQN(LBFGS):
                     t *= 0.5
             if not accepted:
                 break
-            _, g_new = smooth_cost(w_new)
+            _, g_new = smooth_cost1(w_new)
             s = w_new - w
             yv = g_new - g  # smooth-part curvature only
             sy = float(jnp.dot(s, yv))

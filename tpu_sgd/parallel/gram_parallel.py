@@ -116,7 +116,7 @@ def dp_gram_run_fn(
 
 
 def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BLOCK_ROWS,
-                                      batch_rows=None):
+                                      batch_rows=None, resume_dir=None):
     """Per-shard VIRTUAL statistics from HOST-resident rows — the
     beyond-HBM statistics build composed with the data mesh (config 4's
     literal "8-way data-parallel" shape at full 10M×1000 scale,
@@ -138,6 +138,11 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
     ``build_streamed``, <0.1% of rows at scale).  Single-process only
     (every mesh device must be addressable); on a multi-host pod each
     process would run this over its local shard slice.
+
+    ``resume_dir`` (opt-in): per-shard resumable builds — shard ``i``
+    checkpoints under ``resume_dir/shard_i`` (see
+    ``GramLeastSquaresGradient._streamed_prefix``), so a mid-pass kill
+    resumes every shard from its own high-water block.
 
     Returns ``(stats_leaves, B, n_used_local)``.
     """
@@ -167,11 +172,15 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
 
     devices = list(mesh.devices.reshape(-1))
     per_dev = []
+    import os
+
     for i, dev in enumerate(devices):
         s = i * n_local
         PG, Pb, Pyy = GramLeastSquaresGradient._streamed_prefix(
             Xh[s:s + n_used], np.asarray(yh[s:s + n_used]), B, sd, chunk,
             device=dev,
+            resume_dir=(None if resume_dir is None
+                        else os.path.join(resume_dir, f"shard_{i}")),
         )
         per_dev.append((PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1]))
     jax.block_until_ready(per_dev)
@@ -221,3 +230,128 @@ def dp_virtual_gram_run_fn(
     in_specs = (P(), P(DATA_AXIS)) + _STATS_SPECS
     out_specs = (P(), P(), P())
     return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
+def _validate_data_mesh(mesh):
+    if set(mesh.shape) != {DATA_AXIS}:
+        raise NotImplementedError(
+            "total statistics compose with a 1-D 'data' mesh; "
+            f"got axes {tuple(mesh.shape)}"
+        )
+    return mesh.shape[DATA_AXIS]
+
+
+def build_sharded_total_stats(mesh, Xd, yd,
+                              block_rows: int = DEFAULT_BLOCK_ROWS):
+    """Replicated EXACT total statistics ``(G, b, yy)`` of a dataset via
+    per-shard blockwise accumulation + one ``psum`` — the quasi-Newton
+    meshed sufficient-statistics substitution.
+
+    The quasi-Newton CostFun reads ONLY totals (full-batch sums and the
+    line-search sweep — never windows), so the meshed build needs no
+    prefix stacks: each shard scans its rows block-by-block with an O(d²)
+    carry (``GramLeastSquaresGradient._total_stats``) and one psum makes
+    the totals replicated.  Non-divisible row counts pad with a valid
+    mask and stay EXACT (masked-operand matmuls).  Returns a VIRTUAL
+    totals-only :class:`GramData` (windows degenerate to the full batch
+    — quasi-Newton only; GD sliced sampling must not use it).
+    """
+    from tpu_sgd.parallel.data_parallel import shard_dataset
+
+    import numpy as np
+
+    k = _validate_data_mesh(mesh)
+    # Host inputs stay numpy until shard_dataset places each shard on its
+    # own device — jnp.asarray here would stage the whole (possibly
+    # beyond-one-HBM) matrix through the default device first.
+    if not isinstance(Xd, jax.Array):
+        Xd = np.asarray(Xd)
+    if not jnp.issubdtype(Xd.dtype, jnp.inexact):
+        Xd = Xd.astype(np.float32 if isinstance(Xd, np.ndarray)
+                       else jnp.float32)
+    if not isinstance(yd, jax.Array):
+        yd = np.asarray(yd)
+    if not jnp.issubdtype(yd.dtype, jnp.inexact):
+        yd = yd.astype(np.float32 if isinstance(yd, np.ndarray)
+                       else jnp.float32)
+    n, d = Xd.shape
+    Xs, ys, valid = shard_dataset(mesh, Xd, yd)
+    if valid is None:
+        valid = jax.device_put(
+            jnp.ones((Xs.shape[0],), bool),
+            jax.sharding.NamedSharding(mesh, P(DATA_AXIS)),
+        )
+    n_local = Xs.shape[0] // k
+    B = max(1, min(int(block_rows), n_local))
+    sd = jnp.promote_types(jnp.float32, Xd.dtype)
+
+    def body(Xl, yl, vl):
+        G, b, yy = GramLeastSquaresGradient._total_stats(
+            Xl, yl, B=B, stats_dtype=sd, valid=vl
+        )
+        return jax.lax.psum((G, b, yy), DATA_AXIS)
+
+    fn = jax.jit(shard_map_fn(
+        mesh, body,
+        (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        (P(), P(), P()),
+    ))
+    G, b, yy = fn(Xs, ys, valid)
+    return GramLeastSquaresGradient.totals_only_data(
+        G, b, yy, n, d, Xd.dtype
+    )
+
+
+def build_streamed_total_stats(mesh, Xh, yh,
+                               block_rows: int = DEFAULT_BLOCK_ROWS,
+                               batch_rows=None):
+    """Replicated EXACT total statistics of HOST-resident rows — the
+    quasi-Newton beyond-HBM build composed with the data mesh.
+
+    Shard ``i`` streams its contiguous host row slice chunk-by-chunk to
+    ITS OWN device with an O(d²) totals carry
+    (``GramLeastSquaresGradient._streamed_totals``) — no prefix stacks,
+    no dropped rows (the ``n % k`` remainder rides with the last shard),
+    peak per-device footprint one chunk + (d, d).  The k tiny (d, d)
+    totals then combine on the first device.  Single-process build (every
+    mesh device addressable); a multi-host pod runs this per process over
+    its local slice.  Returns a VIRTUAL totals-only :class:`GramData`
+    (quasi-Newton only — see :func:`build_sharded_total_stats`).
+    """
+    import numpy as np
+
+    k = _validate_data_mesh(mesh)
+    Xh = np.asarray(Xh)
+    yh = np.asarray(yh)
+    n, d = Xh.shape
+    if n < k:
+        raise ValueError(f"{n} rows cannot shard {k} ways")
+    data_dtype = (Xh.dtype if jnp.issubdtype(Xh.dtype, jnp.inexact)
+                  else jnp.float32)
+    sd = GramLeastSquaresGradient._resolve_stats_dtype(data_dtype, None)
+    n_local = n // k
+    B = max(1, min(int(block_rows), n_local))
+    chunk = int(batch_rows) if batch_rows else 64 * B
+    chunk = max(B, (chunk // B) * B)
+
+    devices = list(mesh.devices.reshape(-1))
+    totals = []
+    for i, dev in enumerate(devices):
+        s = i * n_local
+        e = (i + 1) * n_local if i + 1 < k else n  # remainder to the last
+        totals.append(GramLeastSquaresGradient._streamed_totals(
+            Xh[s:e], yh[s:e], B, sd, chunk, device=dev,
+        ))
+    jax.block_until_ready(totals)
+    dev0 = devices[0]
+    G, b, yy = totals[0]
+    G = jax.device_put(G, dev0)
+    b = jax.device_put(b, dev0)
+    yy = jax.device_put(yy, dev0)
+    for Gi, bi, yyi in totals[1:]:
+        G = G + jax.device_put(Gi, dev0)
+        b = b + jax.device_put(bi, dev0)
+        yy = yy + jax.device_put(yyi, dev0)
+    return GramLeastSquaresGradient.totals_only_data(
+        G, b, yy, n, d, data_dtype
+    )

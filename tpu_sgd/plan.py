@@ -496,43 +496,63 @@ def plan(
     return chosen
 
 
+#: schedules a quasi-Newton optimizer can be forced onto
+QN_SCHEDULES = ("resident_stock", "resident_gram", "host_streamed",
+                "streamed_virtual_gram")
+
+
 def plan_quasi_newton(optimizer, X, y,
                       cost_model: Optional[CostModel] = None,
                       free_hbm: Optional[float] = None,
                       force: Optional[str] = None) -> Optional[Plan]:
     """Schedule decision for the quasi-Newton optimizers (LBFGS/OWL-QN):
     enable the sufficient-statistics substitution when the one-time build
-    amortizes inside ``max_num_iterations``.
+    amortizes inside ``max_num_iterations``, and pick the beyond-HBM
+    execution otherwise.
 
     Each quasi-Newton iteration is several FULL-batch passes over ``X``
     (cost+gradient at the current and accepted points, plus the batched
     line-search sweep — ~4 row reads), so the break-even comes much
-    earlier than for mini-batch SGD.  Beyond HBM, the statistics ARE the
-    only viable schedule (full-batch passes over host-streamed rows would
-    pay the feed per evaluation): when the stack fits, the plan is
-    ``streamed_virtual_gram`` (one streaming build pass, then O(d²)
-    evaluations; full-batch sums are exact from the totals — the only
-    deviation is the dropped ``n % block_rows`` tail).  ``force`` accepts
-    ``resident_stock`` / ``resident_gram`` / ``streamed_virtual_gram``."""
+    earlier than for mini-batch SGD.  The menu:
+
+    * least squares, fits HBM: ``resident_gram`` when the build
+      amortizes, else ``resident_stock``;
+    * least squares, beyond HBM: ``streamed_virtual_gram`` — one
+      streaming build pass, then every cost/sweep is an O(d²)
+      statistics read (single device: prefix stacks, the ``n % B`` tail
+      dropped; meshed: per-shard O(d²) totals carries, EXACT);
+    * any other loss, beyond HBM: ``host_streamed`` — the chunked
+      treeAggregate CostFun (``optimize/streamed_costfun.py``), the
+      literal analogue of the reference's any-size-any-loss CostFun
+      ([U] mllib/optimization/LBFGS.scala, SURVEY.md §2 #18).
+
+    Meshed optimizers (1-D data mesh) divide the HBM budget by the
+    shard count exactly as the GD planner does; the statistics builds
+    run per shard and combine to replicated totals.  ``force`` accepts
+    any of ``QN_SCHEDULES``."""
     import numpy as np
 
     from tpu_sgd.ops.gradients import LeastSquaresGradient
-    from tpu_sgd.ops.gram import GramData
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS, GramData
     from tpu_sgd.ops.sparse import is_sparse
     from tpu_sgd.optimize.lbfgs import LBFGS
 
     if (not isinstance(optimizer, LBFGS) or is_sparse(X)
-            or isinstance(X, GramData)
-            or optimizer.mesh is not None
-            or type(optimizer.gradient) is not LeastSquaresGradient):
+            or isinstance(X, GramData)):
         return None
-    if force is not None and force not in (
-            "resident_stock", "resident_gram", "streamed_virtual_gram"):
+    if force is not None and force not in QN_SCHEDULES:
         raise ValueError(
             f"schedule {force!r} does not exist behind a quasi-Newton "
-            "optimizer; choose resident_stock, resident_gram, or "
-            "streamed_virtual_gram"
+            f"optimizer; choose one of {QN_SCHEDULES}"
         )
+    n_devices = 1
+    if optimizer.mesh is not None:
+        from tpu_sgd.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        mesh_shape = optimizer.mesh.shape
+        if DATA_AXIS not in mesh_shape or mesh_shape.get(MODEL_AXIS, 1) > 1:
+            return None  # model-sharded: leave the user's config alone
+        n_devices = int(mesh_shape[DATA_AXIS])
     shape = np.shape(X)
     if len(shape) != 2 or shape[0] == 0:
         return None
@@ -545,64 +565,151 @@ def plan_quasi_newton(optimizer, X, y,
     else:
         budget_source = "caller"
     iters = int(optimizer.max_num_iterations)
-    data_bytes = n * d * itemsize + n * 4.0
+    gram_able = type(optimizer.gradient) is LeastSquaresGradient
+    n_local = max(1, math.ceil(n / n_devices))
+    data_bytes_local = n_local * d * itemsize + n_local * 4.0
+    fits = data_bytes_local <= free_hbm
     est = {
         "n": n, "d": d, "itemsize": int(itemsize),
+        "n_devices": int(n_devices), "n_local": int(n_local),
+        "data_bytes_local": data_bytes_local,
         "free_hbm": float(free_hbm), "budget_source": budget_source,
+        "fits_resident": bool(fits), "gram_able": bool(gram_able),
         "max_num_iterations": iters,
     }
-    if data_bytes > free_hbm:
-        B, batch_rows = choose_streamed_build(n, d, itemsize, free_hbm)
+    per_dev = f"/device × {n_devices}" if n_devices > 1 else ""
+
+    def _force_wrap(chosen):
+        if force is None or force == chosen.schedule:
+            return chosen
+        if (force in ("resident_gram", "streamed_virtual_gram")
+                and est.get("block_rows") is None):
+            warnings.warn(
+                f"forced {force} has NO feasible block size at this "
+                f"budget ({_fmt_gb(free_hbm)} free vs O(d²) "
+                "statistics); the build will run at the default "
+                "block size and may exhaust device memory",
+                RuntimeWarning, stacklevel=4,
+            )
+        if force.startswith("resident_") and not fits:
+            warnings.warn(
+                f"forced {force} commits "
+                f"{_fmt_gb(data_bytes_local)}{per_dev} to a device with "
+                f"only {_fmt_gb(free_hbm)} in the probed budget — it "
+                "does not fit and will likely exhaust device memory",
+                RuntimeWarning, stacklevel=4,
+            )
+        return Plan(
+            force,
+            f"forced by caller (planner would pick {chosen.schedule}: "
+            + chosen.reason + ")",
+            block_rows=est.get("block_rows"),
+            batch_rows=est.get("batch_rows"), estimates=est,
+        )
+
+    # ---- non-least-squares losses ---------------------------------------
+    if not gram_able:
+        if force in ("resident_gram", "streamed_virtual_gram"):
+            raise ValueError(
+                f"schedule {force!r} cannot apply: no fixed-size "
+                "sufficient statistics exist for "
+                f"{type(optimizer.gradient).__name__} (least squares "
+                "only); choose resident_stock or host_streamed"
+            )
+        if fits:
+            chosen = Plan(
+                "resident_stock",
+                f"data ({_fmt_gb(data_bytes_local)}{per_dev}) fits; "
+                "stock full-batch passes (no fixed-size statistics "
+                f"exist for {type(optimizer.gradient).__name__})",
+                estimates=est,
+            )
+        else:
+            # chunk sized so two in-flight buffers use <= half the
+            # budget (the policy function is the evaluator's own)
+            from tpu_sgd.optimize.streamed_costfun import (
+                default_stream_batch_rows,
+            )
+
+            batch_rows = default_stream_batch_rows(
+                d, itemsize, chunk_bytes=free_hbm * 0.25)
+            est["batch_rows"] = batch_rows
+            chosen = Plan(
+                "host_streamed",
+                f"data ({_fmt_gb(data_bytes_local)}{per_dev}) exceeds "
+                f"HBM ({_fmt_gb(free_hbm)} free) and "
+                f"{type(optimizer.gradient).__name__} has no fixed-size "
+                "statistics: every full-batch cost/sweep streams the "
+                "rows through the device in "
+                f"{batch_rows}-row chunks (the chunked treeAggregate "
+                "CostFun — feed-bound, ~3 dataset reads per iteration)",
+                batch_rows=batch_rows, estimates=est,
+            )
+        return _force_wrap(chosen)
+
+    # ---- least squares, beyond HBM --------------------------------------
+    if not fits:
+        B, batch_rows = choose_streamed_build(n_local, d, itemsize,
+                                              free_hbm)
+        if B is None and n_devices > 1:
+            # the meshed build carries O(d²) totals, not prefix stacks —
+            # feasible whenever one chunk fits beside the (d, d) carry
+            rows = int((free_hbm - 3 * d * d * 4.0)
+                       // max(1, 2 * (d * itemsize + 4)))
+            if rows >= 1:
+                B, batch_rows = min(DEFAULT_BLOCK_ROWS, rows), rows
         if B is not None:
             est.update(block_rows=B, batch_rows=batch_rows,
-                       stack_bytes=_stack_bytes(n, B, d))
+                       stack_bytes=(_stack_bytes(n_local, B, d)
+                                    if n_devices == 1 else 3 * d * d * 4.0))
+            tail_note = (
+                f"exact totals; the n_local % {B} tail rows are dropped"
+                if n_devices == 1 else
+                "EXACT totals — the meshed build keeps every row"
+            )
             chosen = Plan(
                 "streamed_virtual_gram",
-                f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
-                f"({_fmt_gb(free_hbm)} free) but its statistics "
+                f"data ({_fmt_gb(data_bytes_local)}{per_dev}) exceeds "
+                f"HBM ({_fmt_gb(free_hbm)} free) but its statistics "
                 f"({_fmt_gb(est['stack_bytes'])}, B={B}) fit beside the "
-                "build chunk: one streaming build pass, then every "
+                "build chunk: one streaming build pass"
+                f"{' per shard' if n_devices > 1 else ''}, then every "
                 "full-batch cost/sweep is an O(d²) statistics read "
-                f"(exact totals; the n % {B} tail rows are dropped)",
+                f"({tail_note})",
                 block_rows=B, batch_rows=batch_rows, estimates=est,
             )
         else:
             chosen = Plan(
                 "resident_stock",
-                f"data ({_fmt_gb(data_bytes)}) exceeds HBM "
-                f"({_fmt_gb(free_hbm)} free) and so does its O(d²) "
+                f"data ({_fmt_gb(data_bytes_local)}{per_dev}) exceeds "
+                f"HBM ({_fmt_gb(free_hbm)} free) and so does its O(d²) "
                 "statistics stack; no schedule fits this device",
                 estimates=est,
             )
-        if force is not None and force != chosen.schedule:
-            if (force in ("resident_gram", "streamed_virtual_gram")
-                    and est.get("block_rows") is None):
-                warnings.warn(
-                    f"forced {force} has NO feasible block size at this "
-                    f"budget ({_fmt_gb(free_hbm)} free vs O(d²) "
-                    "statistics); the build will run at the default "
-                    "block size and may exhaust device memory",
-                    RuntimeWarning, stacklevel=3,
-                )
-            return Plan(
-                force,
-                f"forced by caller (planner would pick {chosen.schedule}: "
-                + chosen.reason + ")",
-                block_rows=est.get("block_rows"),
-                batch_rows=est.get("batch_rows"), estimates=est,
-            )
-        return chosen
-    B = choose_block_rows(n, d, free_hbm - data_bytes)
+        return _force_wrap(chosen)
+
+    # ---- least squares, resident ----------------------------------------
+    if n_devices == 1:
+        B = choose_block_rows(n_local, d, free_hbm - data_bytes_local)
+    else:
+        # the meshed substitution carries O(d²) TOTALS per shard, not a
+        # prefix stack (build_sharded_total_stats) — feasible whenever
+        # the tiny carry fits the headroom
+        from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS as _DEF_B
+
+        carry_bytes = 3 * d * d * 4.0
+        B = (min(_DEF_B, n_local)
+             if carry_bytes <= free_hbm - data_bytes_local else None)
     chosen = None
     if B is not None:
         # ~4 full row reads per iteration vs O(d^2) stats matvecs (the
         # 25-trial sweep's (T,d)x(d,d) matmul reads G once per chunk)
-        stock_iter_s = 4.0 * n * d * itemsize / (cm.hbm_gb_s * 1e9)
+        stock_iter_s = 4.0 * n_local * d * itemsize / (cm.hbm_gb_s * 1e9)
         gram_iter_s = (cm.gram_iter_overhead_s
                        + 8.0 * d * d * 4.0 / (cm.hbm_gb_s * 1e9))
         build_s = (cm.build_overhead_s
-                   + n * d * itemsize / (cm.hbm_gb_s * 1e9)
-                   + 2.0 * n * d * d / cm.mxu_f32_flops)
+                   + n_local * d * itemsize / (cm.hbm_gb_s * 1e9)
+                   + 2.0 * n_local * d * d / cm.mxu_f32_flops)
         saving = stock_iter_s - gram_iter_s
         amortize = math.inf if saving <= 0 else build_s / saving
         est.update(block_rows=B, stock_iter_s=stock_iter_s,
@@ -612,9 +719,11 @@ def plan_quasi_newton(optimizer, X, y,
             chosen = Plan(
                 "resident_gram",
                 f"quasi-Newton least squares on a resident "
-                f"({_fmt_gb(data_bytes)}) dataset: full-batch "
-                f"cost/sweep from statistics (B={B}; build amortizes "
-                f"in ~{amortize:.0f} of {iters} iterations)",
+                f"({_fmt_gb(data_bytes_local)}{per_dev}) dataset: "
+                f"full-batch cost/sweep from statistics (B={B}; build "
+                f"amortizes in ~{amortize:.0f} of {iters} iterations"
+                + ("; per-shard totals combine over the mesh"
+                   if n_devices > 1 else "") + ")",
                 block_rows=B, estimates=est,
             )
         elif force == "resident_gram":
@@ -626,21 +735,15 @@ def plan_quasi_newton(optimizer, X, y,
                 RuntimeWarning, stacklevel=3,
             )
     if chosen is None:
-        why = f"data ({_fmt_gb(data_bytes)}) fits; stock full-batch passes"
+        why = (f"data ({_fmt_gb(data_bytes_local)}{per_dev}) fits; "
+               "stock full-batch passes")
         if "build_amortize_iters" in est:
             why += (
                 f" (statistics build would amortize in "
                 f"~{est['build_amortize_iters']:.0f} iters > {iters})"
             )
         chosen = Plan("resident_stock", why, estimates=est)
-    if force is not None and force != chosen.schedule:
-        return Plan(
-            force,
-            f"forced by caller (planner would pick {chosen.schedule}: "
-            + chosen.reason + ")",
-            block_rows=est.get("block_rows"), estimates=est,
-        )
-    return chosen
+    return _force_wrap(chosen)
 
 
 def plan_for(optimizer, X, y, cost_model: Optional[CostModel] = None,
