@@ -142,16 +142,53 @@ def test_fit_steady_state_two_points_matches_old_protocol(bench):
 
 
 def test_promote_measured_at_size(bench):
-    result = {"metric": "m", "value": 1210.9}
+    """VERDICT r4 #3: the measured-at-size figure IS the headline value;
+    the resident-slab conversion demotes to a named secondary field and
+    vs_baseline rescales with the promotion."""
+    result = {"metric": "m", "value": 1210.9, "vs_baseline": 181092.64}
     record = {"streamed": {"gram": {
         "epochs_per_sec_post_build": 3885.21, "epochs_per_sec_amortized_100":
-        0.8213, "rows_used": 9994240, "dim": 1000}}}
+        0.8213, "rows_used": 9994240, "dim": 1000, "build_s": 278.7,
+        "build_feed_gb_per_s": 0.0717}}}
     bench.promote_measured_at_size(result, record)
-    assert result["epochs_per_sec_post_build"] == 3885.2
+    assert result["value"] == 3885.2  # MEASURED at size leads
+    assert result["epochs_per_sec_converted_from_resident"] == 1210.9
+    assert result["vs_baseline"] == pytest.approx(
+        181092.64 * 3885.21 / 1210.9, rel=1e-3)
     assert result["epochs_per_sec_amortized_100"] == 0.82
+    assert result["build_s"] == 278.7
     assert result["measured_rows"] == 9994240
     assert "MEASURED" in result["value_basis"]
+    # the amortized figure carries its environment basis: a cold reader
+    # must see it is tunnel-feed-bound, not a device property
+    assert "tunnel" in result["amortized_basis"]
+    assert "pod-local" in result["amortized_basis"]
     # absent capture: result untouched
     r2 = {"metric": "m"}
     bench.promote_measured_at_size(r2, {"streamed": None})
     assert r2 == {"metric": "m"}
+
+
+def test_promote_measured_at_size_idempotent(bench):
+    """Re-promotion (the stream-gram check merges fresh captures into a
+    persisted record; _report_persisted promotes on read) must not
+    double-rescale vs_baseline or lose the pristine conversion."""
+    result = {"metric": "m", "value": 1210.9, "vs_baseline": 181092.64}
+    gram = {
+        "epochs_per_sec_post_build": 3885.21,
+        "epochs_per_sec_amortized_100": 0.8213,
+        "rows_used": 9994240, "dim": 1000, "build_s": 278.7,
+        "build_feed_gb_per_s": 0.0717,
+    }
+    record = {"streamed": {"gram": gram}}
+    bench.promote_measured_at_size(result, record)
+    once = dict(result)
+    bench.promote_measured_at_size(result, record)
+    assert result == once  # same capture: a no-op
+    # a FRESHER capture re-promotes from the new measurement
+    gram2 = dict(gram, epochs_per_sec_post_build=4000.0)
+    bench.promote_measured_at_size(result, {"streamed": {"gram": gram2}})
+    assert result["value"] == 4000.0
+    assert result["epochs_per_sec_converted_from_resident"] == 1210.9
+    assert result["vs_baseline"] == pytest.approx(
+        181092.64 * 4000.0 / 1210.9, rel=1e-3)

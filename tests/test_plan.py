@@ -976,3 +976,37 @@ def test_meshed_resident_gram_skips_stack_feasibility():
     p = plan_quasi_newton(LBFGS().set_mesh(data_mesh()), tight, None,
                           free_hbm=12 * GB)
     assert p.schedule == "resident_gram"
+
+
+# ---- self-calibration (round 5: VERDICT r4 #6) -----------------------------
+
+def test_cost_model_calibrate_probe():
+    """The ~2 s probe returns measured positive rates and keeps the
+    other constants (plus explicit overrides)."""
+    cm = CostModel.calibrate(copy_mb=4, feed_mb=4)
+    assert cm.hbm_gb_s > 0 and cm.host_feed_gb_s > 0
+    assert cm.hbm_bytes == CostModel().hbm_bytes  # defaults untouched
+    cm2 = CostModel.calibrate(copy_mb=4, feed_mb=4, hbm_safety=0.5)
+    assert cm2.hbm_safety == 0.5
+    # overrides win over the measured fields too (probe one, pin one)
+    cm3 = CostModel.calibrate(copy_mb=4, feed_mb=4, host_feed_gb_s=50.0)
+    assert cm3.host_feed_gb_s == 50.0 and cm3.hbm_gb_s > 0
+
+
+def test_fed_cost_model_flips_streaming_boundary():
+    """Decision boundaries must MOVE with the cost model: on the slow
+    calibrated tunnel feed (0.15 GB/s) a 20-iteration beyond-HBM run
+    amortizes the one-time virtual-gram build in ~10 iterations; on a
+    pod-local 50 GB/s feed the same build needs ~40 — the planner must
+    flip away from the build (VERDICT r4 #6: the persisted constants are
+    single-environment calibrations)."""
+    kw = dict(itemsize=2, gram_able=True, sampling="sliced",
+              mini_batch_fraction=0.1, num_iterations=20,
+              free_hbm=12 * GB)
+    slow = plan(10_000_000, 1000, **kw)
+    assert slow.schedule == "streamed_virtual_gram"
+    fast = plan(10_000_000, 1000,
+                cost_model=CostModel(host_feed_gb_s=50.0), **kw)
+    assert fast.schedule == "partial_residency"
+    assert fast.estimates["streamed_iter_s"] < \
+        slow.estimates["streamed_iter_s"] / 100

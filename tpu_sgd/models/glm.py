@@ -222,12 +222,10 @@ class GeneralizedLinearAlgorithm:
             # a planned run: the PREVIOUS plan's schedule flags are the
             # planner's own and must not leak onto this dataset (e.g. a
             # stale host_streaming=True would crash a zero-flag user on
-            # BCOO input) — reset to stock.
-            opt.host_streaming = False
-            opt.sufficient_stats = False
-            opt.streamed_stats = False
-            if hasattr(opt, "streaming_resident_rows"):
-                opt.streaming_resident_rows = 0
+            # BCOO input) — reset to stock via the optimizers' own
+            # clearing hook (one flag list, not three hand-rolled
+            # copies).
+            opt._clear_planned_schedule()
             if (hasattr(opt, "stream_batch_rows")
                     and "stream_batch_rows" not in getattr(
                         opt, "_user_gram_opts", frozenset())):
