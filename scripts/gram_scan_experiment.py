@@ -220,20 +220,57 @@ def main() -> int:
         "chunked", run_chunked, ladder,
         lambda n: jnp.asarray(n // K_CHUNK, jnp.int32))
 
+    # ---- (d) the PRODUCT chunked driver: full contract, bulk gathers ----
+    # (round 5, optimize/gram_driver.py — what set_gram_options(
+    # chunk_iters=K) actually ships; measures whether the gather win
+    # survives the loss-history/convergence bookkeeping)
+    from tpu_sgd.optimize.gram_driver import make_chunked_gram_run
+
+    def run_product(iters):
+        cfg = SGDConfig(step_size=STEP, num_iterations=iters,
+                        mini_batch_fraction=FRAC, convergence_tol=0.0,
+                        sampling="sliced", seed=SEED)
+        run = jax.jit(make_chunked_gram_run(
+            SimpleUpdater(), cfg, n=ROWS, block_rows=BLOCK,
+            chunk_iters=K_CHUNK))
+        w0 = jnp.zeros((DIM,), jnp.float32)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(w0, st, y))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        w, losses, n_rec = jax.block_until_ready(run(w0, st, y))
+        return time.perf_counter() - t0, compile_s, w
+
+    dt_d, compile_d, w_d = run_product(ladder[0])
+    log(f"product-chunked: compile+first {compile_d:.1f}s")
+    pts_d = [(ladder[0], dt_d)]
+    for it in ladder[1:]:
+        dt, _, w_d = run_product(it)
+        pts_d.append((it, dt))
+    slope_d, fixed_d, fit_d = fit_steady_state(pts_d)
+    log(f"product-chunked: {slope_d * 1e3:.4f} ms/iter (residuals "
+        f"{fit_d['residual_ms']} ms)")
+    w_d = np.asarray(w_d)
+
     # trajectory agreement: same window stream + same math -> same weights
     agree_b = bool(np.allclose(w_b, w_a, rtol=1e-4, atol=1e-5))
     agree_c = bool(np.allclose(w_c, w_a, rtol=1e-4, atol=1e-5))
+    agree_d = bool(np.allclose(w_d, w_a, rtol=1e-4, atol=1e-5))
     log(f"weights agree: bare={agree_b} chunked={agree_c} "
+        f"product={agree_d} "
         f"(max|dw| bare {np.abs(w_b - w_a).max():.2e}, chunked "
-        f"{np.abs(w_c - w_a).max():.2e})")
+        f"{np.abs(w_c - w_a).max():.2e}, product "
+        f"{np.abs(w_d - w_a).max():.2e})")
 
     record = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "platform": platform,
         "note": (
-            "exploratory decomposition of the aligned-gram iteration; "
-            "the product path is untouched — a clean winner here is a "
-            "candidate product change for the next round"
+            "decomposition of the aligned-gram iteration; "
+            "product_chunked is the SHIPPED chunked driver "
+            "(set_gram_options(chunk_iters=K), optimize/gram_driver.py) "
+            "— if it beats full_contract with weights_agree, flipping "
+            "the planner default is the follow-up"
         ),
         "workload": {"rows": ROWS, "dim": DIM, "block_rows": BLOCK,
                      "frac": FRAC, "k_chunk": K_CHUNK},
@@ -243,8 +280,11 @@ def main() -> int:
         "bare_fit": fit_b,
         "chunked_ms": slope_c * 1e3,
         "chunked_fit": fit_c,
+        "product_chunked_ms": slope_d * 1e3,
+        "product_chunked_fit": fit_d,
         "bookkeeping_ms": (slope_a - slope_b) * 1e3,
-        "weights_agree": {"bare": agree_b, "chunked": agree_c},
+        "weights_agree": {"bare": agree_b, "chunked": agree_c,
+                          "product": agree_d},
     }
     if platform == "cpu":
         log("CPU fallback: not persisting")

@@ -89,6 +89,33 @@ def _dot_hi(a, b, dtype):
     )
 
 
+def aligned_window_blocks(m: int, B: int, nbf: int) -> int:
+    """Whole-block window length of an m-row aligned window — THE
+    rounding shared by the per-iteration executor
+    (``_window_sums_aligned``) and the chunked-gather driver
+    (``optimize/gram_driver.py``), so their trajectories cannot drift."""
+    return max(1, min(nbf, round(m / B)))
+
+
+def aligned_window_k1(start, n: int, m: int, B: int, nbf: int, mb: int):
+    """First block index of the aligned window at row ``start`` — the
+    clamp-then-floor shared by both aligned drivers."""
+    start = jnp.clip(start, 0, max(n - m, 0))
+    return jnp.clip(start // B, 0, nbf - mb)
+
+
+def aligned_window_terms(PG_diff, Pb_diff, yy_diff, w_sd):
+    """``(g_sum, loss_sum)`` of an aligned window from its already-
+    differenced prefix stats — the quadratic-loss math shared by both
+    aligned drivers (stats dtype in, stats dtype out)."""
+    sd = PG_diff.dtype
+    Gw = _dot_hi(PG_diff, w_sd, sd)
+    g_sum = Gw - Pb_diff
+    loss_sum = 0.5 * (jnp.dot(w_sd, g_sum) - jnp.dot(w_sd, Pb_diff)
+                      + yy_diff)
+    return g_sum, loss_sum
+
+
 def _running_sum(carry0, blocks):
     """Inclusive running sum over the leading axis via ``lax.scan`` —
     shared by the one-shot and the chunked-streaming prefix builders
@@ -864,9 +891,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         B = st.block_rows
         n = st.shape[0]
         nbf = n // B
-        mb = max(1, min(nbf, round(m / B)))
-        start = jnp.clip(start, 0, max(n - m, 0))
-        k1 = jnp.clip(start // B, 0, nbf - mb)
+        mb = aligned_window_blocks(m, B, nbf)
+        k1 = aligned_window_k1(start, n, m, B, nbf, mb)
         k2 = k1 + mb
         sd = st.PG.dtype
         PG1 = jax.lax.dynamic_slice_in_dim(st.PG, k1, 1, 0)[0]
@@ -875,11 +901,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         Pb2 = jax.lax.dynamic_slice_in_dim(st.Pb, k2, 1, 0)[0]
         yy = (jax.lax.dynamic_slice_in_dim(st.Pyy, k2, 1, 0)[0]
               - jax.lax.dynamic_slice_in_dim(st.Pyy, k1, 1, 0)[0])
-        w_sd = weights.astype(sd)
-        Gw = _dot_hi(PG2 - PG1, w_sd, sd)
-        b = Pb2 - Pb1
-        g_sum = Gw - b
-        loss_sum = 0.5 * (jnp.dot(w_sd, g_sum) - jnp.dot(w_sd, b) + yy)
+        g_sum, loss_sum = aligned_window_terms(
+            PG2 - PG1, Pb2 - Pb1, yy, weights.astype(sd))
         count = jnp.asarray(mb * B, cd)
         return g_sum.astype(cd), loss_sum.astype(cd), count
 

@@ -257,6 +257,7 @@ class GradientDescent(Optimizer):
         self.gram_block_rows = DEFAULT_BLOCK_ROWS
         self.gram_batch_rows = None
         self.gram_aligned = False
+        self.gram_chunk_iters = None
         #: gram-knob fields the USER set via set_gram_options /
         #: set_streamed_stats — the planner preserves these and resets
         #: only plan-owned fields (Plan.apply)
@@ -399,7 +400,7 @@ class GradientDescent(Optimizer):
         return self
 
     def set_gram_options(self, block_rows: int = None, aligned: bool = None,
-                         batch_rows: int = None):
+                         batch_rows: int = None, chunk_iters: int = None):
         """Tuning knobs for the sufficient-statistics schedules.
 
         ``block_rows`` trades prefix-stack memory (``n/B · d² · 4`` bytes)
@@ -411,6 +412,13 @@ class GradientDescent(Optimizer):
         ``batch_rows`` caps the streamed build's host→device chunk (the
         chunk is co-resident with the growing prefix stack, so a tight
         device budget needs a smaller chunk than the 64-block default).
+        ``chunk_iters=K`` switches block-aligned sliced execution to the
+        chunked-gather driver (``optimize/gram_driver.py``): K window
+        endpoints gathered from the prefix stacks per outer step, the
+        same per-iteration contract — opt-in until the hardware
+        decomposition capture settles its default.  SINGLE-DEVICE only:
+        the meshed gram runners keep the per-iteration driver (a warning
+        says so when both are set).
         The execution planner (``tpu_sgd/plan.py``) sets ``block_rows``/
         ``batch_rows`` automatically; ``aligned`` stays opt-in."""
         provided = set()
@@ -431,6 +439,13 @@ class GradientDescent(Optimizer):
                 )
             self.gram_batch_rows = int(batch_rows)
             provided.add("batch_rows")
+        if chunk_iters is not None:
+            if int(chunk_iters) < 1:
+                raise ValueError(
+                    f"chunk_iters must be positive, got {chunk_iters}"
+                )
+            self.gram_chunk_iters = int(chunk_iters)
+            provided.add("chunk_iters")
         # user-set knobs survive auto-planning (Plan.apply skips them).
         # Only the plan CACHE key is cleared — not last_plan: knobs are
         # not a schedule choice, so re-planning must still run (the
@@ -661,6 +676,17 @@ class GradientDescent(Optimizer):
         substitution."""
         import numpy as np
 
+        if self.gram_chunk_iters and self.mesh is not None:
+            import warnings
+
+            warnings.warn(
+                "chunk_iters applies to the single-device aligned-gram "
+                "driver only; the meshed gram runners keep the "
+                "per-iteration driver (drop set_mesh to use the chunked "
+                "driver)",
+                RuntimeWarning, stacklevel=3,
+            )
+
         if self.listener is not None or self.checkpoint_manager is not None:
             if (self.sufficient_stats and self.mesh is not None
                     and not sparse_X):
@@ -737,12 +763,47 @@ class GradientDescent(Optimizer):
                 else:
                     w, losses, n_rec = fn(w0, Xd, yd)
         else:
-            w, losses, n_rec = self._runner(with_valid=False)(w0, X, y)
+            fn = self._maybe_chunked_gram_run(X)
+            if fn is not None:
+                w, losses, n_rec = fn(w0, X, y)
+            else:
+                w, losses, n_rec = self._runner(with_valid=False)(w0, X, y)
         n_rec = int(n_rec)
         self._loss_history = np.asarray(losses)[:n_rec]
         if self.check_numerics:
             _raise_if_nonfinite(self._loss_history)
         return w, self._loss_history
+
+    def _maybe_chunked_gram_run(self, X):
+        """The chunked-gather driver (``optimize/gram_driver.py``) when
+        the ``chunk_iters`` knob is set and this execution is block-
+        ALIGNED statistics with sliced windows — virtual stats (X.X is
+        None) are aligned by construction; resident stats qualify in
+        aligned mode.  None otherwise (the per-iteration driver runs)."""
+        from tpu_sgd.ops.gram import GramData, GramLeastSquaresGradient
+
+        cfg = self.config
+        if (not self.gram_chunk_iters
+                or not isinstance(X, GramData)
+                or not isinstance(self.gradient, GramLeastSquaresGradient)
+                or not (X.X is None or self.gradient.aligned
+                        or self.gram_aligned)
+                or cfg.sampling != "sliced"
+                or cfg.mini_batch_fraction >= 1.0):
+            return None
+        n = X.shape[0]
+        key = ("chunked_gram_run", self.updater, cfg, n, X.block_rows,
+               self.gram_chunk_iters)
+        fn = self._run_cache.get(key)
+        if fn is None:
+            from tpu_sgd.optimize.gram_driver import make_chunked_gram_run
+
+            fn = jax.jit(make_chunked_gram_run(
+                self.updater, cfg, n=n, block_rows=X.block_rows,
+                chunk_iters=self.gram_chunk_iters,
+            ))
+            self._run_cache[key] = fn
+        return fn
 
     def _check_streamed_stats_applies(self, sparse_X):
         """Shared guards for ``set_streamed_stats`` (single-device and

@@ -191,6 +191,10 @@ class Plan:
     batch_rows: Optional[int] = None
     aligned: bool = False
     resident_rows: int = 0
+    #: chunked-gather driver iterations per outer step (gram schedules;
+    #: None = the per-iteration driver — the default until the hardware
+    #: decomposition capture settles the win)
+    chunk_iters: Optional[int] = None
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -236,6 +240,9 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
         optimizer.gram_batch_rows = p.batch_rows or None
     if "aligned" not in user and hasattr(optimizer, "gram_aligned"):
         optimizer.gram_aligned = bool(p.aligned)
+    if ("chunk_iters" not in user
+            and hasattr(optimizer, "gram_chunk_iters")):
+        optimizer.gram_chunk_iters = p.chunk_iters or None
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
