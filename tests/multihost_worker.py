@@ -135,6 +135,35 @@ def main():
     )
     assert opt_g._gram_dp_entry is not None, "gram DP path did not engage"
 
+    # round 5: host-streamed chunked CostFun over the multi-host mesh —
+    # each process streams ITS OWN local row slice per chunk (the uneven
+    # 37/63 split makes proc 0 feed all-invalid padding chunks once its
+    # rows run out, exercising the allgathered chunk-grid agreement)
+    from tpu_sgd.ops.gradients import LogisticGradient
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+
+    yb = (y > 0).astype(np.float32)
+    w_cf, hist_cf = (
+        LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+              max_num_iterations=8)
+        .set_mesh(mesh)
+        .set_host_streaming(True, batch_rows=40)
+        .optimize_with_history((X_local, yb[lo:hi]), w0)
+    )
+
+    # zero-local-rows limiting case: proc 1 holds NO rows and must still
+    # join every collective (all-invalid chunks) instead of bailing out
+    # and deadlocking proc 0 (round-5 review finding)
+    lo_z, hi_z = (0, X.shape[0]) if proc_id == 0 else (X.shape[0],
+                                                       X.shape[0])
+    w_cf0, hist_cf0 = (
+        LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+              max_num_iterations=4)
+        .set_mesh(mesh)
+        .set_host_streaming(True, batch_rows=40)
+        .optimize_with_history((X[lo_z:hi_z], yb[lo_z:hi_z]), w0)
+    )
+
     # outputs are replicated (P() specs) -> every process holds full values
     json.dump(
         {
@@ -149,6 +178,10 @@ def main():
             "lbfgs_hist": np.asarray(hist_lbfgs).tolist(),
             "gram_w": np.asarray(w_gram).tolist(),
             "gram_hist": np.asarray(hist_gram).tolist(),
+            "costfun_w": np.asarray(w_cf).tolist(),
+            "costfun_hist": np.asarray(hist_cf).tolist(),
+            "costfun_zero_w": np.asarray(w_cf0).tolist(),
+            "costfun_zero_hist": np.asarray(hist_cf0).tolist(),
         },
         open(out_path, "w"),
     )

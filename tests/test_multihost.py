@@ -163,6 +163,52 @@ def test_multihost_gram_dp_matches_single_process(worker_results):
                                np.asarray(hist_ref), rtol=2e-4, atol=1e-5)
 
 
+def test_multihost_streamed_costfun_matches_single_process(worker_results):
+    """Round 5: the host-streamed chunked CostFun over a REAL 2-process
+    mesh — per-process local chunk streams assembled into global psum'd
+    programs — reproduces the single-process RESIDENT trajectory (the
+    any-size-any-loss CostFun contract, multi-host leg)."""
+    from tpu_sgd.ops.gradients import LogisticGradient
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    X, y = global_dataset()
+    yb = (y > 0).astype(np.float32)
+    w0 = np.zeros((X.shape[1],), np.float32)
+    w_ref, hist_ref = LBFGS(
+        LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+        max_num_iterations=8,
+    ).optimize_with_history((X, yb), w0)
+    r = worker_results[0]
+    assert len(r["costfun_hist"]) == len(hist_ref)
+    np.testing.assert_allclose(np.asarray(r["costfun_w"]),
+                               np.asarray(w_ref), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(r["costfun_hist"]),
+                               np.asarray(hist_ref), rtol=1e-4, atol=1e-6)
+
+
+def test_multihost_costfun_zero_row_process(worker_results):
+    """A process holding ZERO local rows joins the chunked CostFun's
+    collectives with all-invalid chunks — the job completes and matches
+    the single-process run on the remaining rows (deadlock regression,
+    round-5 review)."""
+    from tpu_sgd.ops.gradients import LogisticGradient
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    X, y = global_dataset()
+    yb = (y > 0).astype(np.float32)
+    w0 = np.zeros((X.shape[1],), np.float32)
+    w_ref, hist_ref = LBFGS(
+        LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+        max_num_iterations=4,
+    ).optimize_with_history((X, yb), w0)
+    r = worker_results[0]
+    assert len(r["costfun_zero_hist"]) == len(hist_ref)
+    np.testing.assert_allclose(np.asarray(r["costfun_zero_w"]),
+                               np.asarray(w_ref), rtol=1e-3, atol=1e-4)
+
+
 def test_multihost_lbfgs_matches_single_process(worker_results):
     """The meshed LBFGS CostFun (one psum per evaluation) over a REAL
     2-process mesh tracks the single-process optimizer."""

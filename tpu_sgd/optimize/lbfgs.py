@@ -651,6 +651,13 @@ class LBFGS(Optimizer):
         self._gram_entry = (X, y, g, self.gram_block_rows, self.mesh)
         return g, data
 
+    def _mesh_spans_processes(self) -> bool:
+        if self.mesh is None:
+            return False
+        from tpu_sgd.optimize.streamed_costfun import mesh_spans_processes
+
+        return mesh_spans_processes(self.mesh)
+
     def _host_streamed_costfun(self, X, y):
         """Guards + identity-cached :class:`StreamedCostFun` for
         ``set_host_streaming`` (shared with the OWLQN override)."""
@@ -696,7 +703,12 @@ class LBFGS(Optimizer):
         it)."""
         import numpy as np
 
-        if int(np.shape(X)[0]) == 0:
+        if int(np.shape(X)[0]) == 0 and not self._mesh_spans_processes():
+            # single-host empty input: the resident path's early return
+            # covers it.  A multihost process with ZERO local rows must
+            # NOT bail here — it still joins every collective (allgather
+            # + per-chunk psums), feeding all-invalid chunks; bailing
+            # would deadlock its peers.
             return None
         scf = self._host_streamed_costfun(X, y)
         w = jnp.asarray(initial_weights)

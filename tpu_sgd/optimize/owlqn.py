@@ -117,7 +117,9 @@ class OWLQN(LBFGS):
         smooth part only — exactly what :meth:`_owlqn_loop` consumes."""
         import numpy as np
 
-        if int(np.shape(X)[0]) == 0:
+        if int(np.shape(X)[0]) == 0 and not self._mesh_spans_processes():
+            # see LBFGS._host_streamed_evaluators: a multihost process
+            # with zero local rows must still join the collectives
             return None
         scf = self._host_streamed_costfun(X, y)
         w = jnp.asarray(initial_weights)

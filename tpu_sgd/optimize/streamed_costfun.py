@@ -21,8 +21,11 @@ literal chunked treeAggregate.
 Mesh composition: under a 1-D data mesh each chunk is ``device_put``
 row-sharded across the cores and the per-chunk partial sums ``psum`` over
 ICI before accumulating into replicated accumulators — the multi-executor
-treeAggregate shape.  On a multi-host pod each process would stream its
-local slice; single-process meshes stream every shard from this host.
+treeAggregate shape.  On a multi-host job each process streams ITS OWN
+local row slice and per-chunk global arrays assemble via
+``make_array_from_process_local_data`` (no cross-host rows; the chunk
+grid is agreed by allgather so every process runs the same psum'd
+programs); single-process meshes stream every shard from this host.
 
 Cost model: every evaluation re-reads the whole dataset through the host
 feed (an LBFGS iteration is ~2 cost evaluations + 1 sweep), so this is the
@@ -36,7 +39,7 @@ disk/recomputation when not.
 from __future__ import annotations
 
 import math
-from functools import partial
+from functools import lru_cache as _lru_cache, partial
 from typing import Optional
 
 import jax
@@ -51,6 +54,19 @@ Array = jax.Array
 _DEFAULT_CHUNK_BYTES = 256e6
 
 
+def mesh_spans_processes(mesh) -> bool:
+    """True when ``mesh`` contains devices of OTHER processes — the
+    multihost regime where chunk arrays must assemble from per-process
+    local slices and the chunk grid is agreed by collectives.  A mesh of
+    only this process's devices streams single-host even inside a
+    multi-process job (gating on ``process_count() > 1`` alone would
+    run a job-wide allgather nobody else joins)."""
+    import jax
+
+    pid = jax.process_index()
+    return any(d.process_index != pid for d in mesh.devices.flat)
+
+
 def default_stream_batch_rows(d: int, itemsize: int,
                               chunk_bytes: Optional[float] = None) -> int:
     """Rows per streamed chunk at a byte budget (default ~256 MB) —
@@ -59,6 +75,13 @@ def default_stream_batch_rows(d: int, itemsize: int,
     if chunk_bytes is None:
         chunk_bytes = _DEFAULT_CHUNK_BYTES
     return max(1024, int(chunk_bytes // max(1, d * itemsize)))
+
+
+@_lru_cache(maxsize=64)
+def _replicated_zeros_fn(shape, dtype_name, sharding):
+    """Cached jitted maker of replicated global zero accumulators."""
+    return jax.jit(partial(jnp.zeros, shape, jnp.dtype(dtype_name)),
+                   out_shardings=sharding)
 
 
 class StreamedCostFun:
@@ -80,7 +103,11 @@ class StreamedCostFun:
         self.gradient = gradient
         Xh = np.asarray(X)
         yh = np.asarray(y)
-        if Xh.ndim != 2 or Xh.shape[0] == 0:
+        multihost = mesh is not None and mesh_spans_processes(mesh)
+        if Xh.ndim != 2 or (Xh.shape[0] == 0 and not multihost):
+            # a multihost process MAY hold zero local rows (uneven
+            # splits): it still must join every collective, feeding
+            # all-invalid chunks
             raise ValueError(f"need a non-empty (n, d) matrix, got {Xh.shape}")
         if not jnp.issubdtype(Xh.dtype, jnp.inexact):
             Xh = Xh.astype(np.float32)  # match optimize()'s coercion
@@ -110,8 +137,35 @@ class StreamedCostFun:
             self._row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
             self._vec_sharding = NamedSharding(mesh, P(DATA_AXIS))
             self._rep_sharding = NamedSharding(mesh, P())
-        self.cap = cap
-        self.n_chunks = math.ceil(n / cap)
+        self._multihost = multihost
+        if self._multihost:
+            # Multi-host: (X, y) are THIS process's local rows (the
+            # executor-reads-its-own-splits contract, SURVEY.md §3.4).
+            # Every process must run the SAME number of psum'd chunk
+            # programs, so the chunk grid is agreed via allgather on the
+            # LARGEST local slice; processes that exhaust their rows feed
+            # all-invalid padding chunks (masked, exact sums).
+            from jax.experimental import multihost_utils
+
+            from tpu_sgd.parallel.mesh import DATA_AXIS
+
+            k = mesh.shape[DATA_AXIS]
+            k_local = dict(mesh.local_mesh.shape).get(DATA_AXIS, 1)
+            # derive the chunk size from batch_rows ALONE — the
+            # single-process `min(batch_rows, n)` clamp uses the LOCAL
+            # row count, which differs across processes and would
+            # desync the global chunk shapes
+            cap_global = max(1, int(batch_rows))
+            cap_global += (-cap_global) % k
+            cap_local = max(1, cap_global * k_local // k)
+            cap_local += (-cap_local) % max(1, k_local)
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray(n)))
+            self.cap = cap_local  # per-process rows per chunk
+            self.n_chunks = math.ceil(int(counts.max()) / cap_local)
+        else:
+            self.cap = cap
+            self.n_chunks = math.ceil(n / cap)
         self._valid_full = None  # cached all-true mask for full chunks
         self._shape_cache = {}  # (mode, w shape/dtype) -> output aval tuple
         self._acc_cost = self._make_acc(mode="cost")
@@ -173,7 +227,12 @@ class StreamedCostFun:
     def _chunk(self, i: int):
         """``(Xc, yc, valid)`` device buffers for chunk ``i`` — the tail
         chunk is zero-padded to the fixed ``cap`` so ONE compiled program
-        serves the whole grid (the valid mask keeps sums exact)."""
+        serves the whole grid (the valid mask keeps sums exact).  On a
+        multi-host job, ``cap`` is the PER-PROCESS chunk rows and the
+        global array assembles from each process's local slice
+        (``make_array_from_process_local_data`` — no cross-host rows)."""
+        if self._multihost:
+            return self._chunk_multihost(i)
         s = i * self.cap
         e = min(s + self.cap, self.n)
         Xb, yb = self.X[s:e], self.y[s:e]
@@ -197,12 +256,43 @@ class StreamedCostFun:
             vd,
         )
 
+    def _chunk_multihost(self, i: int):
+        s = min(i * self.cap, self.n)
+        e = min(s + self.cap, self.n)
+        if e - s == self.cap:  # full chunk: zero-copy slices, cached mask
+            Xp, yp = self.X[s:e], self.y[s:e]
+            if self._valid_full is None:
+                self._valid_full = jax.make_array_from_process_local_data(
+                    self._vec_sharding, np.ones((self.cap,), bool))
+            vd = self._valid_full
+        else:  # partial or exhausted: zero-pad, mask the real rows
+            Xp = np.zeros((self.cap, self.X.shape[1]), self.X.dtype)
+            yp = np.zeros((self.cap,), self.y.dtype)
+            valid = np.zeros((self.cap,), bool)
+            if e > s:
+                Xp[: e - s] = self.X[s:e]
+                yp[: e - s] = self.y[s:e]
+                valid[: e - s] = True
+            vd = jax.make_array_from_process_local_data(
+                self._vec_sharding, valid)
+        return (
+            jax.make_array_from_process_local_data(self._row_sharding, Xp),
+            jax.make_array_from_process_local_data(self._vec_sharding, yp),
+            vd,
+        )
+
     def _stream(self, w, kernel, accs):
         """Drive the chunk grid through ``kernel``: the device step for
         chunk ``i`` is dispatched (async) BEFORE chunk ``i+1`` is
         assembled and transferred, so host feed and device compute
         overlap; only the caller's final read blocks."""
-        w = jax.device_put(w, self._rep_sharding)
+        if self._multihost:
+            # device_put cannot target non-addressable devices; the
+            # replicated weights assemble from identical per-process data
+            w = jax.make_array_from_process_local_data(
+                self._rep_sharding, np.asarray(w))
+        else:
+            w = jax.device_put(w, self._rep_sharding)
         nxt = self._chunk(0)
         for i in range(self.n_chunks):
             cur = nxt
@@ -212,6 +302,16 @@ class StreamedCostFun:
         return accs
 
     def _zeros(self, shapes):
+        if self._multihost:
+            # a compiled SPMD program may produce global replicated
+            # arrays where a host-side placement cannot; the jitted
+            # makers are cached per (shape, dtype, sharding) — the
+            # DONATED buffers must be fresh, the compiled fn need not be
+            return tuple(
+                _replicated_zeros_fn(s.shape, jnp.dtype(s.dtype).name,
+                                     self._rep_sharding)()
+                for s in shapes
+            )
         return tuple(
             jnp.zeros(s.shape, s.dtype, device=self._rep_sharding)
             for s in shapes
