@@ -21,7 +21,7 @@ isolating one component of the full step:
   one_read    the margins matvec only (one HBM read of the window — the
               single-read floor; the gradient matvec is the second read)
 
-Per-iter times come from a two-point fit (K and 4K iterations per launch)
+Per-iter times come from bench's >=3-point regression (K/3K/12K ladder)
 so the fixed tunnel launch cost cancels — the same protocol as bench.py.
 Optionally captures a jax.profiler trace of the full run (PROFILE_TRACE=1)
 under bench_logs/profile_trace/.
@@ -187,19 +187,27 @@ def main():
         return time.perf_counter() - t0
 
     def slope_of(name, make_fn, iters=None):
-        """Two-point fit over K and 4K iterations; launch cost cancels.
-        ``iters`` overrides the ladder length — the gram legs run 30x more
+        """Steady-state per-iteration time via bench's >=3-point
+        regression (1x/3x/12x ladder) — the round-4 protocol whose
+        residuals expose launch jitter instead of absorbing it (the old
+        two-point fit here was the source of round 3's +-25% "spread").
+        ``iters`` overrides the base — the gram legs run 30x more
         iterations because their per-iter cost (~0.1 ms and below) would
         otherwise drown in the +-30 ms tunnel launch jitter."""
+        from bench import fit_steady_state
+
         iters = ITERS if iters is None else iters
-        f1 = make_fn(iters)
-        f4 = make_fn(4 * iters)
-        dt1 = time_fn(f"{name}[{iters}]", f1, w0, X, y)
-        dt4 = time_fn(f"{name}[{4 * iters}]", f4, w0, X, y)
-        slope = (dt4 - dt1) / (3 * iters)
+        pts = []
+        for mult in (1, 3, 12):
+            fn = make_fn(mult * iters)
+            pts.append((mult * iters,
+                        time_fn(f"{name}[{mult * iters}]", fn, w0, X, y)))
+        slope, _fixed, fit = fit_steady_state(pts)
         if slope <= 0:
-            slope = dt4 / (4 * iters)
-        log(f"{name}: {slope * 1e3:.3f} ms/iter steady-state")
+            slope = pts[-1][1] / pts[-1][0]
+        err = fit.get("slope_rel_err")
+        log(f"{name}: {slope * 1e3:.3f} ms/iter steady-state"
+            + (f" (+-{err:.1%})" if err is not None else ""))
         return slope
 
     # the real fused program, loss history and all
