@@ -130,6 +130,36 @@ def main():
     print(f"5d. auto-plan here: {lp.schedule}; the 10Mx1000 config-4 "
           f"shape would plan: {big.schedule}")
 
+    # --- 5e. Beyond-HBM quasi-Newton for ANY loss (round 5) --------------
+    # Least squares has the statistics shortcut above; every OTHER loss
+    # gets the chunked treeAggregate CostFun: set_host_streaming on
+    # LBFGS/OWL-QN streams each full-batch cost/gradient/line-search
+    # evaluation through the device in chunks — the planner picks it
+    # automatically for beyond-HBM logistic/hinge/multinomial fits.
+    from tpu_sgd import LBFGS, SquaredL2Updater
+    from tpu_sgd.ops.gradients import LogisticGradient
+
+    yb = (np.asarray(y) > np.median(np.asarray(y))).astype(np.float32)
+    opt_cf = (LBFGS(LogisticGradient(), SquaredL2Updater(),
+                    reg_param=0.01, max_num_iterations=8)
+              .set_host_streaming(True, batch_rows=512))
+    w_cf, hist_cf = opt_cf.optimize_with_history(
+        (np.asarray(X), yb), np.zeros(X.shape[1], np.float32))
+    print(f"5e. host-streamed chunked CostFun (logistic LBFGS): loss "
+          f"{hist_cf[0]:.3f} -> {hist_cf[-1]:.3f} in {len(hist_cf) - 1} "
+          "iterations, rows never device-resident in full")
+
+    # --- 5f. Planner self-calibration (round 5) --------------------------
+    # The planner's decision-boundary constants are calibrated to ONE
+    # environment; a ~2 s probe re-measures the two rates that move the
+    # boundaries (on-device bandwidth, host feed) for THIS machine.
+    from tpu_sgd.plan import CostModel
+
+    cm = CostModel.calibrate(copy_mb=8, feed_mb=8)
+    print(f"5f. calibrated cost model: hbm={cm.hbm_gb_s:.1f} GB/s, "
+          f"host feed={cm.host_feed_gb_s:.2f} GB/s "
+          "(pass cost_model=cm to plan()/plan_for())")
+
     # --- 6. Classify + evaluate (BinaryClassificationMetrics) ------------
     Xc, yc, _ = logistic_data(4_000, 15, seed=5)
     clf = LogisticRegressionWithSGD.train((Xc, yc), num_iterations=60)

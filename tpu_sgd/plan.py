@@ -38,6 +38,14 @@ Schedules (measured figures: BASELINE.md "Measured results", TPU v5 lite):
                        data) that the plan's ``reason`` states loudly.
 =====================  ====================================================
 
+The quasi-Newton optimizers (LBFGS/OWL-QN) plan a narrower menu through
+:func:`plan_quasi_newton` (``QN_SCHEDULES``): stock full-batch passes,
+the sufficient-statistics substitution (least squares — resident or
+streamed-virtual, meshed via per-shard totals), and — round 5 — the
+``host_streamed`` chunked-CostFun schedule for NON-least-squares losses
+beyond HBM (``optimize/streamed_costfun.py``), closing the reference's
+any-size-any-loss CostFun contract.
+
 The cost model's constants are calibrated to the round-3 hardware captures
 (``BENCH_LAST_TPU.json``); they steer *decision boundaries*, not perf
 claims, and every number the decision used is recorded in
@@ -45,7 +53,9 @@ claims, and every number the decision used is recorded in
 conservative for small problems: the one-time statistics build only pays
 for itself past ``build_amortize_iters`` iterations (measured ~1000–1900
 at 3M×1000), so tiny workloads keep the stock path and its bitwise
-round-2 trajectories.
+round-2 trajectories.  :meth:`CostModel.calibrate` re-measures the two
+environment-sensitive rates (~2 s) for deployments off the calibrated
+tunnel environment.
 """
 
 from __future__ import annotations
