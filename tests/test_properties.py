@@ -265,3 +265,39 @@ def test_resident_window_probability_property(n, frac, r_frac):
     assert hits / max(n - m + 1, 1) == pytest.approx(
         resident_window_probability(n, frac, R)
     )
+
+
+# ---- chunked CostFun sums == one-pass sums over random grids (round 5) ----
+
+@settings(derandomize=True, max_examples=12, deadline=None)
+@given(
+    n=st.integers(5, 400),
+    batch_rows=st.integers(1, 500),
+    seed=st.integers(0, 10_000),
+    grad_i=st.integers(0, 2),
+)
+def test_streamed_costfun_sums_property(n, batch_rows, seed, grad_i):
+    """For ANY (row count, chunk size) grid — chunks larger than the data,
+    single-row chunks, ragged tails — the chunked accumulation equals the
+    one-pass kernels up to summation reassociation (the treeAggregate
+    invariance the reference gets from associativity)."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.optimize.streamed_costfun import StreamedCostFun
+
+    d = 6
+    gradient = (LeastSquaresGradient(), LogisticGradient(),
+                HingeGradient())[grad_i]
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, d)).astype(np.float32)
+    y = (r.random(n) > 0.5).astype(np.float32)
+    w = r.normal(size=(d,)).astype(np.float32)
+    scf = StreamedCostFun(gradient, X, y, batch_rows=batch_rows)
+    gs, ls, c = (np.asarray(v) for v in scf.cost_sums(w))
+    g0, l0, c0 = (np.asarray(v) for v in gradient.batch_sums(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(w)))
+    assert c == c0 == n
+    np.testing.assert_allclose(gs, g0, rtol=3e-5,
+                               atol=3e-4 * max(1, n / 100))
+    np.testing.assert_allclose(ls, l0, rtol=3e-5,
+                               atol=3e-4 * max(1, n / 100))
