@@ -95,3 +95,49 @@ def test_oracle_objective_helper_closed_form():
     np.testing.assert_allclose(got, expect, rtol=1e-5)
     with pytest.raises(ValueError, match="unknown reg kind"):
         full_objective(LeastSquaresGradient(), X, y, w, 0.1, "elastic")
+
+
+def test_host_streamed_costfun_reaches_logistic_oracle():
+    """Round 5: the beyond-HBM chunked-CostFun schedule must reach the
+    SAME optimum as a resident fit — the oracle gap is the end-to-end
+    check that chunked accumulation loses nothing (the reference's
+    CostFun converges identically however many partitions feed it)."""
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    X, y, _ = logistic_data(10_000, 40, seed=9)
+    reg = 0.01
+    w_star = logistic_l2_oracle(X, y, reg_param=reg)
+    opt = (LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=reg,
+                 max_num_iterations=60, convergence_tol=1e-9)
+           .set_host_streaming(True, batch_rows=1024))
+    w, hist = opt.optimize_with_history(
+        (X, y), np.zeros(X.shape[1], np.float32))
+    gap, L, L_star = objective_gap(
+        LogisticGradient(), X, y, w, w_star, reg_param=reg, reg="l2"
+    )
+    assert gap < 0.01, f"gap {gap:.4f} (L={L:.6f} L*={L_star:.6f})"
+
+
+def test_chunked_gram_driver_reaches_least_squares_oracle():
+    """Round 5: the chunked-gather aligned driver converges to the same
+    normal-equations optimum as the per-iteration schedules (the aligned
+    sampling deviation does not move the optimum on shuffled data)."""
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    X, y, _ = linear_data(20_000, 40, eps=0.1, seed=2)
+    w_star = least_squares_oracle(X, y)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_step_size(1.0).set_num_iterations(200)
+           .set_mini_batch_fraction(0.1).set_sampling("sliced")
+           .set_convergence_tol(0.0)
+           .set_streamed_stats(True, block_rows=512)
+           .set_gram_options(chunk_iters=16))
+    w, hist = opt.optimize_with_history(
+        (X, y), np.zeros(X.shape[1], np.float32))
+    assert any(k[0] == "chunked_gram_run" for k in opt._run_cache)
+    gap, L, L_star = objective_gap(
+        LeastSquaresGradient(), X, y, w, w_star
+    )
+    assert gap < 0.02, f"gap {gap:.4f} (L={L:.6f} L*={L_star:.6f})"
