@@ -57,3 +57,65 @@ def test_wrong_weight_dim_raises():
     X, y, _ = linear_data(100, 5, seed=4)
     with pytest.raises(ValueError):
         NormalEquations().optimize((X, y), np.zeros(3, np.float32))
+
+
+# ---- beyond-HBM exact solve (round 5) --------------------------------------
+
+def test_normal_host_streamed_matches_resident(rng):
+    """set_host_streaming: the exact solve from host-streamed Gram totals
+    must match the resident solve (totals accumulate at f32 HIGHEST —
+    at least as precise as the resident Gram matmul)."""
+    from tpu_sgd.optimize.normal import NormalEquations
+
+    from tpu_sgd.ops.gram import streamed_totals_chunking
+
+    n, d = 4100, 12
+    # batch_rows=512 < n: B=512, chunk=512 -> 8 full chunks + a 4-row
+    # tail chunk, exercising the cross-chunk carry AND the sub-block
+    # tail (_total_stats' nbf == 0 branch)
+    B, chunk = streamed_totals_chunking(n, 8192, 512)
+    assert (B, chunk) == (512, 512)
+    assert n % chunk != 0 and n % chunk < B  # the tail is sub-block
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w_true + 0.05 * rng.normal(size=n)).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    w_res = NormalEquations(reg_param=0.01).optimize((X, y), w0)
+    opt = NormalEquations(reg_param=0.01).set_host_streaming(
+        True, batch_rows=512)
+    w_str = opt.optimize((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_str), np.asarray(w_res),
+                               rtol=1e-4, atol=1e-5)
+    assert opt.loss_history.shape == (1,)
+    # the cap is honored EXACTLY even below the default block size
+    # (the totals carry has no stack; B shrinks to the cap)
+    B2, chunk2 = streamed_totals_chunking(100_000, 8192, 500)
+    assert B2 == 500 and chunk2 == 500
+
+
+def test_normal_host_streamed_meshed_matches_single(rng):
+    """Meshed host streaming: per-shard streamed totals combine to the
+    same exact solution (the n % k remainder rides with the last shard —
+    EXACT, unlike the prefix-stack builders)."""
+    from tpu_sgd import data_mesh
+    from tpu_sgd.optimize.normal import NormalEquations
+
+    n, d = 2051, 8  # n % 8 != 0: the remainder rides with the last shard
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    w_one = NormalEquations(reg_param=0.01).set_host_streaming(True) \
+        .optimize((X, y), w0)
+    # batch_rows=64 < n_local=256: each shard streams MULTIPLE chunks
+    # with a sub-block tail
+    w_mesh = NormalEquations(reg_param=0.01).set_mesh(data_mesh()) \
+        .set_host_streaming(True, batch_rows=64).optimize((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_mesh), np.asarray(w_one),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_normal_host_streaming_batch_rows_validation():
+    from tpu_sgd.optimize.normal import NormalEquations
+
+    with pytest.raises(ValueError, match="batch_rows must be positive"):
+        NormalEquations().set_host_streaming(True, batch_rows=0)

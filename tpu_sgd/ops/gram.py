@@ -89,6 +89,25 @@ def _dot_hi(a, b, dtype):
     )
 
 
+def streamed_totals_chunking(n: int, block_rows: int,
+                             batch_rows=None):
+    """``(B, chunk)`` for a streamed TOTALS build: block granularity and
+    host→device chunk rows.  ``batch_rows`` CAPS the chunk EXACTLY — the
+    O(d²) totals carry has no prefix stack, so the block size is free to
+    shrink to honor small caps (unlike the prefix builders, whose stack
+    grows as B shrinks).  THE one policy, shared by
+    ``NormalEquations.set_host_streaming`` and the meshed totals builder
+    (``parallel/gram_parallel.py``)."""
+    n = max(1, int(n))
+    B = max(1, min(int(block_rows), n))
+    if batch_rows:
+        B = max(1, min(B, int(batch_rows)))
+        chunk = max(B, (int(batch_rows) // B) * B)
+    else:
+        chunk = 64 * B
+    return B, min(chunk, n)
+
+
 def aligned_window_blocks(m: int, B: int, nbf: int) -> int:
     """Whole-block window length of an m-row aligned window — THE
     rounding shared by the per-iteration executor
@@ -558,7 +577,12 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         d = X.shape[1]
         init = (jnp.zeros((d, d), sd), jnp.zeros((d,), sd),
                 jnp.zeros((), sd))
-        (G, b, yy), _ = jax.lax.scan(step, init, jnp.arange(nbf))
+        if nbf > 0:
+            (G, b, yy), _ = jax.lax.scan(step, init, jnp.arange(nbf))
+        else:  # fewer rows than one block (a streamed tail chunk): the
+            # static-shape tail below covers everything — scan would
+            # still TRACE its body and reject the oversized slice
+            G, b, yy = init
         Xt = X[nbf * B:]  # static-shape tail
         yt = y[nbf * B:]
         vt = None if valid is None else valid[nbf * B:]

@@ -91,11 +91,39 @@ class NormalEquations(Optimizer):
     def __init__(self, reg_param: float = 0.0):
         self.reg_param = float(reg_param)
         self.mesh = None
+        self.host_streaming = False
+        self.stream_batch_rows = None
         self._loss = None
         self._cache = {}
 
     def set_reg_param(self, r: float):
         self.reg_param = float(r)
+        return self
+
+    def set_host_streaming(self, flag: bool = True,
+                           batch_rows: int = None):
+        """Beyond-HBM EXACT least squares: accumulate the Gram totals by
+        streaming host row chunks through the device with an O(d²) carry
+        (``GramLeastSquaresGradient._streamed_totals``) — the literal
+        analogue of the reference's spark.ml normal solver aggregating
+        its Gram over an RDD of ANY size — then run the tiny (d, d)
+        solve.  EXACT: every row contributes (no dropped tail).
+        Composes with ``set_mesh``: each shard streams its own host
+        slice to its own device and the totals combine once
+        (``parallel/gram_parallel.py`` ``build_streamed_total_stats``).
+
+        Precision note: the streamed totals accumulate at f32 HIGHEST
+        (the statistics contract, ``ops/gram.py``), which is MORE
+        precise than the resident bf16-data Gram matmul — trajectories
+        agree to that rounding.  ``batch_rows`` caps the host→device
+        chunk (default 64 blocks)."""
+        self.host_streaming = bool(flag)
+        if batch_rows is not None:
+            if int(batch_rows) < 1:
+                raise ValueError(
+                    f"batch_rows must be positive, got {batch_rows}"
+                )
+            self.stream_batch_rows = int(batch_rows)
         return self
 
     def set_mesh(self, mesh):
@@ -170,6 +198,16 @@ class NormalEquations(Optimizer):
                 "features -> 8.8 GB), so wide sparse problems should use "
                 "GradientDescent/LBFGS/OWLQN instead"
             )
+        if self.host_streaming:
+            # BEFORE any device coercion: the whole point is that X never
+            # lives on the device in full
+            if np.shape(initial_weights)[-1] != np.shape(X)[1]:
+                raise ValueError(
+                    f"initial_weights has length "
+                    f"{np.shape(initial_weights)[-1]} but the data has "
+                    f"{np.shape(X)[1]} features"
+                )
+            return self._optimize_host_streamed(X, y)
         X = jnp.asarray(X)
         y = jnp.asarray(y)
         if not jnp.issubdtype(y.dtype, jnp.inexact):
@@ -190,6 +228,10 @@ class NormalEquations(Optimizer):
                 w, loss = self._solver(with_valid=True)(Xd, yd, valid)
             else:
                 w, loss = self._solver(with_valid=False)(Xd, yd)
+        return self._finish(w, loss)
+
+    def _finish(self, w, loss):
+        """Shared postlude: rank-deficiency surface + loss history."""
         if not bool(jnp.all(jnp.isfinite(w))):
             raise FloatingPointError(
                 "normal-equations solve produced non-finite weights: the "
@@ -200,3 +242,40 @@ class NormalEquations(Optimizer):
             )
         self._loss = np.asarray([float(loss)], np.float32)
         return w
+
+    def _optimize_host_streamed(self, X, y):
+        """Exact solve from host-streamed Gram totals (see
+        ``set_host_streaming``)."""
+        from tpu_sgd.ops.gram import (DEFAULT_BLOCK_ROWS,
+                                      GramLeastSquaresGradient)
+
+        Xh = np.asarray(X)
+        yh = np.asarray(y)
+        if not jnp.issubdtype(Xh.dtype, jnp.inexact):
+            Xh = Xh.astype(np.float32)
+        if not jnp.issubdtype(yh.dtype, jnp.inexact):
+            yh = yh.astype(np.float32)
+        n = Xh.shape[0]
+        if self.mesh is not None:
+            from tpu_sgd.parallel.gram_parallel import (
+                build_streamed_total_stats,
+            )
+
+            data = build_streamed_total_stats(
+                self.mesh, Xh, yh,
+                batch_rows=self.stream_batch_rows,
+            )
+            G, b, yty = data.G_tot, data.b_tot, data.yy_tot
+        else:
+            from tpu_sgd.ops.gram import streamed_totals_chunking
+
+            B, chunk = streamed_totals_chunking(
+                n, DEFAULT_BLOCK_ROWS, self.stream_batch_rows)
+            sd = GramLeastSquaresGradient._resolve_stats_dtype(
+                Xh.dtype, None)
+            G, b, yty = GramLeastSquaresGradient._streamed_totals(
+                Xh, yh, B, sd, chunk)
+        w, loss = jax.jit(_solve, static_argnums=(4,))(
+            G, b, yty, jnp.asarray(float(n), G.dtype), self.reg_param
+        )
+        return self._finish(w, loss)

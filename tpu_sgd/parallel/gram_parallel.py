@@ -330,9 +330,9 @@ def build_streamed_total_stats(mesh, Xh, yh,
                   else jnp.float32)
     sd = GramLeastSquaresGradient._resolve_stats_dtype(data_dtype, None)
     n_local = n // k
-    B = max(1, min(int(block_rows), n_local))
-    chunk = int(batch_rows) if batch_rows else 64 * B
-    chunk = max(B, (chunk // B) * B)
+    from tpu_sgd.ops.gram import streamed_totals_chunking
+
+    B, chunk = streamed_totals_chunking(n_local, block_rows, batch_rows)
 
     devices = list(mesh.devices.reshape(-1))
     totals = []
