@@ -119,3 +119,28 @@ def test_normal_host_streaming_batch_rows_validation():
 
     with pytest.raises(ValueError, match="batch_rows must be positive"):
         NormalEquations().set_host_streaming(True, batch_rows=0)
+
+
+def test_normal_auto_streams_beyond_budget(rng, monkeypatch, caplog):
+    """Zero-flag contract: a host dataset beyond the probed device budget
+    streams its Gram totals automatically (and logs the decision) instead
+    of committing the full matrix; set_host_streaming(False) forces
+    resident."""
+    import logging
+
+    import tpu_sgd.plan as plan_mod
+    from tpu_sgd.optimize.normal import NormalEquations
+
+    monkeypatch.setattr(plan_mod, "device_budget",
+                        lambda *a, **k: (8e3, "test"))  # 8 KB budget
+    n, d = 1024, 8
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    with caplog.at_level(logging.INFO, logger="tpu_sgd.plan"):
+        w_auto = NormalEquations(reg_param=0.01).optimize((X, y), w0)
+    assert any("normal host_streamed" in r.message for r in caplog.records)
+    w_forced = NormalEquations(reg_param=0.01) \
+        .set_host_streaming(False).optimize((X, y), w0)
+    np.testing.assert_allclose(np.asarray(w_auto), np.asarray(w_forced),
+                               rtol=1e-4, atol=1e-5)

@@ -91,7 +91,9 @@ class NormalEquations(Optimizer):
     def __init__(self, reg_param: float = 0.0):
         self.reg_param = float(reg_param)
         self.mesh = None
-        self.host_streaming = False
+        #: None = AUTO: stream when host data exceeds the probed device
+        #: budget (the zero-flag placement contract); True/False force
+        self.host_streaming = None
         self.stream_batch_rows = None
         self._loss = None
         self._cache = {}
@@ -116,7 +118,12 @@ class NormalEquations(Optimizer):
         (the statistics contract, ``ops/gram.py``), which is MORE
         precise than the resident bf16-data Gram matmul — trajectories
         agree to that rounding.  ``batch_rows`` caps the host→device
-        chunk (default 64 blocks)."""
+        chunk EXACTLY (default 64 blocks).
+
+        The DEFAULT is AUTO: with no flag set, ``optimize`` streams
+        whenever the host data exceeds the probed device budget (and
+        runs resident otherwise) — ``set_host_streaming(False)`` forces
+        the resident path."""
         self.host_streaming = bool(flag)
         if batch_rows is not None:
             if int(batch_rows) < 1:
@@ -198,7 +205,33 @@ class NormalEquations(Optimizer):
                 "features -> 8.8 GB), so wide sparse problems should use "
                 "GradientDescent/LBFGS/OWLQN instead"
             )
-        if self.host_streaming:
+        stream = self.host_streaming
+        if stream is None and not isinstance(X, jax.Array):
+            # AUTO placement (the user never picks it — the scheduler
+            # contract, SURVEY.md §2 #16): a host dataset beyond the
+            # probed per-device budget streams its Gram totals instead
+            # of OOMing on the full commit; shards divide the budget.
+            from tpu_sgd.plan import device_budget
+
+            shape = np.shape(X)
+            budget, _src = device_budget()
+            if self.mesh is not None:
+                from tpu_sgd.parallel.mesh import DATA_AXIS
+
+                budget *= dict(self.mesh.shape).get(DATA_AXIS, 1)
+            itemsize = np.dtype(getattr(X, "dtype", np.float32)).itemsize
+            data_bytes = shape[0] * shape[1] * itemsize + shape[0] * 4.0
+            stream = data_bytes > budget
+            if stream:
+                from tpu_sgd.plan import logger
+
+                logger.info(
+                    "plan: normal host_streamed — data "
+                    f"({data_bytes / 1e9:.2f} GB) exceeds the device "
+                    f"budget ({budget / 1e9:.2f} GB); Gram totals "
+                    "accumulate from host-streamed chunks (exact)"
+                )
+        if stream:
             # BEFORE any device coercion: the whole point is that X never
             # lives on the device in full
             if np.shape(initial_weights)[-1] != np.shape(X)[1]:
