@@ -149,6 +149,20 @@ def main():
           f"{hist_cf[0]:.3f} -> {hist_cf[-1]:.3f} in {len(hist_cf) - 1} "
           "iterations, rows never device-resident in full")
 
+    # --- 5e2. Beyond-HBM EXACT least squares (round 5) -------------------
+    # The normal solver streams its Gram totals from host chunks (O(d^2)
+    # carry, every row counted) and solves exactly — and it does this
+    # AUTOMATICALLY when the data exceeds the device budget.
+    from tpu_sgd.models.regression import LinearRegressionWithNormal
+
+    alg_n = LinearRegressionWithNormal(reg_param=0.0)
+    alg_n.optimizer.set_host_streaming(True, batch_rows=4096)  # or let AUTO decide
+    model_n = alg_n.run((X, y))
+    w_err_n = float(np.linalg.norm(
+        np.asarray(model_n.weights) - np.asarray(model.weights)))
+    print(f"5e2. streamed-totals exact solve: |w_normal - w_sgd| = "
+          f"{w_err_n:.4f} (host chunks, zero full-matrix residency)")
+
     # --- 5f. Planner self-calibration (round 5) --------------------------
     # The planner's decision-boundary constants are calibrated to ONE
     # environment; a ~2 s probe re-measures the two rates that move the
