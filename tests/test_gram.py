@@ -1267,3 +1267,20 @@ def test_chunk_iters_meshed_warns_and_falls_back(rng):
         w, h = opt.optimize_with_history((X, y), np.zeros(d, np.float32))
     assert np.all(np.isfinite(np.asarray(w)))
     assert not any(k[0] == "chunked_gram_run" for k in opt._run_cache)
+
+
+def test_chunk_iters_listener_warns(rng):
+    """The observed (listener) path warns that chunk_iters is ignored —
+    chunking amortizes exactly the per-iteration host hop listeners
+    provide."""
+    from tpu_sgd.utils.events import SGDListener
+
+    X, y = _chunked_setup(rng, n=512)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_step_size(0.2).set_num_iterations(3)
+           .set_mini_batch_fraction(0.25).set_sampling("sliced")
+           .set_streamed_stats(True, block_rows=64)
+           .set_gram_options(chunk_iters=8))
+    opt.listener = SGDListener()
+    with pytest.warns(RuntimeWarning, match="observed"):
+        opt.optimize_with_history((X, y), np.zeros(12, np.float32))

@@ -688,6 +688,17 @@ class GradientDescent(Optimizer):
             )
 
         if self.listener is not None or self.checkpoint_manager is not None:
+            if self.gram_chunk_iters:
+                import warnings
+
+                warnings.warn(
+                    "chunk_iters is ignored on the observed "
+                    "(listener/checkpoint) path: chunking amortizes the "
+                    "per-iteration host hop that listeners exist to "
+                    "provide; detach the listener to use the chunked "
+                    "driver",
+                    RuntimeWarning, stacklevel=3,
+                )
             if (self.sufficient_stats and self.mesh is not None
                     and not sparse_X):
                 import warnings
