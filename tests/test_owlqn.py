@@ -124,3 +124,27 @@ def test_owlqn_dp_mesh_parity():
             ((np.asarray(w8) == 0) != (np.asarray(w1) == 0)).sum()
         )
         assert mismatch <= 1
+
+
+def test_owlqn_multinomial_intercept_exemption_guard(rng):
+    """penalize_intercept=False assumes the GLM's single trailing bias
+    coordinate; a flattened multinomial matrix has one intercept per
+    class row, so the combination must raise instead of silently
+    mis-penalizing (round 5)."""
+    from tpu_sgd.ops.gradients import MultinomialLogisticGradient
+    from tpu_sgd.optimize.owlqn import OWLQN
+
+    n, d, K = 256, 6, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, K, n).astype(np.float32)
+    g = MultinomialLogisticGradient(K)
+    opt = OWLQN(g, reg_param=0.01, max_num_iterations=3,
+                penalize_intercept=False)
+    with pytest.raises(NotImplementedError, match="per class row"):
+        opt.optimize_with_history(
+            (X, y), np.zeros(g.weight_dim(d), np.float32))
+    # penalized intercepts (the default) still run
+    opt2 = OWLQN(g, reg_param=0.01, max_num_iterations=3)
+    w, h = opt2.optimize_with_history(
+        (X, y), np.zeros(g.weight_dim(d), np.float32))
+    assert np.all(np.isfinite(np.asarray(w)))

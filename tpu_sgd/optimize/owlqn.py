@@ -109,6 +109,25 @@ class OWLQN(LBFGS):
         self.penalize_intercept = bool(flag)
         return self
 
+    def _reg_vector(self, w):
+        """Per-coordinate L1 strengths; the intercept exemption assumes
+        VECTOR weights (the GLM bias rides as the LAST coordinate) — a
+        flattened multinomial matrix has one intercept per class row, so
+        exempting only the last coordinate would silently mis-penalize
+        K-2 intercepts."""
+        reg = jnp.full(w.shape, self.reg_param, w.dtype)
+        if not self.penalize_intercept:
+            if getattr(self.gradient, "num_classes", 2) > 2:
+                raise NotImplementedError(
+                    "penalize_intercept=False assumes vector weights "
+                    "(one bias as the last coordinate); multinomial "
+                    "weights carry one intercept per class row — "
+                    "penalize the intercepts or use LBFGS with "
+                    "SquaredL2Updater"
+                )
+            reg = reg.at[-1].set(0.0)
+        return reg
+
     def _host_streamed_evaluators(self, X, y, initial_weights):
         """OWL-QN shape of the host-streamed chunked CostFun (see
         ``LBFGS._host_streamed_evaluators``): ``(w0, reg, smooth_cost1,
@@ -125,9 +144,7 @@ class OWLQN(LBFGS):
         w = jnp.asarray(initial_weights)
         if not jnp.issubdtype(w.dtype, jnp.inexact):
             w = w.astype(jnp.float32)
-        reg = jnp.full(w.shape, self.reg_param, w.dtype)
-        if not self.penalize_intercept:
-            reg = reg.at[-1].set(0.0)
+        reg = self._reg_vector(w)
         l1_value = lambda wv: jnp.sum(reg * jnp.abs(wv))
 
         @jax.jit
@@ -180,10 +197,7 @@ class OWLQN(LBFGS):
 
         was_gram_input = isinstance(X, _GramData)
         gradient, X = self._substitute_gram(self.gradient, X, y)
-        reg_vec = jnp.full(w.shape, self.reg_param, w.dtype)
-        if not self.penalize_intercept:
-            reg_vec = reg_vec.at[-1].set(0.0)
-        reg = reg_vec  # per-coordinate, broadcast through the helpers
+        reg = self._reg_vector(w)  # per-coordinate, broadcast through
 
         mesh = self.mesh
         if isinstance(X, _GramData) and not was_gram_input:
