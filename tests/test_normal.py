@@ -144,3 +144,94 @@ def test_normal_auto_streams_beyond_budget(rng, monkeypatch, caplog):
         .set_host_streaming(False).optimize((X, y), w0)
     np.testing.assert_allclose(np.asarray(w_auto), np.asarray(w_forced),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_totals_resumable_bitwise(rng, tmp_path):
+    """A totals accumulation killed mid-pass resumes from its carry
+    checkpoint and produces BITWISE-identical totals (round 5: the cheap
+    sibling of the prefix builder's resume)."""
+    from tpu_sgd.ops import gram as gram_mod
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    n, d = 1500, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    import jax.numpy as jnp
+
+    sd = jnp.float32
+    ref = GramLeastSquaresGradient._streamed_totals(X, y, 128, sd, 256)
+    resume_dir = str(tmp_path / "totals")
+    calls = {"n": 0}
+    real = gram_mod._acc_totals
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("simulated wedge")
+        return real(*args)
+
+    gram_mod._acc_totals = dying
+    try:
+        with pytest.raises(RuntimeError, match="wedge"):
+            GramLeastSquaresGradient._streamed_totals(
+                X, y, 128, sd, 256, resume_dir=resume_dir,
+                checkpoint_every=1)
+    finally:
+        gram_mod._acc_totals = real
+    import os
+
+    assert os.path.exists(os.path.join(resume_dir, "totals.npz"))
+    got = GramLeastSquaresGradient._streamed_totals(
+        X, y, 128, sd, 256, resume_dir=resume_dir)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not os.path.exists(resume_dir)  # finalized
+
+
+def test_streamed_totals_resume_rejects_different_dataset(rng, tmp_path):
+    from tpu_sgd.ops import gram as gram_mod
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+    import jax.numpy as jnp
+
+    n, d = 800, 5
+    XA = rng.normal(size=(n, d)).astype(np.float32)
+    XB = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    resume_dir = str(tmp_path / "totals")
+    calls = {"n": 0}
+    real = gram_mod._acc_totals
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("wedge")
+        return real(*args)
+
+    gram_mod._acc_totals = dying
+    try:
+        with pytest.raises(RuntimeError):
+            GramLeastSquaresGradient._streamed_totals(
+                XA, y, 64, jnp.float32, 128, resume_dir=resume_dir,
+                checkpoint_every=1)
+    finally:
+        gram_mod._acc_totals = real
+    with pytest.raises(ValueError, match="different build"):
+        GramLeastSquaresGradient._streamed_totals(
+            XB, y, 64, jnp.float32, 128, resume_dir=resume_dir)
+
+
+def test_normal_streamed_resume_dir_end_to_end(rng, tmp_path):
+    """resume_dir threads through the public solver API (and is a no-op
+    on an uninterrupted build)."""
+    from tpu_sgd.optimize.normal import NormalEquations
+
+    n, d = 1200, 7
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    w_plain = NormalEquations(reg_param=0.01).set_host_streaming(
+        True, batch_rows=256).optimize((X, y), w0)
+    w_ckpt = NormalEquations(reg_param=0.01).set_host_streaming(
+        True, batch_rows=256,
+        resume_dir=str(tmp_path / "nrm")).optimize((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_ckpt), np.asarray(w_plain))

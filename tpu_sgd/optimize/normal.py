@@ -95,6 +95,7 @@ class NormalEquations(Optimizer):
         #: budget (the zero-flag placement contract); True/False force
         self.host_streaming = None
         self.stream_batch_rows = None
+        self.stream_resume_dir = None
         self._loss = None
         self._cache = {}
 
@@ -103,7 +104,8 @@ class NormalEquations(Optimizer):
         return self
 
     def set_host_streaming(self, flag: bool = True,
-                           batch_rows: int = None):
+                           batch_rows: int = None,
+                           resume_dir: str = None):
         """Beyond-HBM EXACT least squares: accumulate the Gram totals by
         streaming host row chunks through the device with an O(d²) carry
         (``GramLeastSquaresGradient._streamed_totals``) — the literal
@@ -118,7 +120,9 @@ class NormalEquations(Optimizer):
         (the statistics contract, ``ops/gram.py``), which is MORE
         precise than the resident bf16-data Gram matmul — trajectories
         agree to that rounding.  ``batch_rows`` caps the host→device
-        chunk EXACTLY (default 64 blocks).
+        chunk EXACTLY (default 64 blocks); ``resume_dir`` makes the
+        accumulation resumable (one tiny carry checkpoint per chunk —
+        see ``_streamed_totals``).
 
         The DEFAULT is AUTO: with no flag set, ``optimize`` streams
         whenever the host data exceeds the probed device budget (and
@@ -131,6 +135,10 @@ class NormalEquations(Optimizer):
                     f"batch_rows must be positive, got {batch_rows}"
                 )
             self.stream_batch_rows = int(batch_rows)
+        if resume_dir is not None:
+            # sticky like batch_rows: re-asserting the flag must not
+            # silently drop crash protection (clear via the attribute)
+            self.stream_resume_dir = resume_dir
         return self
 
     def set_mesh(self, mesh):
@@ -297,6 +305,7 @@ class NormalEquations(Optimizer):
             data = build_streamed_total_stats(
                 self.mesh, Xh, yh,
                 batch_rows=self.stream_batch_rows,
+                resume_dir=self.stream_resume_dir,
             )
             G, b, yty = data.G_tot, data.b_tot, data.yy_tot
         else:
@@ -307,7 +316,8 @@ class NormalEquations(Optimizer):
             sd = GramLeastSquaresGradient._resolve_stats_dtype(
                 Xh.dtype, None)
             G, b, yty = GramLeastSquaresGradient._streamed_totals(
-                Xh, yh, B, sd, chunk)
+                Xh, yh, B, sd, chunk,
+                resume_dir=self.stream_resume_dir)
         w, loss = jax.jit(_solve, static_argnums=(4,))(
             G, b, yty, jnp.asarray(float(n), G.dtype), self.reg_param
         )

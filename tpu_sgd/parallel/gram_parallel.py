@@ -304,7 +304,7 @@ def build_sharded_total_stats(mesh, Xd, yd,
 
 def build_streamed_total_stats(mesh, Xh, yh,
                                block_rows: int = DEFAULT_BLOCK_ROWS,
-                               batch_rows=None):
+                               batch_rows=None, resume_dir=None):
     """Replicated EXACT total statistics of HOST-resident rows — the
     quasi-Newton beyond-HBM build composed with the data mesh.
 
@@ -334,6 +334,8 @@ def build_streamed_total_stats(mesh, Xh, yh,
 
     B, chunk = streamed_totals_chunking(n_local, block_rows, batch_rows)
 
+    import os
+
     devices = list(mesh.devices.reshape(-1))
     totals = []
     for i, dev in enumerate(devices):
@@ -341,8 +343,16 @@ def build_streamed_total_stats(mesh, Xh, yh,
         e = (i + 1) * n_local if i + 1 < k else n  # remainder to the last
         totals.append(GramLeastSquaresGradient._streamed_totals(
             Xh[s:e], yh[s:e], B, sd, chunk, device=dev,
+            resume_dir=(None if resume_dir is None
+                        else os.path.join(resume_dir, f"shard_{i}")),
+            finalize=False,  # a later shard's crash must not force the
+            # completed shards to re-stream — clean up only when ALL done
         ))
     jax.block_until_ready(totals)
+    if resume_dir is not None:
+        import shutil
+
+        shutil.rmtree(resume_dir, ignore_errors=True)
     dev0 = devices[0]
     G, b, yy = totals[0]
     G = jax.device_put(G, dev0)
