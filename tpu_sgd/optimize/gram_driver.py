@@ -20,10 +20,15 @@ many losses as the per-iteration driver would.  Applies to block-aligned
 windows only (virtual statistics, or resident stats in aligned mode)
 with sliced sampling — exactly the regime the headline measures.
 
-Opt-in via ``GradientDescent.set_gram_options(chunk_iters=K)`` until the
-hardware capture (``GRAM_SCAN_EXPERIMENT.json``) settles whether the
-gather wins on the TPU the way it does on CPU (~2.6×); the planner can
-then set ``Plan.chunk_iters`` by default.
+HARDWARE VERDICT (2026-08-01, ``GRAM_SCAN_EXPERIMENT.json``): on the
+TPU v5 lite the gather LOSES — 0.556 ms/iter (trajectory-clean) vs
+0.0259 ms/iter for the per-iteration driver, because ``jnp.take`` of
+K prefix pairs materializes 2·K (d, d) blocks through HBM while the
+per-iteration driver's two dynamic slices stay fused; the bookkeeping
+it amortizes measured only ~0.0036 ms/iter (14%).  The driver stays
+OPT-IN via ``GradientDescent.set_gram_options(chunk_iters=K)`` — it
+still wins ~1.4–2.6× on CPU hosts — and the planner default remains
+the per-iteration contract (see BASELINE.md, round-5 decision).
 """
 
 from __future__ import annotations
