@@ -858,6 +858,52 @@ def matched_loss_speedup(cpu: dict, tpu: dict):
     return cpu_t / tpu_t, detail
 
 
+def keep_conservative_matched(prev: dict, record: dict, result: dict):
+    """Matched-loss clobber protection (BASELINE.md protocol).
+
+    Both walls are environment-sensitive — the CPU side to ambient load
+    (observed 12–39 s across same-day runs), the TPU side to tunnel
+    launch jitter — so "authoritative" means the capture with the LOWER
+    computed speedup: contention on either side can only be corrected
+    downward, never gamed upward.  (This generalizes BASELINE.md's
+    lower-CPU-wall rule, which compared only the dominant noise source;
+    comparing ratios also refuses a prior whose fast TPU wall would
+    INFLATE the headline past the fresh quiet run.)  When the prior
+    persisted capture (same workload, same pre-registered target) has
+    the lower ratio — or the fresh run produced no matched capture at
+    all — the prior one stays in ``record`` and
+    ``result["matched_loss_speedup"]`` is recomputed from it, with the
+    displaced capture kept alongside for transparency.
+    """
+    pm = prev.get("matched")
+    fresh_m = record.get("matched")
+    if not (pm and pm.get("cpu_wall_s") and pm.get("tpu_wall_s")
+            and pm.get("rows") == MATCHED_ROWS
+            and pm.get("target_loss") == TARGET_LOSS):
+        return
+    prior_ratio = pm["cpu_wall_s"] / pm["tpu_wall_s"]
+    fresh_ratio = None
+    if fresh_m and fresh_m.get("cpu_wall_s") and fresh_m.get("tpu_wall_s"):
+        fresh_ratio = fresh_m["cpu_wall_s"] / fresh_m["tpu_wall_s"]
+    if fresh_ratio is not None and prior_ratio >= fresh_ratio:
+        return
+    pm.setdefault("captured_at", prev.get("timestamp"))
+    if fresh_ratio is not None:
+        pm["displaced_contended_capture"] = {
+            "captured_at": record.get("timestamp"),
+            "cpu_wall_s": fresh_m["cpu_wall_s"],
+            "tpu_wall_s": fresh_m["tpu_wall_s"],
+            "note": "higher speedup ratio; discarded per the "
+                    "pre-registered conservative-capture protocol",
+        }
+    record["matched"] = pm
+    result["matched_loss_speedup"] = round(prior_ratio, 2)
+    log("matched-loss: keeping the prior conservative capture "
+        f"({prior_ratio:.1f}x vs fresh "
+        f"{round(fresh_ratio, 1) if fresh_ratio is not None else None}x) "
+        "per the conservative-capture protocol")
+
+
 def _report_persisted():
     """Print the persisted last-known-good TPU result, marked stale."""
     with open(LAST_TPU_PATH) as f:
@@ -956,6 +1002,7 @@ def main():
                 record["pallas"] = prev["pallas"]
                 for c in record["pallas"]:
                     c.setdefault("captured_at", prev.get("timestamp"))
+            keep_conservative_matched(prev, record, result)
         except (OSError, ValueError):
             pass
         if (os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"

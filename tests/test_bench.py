@@ -192,3 +192,83 @@ def test_promote_measured_at_size_idempotent(bench):
     assert result["epochs_per_sec_converted_from_resident"] == 1210.9
     assert result["vs_baseline"] == pytest.approx(
         181092.64 * 4000.0 / 1210.9, rel=1e-3)
+
+def _matched(cpu_wall, tpu_wall=0.06, **over):
+    m = {"target_loss": None, "rows": None, "iters_budget": 25,
+         "cpu_hit_iter": 20, "tpu_hit_iter": 20,
+         "cpu_wall_s": cpu_wall, "tpu_wall_s": tpu_wall}
+    m.update(over)
+    return m
+
+
+def _with_workload(bench, m):
+    m["target_loss"] = bench.TARGET_LOSS
+    m["rows"] = bench.MATCHED_ROWS
+    return m
+
+
+def test_keep_conservative_matched_prior_wins(bench):
+    """A contended fresh capture (higher ratio) must not displace the
+    prior quiet one; the result speedup recomputes from the prior."""
+    prev = {"timestamp": "T0",
+            "matched": _with_workload(bench, _matched(12.0, 0.06))}
+    record = {"timestamp": "T1",
+              "matched": _with_workload(bench, _matched(39.0, 0.065))}
+    result = {"matched_loss_speedup": 600.0}
+    bench.keep_conservative_matched(prev, record, result)
+    assert record["matched"]["cpu_wall_s"] == 12.0
+    assert record["matched"]["captured_at"] == "T0"
+    np.testing.assert_allclose(result["matched_loss_speedup"], 200.0)
+    disp = record["matched"]["displaced_contended_capture"]
+    assert disp["cpu_wall_s"] == 39.0
+    assert disp["captured_at"] == "T1"
+
+
+def test_keep_conservative_matched_fresh_wins(bench):
+    """A quieter fresh capture (lower ratio) IS the conservative one
+    and replaces the prior untouched."""
+    prev = {"timestamp": "T0",
+            "matched": _with_workload(bench, _matched(39.0))}
+    record = {"matched": _with_workload(bench, _matched(12.0))}
+    result = {"matched_loss_speedup": 200.0}
+    bench.keep_conservative_matched(prev, record, result)
+    assert record["matched"]["cpu_wall_s"] == 12.0
+    assert result["matched_loss_speedup"] == 200.0
+    assert "displaced_contended_capture" not in record["matched"]
+
+
+def test_keep_conservative_matched_compares_ratios_not_walls(bench):
+    """A prior with a LOWER CPU wall but a faster TPU wall can carry a
+    HIGHER ratio than the fresh quiet run; conservatism compares the
+    computed speedups, so the fresh (lower-ratio) capture stays."""
+    prev = {"timestamp": "T0",
+            "matched": _with_workload(bench, _matched(12.0, 0.03))}  # 400x
+    record = {"timestamp": "T1",
+              "matched": _with_workload(bench, _matched(13.0, 0.065))}  # 200x
+    result = {"matched_loss_speedup": 200.0}
+    bench.keep_conservative_matched(prev, record, result)
+    assert record["matched"]["cpu_wall_s"] == 13.0
+    assert result["matched_loss_speedup"] == 200.0
+
+
+def test_keep_conservative_matched_no_fresh(bench):
+    """A run whose matched leg produced nothing keeps the prior capture
+    (clobber protection, same as the streamed/gram legs)."""
+    prev = {"timestamp": "T0",
+            "matched": _with_workload(bench, _matched(12.0, 0.06))}
+    record = {"matched": None}
+    result = {}
+    bench.keep_conservative_matched(prev, record, result)
+    assert record["matched"]["cpu_wall_s"] == 12.0
+    np.testing.assert_allclose(result["matched_loss_speedup"], 200.0)
+
+
+def test_keep_conservative_matched_workload_mismatch(bench):
+    """A prior capture from a different workload or target never applies."""
+    prev = {"timestamp": "T0", "matched": _matched(12.0, rows=1234,
+                                                   target_loss=0.5)}
+    record = {"matched": _with_workload(bench, _matched(39.0))}
+    result = {"matched_loss_speedup": 600.0}
+    bench.keep_conservative_matched(prev, record, result)
+    assert record["matched"]["cpu_wall_s"] == 39.0
+    assert result["matched_loss_speedup"] == 600.0
