@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Hardware leg for the host-streamed chunked CostFun (round 5's flagship).
+
+``quasi_newton_tpu_check.py`` proves one small streamed-LBFGS leg on
+hardware; this check exercises the full surface of
+``optimize/streamed_costfun.py`` on the real chip at a scale where the
+chunk grid and double-buffered feed matter:
+
+* logistic LBFGS and hinge OWL-QN over a 200k x 500 slab forced through
+  64 MB chunks (7 chunk programs per full-batch evaluation);
+* a multinomial leg whose backtracking ladder streams MATRIX trial
+  weights through ``sweep_sums``;
+* a same-device resident-vs-streamed agreement gate (the evaluator's
+  core contract: identical sums, different execution), plus the usual
+  cross-backend CPU check within 2%;
+* per-evaluation walls from instrumented ``cost_sums``/``sweep_sums``,
+  reported as an effective host->device feed rate — on this
+  tunnel-attached environment the expected figure is the ~0.07 GB/s
+  tunnel rate (BASELINE.md), NOT device speed; the check is that the
+  chunked evaluator sustains the link's rate rather than degrading it.
+
+True beyond-HBM scale (>16 GB) through a 0.07 GB/s tunnel would cost
+~15 min per evaluation — the correctness-at-reduced-scale approach is
+the same one SPARSE_TPU_CHECK.json uses, and the code path is
+byte-for-byte the one a pod-local host runs at PCIe rates.
+
+The script ends by running ``calibrate_tpu_check.py`` (a ~2 s probe) so
+the planner-calibration capture rides the same watcher slot.
+
+Run when the tunnel is up:  python scripts/streamed_costfun_tpu_check.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "STREAMED_COSTFUN_TPU_CHECK.json")
+
+_CHILD = r"""
+import os, sys, json, time
+if os.environ.get("SC_CHECK_CPU"):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax; jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+import numpy as np, jax.numpy as jnp
+sys.path.insert(0, %(repo)r)
+from tpu_sgd import LBFGS, OWLQN, SquaredL2Updater
+from tpu_sgd.ops.gradients import (HingeGradient, LogisticGradient,
+                                   MultinomialLogisticGradient)
+from tpu_sgd.optimize import streamed_costfun as scf
+
+out = {"platform": jax.devices()[0].platform,
+       "device": str(jax.devices()[0].device_kind), "legs": {}}
+
+# instrument the evaluator: per-call walls for every full-batch pass
+_walls = []
+def _timed(name, orig):
+    def wrap(self, w):
+        t0 = time.perf_counter()
+        r = orig(self, w)
+        jax.block_until_ready(r)
+        _walls.append((name, self.n * self.X.shape[1] * self.X.dtype.itemsize,
+                       time.perf_counter() - t0))
+        return r
+    return wrap
+for _n in ("cost_sums", "loss_sums", "sweep_sums"):
+    setattr(scf.StreamedCostFun, _n,
+            _timed(_n, getattr(scf.StreamedCostFun, _n)))
+
+rng = np.random.default_rng(17)
+n, d = 200_000, 500
+X = rng.normal(size=(n, d)).astype(np.float32)
+wt = rng.uniform(-1, 1, size=(d,)).astype(np.float32)
+y_log = (1 / (1 + np.exp(-X @ wt)) > rng.uniform(size=(n,))).astype(np.float32)
+CHUNK_ROWS = 32_768  # 64 MB chunks -> 7 chunk programs per evaluation
+
+def leg_logistic_lbfgs_streamed():
+    opt = (LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+                 max_num_iterations=6)
+           .set_host_streaming(True, batch_rows=CHUNK_ROWS))
+    w, hist = opt.optimize_with_history((X, y_log), jnp.zeros((d,)))
+    jax.block_until_ready(w)
+    assert opt._stream_costfun_entry is not None, "CostFun did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
+def leg_logistic_lbfgs_resident():
+    opt = LBFGS(LogisticGradient(), SquaredL2Updater(), reg_param=0.01,
+                max_num_iterations=6)
+    w, hist = opt.optimize_with_history((X, y_log), jnp.zeros((d,)))
+    jax.block_until_ready(w)
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
+def leg_hinge_owlqn_streamed():
+    opt = (OWLQN(HingeGradient(), reg_param=1e-4, max_num_iterations=6)
+           .set_host_streaming(True, batch_rows=CHUNK_ROWS))
+    w, hist = opt.optimize_with_history((X, y_log), jnp.zeros((d,)))
+    jax.block_until_ready(w)
+    assert opt._stream_costfun_entry is not None, "CostFun did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
+def leg_multinomial_sweep_streamed():
+    r = np.random.default_rng(23)
+    nm, dm, K = 50_000, 200, 4
+    Xm = r.normal(size=(nm, dm)).astype(np.float32)
+    Wt = r.uniform(-1, 1, size=(K - 1, dm)).astype(np.float32)
+    logits = np.concatenate([np.zeros((nm, 1)), Xm @ Wt.T], axis=1)
+    ym = np.argmax(logits + r.gumbel(size=logits.shape), axis=1)
+    opt = (LBFGS(MultinomialLogisticGradient(K), SquaredL2Updater(),
+                 reg_param=0.01, max_num_iterations=6)
+           .set_host_streaming(True, batch_rows=16_384))
+    w, hist = opt.optimize_with_history(
+        (Xm, ym.astype(np.float32)), jnp.zeros(((K - 1) * dm,)))
+    jax.block_until_ready(w)
+    assert opt._stream_costfun_entry is not None, "CostFun did not engage"
+    return [round(float(x), 6) for x in np.asarray(hist)]
+
+for name, fn in [("logistic_lbfgs_streamed", leg_logistic_lbfgs_streamed),
+                 ("logistic_lbfgs_resident", leg_logistic_lbfgs_resident),
+                 ("hinge_owlqn_streamed", leg_hinge_owlqn_streamed),
+                 ("multinomial_sweep_streamed", leg_multinomial_sweep_streamed)]:
+    _walls.clear()
+    t0 = time.perf_counter()
+    hist = fn()
+    wall = round(time.perf_counter() - t0, 3)
+    evals = [(nm_, b, round(w_, 4)) for nm_, b, w_ in _walls]
+    steady = [w_ for _, _, w_ in _walls[2:]] or [w_ for _, _, w_ in _walls]
+    bytes_per = _walls[0][1] if _walls else 0
+    feed = (bytes_per / (sum(steady) / len(steady)) / 1e9) if steady else None
+    out["legs"][name] = {
+        "final": hist[-1], "history": hist, "wall_s": wall,
+        "n_evaluations": len(evals),
+        "eval_wall_s_steady": round(sum(steady) / len(steady), 4) if steady else None,
+        "effective_feed_gb_s": round(feed, 4) if feed else None,
+    }
+print("SC_JSON:" + json.dumps(out))
+""" % {"repo": REPO}
+
+
+def run_side(env_extra):
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.run([sys.executable, "-c", _CHILD],
+                          capture_output=True, text=True, timeout=1500,
+                          env=env)
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith("SC_JSON:")), None)
+    if line is None:
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-3000:])
+        raise SystemExit("streamed-costfun child produced no record")
+    return json.loads(line[len("SC_JSON:"):])
+
+
+def main():
+    t0 = time.time()
+    print("streamed-CostFun hardware check", file=sys.stderr, flush=True)
+    tpu = run_side({})
+    print(f"tpu side: {tpu['device']} ({tpu['platform']})",
+          file=sys.stderr, flush=True)
+    cpu = run_side({"SC_CHECK_CPU": "1"})
+
+    rec = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "platform": tpu["platform"], "device": tpu["device"],
+           "legs": {}}
+    ok = tpu["platform"] == "tpu"
+    for name, leg in tpu["legs"].items():
+        c = cpu["legs"][name]["final"]
+        t = leg["final"]
+        rel = abs(t - c) / max(abs(c), 1e-12)
+        leg_ok = rel < 0.02
+        ok = ok and leg_ok
+        rec["legs"][name] = dict(leg, cpu_final=c,
+                                 rel_gap=round(rel, 6), ok=leg_ok)
+        print(f"{name}: tpu {t:.6f} vs cpu {c:.6f} -> "
+              f"{'OK' if leg_ok else 'FAIL'}"
+              + (f" (feed {leg['effective_feed_gb_s']} GB/s)"
+                 if leg.get("effective_feed_gb_s") else ""),
+              file=sys.stderr, flush=True)
+
+    # same-device contract: streamed == resident trajectory (both TPU)
+    sv = tpu["legs"]["logistic_lbfgs_streamed"]["final"]
+    rv = tpu["legs"]["logistic_lbfgs_resident"]["final"]
+    same_dev_gap = abs(sv - rv) / max(abs(rv), 1e-12)
+    rec["streamed_vs_resident_same_device_gap"] = round(same_dev_gap, 6)
+    ok = ok and same_dev_gap < 1e-3
+    rec["ok"] = bool(ok)
+    rec["wall_s"] = round(time.time() - t0, 1)
+    rec["note"] = (
+        "correctness + link-rate capture at reduced scale: the chunked "
+        "evaluator's code path is identical at any scale; a true >16 GB "
+        "dataset through this environment's ~0.07 GB/s tunnel would cost "
+        "~15 min per full-batch evaluation, so the feed-rate fields here "
+        "document that the evaluator sustains the link rate (pod-local "
+        "hosts feed 2-3 orders faster, same code)"
+    )
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"all legs agree: {ok}; wrote {OUT}", file=sys.stderr, flush=True)
+
+    # ride the same watcher slot for the ~2 s planner-calibration probe
+    calib = os.path.join(REPO, "scripts", "calibrate_tpu_check.py")
+    try:
+        subprocess.run([sys.executable, calib], timeout=900)
+    except Exception as e:  # the probe is a bonus capture, never a failure
+        print(f"calibration probe skipped ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
