@@ -48,6 +48,8 @@ cm = CostModel.calibrate(dev)
 out["calibrate_s"] = round(time.perf_counter() - t0, 3)
 out["measured"] = {"hbm_gb_s": round(cm.hbm_gb_s, 1),
                    "host_feed_gb_s": round(cm.host_feed_gb_s, 4)}
+out["report"] = {k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in cm.calibration_report.items()}
 out["defaults"] = {"hbm_gb_s": DEFAULT_COST_MODEL.hbm_gb_s,
                    "host_feed_gb_s": DEFAULT_COST_MODEL.host_feed_gb_s}
 
@@ -86,9 +88,15 @@ def main():
     meas, dflt = rec["measured"], rec["defaults"]
     hbm_ratio = meas["hbm_gb_s"] / dflt["hbm_gb_s"]
     plans_agree = all(v["agree"] for v in rec["plans"].values())
+    # calibrate() keeps a default when a probe's measurement is rejected
+    # (non-positive slope / implausible rate) and says so in its
+    # calibration_report — a hardware check whose probe measured nothing
+    # must not report ok.
+    fell_back = rec["report"]["hbm_fell_back"] or rec["report"]["feed_fell_back"]
     rec["ok"] = (rec["platform"] == "tpu"
                  and 0.5 <= hbm_ratio <= 2.0
-                 and plans_agree)
+                 and plans_agree
+                 and not fell_back)
     rec["note"] = (
         "correctness-only: validates that the ~2s probe measures this "
         "chip's effective rates in the persisted defaults' range and "

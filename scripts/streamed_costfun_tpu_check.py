@@ -64,7 +64,12 @@ def _timed(name, orig):
     def wrap(self, w):
         t0 = time.perf_counter()
         r = orig(self, w)
-        jax.block_until_ready(r)
+        # 1-element readbacks, not block_until_ready: the experimental
+        # axon platform has been observed returning from block_until_ready
+        # before the work completes (see CostModel.calibrate), which would
+        # record dispatch rather than evaluation walls here
+        for leaf in jax.tree_util.tree_leaves(r):
+            np.asarray(jnp.ravel(leaf)[:1])
         _walls.append((name, self.n * self.X.shape[1] * self.X.dtype.itemsize,
                        time.perf_counter() - t0))
         return r
@@ -134,7 +139,7 @@ for name, fn in [("logistic_lbfgs_streamed", leg_logistic_lbfgs_streamed),
     feed = (bytes_per / (sum(steady) / len(steady)) / 1e9) if steady else None
     out["legs"][name] = {
         "final": hist[-1], "history": hist, "wall_s": wall,
-        "n_evaluations": len(evals),
+        "n_evaluations": len(evals), "evaluations": evals,
         "eval_wall_s_steady": round(sum(steady) / len(steady), 4) if steady else None,
         "effective_feed_gb_s": round(feed, 4) if feed else None,
     }

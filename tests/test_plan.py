@@ -985,12 +985,26 @@ def test_cost_model_calibrate_probe():
     other constants (plus explicit overrides)."""
     cm = CostModel.calibrate(copy_mb=4, feed_mb=4)
     assert cm.hbm_gb_s > 0 and cm.host_feed_gb_s > 0
+    # A collapsed/elided measurement reads ~700,000 GB/s (the axon
+    # constant-trip-count failure, CALIBRATION_TPU_CHECK round 5); no
+    # real memory system exceeds ~20 TB/s, so a sane probe stays under.
+    assert cm.hbm_gb_s < 20_000
     assert cm.hbm_bytes == CostModel().hbm_bytes  # defaults untouched
     cm2 = CostModel.calibrate(copy_mb=4, feed_mb=4, hbm_safety=0.5)
     assert cm2.hbm_safety == 0.5
     # overrides win over the measured fields too (probe one, pin one)
     cm3 = CostModel.calibrate(copy_mb=4, feed_mb=4, host_feed_gb_s=50.0)
     assert cm3.host_feed_gb_s == 50.0 and cm3.hbm_gb_s > 0
+    # feed_mb so small both probe buffers clamp to the same 1024-element
+    # minimum: zero byte delta must fall back to the default rate, never
+    # 0.0 (plan() divides by host_feed_gb_s)
+    cm4 = CostModel.calibrate(copy_mb=4, feed_mb=0.003)
+    assert cm4.host_feed_gb_s == CostModel().host_feed_gb_s
+    # the report says WHICH probes fell back (hardware checks gate on it)
+    assert cm4.calibration_report["feed_fell_back"] is True
+    assert cm.calibration_report["hbm_fell_back"] is False
+    # report is advisory: excluded from model equality
+    assert CostModel(calibration_report={"x": 1}) == CostModel()
 
 
 def test_fed_cost_model_flips_streaming_boundary():

@@ -107,6 +107,12 @@ class CostModel:
     #: minimum fraction of iterations that must avoid transfer for partial
     #: residency to be chosen over plain streaming
     min_resident_gain: float = 0.05
+    #: set by :meth:`calibrate` — raw probe readings plus which probes
+    #: were rejected and fell back to the persisted defaults; excluded
+    #: from equality/repr (two models with the same rates ARE the same
+    #: model however they were obtained)
+    calibration_report: Optional[dict] = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     @classmethod
     def calibrate(cls, device=None, copy_mb: float = 256.0,
@@ -120,12 +126,27 @@ class CostModel:
         host every streaming decision boundary shifts ~100×, so a
         deployment that cares about the boundaries should probe once:
 
-        * ``hbm_gb_s`` — effective on-device bandwidth: ONE compiled
-          program looping 200 read+write passes over a ``copy_mb``
-          buffer, so the per-program launch tax (observed ~65 ms through
-          a remote tunnel) is amortized out of the measurement;
-        * ``host_feed_gb_s`` — one timed ``device_put`` of a ``feed_mb``
-          host buffer (after a warm-up transfer absorbing allocation).
+        * ``hbm_gb_s`` — effective on-device bandwidth from the SLOPE
+          between two trip counts of one compiled read+write loop over a
+          ``copy_mb`` buffer, so the per-call tax (launch + readback,
+          ~65–130 ms through a remote tunnel) cancels out.  The trip
+          count is a TRACED argument — a constant bound lets XLA unroll
+          and fold the whole loop into one fused pass (measured on the
+          axon tunnel: a constant-200 loop reported ~700,000 GB/s) —
+          and each timing ends with a 1-element device→host readback,
+          which cannot return before the work is done even where
+          ``block_until_ready`` is unreliable (experimental remote
+          platforms).
+        * ``host_feed_gb_s`` — the same two-point slope over two
+          ``device_put`` sizes (``feed_mb`` and a quarter of it), each
+          synced by readback, cancelling the per-transfer round trip.
+
+        Either probe falls back to the persisted default (and keeps the
+        other's measurement) if its slope comes out non-positive or the
+        implied rate lands outside a physical-plausibility window
+        (1–20,000 GB/s for HBM, 0.001–1,000 GB/s for host feed) — a
+        wedged tunnel or an elided program must not poison the cost
+        model with a garbage rate.
         """
         import time
 
@@ -138,29 +159,73 @@ class CostModel:
 
         n_elems = max(1024, int(copy_mb * 1e6 // 4))
         x = jnp.zeros((n_elems,), jnp.float32, device=device)
-        loops = 200
 
         @jax.jit
-        def many_passes(a):
-            return jax.lax.fori_loop(0, loops, lambda i, v: v + 1.0, a)
+        def many_passes(a, n):
+            return jax.lax.fori_loop(0, n, lambda i, v: v + 1.0, a)
 
-        jax.block_until_ready(many_passes(x))  # compile + warm
-        t0 = time.perf_counter()
-        jax.block_until_ready(many_passes(x))
-        dt = time.perf_counter() - t0
-        hbm_gb_s = loops * 2.0 * n_elems * 4.0 / max(dt, 1e-9) / 1e9
+        def timed_passes(loops):
+            t0 = time.perf_counter()
+            r = many_passes(x, jnp.int32(loops))
+            np.asarray(r[:1])  # readback: forces true completion
+            return time.perf_counter() - t0
 
-        h = np.zeros((max(1024, int(feed_mb * 1e6 // 4)),), np.float32)
-        jax.block_until_ready(jax.device_put(h, device))  # warm alloc
-        t0 = time.perf_counter()
-        jax.block_until_ready(jax.device_put(h, device))
-        dt = time.perf_counter() - t0
-        feed_gb_s = h.nbytes / max(dt, 1e-9) / 1e9
+        lo, hi = 50, 200
+        timed_passes(2)  # compile + warm (dynamic bound: one program)
+        dt_lo, dt_hi = timed_passes(lo), timed_passes(hi)
+        slope = dt_hi - dt_lo
+        hbm_raw = ((hi - lo) * 2.0 * n_elems * 4.0 / slope / 1e9
+                   if slope > 1e-5 else 0.0)
+        hbm_fell_back = not (1.0 <= hbm_raw <= 20_000.0)
+        if hbm_fell_back:
+            # Collapsed, elided, or noise-dominated measurement (no real
+            # memory system exceeds ~20 TB/s) — do not trust it.
+            logger.warning(
+                "calibrate: HBM probe rejected (implied %.1f GB/s, slope "
+                "%.2e s); keeping the persisted default %.1f GB/s",
+                hbm_raw, slope, cls.hbm_gb_s)
+        hbm_gb_s = cls.hbm_gb_s if hbm_fell_back else hbm_raw
+        hbm_slope = slope
 
+        n_feed = max(1024, int(feed_mb * 1e6 // 4))
+        h_lo = np.zeros((max(1024, n_feed // 4),), np.float32)
+        h_hi = np.zeros((n_feed,), np.float32)
+
+        def timed_put(h):
+            t0 = time.perf_counter()
+            y = jax.device_put(h, device)
+            np.asarray(y[:1])  # readback: forces arrival
+            return time.perf_counter() - t0
+
+        timed_put(h_lo)  # warm the transfer path + both buffer sizes'
+        timed_put(h_hi)  # device allocations before timing either
+        slope = timed_put(h_hi) - timed_put(h_lo)
+        nbytes_delta = h_hi.nbytes - h_lo.nbytes
+        # Trust the slope only when h_lo escaped its 1024-element clamp
+        # (feed_mb >= ~0.017): a partially-clamped pair leaves a few-KB
+        # byte delta whose jitter-dominated slope can land inside the
+        # plausibility window as a garbage rate.
+        unclamped = n_feed // 4 >= 1024
+        feed_raw = (nbytes_delta / slope / 1e9
+                    if slope > 1e-5 and unclamped else 0.0)
+        feed_fell_back = not (1e-3 <= feed_raw <= 1_000.0)
+        if feed_fell_back:
+            # Clamped buffers, jitter-dominated slope, or an implausible
+            # rate: fall back rather than poison the model.
+            logger.warning(
+                "calibrate: host-feed probe rejected (implied %.4f GB/s, "
+                "slope %.2e s); keeping the persisted default %.3f GB/s",
+                feed_raw, slope, cls.host_feed_gb_s)
+        feed_gb_s = cls.host_feed_gb_s if feed_fell_back else feed_raw
+
+        report = {"hbm_raw_gb_s": hbm_raw, "hbm_slope_s": hbm_slope,
+                  "hbm_fell_back": hbm_fell_back,
+                  "feed_raw_gb_s": feed_raw, "feed_slope_s": slope,
+                  "feed_fell_back": feed_fell_back}
         # explicit overrides win, including over the measured fields
         # (a user may probe one rate while pinning the other)
         return cls(**{"hbm_gb_s": hbm_gb_s, "host_feed_gb_s": feed_gb_s,
-                      **overrides})
+                      "calibration_report": report, **overrides})
 
 
 DEFAULT_COST_MODEL = CostModel()
