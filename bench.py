@@ -904,6 +904,93 @@ def keep_conservative_matched(prev: dict, record: dict, result: dict):
         "per the conservative-capture protocol")
 
 
+def keep_conservative_cpu_baseline(prev, record, result, tpu_eps):
+    """CPU-baseline clobber protection — the ``vs_baseline`` analogue of
+    :func:`keep_conservative_matched`.
+
+    The baseline workload is deterministic (MATCHED_ROWS×DIM, fixed
+    iteration count); across runs only ambient load on the 1-core host
+    moves its wall clock, and load can only SLOW it — deflating the
+    denominator and inflating ``vs_baseline`` (observed: a 2× swing,
+    975k vs 1.92M, between a quiet and a suite-contended run).  So the
+    FASTEST observed CPU rate is authoritative: keep the running best in
+    ``record["cpu_baseline"]`` and recompute ``vs_baseline`` from it,
+    noting a displaced slower fresh reading for transparency."""
+    pb = (prev or {}).get("cpu_baseline")
+    fresh = record.get("cpu_baseline")
+    if not (pb and pb.get("epochs_per_sec") and pb["epochs_per_sec"] > 0
+            and pb.get("rows") == MATCHED_ROWS and pb.get("dim") == DIM):
+        return
+    if fresh and fresh["epochs_per_sec"] >= pb["epochs_per_sec"]:
+        return  # fresh run is the new quietest observation
+    if not tpu_eps:
+        # vs_baseline cannot be recomputed — leave the fresh reading and
+        # its own ratio in place rather than persist a record whose
+        # cpu_baseline and vs_baseline disagree
+        return
+    pb.setdefault("captured_at", prev.get("timestamp"))
+    if fresh:
+        pb["displaced_contended_reading"] = {
+            "epochs_per_sec": fresh["epochs_per_sec"],
+            "captured_at": record.get("timestamp"),
+            "note": "slower CPU rate (ambient load); discarded per the "
+                    "conservative-baseline protocol — a loaded host must "
+                    "not inflate vs_baseline",
+        }
+    record["cpu_baseline"] = pb
+    result["vs_baseline"] = round(tpu_eps / pb["epochs_per_sec"], 2)
+    fresh_txt = (f"{fresh['epochs_per_sec']:.4f}" if fresh else "none")
+    log("cpu baseline: keeping the prior quiet-machine rate "
+        f"({pb['epochs_per_sec']:.4f} vs fresh {fresh_txt} epochs/sec) — "
+        f"vs_baseline recomputed to {result['vs_baseline']}")
+
+
+def enrich_from_prev(prev, record, result, tpu_eps):
+    """Best-effort enrichment of a fresh ``record`` from the prior
+    persisted one: restore expensive captures a run skipped (streamed /
+    chunked / gram / pallas legs) and apply the two conservative keepers.
+
+    Each step is INDEPENDENTLY guarded: a malformed field in one section
+    of a hand-edited ``BENCH_LAST_TPU.json`` must neither disable the
+    remaining steps (e.g. a bad ``matched`` silently turning off the
+    cpu-baseline keeper) nor abort the run before the fresh hardware
+    measurement persists — and leg restores shape-validate BEFORE
+    assigning, so a malformed prior can never leak partially into the
+    record.  Returns the prior streamed capture (or None)."""
+    def best_effort(step):
+        try:
+            step()
+        except (TypeError, KeyError, AttributeError, ValueError):
+            pass
+
+    # the streamed restore is pure dict reads — nothing to guard
+    prev_streamed = None
+    ps = prev.get("streamed")
+    if isinstance(ps, dict) and "error" not in ps:
+        ps.setdefault("captured_at", prev.get("timestamp"))
+        prev_streamed = ps
+
+    def restore_leg(name):
+        # Clobber protection for the chunked/gram/pallas sweeps: a run
+        # that skipped one (BENCH_CHUNKS= empty) must not null out a
+        # prior capture.
+        def step():
+            pl = prev.get(name)
+            if (record.get(name) is None and isinstance(pl, list)
+                    and all(isinstance(c, dict) for c in pl)):
+                record[name] = pl
+                for c in pl:
+                    c.setdefault("captured_at", prev.get("timestamp"))
+        return step
+
+    for leg in ("chunked", "gram", "pallas"):
+        best_effort(restore_leg(leg))
+    best_effort(lambda: keep_conservative_matched(prev, record, result))
+    best_effort(lambda: keep_conservative_cpu_baseline(
+        prev, record, result, tpu_eps))
+    return prev_streamed
+
+
 def _report_persisted():
     """Print the persisted last-known-good TPU result, marked stale."""
     with open(LAST_TPU_PATH) as f:
@@ -958,11 +1045,17 @@ def main():
         # the tunnel may be wedged the next time anything runs — and BEFORE
         # the long streamed run below, so a mid-stream wedge (or the
         # watcher's timeout) cannot discard an already-captured headline.
+        now = time.strftime("%Y-%m-%dT%H:%M:%S")
         record = {
-            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "timestamp": now,
             "result": result,
             "platform": tpu["platform"],
             "matched": matched,
+            "cpu_baseline": {
+                "epochs_per_sec": cpu["epochs_per_sec"],
+                "rows": MATCHED_ROWS, "dim": DIM,
+                "captured_at": now,
+            },
             "steady_state_iter_ms": tpu.get("steady_state_iter_ms"),
             "fixed_launch_ms": tpu.get("fixed_launch_ms"),
             "xla_fit": tpu.get("xla_fit"),
@@ -980,31 +1073,15 @@ def main():
         # prior capture is read unconditionally so that ANY outcome — skip,
         # reuse, or a refresh attempt that dies mid-run — can fall back to
         # it instead of destroying it.
-        prev_streamed = None
         try:
             with open(LAST_TPU_PATH) as f:
                 prev = json.load(f)
-            if prev.get("streamed") and "error" not in prev["streamed"]:
-                prev_streamed = prev["streamed"]
-                prev_streamed.setdefault("captured_at", prev.get("timestamp"))
-            # Same clobber protection for the chunked sweep: a run that
-            # skipped it (BENCH_CHUNKS= empty) must not null out a prior
-            # capture.
-            if record.get("chunked") is None and prev.get("chunked"):
-                record["chunked"] = prev["chunked"]
-                for c in record["chunked"]:
-                    c.setdefault("captured_at", prev.get("timestamp"))
-            if record.get("gram") is None and prev.get("gram"):
-                record["gram"] = prev["gram"]
-                for c in record["gram"]:
-                    c.setdefault("captured_at", prev.get("timestamp"))
-            if record.get("pallas") is None and prev.get("pallas"):
-                record["pallas"] = prev["pallas"]
-                for c in record["pallas"]:
-                    c.setdefault("captured_at", prev.get("timestamp"))
-            keep_conservative_matched(prev, record, result)
+            if not isinstance(prev, dict):
+                prev = {}
         except (OSError, ValueError):
-            pass
+            prev = {}
+        prev_streamed = enrich_from_prev(prev, record, result,
+                                         tpu["epochs_per_sec"])
         if (os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"
                 or os.environ.get("BENCH_STREAMED", "1") == "0"):
             # Not refreshing — or refresh+skip, which is contradictory and
