@@ -1284,3 +1284,35 @@ def test_chunk_iters_listener_warns(rng):
     opt.listener = SGDListener()
     with pytest.warns(RuntimeWarning, match="observed"):
         opt.optimize_with_history((X, y), np.zeros(12, np.float32))
+
+
+def test_chunked_driver_ignores_optimizer_aligned_on_prebuilt_exact(rng):
+    """A prebuilt EXACT (aligned=False) gram gradient runs exact windows
+    per-iteration; ``set_gram_options(aligned=True, chunk_iters=K)`` on
+    the OPTIMIZER configures future auto-builds and must NOT reroute the
+    prebuilt gradient through the aligned chunked driver — that would
+    switch the window math and silently change the trajectory the
+    chunk_iters contract promises to preserve."""
+    X, y = _chunked_setup(rng, n=2048)
+    gram = GramLeastSquaresGradient.build(X, y, block_rows=256)
+
+    def make(chunk):
+        opt = (GradientDescent(gram, SimpleUpdater())
+               .set_step_size(0.3).set_num_iterations(20)
+               .set_mini_batch_fraction(0.1).set_sampling("sliced")
+               .set_seed(7).set_convergence_tol(0.0))
+        opt.set_gram_options(aligned=True,
+                             chunk_iters=chunk if chunk else None)
+        return opt
+
+    opt_c = make(8)
+    w_c, h_c = opt_c.optimize_with_history(
+        (gram.data, y), np.zeros(12, np.float32))
+    assert not any(k[0] == "chunked_gram_run" for k in opt_c._run_cache)
+    opt_0 = make(None)
+    w_0, h_0 = opt_0.optimize_with_history(
+        (gram.data, y), np.zeros(12, np.float32))
+    np.testing.assert_allclose(np.asarray(h_c), np.asarray(h_0),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(w_c), np.asarray(w_0),
+                               rtol=1e-6, atol=1e-7)

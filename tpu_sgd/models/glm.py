@@ -196,20 +196,7 @@ class GeneralizedLinearAlgorithm:
             # (beyond HBM) the streamed-virtual-statistics schedule
             p = plan_quasi_newton(opt, X, y, force=force)
             if p is not None:
-                from tpu_sgd.plan import apply_gram_knobs
-
-                opt.sufficient_stats = p.schedule == "resident_gram"
-                opt.streamed_stats = p.schedule == "streamed_virtual_gram"
-                opt.host_streaming = p.schedule == "host_streamed"
-                if "stream_batch_rows" not in getattr(
-                        opt, "_user_gram_opts", frozenset()):
-                    opt.stream_batch_rows = (
-                        p.batch_rows if p.schedule == "host_streamed"
-                        else None)
-                # direct assignment, user-set knobs preserved (the
-                # setters record user intent — see Plan.apply)
-                apply_gram_knobs(opt, p)
-                opt.last_plan = p
+                p.apply_quasi_newton(opt)
         else:
             p = plan_for(opt, X, y, force=force)
             if p is not None:

@@ -797,8 +797,13 @@ class GradientDescent(Optimizer):
         if (not self.gram_chunk_iters
                 or not isinstance(X, GramData)
                 or not isinstance(self.gradient, GramLeastSquaresGradient)
-                or not (X.X is None or self.gradient.aligned
-                        or self.gram_aligned)
+                # engage ONLY where the per-iteration path itself runs
+                # aligned windows (window_sums' own dispatch): gating on
+                # the optimizer-level gram_aligned knob would switch a
+                # prebuilt non-aligned gradient to aligned math and
+                # silently change the trajectory chunk_iters promises to
+                # preserve
+                or not (X.X is None or self.gradient.aligned)
                 or cfg.sampling != "sliced"
                 or cfg.mini_batch_fraction >= 1.0):
             return None

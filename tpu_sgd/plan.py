@@ -297,6 +297,26 @@ class Plan:
         optimizer.last_plan = self
         return optimizer
 
+    def apply_quasi_newton(self, optimizer):
+        """Configure an ``LBFGS``/``OWLQN`` optimizer per this plan — the
+        quasi-Newton analogue of :meth:`apply`, kept HERE so schedule
+        application has one home and callers (``models/glm.py``) cannot
+        drift from it.  Same contract as :meth:`apply`: direct
+        assignment, user-set knobs win, plan-owned fields always reset."""
+        if self.schedule not in SCHEDULES:
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+        optimizer.sufficient_stats = self.schedule == "resident_gram"
+        optimizer.streamed_stats = self.schedule == "streamed_virtual_gram"
+        optimizer.host_streaming = self.schedule == "host_streamed"
+        if "stream_batch_rows" not in getattr(
+                optimizer, "_user_gram_opts", frozenset()):
+            optimizer.stream_batch_rows = (
+                self.batch_rows if self.schedule == "host_streamed"
+                else None)
+        apply_gram_knobs(optimizer, self)
+        optimizer.last_plan = self
+        return optimizer
+
 
 def apply_gram_knobs(optimizer, p: "Plan") -> None:
     """Write a plan's gram build knobs onto ``optimizer``, preserving any
@@ -304,15 +324,20 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
     (recorded in ``_user_gram_opts``).  Plan-owned fields are always
     reset — a previous dataset's block size or streamed-build chunk cap
     must not leak into this build (the gram identity caches key on them).
-    Shared by :meth:`Plan.apply` (GradientDescent) and the quasi-Newton
-    plan application (``models/glm.py``)."""
+    Shared by :meth:`Plan.apply` (GradientDescent) and
+    :meth:`Plan.apply_quasi_newton` (LBFGS/OWL-QN)."""
     from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
 
     user = getattr(optimizer, "_user_gram_opts", frozenset())
     if "block_rows" not in user:
         optimizer.gram_block_rows = p.block_rows or DEFAULT_BLOCK_ROWS
     if "batch_rows" not in user:
-        optimizer.gram_batch_rows = p.batch_rows or None
+        # A host_streamed plan sizes batch_rows as the STREAM chunk (a
+        # global, mesh-scaled row count owned by stream_batch_rows) —
+        # writing it here would hand a later manual streamed-gram build
+        # an absurd chunk cap sized for the wrong schedule.
+        optimizer.gram_batch_rows = (
+            None if p.schedule == "host_streamed" else p.batch_rows or None)
     if "aligned" not in user and hasattr(optimizer, "gram_aligned"):
         optimizer.gram_aligned = bool(p.aligned)
     if ("chunk_iters" not in user
@@ -586,32 +611,13 @@ def plan(
         )
 
     if force is not None and force != chosen.schedule:
-        if (force in ("resident_gram", "streamed_virtual_gram")
-                and est.get("block_rows") is None):
-            warnings.warn(
-                f"forced {force} has NO feasible block size at this "
-                f"budget ({_fmt_gb(free_hbm)} free vs O(d²) statistics); "
-                "the build will run at the default block size and may "
-                "exhaust device memory",
-                RuntimeWarning, stacklevel=3,
-            )
-        if force.startswith("resident_") and not fits:
-            warnings.warn(
-                f"forced {force} commits the {_fmt_gb(data_bytes_local)} "
-                f"slab to a device with only {_fmt_gb(free_hbm)} in the "
-                "probed budget — it does not fit and will likely exhaust "
-                "device memory",
-                RuntimeWarning, stacklevel=3,
-            )
-        forced = Plan(
-            force,
-            f"forced by caller (planner would pick {chosen.schedule}: "
-            + chosen.reason + ")",
-            block_rows=est.get("block_rows"),
-            batch_rows=est.get("batch_rows"),
+        forced = _forced_plan(
+            force, chosen, est, fits=fits, free_hbm=free_hbm,
+            data_bytes_local=data_bytes_local,
+            per_dev=f"/device × {n_devices}" if n_devices > 1 else "",
+            stacklevel=4,
             aligned=force == "streamed_virtual_gram",
             resident_rows=est.get("resident_rows", 0),
-            estimates=est,
         )
         if force == "partial_residency" and not forced.resident_rows:
             if fits:
@@ -630,6 +636,40 @@ def plan(
             )
         return forced
     return chosen
+
+
+def _forced_plan(force, chosen, est, *, fits, free_hbm, data_bytes_local,
+                 per_dev="", stacklevel=3, **plan_fields):
+    """The forced-schedule contract, shared by :func:`plan` and
+    :func:`plan_quasi_newton`'s ``_force_wrap``: warn when the forced
+    schedule has no feasible statistics block size or exceeds the probed
+    budget, then construct the forced :class:`Plan` recording what the
+    planner would have picked instead."""
+    if (force in ("resident_gram", "streamed_virtual_gram")
+            and est.get("block_rows") is None):
+        warnings.warn(
+            f"forced {force} has NO feasible block size at this "
+            f"budget ({_fmt_gb(free_hbm)} free vs O(d²) statistics); "
+            "the build will run at the default block size and may "
+            "exhaust device memory",
+            RuntimeWarning, stacklevel=stacklevel,
+        )
+    if force.startswith("resident_") and not fits:
+        warnings.warn(
+            f"forced {force} commits {_fmt_gb(data_bytes_local)}"
+            f"{per_dev} to a device with only {_fmt_gb(free_hbm)} in "
+            "the probed budget — it does not fit and will likely "
+            "exhaust device memory",
+            RuntimeWarning, stacklevel=stacklevel,
+        )
+    return Plan(
+        force,
+        f"forced by caller (planner would pick {chosen.schedule}: "
+        + chosen.reason + ")",
+        block_rows=est.get("block_rows"),
+        batch_rows=est.get("batch_rows"),
+        estimates=est, **plan_fields,
+    )
 
 
 #: schedules a quasi-Newton optimizer can be forced onto
@@ -718,29 +758,10 @@ def plan_quasi_newton(optimizer, X, y,
     def _force_wrap(chosen):
         if force is None or force == chosen.schedule:
             return chosen
-        if (force in ("resident_gram", "streamed_virtual_gram")
-                and est.get("block_rows") is None):
-            warnings.warn(
-                f"forced {force} has NO feasible block size at this "
-                f"budget ({_fmt_gb(free_hbm)} free vs O(d²) "
-                "statistics); the build will run at the default "
-                "block size and may exhaust device memory",
-                RuntimeWarning, stacklevel=4,
-            )
-        if force.startswith("resident_") and not fits:
-            warnings.warn(
-                f"forced {force} commits "
-                f"{_fmt_gb(data_bytes_local)}{per_dev} to a device with "
-                f"only {_fmt_gb(free_hbm)} in the probed budget — it "
-                "does not fit and will likely exhaust device memory",
-                RuntimeWarning, stacklevel=4,
-            )
-        return Plan(
-            force,
-            f"forced by caller (planner would pick {chosen.schedule}: "
-            + chosen.reason + ")",
-            block_rows=est.get("block_rows"),
-            batch_rows=est.get("batch_rows"), estimates=est,
+        return _forced_plan(
+            force, chosen, est, fits=fits, free_hbm=free_hbm,
+            data_bytes_local=data_bytes_local, per_dev=per_dev,
+            stacklevel=5,
         )
 
     # ---- non-least-squares losses ---------------------------------------
