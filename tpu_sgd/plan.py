@@ -170,22 +170,28 @@ class CostModel:
             np.asarray(r[:1])  # readback: forces true completion
             return time.perf_counter() - t0
 
+        def accept(raw, slope_s, window, default, label):
+            """ONE rejection policy for both probes: a rate outside its
+            physical-plausibility window (collapsed, elided, clamped, or
+            noise-dominated measurement) falls back to the persisted
+            default with a warning.  Returns ``(rate, fell_back)``."""
+            fell_back = not (window[0] <= raw <= window[1])
+            if fell_back:
+                logger.warning(
+                    "calibrate: %s probe rejected (implied %.6g GB/s, "
+                    "slope %.2e s); keeping the persisted default "
+                    "%.6g GB/s", label, raw, slope_s, default)
+            return (default if fell_back else raw), fell_back
+
         lo, hi = 50, 200
         timed_passes(2)  # compile + warm (dynamic bound: one program)
         dt_lo, dt_hi = timed_passes(lo), timed_passes(hi)
-        slope = dt_hi - dt_lo
-        hbm_raw = ((hi - lo) * 2.0 * n_elems * 4.0 / slope / 1e9
-                   if slope > 1e-5 else 0.0)
-        hbm_fell_back = not (1.0 <= hbm_raw <= 20_000.0)
-        if hbm_fell_back:
-            # Collapsed, elided, or noise-dominated measurement (no real
-            # memory system exceeds ~20 TB/s) — do not trust it.
-            logger.warning(
-                "calibrate: HBM probe rejected (implied %.1f GB/s, slope "
-                "%.2e s); keeping the persisted default %.1f GB/s",
-                hbm_raw, slope, cls.hbm_gb_s)
-        hbm_gb_s = cls.hbm_gb_s if hbm_fell_back else hbm_raw
-        hbm_slope = slope
+        hbm_slope = dt_hi - dt_lo
+        hbm_raw = ((hi - lo) * 2.0 * n_elems * 4.0 / hbm_slope / 1e9
+                   if hbm_slope > 1e-5 else 0.0)
+        # no real memory system exceeds ~20 TB/s
+        hbm_gb_s, hbm_fell_back = accept(
+            hbm_raw, hbm_slope, (1.0, 20_000.0), cls.hbm_gb_s, "HBM")
 
         n_feed = max(1024, int(feed_mb * 1e6 // 4))
         h_lo = np.zeros((max(1024, n_feed // 4),), np.float32)
@@ -208,15 +214,9 @@ class CostModel:
         unclamped = n_feed // 4 >= 1024
         feed_raw = (nbytes_delta / slope / 1e9
                     if slope > 1e-5 and unclamped else 0.0)
-        feed_fell_back = not (1e-3 <= feed_raw <= 1_000.0)
-        if feed_fell_back:
-            # Clamped buffers, jitter-dominated slope, or an implausible
-            # rate: fall back rather than poison the model.
-            logger.warning(
-                "calibrate: host-feed probe rejected (implied %.4f GB/s, "
-                "slope %.2e s); keeping the persisted default %.3f GB/s",
-                feed_raw, slope, cls.host_feed_gb_s)
-        feed_gb_s = cls.host_feed_gb_s if feed_fell_back else feed_raw
+        feed_gb_s, feed_fell_back = accept(
+            feed_raw, slope, (1e-3, 1_000.0), cls.host_feed_gb_s,
+            "host-feed")
 
         report = {"hbm_raw_gb_s": hbm_raw, "hbm_slope_s": hbm_slope,
                   "hbm_fell_back": hbm_fell_back,
