@@ -501,3 +501,53 @@ def test_partial_residency_via_train_api():
     np.testing.assert_allclose(np.asarray(m_res.weights),
                                np.asarray(m_plain.weights),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_stepwise_numerics_reports_true_iteration(rng):
+    """The stepwise (listener) driver checks one loss at a time; the
+    numerics error must name the ACTUAL diverging iteration, not
+    'iteration 1'."""
+    from tpu_sgd.utils.events import SGDListener
+
+    X = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (X @ rng.uniform(-1, 1, 8).astype(np.float32)).astype(np.float32)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_step_size(1e12).set_num_iterations(10)
+           .set_mini_batch_fraction(1.0).set_check_numerics(True)
+           .set_listener(SGDListener()))
+    with pytest.raises(FloatingPointError) as exc:
+        opt.optimize_with_history((X, y), np.zeros(8, np.float32))
+    import re
+
+    reported = int(re.search(r"iteration (\d+)", str(exc.value)).group(1))
+    assert reported > 1  # iteration 1 (w0=0) is always finite here
+
+
+def test_host_streaming_validates_initial_weights(rng):
+    """The host-streaming branch must raise the same clear ValueError
+    as the resident paths on a wrong-length w0 — not an opaque XLA
+    shape error inside the streamed step."""
+    X = rng.normal(size=(128, 8)).astype(np.float32)
+    y = rng.normal(size=(128,)).astype(np.float32)
+    opt = GradientDescent().set_host_streaming(True)
+    with pytest.raises(ValueError, match="initial_weights has length"):
+        opt.optimize_with_history((X, y), np.zeros(5, np.float32))
+
+
+def test_chunk_iters_warning_on_meshed_streamed_stats(rng):
+    """The meshed streamed-stats route returns before the resident
+    router; the dropped-chunk_iters warning must still fire there."""
+    import warnings as _w
+
+    from tpu_sgd import data_mesh
+
+    X = rng.normal(size=(1024, 8)).astype(np.float32)
+    y = (X @ rng.uniform(-1, 1, 8).astype(np.float32)).astype(np.float32)
+    opt = (GradientDescent(LeastSquaresGradient(), SimpleUpdater())
+           .set_num_iterations(3).set_mesh(data_mesh())
+           .set_streamed_stats(True, block_rows=64)
+           .set_gram_options(chunk_iters=4))
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        opt.optimize_with_history((X, y), np.zeros(8, np.float32))
+    assert any("chunk_iters applies" in str(r.message) for r in rec)

@@ -237,3 +237,49 @@ def test_owlqn_meshed_sufficient_stats_matches_stock():
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(w_mesh), np.asarray(w_stock),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_repeat_optimize_reuses_compiled_evaluators(rng):
+    """Repeated optimize() calls (the streaming mode's per-micro-batch
+    re-entry) must reuse the jitted cost/sweep programs, not retrace and
+    recompile them — the evaluator cache keys on everything the closures
+    bake in, so the second call adds NO new entries."""
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    y = (X @ rng.uniform(-1, 1, 8).astype(np.float32)).astype(np.float32)
+    w0 = np.zeros(8, np.float32)
+    opt = LBFGS(LeastSquaresGradient(), SquaredL2Updater(),
+                reg_param=0.01, max_num_iterations=3)
+    w1, _ = opt.optimize_with_history((X, y), w0)
+    entries = dict(opt._eval_cache)
+    w2, _ = opt.optimize_with_history((X, y), w0)
+    assert dict(opt._eval_cache) == entries  # same objects, no rebuild
+    assert all(opt._eval_cache[k] is entries[k] for k in entries)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(w1))
+    # release clears the cache (entries close over dropped gradients)
+    opt.release_sufficient_stats()
+    assert opt._eval_cache == {}
+
+
+def test_dataset_sweep_evicts_displaced_gram_evaluators(rng):
+    """Switching datasets replaces the single-slot gram bundle; the
+    evaluator cache must drop entries closing over the DISPLACED gram
+    gradient, or a hyperparameter sweep pins every prior dataset's rows
+    and prefix stacks in device memory."""
+    def data(seed, n=512):
+        r = np.random.default_rng(seed)
+        X = r.normal(size=(n, 8)).astype(np.float32)
+        return X, (X @ r.uniform(-1, 1, 8).astype(np.float32)).astype(
+            np.float32)
+
+    opt = LBFGS(LeastSquaresGradient(), SquaredL2Updater(),
+                reg_param=0.01, max_num_iterations=3) \
+        .set_sufficient_stats(True)
+    w0 = np.zeros(8, np.float32)
+    Xa, ya = data(1)
+    opt.optimize_with_history((Xa, ya), w0)
+    grad_a = opt._gram_entry[2]
+    assert any(grad_a in k for k in opt._eval_cache)
+    Xb, yb = data(2)
+    opt.optimize_with_history((Xb, yb), w0)
+    assert opt._gram_entry[2] is not grad_a
+    assert not any(grad_a in k for k in opt._eval_cache)  # evicted

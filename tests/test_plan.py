@@ -1049,3 +1049,44 @@ def test_host_streamed_plan_does_not_leak_stream_chunk_into_gram_knob():
     assert opt.streamed_stats and not opt.host_streaming
     assert opt.stream_batch_rows is None
     assert opt.gram_batch_rows == 4096
+
+
+def test_manual_schedule_after_plan_resets_plan_owned_knobs():
+    """A manual schedule setter taking the wheel after an auto-planned
+    run must reset the plan's SIZING knobs too: a block size / chunk cap
+    sized for the planned dataset leaking into a manual build on a
+    different dataset is the same class as the host_streamed batch_rows
+    leak (round-5 fix), via the manual-after-plan path."""
+    from tpu_sgd import GradientDescent
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
+
+    opt = GradientDescent()
+    p = Plan("streamed_virtual_gram", "test", block_rows=512,
+             batch_rows=4096, aligned=True)
+    p.apply(opt)
+    assert opt.gram_block_rows == 512 and opt.gram_batch_rows == 4096
+    opt.set_streamed_stats(True)  # user takes the wheel, new dataset
+    assert opt.gram_block_rows == DEFAULT_BLOCK_ROWS
+    assert opt.gram_batch_rows is None
+    assert opt.gram_aligned is False and opt.gram_chunk_iters is None
+    # ...but a USER-set knob survives the reset
+    opt2 = GradientDescent().set_gram_options(block_rows=128)
+    Plan("streamed_virtual_gram", "t", block_rows=512,
+         batch_rows=4096).apply(opt2)
+    assert opt2.gram_block_rows == 128  # user knob held through the plan
+    opt2.set_sufficient_stats(True)
+    assert opt2.gram_block_rows == 128  # and through the manual reset
+    assert opt2.gram_batch_rows is None
+
+
+def test_set_gram_options_validates_before_applying():
+    """A bad LATER knob must not leave earlier knobs half-applied (and
+    unrecorded in _user_gram_opts)."""
+    from tpu_sgd import GradientDescent, LBFGS
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
+
+    for opt in (GradientDescent(), LBFGS()):
+        with pytest.raises(ValueError, match="batch_rows must be positive"):
+            opt.set_gram_options(block_rows=4096, batch_rows=0)
+        assert opt.gram_block_rows == DEFAULT_BLOCK_ROWS
+        assert "block_rows" not in opt._user_gram_opts

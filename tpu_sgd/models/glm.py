@@ -212,11 +212,7 @@ class GeneralizedLinearAlgorithm:
             # BCOO input) — reset to stock via the optimizers' own
             # clearing hook (one flag list, not three hand-rolled
             # copies).
-            opt._clear_planned_schedule()
-            if (hasattr(opt, "stream_batch_rows")
-                    and "stream_batch_rows" not in getattr(
-                        opt, "_user_gram_opts", frozenset())):
-                opt.stream_batch_rows = None
+            opt._clear_planned_schedule()  # flags AND plan-owned knobs
             opt.last_plan = None
             opt._plan_key = None
         if p is None and force is not None:

@@ -45,28 +45,56 @@ from tpu_sgd.optimize.optimizer import Dataset, Optimizer
 Array = jax.Array
 
 
-def _raise_if_nonfinite(losses) -> None:
+def _raise_if_nonfinite(losses, first_iteration: int = 1) -> None:
     """Shared numerics check (``set_check_numerics``), one message for all
-    optimizer paths."""
+    optimizer paths.  ``first_iteration`` is the 1-based iteration number
+    of ``losses[0]`` — the stepwise driver checks one loss at a time and
+    must report the TRUE diverging iteration, not 'iteration 1'."""
     import numpy as np
 
     arr = np.asarray(losses)
     bad = np.nonzero(~np.isfinite(arr))[0]
     if bad.size:
         raise FloatingPointError(
-            f"non-finite loss at iteration {int(bad[0]) + 1} "
+            f"non-finite loss at iteration {int(bad[0]) + first_iteration} "
             f"(loss={arr[bad[0]]}); reduce step_size or check the data"
         )
+
+
+def _coerce_w0(gradient, initial_weights, n_features):
+    """ONE coerce-and-validate for initial weights, shared by every
+    driver branch (resident / host-streamed / GramData / meshed
+    streamed-gram): float32 master weights (mixed-precision mode: bf16
+    data halves HBM traffic, f32 weights keep convergence) and the
+    clear length error instead of an opaque XLA shape failure."""
+    w0 = jnp.asarray(initial_weights)
+    if not jnp.issubdtype(w0.dtype, jnp.inexact):
+        w0 = w0.astype(jnp.float32)
+    expect_dim = gradient.weight_dim(n_features)
+    if w0.shape[-1] != expect_dim:
+        raise ValueError(
+            f"initial_weights has length {w0.shape[-1]} but this "
+            f"gradient needs {expect_dim} for {n_features}-feature data"
+        )
+    return w0
+
+
+def _sample_key(key, i, axis_name):
+    """THE per-iteration (and per-shard, like Spark's per-partition
+    sampler) sample-key recipe, deterministic in (seed, iteration, shard
+    index).  One definition shared by the Bernoulli mask and the
+    indexed/sliced streams so an edit to the fold order cannot silently
+    desync them."""
+    k = jax.random.fold_in(key, i)
+    if axis_name is not None:
+        k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+    return k
 
 
 def _make_mask(cfg: SGDConfig, key, i, n_local, valid, axis_name):
     """Per-iteration Bernoulli mini-batch mask (None = take everything)."""
     if cfg.mini_batch_fraction < 1.0:
-        k = jax.random.fold_in(key, i)
-        if axis_name is not None:
-            # Independent sample stream per shard, like Spark's per-partition
-            # sampler; deterministic in (seed, iteration, shard index).
-            k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+        k = _sample_key(key, i, axis_name)
         mask = jax.random.bernoulli(k, cfg.mini_batch_fraction, (n_local,))
         return mask if valid is None else mask & valid
     return valid
@@ -99,18 +127,10 @@ def make_step(
     indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
     sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
 
-    def _iter_key(i):
-        """Per-iteration (and per-shard, like Spark's per-partition sampler)
-        sample key, deterministic in (seed, iteration, shard index)."""
-        k = jax.random.fold_in(key, i)
-        if axis_name is not None:
-            k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
-        return k
-
     def step(weights, X, y, i, reg_val, valid=None):
         if sliced or indexed:
             m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
-            k = _iter_key(i)
+            k = _sample_key(key, i, axis_name)
         if sliced:
             # HBM-optimal path: a contiguous row window at a random offset —
             # one sequential DMA (zero-copy under PallasGradient) instead of
@@ -369,6 +389,12 @@ class GradientDescent(Optimizer):
             self.streaming_resident_rows = 0
             self.sufficient_stats = False
             self.streamed_stats = False
+            # ...and the plan's SIZING knobs: a block size / chunk cap
+            # sized for the planned dataset must not leak into a manual
+            # schedule on a different one (user-set knobs survive)
+            from tpu_sgd.plan import reset_plan_owned_gram_knobs
+
+            reset_plan_owned_gram_knobs(self)
 
     def _mark_manual_schedule(self):
         """A user-called schedule setter invalidates any auto-plan: the
@@ -421,36 +447,37 @@ class GradientDescent(Optimizer):
         says so when both are set).
         The execution planner (``tpu_sgd/plan.py``) sets ``block_rows``/
         ``batch_rows`` automatically; ``aligned`` stays opt-in."""
-        provided = set()
+        # validate EVERY argument before applying ANY: a bad later knob
+        # must not leave the optimizer half-configured (earlier knobs
+        # mutated but unrecorded in _user_gram_opts / plan cache intact)
+        provided = {}
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
                     f"block_rows must be positive, got {block_rows}"
                 )
-            self.gram_block_rows = int(block_rows)
-            provided.add("block_rows")
+            provided["block_rows"] = ("gram_block_rows", int(block_rows))
         if aligned is not None:
-            self.gram_aligned = bool(aligned)
-            provided.add("aligned")
+            provided["aligned"] = ("gram_aligned", bool(aligned))
         if batch_rows is not None:
             if int(batch_rows) < 1:
                 raise ValueError(
                     f"batch_rows must be positive, got {batch_rows}"
                 )
-            self.gram_batch_rows = int(batch_rows)
-            provided.add("batch_rows")
+            provided["batch_rows"] = ("gram_batch_rows", int(batch_rows))
         if chunk_iters is not None:
             if int(chunk_iters) < 1:
                 raise ValueError(
                     f"chunk_iters must be positive, got {chunk_iters}"
                 )
-            self.gram_chunk_iters = int(chunk_iters)
-            provided.add("chunk_iters")
+            provided["chunk_iters"] = ("gram_chunk_iters", int(chunk_iters))
+        for attr, val in provided.values():
+            setattr(self, attr, val)
         # user-set knobs survive auto-planning (Plan.apply skips them).
         # Only the plan CACHE key is cleared — not last_plan: knobs are
         # not a schedule choice, so re-planning must still run (the
         # manual gate in glm._auto_plan keys on last_plan is None).
-        self._user_gram_opts = self._user_gram_opts | provided
+        self._user_gram_opts = self._user_gram_opts | set(provided)
         self._plan_key = None
         return self
 
@@ -551,16 +578,7 @@ class GradientDescent(Optimizer):
             y = jnp.asarray(y)
             if not jnp.issubdtype(y.dtype, jnp.inexact):
                 y = y.astype(jnp.float32)
-            w0 = jnp.asarray(initial_weights)
-            if not jnp.issubdtype(w0.dtype, jnp.inexact):
-                w0 = w0.astype(jnp.float32)
-            expect_dim = self.gradient.weight_dim(X.shape[1])
-            if w0.shape[-1] != expect_dim:
-                raise ValueError(
-                    f"initial_weights has length {w0.shape[-1]} but this "
-                    f"gradient needs {expect_dim} for {X.shape[1]}-feature "
-                    "data"
-                )
+            w0 = _coerce_w0(self.gradient, initial_weights, X.shape[1])
             return self._optimize_routed(X, y, w0, sparse_X=False)
         sparse_X = is_sparse(X)
         if sparse_X:
@@ -594,6 +612,10 @@ class GradientDescent(Optimizer):
             # device re-enters through the GramData branch above.
             self._check_streamed_stats_applies(sparse_X)
             if self.mesh is not None:
+                # this route returns before _optimize_routed's warning
+                # would fire — the user's explicit chunk_iters request is
+                # being dropped and must not go silent
+                self._warn_chunk_iters_with_mesh(stacklevel=3)
                 return self._optimize_streamed_stats_mesh(
                     X, y, initial_weights
                 )
@@ -617,12 +639,16 @@ class GradientDescent(Optimizer):
                     "('model') sharding needs the resident path"
                 )
             Xh = np.asarray(X)
+            # same weight validation/coercion as the resident paths — a
+            # wrong-length w0 must raise the clear ValueError here, not
+            # an opaque XLA dot-shape error inside the streamed step
+            w0 = _coerce_w0(self.gradient, initial_weights, Xh.shape[1])
             if Xh.shape[0] == 0:
                 self._loss_history = np.zeros((0,), np.float32)
-                return jnp.asarray(initial_weights), self._loss_history
+                return w0, self._loss_history
             w, hist = optimize_host_streamed(
                 self.gradient, self.updater, self.config, Xh, np.asarray(y),
-                initial_weights, mesh=self.mesh, listener=self.listener,
+                w0, mesh=self.mesh, listener=self.listener,
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every,
                 resident_rows=self.streaming_resident_rows,
@@ -638,17 +664,7 @@ class GradientDescent(Optimizer):
         y = jnp.asarray(y)
         if not jnp.issubdtype(y.dtype, jnp.inexact):
             y = y.astype(jnp.float32)
-        # Weights stay float32 even when X is bf16 (mixed-precision mode:
-        # bf16 data halves HBM traffic, f32 master weights keep convergence).
-        w0 = jnp.asarray(initial_weights)
-        if not jnp.issubdtype(w0.dtype, jnp.inexact):
-            w0 = w0.astype(jnp.float32)
-        expect_dim = self.gradient.weight_dim(X.shape[1])
-        if w0.shape[-1] != expect_dim:
-            raise ValueError(
-                f"initial_weights has length {w0.shape[-1]} but this gradient "
-                f"needs {expect_dim} for {X.shape[1]}-feature data"
-            )
+        w0 = _coerce_w0(self.gradient, initial_weights, X.shape[1])
         n = X.shape[0]
         if n == 0:
             self._loss_history = np.zeros((0,), np.float32)
@@ -676,16 +692,7 @@ class GradientDescent(Optimizer):
         substitution."""
         import numpy as np
 
-        if self.gram_chunk_iters and self.mesh is not None:
-            import warnings
-
-            warnings.warn(
-                "chunk_iters applies to the single-device aligned-gram "
-                "driver only; the meshed gram runners keep the "
-                "per-iteration driver (drop set_mesh to use the chunked "
-                "driver)",
-                RuntimeWarning, stacklevel=3,
-            )
+        self._warn_chunk_iters_with_mesh(stacklevel=4)
 
         if self.listener is not None or self.checkpoint_manager is not None:
             if self.gram_chunk_iters:
@@ -784,6 +791,21 @@ class GradientDescent(Optimizer):
         if self.check_numerics:
             _raise_if_nonfinite(self._loss_history)
         return w, self._loss_history
+
+    def _warn_chunk_iters_with_mesh(self, stacklevel: int = 3) -> None:
+        """One warning for every route that drops an explicit
+        ``chunk_iters`` because a mesh is set — the meshed gram runners
+        keep the per-iteration driver."""
+        if self.gram_chunk_iters and self.mesh is not None:
+            import warnings
+
+            warnings.warn(
+                "chunk_iters applies to the single-device aligned-gram "
+                "driver only; the meshed gram runners keep the "
+                "per-iteration driver (drop set_mesh to use the chunked "
+                "driver)",
+                RuntimeWarning, stacklevel=stacklevel,
+            )
 
     def _maybe_chunked_gram_run(self, X):
         """The chunked-gather driver (``optimize/gram_driver.py``) when
@@ -912,14 +934,7 @@ class GradientDescent(Optimizer):
             self._streamed_gram_dp_entry = (
                 X, y, self.mesh, (stats, B, n_used, yd), opts,
             )
-        w0 = jnp.asarray(initial_weights)
-        if not jnp.issubdtype(w0.dtype, jnp.inexact):
-            w0 = w0.astype(jnp.float32)
-        if w0.shape[-1] != d:
-            raise ValueError(
-                f"initial_weights has length {w0.shape[-1]} but this "
-                f"gradient needs {d} for {d}-feature data"
-            )
+        w0 = _coerce_w0(self.gradient, initial_weights, d)
         dtype_name = str(np.dtype(Xh.dtype)
                          if np.issubdtype(Xh.dtype, np.inexact)
                          else np.dtype(np.float32))
@@ -1112,7 +1127,7 @@ class GradientDescent(Optimizer):
             if c > 0:
                 loss_f = float(loss_i)
                 if self.check_numerics and not np.isfinite(loss_f):
-                    _raise_if_nonfinite([loss_f])
+                    _raise_if_nonfinite([loss_f], first_iteration=i)
                 losses.append(loss_f)
                 delta = float(jnp.linalg.norm(new_w - w))
                 reg_val = float(new_reg)

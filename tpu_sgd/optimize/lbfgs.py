@@ -349,10 +349,16 @@ class LBFGS(Optimizer):
         self._gram_entry = None
         self._streamed_gram_entry = None
         self._stream_costfun_entry = None
+        self._eval_cache = {}
         self._loss_history = None
 
     # fluent setters, reference parity
     def set_gradient(self, g):
+        if g is not self.gradient:
+            # a swapped-out gradient (e.g. a user-built gram bundle in a
+            # dataset sweep) must not stay pinned through cached
+            # evaluators keyed on it
+            self._evict_eval_entries(self.gradient)
         self.gradient = g
         return self
 
@@ -386,6 +392,13 @@ class LBFGS(Optimizer):
             self.host_streaming = False
             self.sufficient_stats = False
             self.streamed_stats = False
+            # ...and the plan's sizing knobs (see GradientDescent's
+            # _clear_planned_schedule): a manual schedule on a new
+            # dataset must not inherit the planned dataset's block size
+            # or chunk caps
+            from tpu_sgd.plan import reset_plan_owned_gram_knobs
+
+            reset_plan_owned_gram_knobs(self)
 
     def set_sufficient_stats(self, flag: bool = True):
         """Run the least-squares CostFun and line-search sweep from
@@ -415,7 +428,37 @@ class LBFGS(Optimizer):
         self._gram_entry = None
         self._streamed_gram_entry = None
         self._stream_costfun_entry = None
+        self._eval_cache = {}  # entries close over the dropped gradients
         return self
+
+    def _evict_eval_entries(self, gradient) -> None:
+        """Drop cached evaluators that close over ``gradient``.  Called
+        when a gram identity-cache slot is REPLACED (new dataset): the
+        old single-slot behavior freed the prior GramData automatically,
+        and the evaluator cache must not keep the displaced gradient —
+        and its rows + GB-scale prefix stacks — pinned in HBM across a
+        dataset sweep."""
+        if gradient is None:
+            return
+        for k in [k for k in self._eval_cache if gradient in k]:
+            del self._eval_cache[k]
+
+    def _cached_eval(self, key, builder):
+        """Instance-level evaluator cache.  The cost/sweep/loss builders
+        create FRESH ``jax.jit`` wrappers, so without this every
+        ``optimize()`` call retraced and recompiled the full-batch
+        programs — seconds of compile per call on the streaming mode's
+        repeated re-entries, where ``GradientDescent``'s cached runner
+        pays it once.  ``key`` must capture everything the built closure
+        BAKES IN (gradient/updater identity, reg params, mesh, masking,
+        sparse shape — and for OWL-QN the reg vector's shape/dtype and
+        intercept exemption); jit itself handles new data shapes within
+        a cached wrapper."""
+        fn = self._eval_cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._eval_cache[key] = fn
+        return fn
 
     def set_gram_options(self, block_rows: int = None,
                          batch_rows: int = None):
@@ -423,26 +466,29 @@ class LBFGS(Optimizer):
         planner): ``block_rows`` sizes the prefix stack (memory vs edge
         traffic — see ``ops/gram.py``); ``batch_rows`` caps the streamed
         build's host→device chunk, co-resident with the stack."""
-        provided = set()
+        # validate EVERY argument before applying ANY (see the
+        # GradientDescent setter: a bad later knob must not leave the
+        # optimizer half-configured)
+        provided = {}
         if block_rows is not None:
             if int(block_rows) < 1:
                 raise ValueError(
                     f"block_rows must be positive, got {block_rows}"
                 )
-            self.gram_block_rows = int(block_rows)
-            provided.add("block_rows")
+            provided["block_rows"] = ("gram_block_rows", int(block_rows))
         if batch_rows is not None:
             if int(batch_rows) < 1:
                 raise ValueError(
                     f"batch_rows must be positive, got {batch_rows}"
                 )
-            self.gram_batch_rows = int(batch_rows)
-            provided.add("batch_rows")
+            provided["batch_rows"] = ("gram_batch_rows", int(batch_rows))
+        for attr, val in provided.values():
+            setattr(self, attr, val)
         # user-set knobs survive auto-planning (glm._auto_plan skips
         # them).  Only the plan CACHE key is cleared — not last_plan:
         # knobs are not a schedule choice, so re-planning must still run
         # (the manual gate in glm._auto_plan keys on last_plan is None).
-        self._user_gram_opts = self._user_gram_opts | provided
+        self._user_gram_opts = self._user_gram_opts | set(provided)
         self._plan_key = None
         return self
 
@@ -593,6 +639,10 @@ class LBFGS(Optimizer):
                 block_rows=self.gram_block_rows,
                 batch_rows=self.gram_batch_rows,
             )
+        if self._streamed_gram_entry is not None:
+            # new dataset displaces the old bundle: drop evaluators
+            # that would pin its statistics in HBM
+            self._evict_eval_entries(self._streamed_gram_entry[2])
         self._streamed_gram_entry = (X, y, g, opts)
         return g
 
@@ -648,6 +698,8 @@ class LBFGS(Optimizer):
             g = GramLeastSquaresGradient.build(
                 X, y, block_rows=self.gram_block_rows)
             data = g.data
+        if self._gram_entry is not None:
+            self._evict_eval_entries(self._gram_entry[2])
         self._gram_entry = (X, y, g, self.gram_block_rows, self.mesh)
         return g, data
 
@@ -716,17 +768,24 @@ class LBFGS(Optimizer):
             w = w.astype(jnp.float32)
         reg_value, reg_grad = _reg_terms(self.updater, self.reg_param)
 
-        @jax.jit
-        def _finish_cost(gs, ls, c, wv):
-            return ls / c + reg_value(wv), gs / c + reg_grad(wv)
+        def _build_finishes():
+            @jax.jit
+            def _finish_cost(gs, ls, c, wv):
+                return ls / c + reg_value(wv), gs / c + reg_grad(wv)
 
-        @jax.jit
-        def _finish_sweep(ls, c, W):
-            return ls / c + jax.vmap(reg_value)(W)
+            @jax.jit
+            def _finish_sweep(ls, c, W):
+                return ls / c + jax.vmap(reg_value)(W)
 
-        @jax.jit
-        def _finish_loss(ls, c, wv):
-            return ls / c + reg_value(wv)
+            @jax.jit
+            def _finish_loss(ls, c, wv):
+                return ls / c + reg_value(wv)
+
+            return _finish_cost, _finish_sweep, _finish_loss
+
+        _finish_cost, _finish_sweep, _finish_loss = self._cached_eval(
+            ("stream_finish", self.updater, float(self.reg_param)),
+            _build_finishes)
 
         def cost1(wv):
             return _finish_cost(*scf.cost_sums(wv), wv)
@@ -774,6 +833,12 @@ class LBFGS(Optimizer):
             # iteration loop runs unmeshed from exact totals (user-passed
             # GramData with a mesh still raises in _shard_for_mesh)
             mesh = None
+            if not isinstance(y, jnp.ndarray):
+                # the statistics carry Xᵀy / yᵀy — the gram cost never
+                # reads y, but the host numpy array defer_commit left
+                # here would re-upload host→device on EVERY evaluation
+                # (~3/iteration); swap in an empty device vector
+                y = jnp.zeros((0,), jnp.float32)
         valid = None
         sparse_shape = None
         if mesh is not None:
@@ -781,15 +846,21 @@ class LBFGS(Optimizer):
         with_valid = valid is not None
         data_args = (X, y, valid) if with_valid else (X, y)
 
-        cost = _build_cost(gradient, reg_value, reg_grad, mesh, with_valid,
-                           sparse_shape)
+        eval_key = (gradient, self.updater, float(self.reg_param),
+                    mesh, with_valid, sparse_shape)
+        cost = self._cached_eval(
+            ("cost",) + eval_key,
+            lambda: _build_cost(gradient, reg_value, reg_grad, mesh,
+                                with_valid, sparse_shape))
 
         def cost1(wv):
             return cost(wv, *data_args)
 
         if hasattr(gradient, "loss_sweep"):
-            sweep = _build_loss_sweep(gradient, reg_value, mesh, with_valid,
-                                      sparse_shape)
+            sweep = self._cached_eval(
+                ("sweep",) + eval_key,
+                lambda: _build_loss_sweep(gradient, reg_value, mesh,
+                                          with_valid, sparse_shape))
 
             def sweep1(W):
                 return sweep(W, *data_args)
@@ -797,9 +868,10 @@ class LBFGS(Optimizer):
             return self._qn_loop(w, cost1, sweep1, None)
         # exotic gradients without a sweep rule: sequential trials
         _warn_sequential_line_search(gradient, self._LS_TRIALS)
-        loss_only = _build_loss_only(
-            gradient, reg_value, mesh, with_valid, sparse_shape
-        )
+        loss_only = self._cached_eval(
+            ("loss",) + eval_key,
+            lambda: _build_loss_only(gradient, reg_value, mesh,
+                                     with_valid, sparse_shape))
 
         def loss1(wv):
             return loss_only(wv, *data_args)

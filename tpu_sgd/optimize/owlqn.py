@@ -147,17 +147,27 @@ class OWLQN(LBFGS):
         reg = self._reg_vector(w)
         l1_value = lambda wv: jnp.sum(reg * jnp.abs(wv))
 
-        @jax.jit
-        def _finish_smooth(gs, ls, c):
-            return ls / c, gs / c
+        def _build_finishes():
+            @jax.jit
+            def _finish_smooth(gs, ls, c):
+                return ls / c, gs / c
 
-        @jax.jit
-        def _finish_sweep(ls, c, W):
-            return ls / c + jax.vmap(l1_value)(W)
+            @jax.jit
+            def _finish_sweep(ls, c, W):
+                return ls / c + jax.vmap(l1_value)(W)
 
-        @jax.jit
-        def _finish_loss(ls, c, wv):
-            return ls / c + l1_value(wv)
+            @jax.jit
+            def _finish_loss(ls, c, wv):
+                return ls / c + l1_value(wv)
+
+            return _finish_smooth, _finish_sweep, _finish_loss
+
+        # the l1 closure bakes reg as a jit constant — key on its inputs
+        _finish_smooth, _finish_sweep, _finish_loss = self._cached_eval(
+            ("owlqn_stream_finish", float(self.reg_param),
+             bool(self.penalize_intercept),
+             tuple(reg.shape), str(reg.dtype)),
+            _build_finishes)
 
         def smooth_cost1(wv):
             return _finish_smooth(*scf.cost_sums(wv))
@@ -204,6 +214,10 @@ class OWLQN(LBFGS):
             # internally substituted statistics are replicated: run
             # unmeshed from exact totals (see LBFGS.optimize_with_history)
             mesh = None
+            if not isinstance(y, jnp.ndarray):
+                # the statistics carry Xᵀy / yᵀy — y is never read; do
+                # not re-upload the host array per evaluation
+                y = jnp.zeros((0,), jnp.float32)
         valid = None
         sparse_shape = None
         if mesh is not None:
@@ -214,17 +228,28 @@ class OWLQN(LBFGS):
         l1_value = lambda wv: jnp.sum(reg * jnp.abs(wv))
         zero = lambda wv: jnp.zeros((), wv.dtype)
         zero_grad = jnp.zeros_like
+        # cache keys: the l1 closures BAKE the reg vector as a jit
+        # constant, so anything that changes its contents (reg_param,
+        # intercept exemption, weight shape/dtype) must key the entry
+        base_key = (gradient, mesh, with_valid, sparse_shape)
+        l1_key = base_key + (float(self.reg_param),
+                             bool(self.penalize_intercept),
+                             tuple(reg.shape), str(reg.dtype))
         # smooth cost (mesh-aware psum inside); the L1 part is added where
         # the algorithm needs the FULL objective
-        _smooth = _build_cost(gradient, zero, zero_grad, mesh, with_valid,
-                              sparse_shape)
+        _smooth = self._cached_eval(
+            ("owlqn_smooth",) + base_key,
+            lambda: _build_cost(gradient, zero, zero_grad, mesh,
+                                with_valid, sparse_shape))
 
         def smooth_cost1(wv):
             return _smooth(wv, *data_args)
 
         if hasattr(gradient, "loss_sweep"):
-            sweep = _build_loss_sweep(gradient, l1_value, mesh, with_valid,
-                                      sparse_shape)
+            sweep = self._cached_eval(
+                ("owlqn_sweep",) + l1_key,
+                lambda: _build_loss_sweep(gradient, l1_value, mesh,
+                                          with_valid, sparse_shape))
 
             def sweep1(W):
                 return sweep(W, *data_args)
@@ -233,8 +258,10 @@ class OWLQN(LBFGS):
         # exotic gradients without a sweep rule
         _warn_sequential_line_search(gradient, self._LS_TRIALS)
         # loss-only compile: XLA drops the gradient matmul per trial
-        _loss = _build_loss_only(gradient, l1_value, mesh, with_valid,
-                                 sparse_shape)
+        _loss = self._cached_eval(
+            ("owlqn_loss",) + l1_key,
+            lambda: _build_loss_only(gradient, l1_value, mesh,
+                                     with_valid, sparse_shape))
 
         def full_loss1(wv):
             return _loss(wv, *data_args)

@@ -345,6 +345,32 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
         optimizer.gram_chunk_iters = p.chunk_iters or None
 
 
+def reset_plan_owned_gram_knobs(optimizer) -> None:
+    """The clearing counterpart of :func:`apply_gram_knobs`: restore
+    every gram knob the USER did not set (``_user_gram_opts``) to its
+    constructor default.  Called when a manual schedule setter takes the
+    wheel after an auto-planned run — the previous plan's block size /
+    chunk caps were sized for ITS dataset and budget, and a manual
+    schedule on a different dataset must not inherit them (the same
+    leak class as the host_streamed batch_rows fix, but via the
+    manual-after-plan path)."""
+    from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
+
+    user = getattr(optimizer, "_user_gram_opts", frozenset())
+    if "block_rows" not in user:
+        optimizer.gram_block_rows = DEFAULT_BLOCK_ROWS
+    if "batch_rows" not in user:
+        optimizer.gram_batch_rows = None
+    if "aligned" not in user and hasattr(optimizer, "gram_aligned"):
+        optimizer.gram_aligned = False
+    if ("chunk_iters" not in user
+            and hasattr(optimizer, "gram_chunk_iters")):
+        optimizer.gram_chunk_iters = None
+    if ("stream_batch_rows" not in user
+            and hasattr(optimizer, "stream_batch_rows")):
+        optimizer.stream_batch_rows = None
+
+
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
     """Device bytes of the f32 block-prefix statistics at this block size
     (PG + Pb + Pyy + totals; see ops/gram.py memory note)."""
