@@ -1316,3 +1316,62 @@ def test_chunked_driver_ignores_optimizer_aligned_on_prebuilt_exact(rng):
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(np.asarray(w_c), np.asarray(w_0),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_statistics_evaluator_dots_run_highest_precision(rng):
+    """EVERY matmul inside the statistics evaluators must carry
+    Precision.HIGHEST: the TPU default runs f32 operands through bf16
+    passes, and near convergence the quadratic loss is a near-zero
+    difference of ~||y||^2-magnitude terms — a default-precision dot's
+    relative error dwarfs it (module docstring contract).  CPU runs
+    full-precision dots either way, so this asserts the lowered jaxpr's
+    precision attributes instead of numerics."""
+    X, y, w = _data(rng)
+    g = GramLeastSquaresGradient.build(X, y, block_rows=128)
+    W = jnp.stack([w, 0.5 * w])
+    evaluators = {
+        "batch_sums": lambda: g.batch_sums(g.data, y, w),
+        "loss_sweep": lambda: g.loss_sweep(g.data, y, W),
+        "window_sums_exact": lambda: g.window_sums(
+            g.data, y, w, jnp.int32(17), 256),
+        "total_stats": lambda: GramLeastSquaresGradient._total_stats(
+            jnp.asarray(X), jnp.asarray(y), B=128,
+            stats_dtype=jnp.float32),
+    }
+    for name, fn in evaluators.items():
+        s = str(jax.make_jaxpr(fn)())
+        assert "dot_general" in s, name
+        assert "precision=None" not in s, (
+            f"{name} lowers a default-precision matmul")
+
+
+def test_stats_dtype_rejects_non_floating(rng):
+    """An int stats_dtype would silently truncate every element in the
+    upcast; the resolver must reject the whole non-float family, not
+    just sub-f32 floats."""
+    X, y, _ = _data(rng)
+    for bad in (jnp.int32, jnp.int16, bool):
+        with pytest.raises(ValueError, match="floating"):
+            GramLeastSquaresGradient.build(X, y, stats_dtype=bad)
+    with pytest.raises(ValueError, match="float32 or wider"):
+        GramLeastSquaresGradient.build(X, y, stats_dtype=jnp.bfloat16)
+
+
+def test_single_block_virtual_stats_warn_on_sliced(rng):
+    """A totals-only/single-block virtual bundle cannot express
+    sub-batch windows — feeding it to sliced mini-batch GD silently
+    runs full-batch iterations, and the driver must say so."""
+    import warnings as _w
+
+    from tpu_sgd import GradientDescent, SimpleUpdater
+
+    X, y, _ = _data(rng, n=512, d=8)
+    g = GramLeastSquaresGradient.build_streamed(X, y, block_rows=512)
+    assert g.data.PG.shape[0] == 2  # single block by construction
+    opt = (GradientDescent(g, SimpleUpdater())
+           .set_step_size(0.1).set_num_iterations(3)
+           .set_mini_batch_fraction(0.25).set_sampling("sliced"))
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        opt.optimize_with_history((g.data, y), np.zeros(8, np.float32))
+    assert any("degenerate to FULL-BATCH" in str(r.message) for r in rec)

@@ -130,7 +130,10 @@ def aligned_window_terms(PG_diff, Pb_diff, yy_diff, w_sd):
     sd = PG_diff.dtype
     Gw = _dot_hi(PG_diff, w_sd, sd)
     g_sum = Gw - Pb_diff
-    loss_sum = 0.5 * (jnp.dot(w_sd, g_sum) - jnp.dot(w_sd, Pb_diff)
+    # HIGHEST-precision dots: near convergence the loss is the near-zero
+    # difference of ~||y||^2-magnitude terms, and a default-precision
+    # (bf16-pass) dot's relative error dwarfs it (module docstring)
+    loss_sum = 0.5 * (_dot_hi(w_sd, g_sum, sd) - _dot_hi(w_sd, Pb_diff, sd)
                       + yy_diff)
     return g_sum, loss_sum
 
@@ -420,7 +423,6 @@ class _PrefixBuildCheckpoint:
 
     def __init__(self, path, *, n_used, d, B, sd_name, chunk,
                  fingerprint=""):
-        import json
         import os
 
         self.path = path
@@ -472,7 +474,6 @@ class _PrefixBuildCheckpoint:
 
     def save_part(self, start_block: int, pG, pb, pyy,
                   high_water_rows: int) -> None:
-        import json
         import os
 
         import numpy as np
@@ -596,7 +597,14 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         if stats_dtype is None:
             stats_dtype = jnp.promote_types(jnp.float32, data_dtype)
         sd = jnp.dtype(stats_dtype)
-        if jnp.issubdtype(sd, jnp.inexact) and jnp.finfo(sd).bits < 32:
+        if not jnp.issubdtype(sd, jnp.floating):
+            # an int/bool stats dtype would silently truncate every
+            # element in the _dot_hi upcast — garbage statistics, no error
+            raise ValueError(
+                f"stats_dtype must be a floating dtype, got {sd}; "
+                "use float32 or wider"
+            )
+        if jnp.finfo(sd).bits < 32:
             raise ValueError(
                 "stats_dtype below f32 loses ~1% on prefix differences; "
                 "use float32 or wider"
@@ -660,7 +668,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
             return (
                 G + _dot_hi(Xm.T, Xb, sd),
                 b + _dot_hi(ym, Xb, sd),
-                yy + jnp.dot(ym, yb.astype(sd)),
+                yy + _dot_hi(ym, yb, sd),
             ), None
 
         d = X.shape[1]
@@ -677,7 +685,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         vt = None if valid is None else valid[nbf * B:]
         Xm, ym = masked(Xt, yt, vt)
         return (G + _dot_hi(Xm.T, Xt, sd), b + _dot_hi(ym, Xt, sd),
-                yy + jnp.dot(ym, yt.astype(sd)))
+                yy + _dot_hi(ym, yt, sd))
 
     @staticmethod
     def totals_only_data(G_tot, b_tot, yy_tot, n: int, d: int,
@@ -963,7 +971,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         Gw = _dot_hi(st.G_tot, w, sd)
         b = st.b_tot
         g_sum = (Gw - b).astype(cd)
-        loss_sum = (0.5 * (jnp.dot(w, Gw) - 2.0 * jnp.dot(w, b)
+        # cancellation-safe loss dots (see aligned_window_terms)
+        loss_sum = (0.5 * (_dot_hi(w, Gw, sd) - 2.0 * _dot_hi(w, b, sd)
                            + st.yy_tot)).astype(cd)
         return g_sum, loss_sum, jnp.asarray(X.shape[0], cd)
 
@@ -976,7 +985,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         Wc = W.astype(sd)  # (T, d)
         GW = _dot_hi(Wc, st.G_tot, sd)  # (T, d) — G is symmetric
         quad = jnp.sum(GW * Wc, axis=1)
-        lin = jnp.dot(Wc, st.b_tot)
+        lin = _dot_hi(Wc, st.b_tot, sd)
         losses = 0.5 * (quad - 2.0 * lin + st.yy_tot)
         return losses.astype(cd), jnp.asarray(X.shape[0], cd)
 
@@ -1009,7 +1018,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         Gw, b, yy = Gw_e - Gw_s, b_e - b_s, yy_e - yy_s
         g_sum = Gw - b
         wc = weights.astype(cd)
-        loss_sum = 0.5 * (jnp.dot(wc, g_sum) - jnp.dot(wc, b) + yy)
+        loss_sum = 0.5 * (_dot_hi(wc, g_sum, cd) - _dot_hi(wc, b, cd) + yy)
         return g_sum, loss_sum, jnp.asarray(m, cd)
 
     def _window_sums_aligned(self, st, weights, start, m, cd):
@@ -1074,5 +1083,5 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         e_gw = _dot_hi(margins * msk, Xb, sd)
         ybm = yb.astype(sd) * msk
         e_b = _dot_hi(ybm, Xb, sd)
-        e_yy = jnp.dot(yb.astype(sd), ybm)
+        e_yy = _dot_hi(yb, ybm, sd)
         return e_gw.astype(cd), e_b.astype(cd), e_yy.astype(cd)

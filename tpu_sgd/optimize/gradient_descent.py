@@ -447,38 +447,11 @@ class GradientDescent(Optimizer):
         says so when both are set).
         The execution planner (``tpu_sgd/plan.py``) sets ``block_rows``/
         ``batch_rows`` automatically; ``aligned`` stays opt-in."""
-        # validate EVERY argument before applying ANY: a bad later knob
-        # must not leave the optimizer half-configured (earlier knobs
-        # mutated but unrecorded in _user_gram_opts / plan cache intact)
-        provided = {}
-        if block_rows is not None:
-            if int(block_rows) < 1:
-                raise ValueError(
-                    f"block_rows must be positive, got {block_rows}"
-                )
-            provided["block_rows"] = ("gram_block_rows", int(block_rows))
-        if aligned is not None:
-            provided["aligned"] = ("gram_aligned", bool(aligned))
-        if batch_rows is not None:
-            if int(batch_rows) < 1:
-                raise ValueError(
-                    f"batch_rows must be positive, got {batch_rows}"
-                )
-            provided["batch_rows"] = ("gram_batch_rows", int(batch_rows))
-        if chunk_iters is not None:
-            if int(chunk_iters) < 1:
-                raise ValueError(
-                    f"chunk_iters must be positive, got {chunk_iters}"
-                )
-            provided["chunk_iters"] = ("gram_chunk_iters", int(chunk_iters))
-        for attr, val in provided.values():
-            setattr(self, attr, val)
-        # user-set knobs survive auto-planning (Plan.apply skips them).
-        # Only the plan CACHE key is cleared — not last_plan: knobs are
-        # not a schedule choice, so re-planning must still run (the
-        # manual gate in glm._auto_plan keys on last_plan is None).
-        self._user_gram_opts = self._user_gram_opts | set(provided)
-        self._plan_key = None
+        from tpu_sgd.plan import apply_user_gram_knobs
+
+        apply_user_gram_knobs(self, block_rows=block_rows, aligned=aligned,
+                              batch_rows=batch_rows,
+                              chunk_iters=chunk_iters)
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -574,6 +547,21 @@ class GradientDescent(Optimizer):
                 raise NotImplementedError(
                     "GramData input supports sliced sampling or full "
                     f"batch (got sampling={cfg.sampling!r})"
+                )
+            if (cfg.mini_batch_fraction < 1.0 and X.X is None
+                    and X.PG.shape[0] <= 2):
+                import warnings
+
+                # a single-block virtual stack (e.g. a persisted
+                # totals-only bundle from the quasi-Newton/normal paths)
+                # cannot express sub-batch windows: every "window" IS
+                # the full batch — the run silently stops being SGD
+                warnings.warn(
+                    "these virtual statistics hold a single block, so "
+                    f"sliced windows at frac={cfg.mini_batch_fraction} "
+                    "degenerate to FULL-BATCH iterations; rebuild with "
+                    "a smaller block_rows for true mini-batch sampling",
+                    RuntimeWarning, stacklevel=3,
                 )
             y = jnp.asarray(y)
             if not jnp.issubdtype(y.dtype, jnp.inexact):

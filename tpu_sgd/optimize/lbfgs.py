@@ -466,30 +466,10 @@ class LBFGS(Optimizer):
         planner): ``block_rows`` sizes the prefix stack (memory vs edge
         traffic — see ``ops/gram.py``); ``batch_rows`` caps the streamed
         build's host→device chunk, co-resident with the stack."""
-        # validate EVERY argument before applying ANY (see the
-        # GradientDescent setter: a bad later knob must not leave the
-        # optimizer half-configured)
-        provided = {}
-        if block_rows is not None:
-            if int(block_rows) < 1:
-                raise ValueError(
-                    f"block_rows must be positive, got {block_rows}"
-                )
-            provided["block_rows"] = ("gram_block_rows", int(block_rows))
-        if batch_rows is not None:
-            if int(batch_rows) < 1:
-                raise ValueError(
-                    f"batch_rows must be positive, got {batch_rows}"
-                )
-            provided["batch_rows"] = ("gram_batch_rows", int(batch_rows))
-        for attr, val in provided.values():
-            setattr(self, attr, val)
-        # user-set knobs survive auto-planning (glm._auto_plan skips
-        # them).  Only the plan CACHE key is cleared — not last_plan:
-        # knobs are not a schedule choice, so re-planning must still run
-        # (the manual gate in glm._auto_plan keys on last_plan is None).
-        self._user_gram_opts = self._user_gram_opts | set(provided)
-        self._plan_key = None
+        from tpu_sgd.plan import apply_user_gram_knobs
+
+        apply_user_gram_knobs(self, block_rows=block_rows,
+                              batch_rows=batch_rows)
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):

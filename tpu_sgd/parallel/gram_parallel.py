@@ -61,7 +61,7 @@ def build_sharded_gram_stats(mesh, Xd, yd, block_rows: int = DEFAULT_BLOCK_ROWS)
     # f64 data keeps f64 statistics, matching the single-device build()
     # default (prefix-difference cancellation would amplify a silent f32
     # downgrade relative to the stock f64 mesh path).
-    sd = jnp.promote_types(jnp.float32, Xd.dtype)
+    sd = GramLeastSquaresGradient._resolve_stats_dtype(Xd.dtype, None)
     fn = _stats_builder(mesh, B, jnp.dtype(sd).name)
     return fn(Xd, yd), B
 
@@ -148,7 +148,6 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
     """
     import numpy as np
 
-    from tpu_sgd.ops.gram import GramLeastSquaresGradient
     from jax.sharding import NamedSharding
 
     if set(mesh.shape) != {DATA_AXIS}:
@@ -283,7 +282,7 @@ def build_sharded_total_stats(mesh, Xd, yd,
         )
     n_local = Xs.shape[0] // k
     B = max(1, min(int(block_rows), n_local))
-    sd = jnp.promote_types(jnp.float32, Xd.dtype)
+    sd = GramLeastSquaresGradient._resolve_stats_dtype(Xd.dtype, None)
 
     def body(Xl, yl, vl):
         G, b, yy = GramLeastSquaresGradient._total_stats(

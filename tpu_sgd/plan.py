@@ -345,6 +345,44 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
         optimizer.gram_chunk_iters = p.chunk_iters or None
 
 
+#: THE user-facing gram knob table: name -> (optimizer attribute,
+#: requires-positive-int).  Shared by the setters' validate-then-apply
+#: (`apply_user_gram_knobs`), `apply_gram_knobs`, and
+#: `reset_plan_owned_gram_knobs`, so a new knob is wired in ONE place.
+_GRAM_KNOBS = {
+    "block_rows": ("gram_block_rows", True),
+    "batch_rows": ("gram_batch_rows", True),
+    "aligned": ("gram_aligned", False),
+    "chunk_iters": ("gram_chunk_iters", True),
+}
+
+
+def apply_user_gram_knobs(optimizer, **knobs) -> None:
+    """Validate-all-then-apply for USER-set gram knobs (the
+    ``set_gram_options`` body, shared by GradientDescent and LBFGS): a
+    bad LATER argument must not leave earlier knobs half-applied —
+    mutated but unrecorded in ``_user_gram_opts`` with the plan cache
+    intact.  Records every applied knob as user-owned and invalidates
+    the repeat-run plan key (knobs are not a schedule choice, so
+    ``last_plan`` survives and re-planning still runs)."""
+    provided = {}
+    for name, val in knobs.items():
+        if val is None:
+            continue
+        attr, positive = _GRAM_KNOBS[name]
+        if positive:
+            if int(val) < 1:
+                raise ValueError(f"{name} must be positive, got {val}")
+            val = int(val)
+        else:
+            val = bool(val)
+        provided[name] = (attr, val)
+    for attr, val in provided.values():
+        setattr(optimizer, attr, val)
+    optimizer._user_gram_opts = optimizer._user_gram_opts | set(provided)
+    optimizer._plan_key = None
+
+
 def reset_plan_owned_gram_knobs(optimizer) -> None:
     """The clearing counterpart of :func:`apply_gram_knobs`: restore
     every gram knob the USER did not set (``_user_gram_opts``) to its
