@@ -197,3 +197,113 @@ def test_profile_trace_writes_logdir(tmp_path):
         (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
     found = list((tmp_path / "tb").rglob("*"))
     assert found, "no trace files written"
+
+
+def test_checkpoint_ordering_survives_digit_rollover(tmp_path):
+    """Filenames grow a digit at iteration 10^8; ordering must follow the
+    PARSED iteration, or latest_path returns stale state and _prune
+    deletes every new checkpoint as 'oldest'."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    w = np.ones(4, np.float32)
+    mgr.save(99_999_999, w, 0.0, [1.0])
+    mgr.save(100_000_000, 2 * w, 0.0, [1.0, 0.5])
+    assert mgr.latest_path().endswith("ckpt_100000000.npz")
+    st = mgr.restore()
+    assert st["iteration"] == 100_000_000
+    mgr.save(100_000_001, 3 * w, 0.0, [1.0, 0.5, 0.2])
+    # prune kept the two NEWEST, not the two lexicographically-largest
+    assert mgr.restore()["iteration"] == 100_000_001
+    import glob as _g
+    kept = sorted(int(p.split("ckpt_")[1][:-4])
+                  for p in _g.glob(str(tmp_path / "ck" / "ckpt_*.npz")))
+    assert kept == [100_000_000, 100_000_001]
+
+
+def test_checkpoint_restore_falls_back_past_corruption(tmp_path):
+    """keep > 1 exists so one torn newest file cannot break resume: the
+    default restore falls back through older retained checkpoints."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    w = np.ones(4, np.float32)
+    mgr.save(10, w, 0.1, [1.0])
+    mgr.save(20, 2 * w, 0.2, [1.0, 0.5])
+    newest = mgr.latest_path()
+    with open(newest, "wb") as f:
+        f.write(b"torn")  # truncated/unreadable newest
+    st = mgr.restore()
+    assert st is not None and st["iteration"] == 10
+    # an EXPLICIT path still raises (the caller asked for that file)
+    with pytest.raises(Exception):
+        mgr.restore(path=newest)
+
+
+def test_checkpoint_init_sweeps_orphaned_tmp_files(tmp_path):
+    """A crash between write and rename leaves .tmp_ckpt_* orphans; the
+    next manager construction must clean up the STALE ones (recent temp
+    files may belong to a live writer and are spared)."""
+    import os as _os
+
+    d = tmp_path / "ck"
+    d.mkdir()
+    orphan = d / ".tmp_ckpt_00000007.npz"
+    orphan.write_bytes(b"partial")
+    _os.utime(orphan, (1.0, 1.0))  # stale: crashed long ago
+    CheckpointManager(str(d))
+    assert not orphan.exists()
+
+
+def test_checkpoint_tolerates_hand_named_files(tmp_path):
+    """A user-copied 'ckpt_best.npz' must not break every save/restore
+    in the directory; only numbered checkpoints participate."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    w = np.ones(3, np.float32)
+    mgr.save(5, w, 0.0, [1.0])
+    (tmp_path / "ck" / "ckpt_best.npz").write_bytes(b"hand-named")
+    mgr.save(6, 2 * w, 0.0, [1.0, 0.5])  # _prune must not crash
+    assert mgr.restore()["iteration"] == 6
+    assert (tmp_path / "ck" / "ckpt_best.npz").exists()  # never pruned
+
+
+def test_checkpoint_sweep_spares_recent_tmp_files(tmp_path):
+    """The orphan sweep must not delete another process's in-flight
+    temp file — only stale ones (no live writer plausible)."""
+    d = tmp_path / "ck"
+    d.mkdir()
+    fresh = d / ".tmp_ckpt_00000009.npz"
+    fresh.write_bytes(b"in-flight")
+    old = d / ".tmp_ckpt_00000001.npz"
+    old.write_bytes(b"orphan")
+    import os as _os
+
+    _os.utime(old, (1.0, 1.0))  # ancient mtime: a true orphan
+    CheckpointManager(str(d))
+    assert fresh.exists() and not old.exists()
+
+
+def test_checkpoint_corrupt_file_quarantined(tmp_path):
+    """A file the fallback proved unreadable must leave the numbered
+    namespace — otherwise _prune keeps it as 'newest' and deletes every
+    VALID checkpoint the resumed run writes below its iteration."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=1)
+    w = np.ones(3, np.float32)
+    mgr.save(20, w, 0.0, [1.0])
+    torn = tmp_path / "ck" / "ckpt_00000020.npz"
+    torn.write_bytes(b"torn")
+    assert mgr.restore() is None  # keep=1: nothing valid retained
+    assert not torn.exists()  # quarantined aside
+    assert (tmp_path / "ck" / ".bad_ckpt_00000020.npz").exists()
+    # a resumed run's fresh checkpoints now survive pruning
+    mgr.save(1, w, 0.0, [1.0])
+    assert mgr.restore()["iteration"] == 1
+
+
+def test_take_rows_dense_rejects_out_of_range(rng):
+    """The dense fold path must raise like the sparse one — numpy would
+    silently resolve -1 to the tail row."""
+    from tpu_sgd.utils.mlutils import _take_rows
+
+    X = rng.normal(size=(16, 4)).astype(np.float32)
+    with pytest.raises(IndexError, match="row indices"):
+        _take_rows(X, np.array([-1, 0]))
+    with pytest.raises(IndexError, match="row indices"):
+        _take_rows(X, np.array([0, 16]))
+    assert _take_rows(X, np.array([3, 1])).shape == (2, 4)

@@ -683,3 +683,48 @@ def test_sparse_vector_rejects_out_of_range_indices():
         SparseVector(3, [-1], [9.0])
     with pytest.raises(ValueError, match="indices must be in"):
         SparseVector(3, [5], [9.0])
+
+
+def test_take_rows_bcoo_rejects_out_of_range_indices():
+    """Negative indices would silently alias tail rows through the
+    position scatter — a split training on the wrong rows."""
+    from tpu_sgd.ops.sparse import take_rows_bcoo
+
+    X, y, _ = sparse_data(32, 8, nnz_per_row=3, seed=3)
+    with pytest.raises(IndexError, match="row indices"):
+        take_rows_bcoo(X, np.array([-1, 0]))
+    with pytest.raises(IndexError, match="row indices"):
+        take_rows_bcoo(X, np.array([0, 32]))
+
+
+def test_take_rows_bcoo_inherits_uniqueness_flag():
+    """A duplicate-coordinate input keeps its duplicates in the selected
+    subset; the output must not falsely promise unique indices (scatter
+    in unique mode may drop one duplicate's value)."""
+    from jax.experimental.sparse import BCOO
+
+    from tpu_sgd.ops.sparse import take_rows_bcoo
+
+    dup = BCOO(
+        (jnp.asarray([1.0, 2.0]), jnp.asarray([[0, 1], [0, 1]])),
+        shape=(2, 4), unique_indices=False,
+    )
+    out = take_rows_bcoo(dup, np.array([0]))
+    assert out.unique_indices is False
+    assert float(out.todense()[0, 1]) == 3.0  # duplicates still SUM
+    # a genuinely-unique input keeps the flag
+    X, _, _ = sparse_data(16, 8, nnz_per_row=2, seed=0)
+    assert take_rows_bcoo(X, np.arange(4)).unique_indices is True
+
+
+def test_csr_to_bcoo_rejects_out_of_range_feature(tmp_path):
+    """The dense loader raises for a feature index beyond num_features;
+    the sparse path must not silently drop the entry instead."""
+    p = tmp_path / "oob.txt"
+    p.write_text("1 1:0.5 7:1.5\n0 2:2.0\n")
+    from tpu_sgd import load_libsvm_file_bcoo
+
+    X, y = load_libsvm_file_bcoo(str(p))  # self-sized: fine
+    assert X.shape == (2, 7)
+    with pytest.raises(IndexError, match="feature index"):
+        load_libsvm_file_bcoo(str(p), num_features=5)

@@ -92,6 +92,14 @@ def take_rows_bcoo(X, idx):
     if np.unique(idx).size != idx.size:
         raise ValueError("take_rows_bcoo needs unique row indices")
     n, d = X.shape
+    if idx.size and (idx.min() < 0 or idx.max() >= n):
+        # negative indices would silently alias tail rows through the
+        # pos[idx] scatter (Python indexing) — the split trains on the
+        # wrong rows with no error
+        raise IndexError(
+            f"row indices must lie in [0, {n}); got range "
+            f"[{idx.min()}, {idx.max()}]"
+        )
     rows, cols, vals = host_entries(X)
     pos = np.full((n,), -1, np.int64)
     pos[idx] = np.arange(idx.size)
@@ -103,7 +111,12 @@ def take_rows_bcoo(X, idx):
     return BCOO(
         (jnp.asarray(vals[order]), jnp.asarray(out_idx)),
         shape=(int(idx.size), int(d)),
-        indices_sorted=True, unique_indices=True,
+        # the lexsort establishes sorted order, but uniqueness is only
+        # inherited: a duplicate-coordinate input keeps its duplicates
+        # in the selected subset, and falsely promising unique indices
+        # lets downstream scatter modes drop one duplicate's value
+        indices_sorted=True,
+        unique_indices=bool(getattr(X, "unique_indices", False)),
     )
 
 
@@ -126,6 +139,19 @@ def csr_to_bcoo(csr: Tuple, num_features: int, dtype=jnp.float32):
     data = np.asarray(data)
     indices = np.asarray(indices, np.int32)
     indptr = np.asarray(indptr)
+    if indices.size and (int(indices.min()) < 0
+                         or int(indices.max()) >= int(num_features)):
+        # the dense loader raises IndexError for the same input; an
+        # out-of-bounds BCOO column would instead be silently dropped by
+        # every downstream op, hiding the data problem on the sparse path
+        bad = (int(indices.min()) if int(indices.min()) < 0
+               else int(indices.max()))
+        raise IndexError(
+            f"feature index {bad} out of range for "
+            f"num_features={int(num_features)} (negative means a "
+            "malformed 0-based file; otherwise pass a larger "
+            "num_features, e.g. the training dimensionality)"
+        )
     n = indptr.shape[0] - 1
     rows = np.repeat(
         np.arange(n, dtype=np.int32), np.diff(indptr).astype(np.int64)

@@ -136,9 +136,22 @@ def _shard_bcoo_multihost(mesh: Mesh, X, y):
         multihost_utils.process_allgather(np.asarray(local_max_nse))
     )
     nse_local = max(1, int(nse_all.max()))
-    d = int(np.asarray(
+    d_all = np.asarray(
         multihost_utils.process_allgather(np.asarray(d_local))
-    ).max())
+    )
+    if int(d_all.min()) != int(d_all.max()):
+        # resolving by max would silently misalign everything built from
+        # the LOCAL width (w0 length, the appended bias column) — each
+        # process would trace a different program, which in multi-host
+        # JAX is a distributed hang, not a clean error.  Make the user
+        # pin num_features at load time instead.
+        raise ValueError(
+            "processes disagree on the feature count "
+            f"({sorted(int(v) for v in set(d_all.tolist()))}); pass an "
+            "explicit num_features to the loader so every process "
+            "builds the same dimensionality"
+        )
+    d = int(d_all.max())
 
     data_h, idx_h = _layout_blocks(
         rows, cols, vals, local_shards, rows_local, nse_local

@@ -13,12 +13,20 @@ restores the reference's any-iteration replay property without lineage
 from __future__ import annotations
 
 import glob
+import logging
 import os
 from typing import Optional
 
 import numpy as np
 
+logger = logging.getLogger("tpu_sgd.checkpoint")
+
 FORMAT_VERSION = "1.0"
+
+
+class CheckpointVersionError(ValueError):
+    """The checkpoint is intact but from an incompatible format version —
+    a real incompatibility, never skipped by the corruption fallback."""
 
 
 class CheckpointManager:
@@ -28,9 +36,40 @@ class CheckpointManager:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        # a crash mid-save leaves .tmp_ckpt_* orphans (invisible to the
+        # ckpt_*.npz glob but full model-sized files); sweep the STALE
+        # ones here so a flaky job cannot leak disk indefinitely — but
+        # only files old enough that no live writer (another process
+        # sharing this directory, mid-save) can plausibly own them
+        import time as _time
+
+        cutoff = _time.time() - 3600
+        for stale in glob.glob(os.path.join(directory, ".tmp_ckpt_*.npz")):
+            try:
+                if os.path.getmtime(stale) < cutoff:
+                    os.remove(stale)
+            except OSError:
+                pass
 
     def _path(self, iteration: int) -> str:
         return os.path.join(self.directory, f"ckpt_{iteration:08d}.npz")
+
+    @staticmethod
+    def _iteration_of(path: str):
+        """Parsed iteration, or None for a hand-named ckpt_*.npz file
+        (e.g. a user's 'ckpt_best.npz' copy) — those are ignored rather
+        than crashing every save/restore in the directory."""
+        stem = os.path.basename(path)[5:-4]
+        return int(stem) if stem.isdigit() else None
+
+    def _paths_by_iteration(self):
+        # sort by the PARSED iteration, not lexicographically: at
+        # iteration 10^8 the name grows a digit and 'ckpt_100000000'
+        # sorts before 'ckpt_99999999', which would make latest_path
+        # return stale state and _prune delete every NEW checkpoint
+        paths = glob.glob(os.path.join(self.directory, "ckpt_*.npz"))
+        numbered = [p for p in paths if self._iteration_of(p) is not None]
+        return sorted(numbered, key=self._iteration_of)
 
     def save(
         self,
@@ -50,37 +89,75 @@ class CheckpointManager:
         # Temp prefix must NOT match the ckpt_*.npz glob, or a truncated
         # file left by a crash mid-write would be picked up by latest_path.
         tmp = os.path.join(self.directory, f".tmp_ckpt_{iteration:08d}.npz")
-        np.savez(
-            tmp,
-            version=FORMAT_VERSION,
-            iteration=np.asarray(iteration, np.int64),
-            weights=np.asarray(weights),
-            reg_val=np.asarray(reg_val, np.float64),
-            loss_history=np.asarray(loss_history, np.float64),
-            config_key=np.asarray(config_key),
-            **{f"x_{k}": np.asarray(v) for k, v in (extras or {}).items()},
-        )
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                version=FORMAT_VERSION,
+                iteration=np.asarray(iteration, np.int64),
+                weights=np.asarray(weights),
+                reg_val=np.asarray(reg_val, np.float64),
+                loss_history=np.asarray(loss_history, np.float64),
+                config_key=np.asarray(config_key),
+                **{f"x_{k}": np.asarray(v)
+                   for k, v in (extras or {}).items()},
+            )
+            # fsync BEFORE the rename: os.replace is atomic for the
+            # directory entry, but on a writeback mount a power loss can
+            # journal the rename while the data blocks are still dirty —
+            # a durable name pointing at truncated bytes
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
         self._prune()
         return path
 
     def _prune(self):
-        paths = sorted(glob.glob(os.path.join(self.directory, "ckpt_*.npz")))
-        for p in paths[: -self.keep]:
+        for p in self._paths_by_iteration()[: -self.keep]:
             os.remove(p)
 
     def latest_path(self) -> Optional[str]:
-        paths = sorted(glob.glob(os.path.join(self.directory, "ckpt_*.npz")))
+        paths = self._paths_by_iteration()
         return paths[-1] if paths else None
 
     def restore(self, path: Optional[str] = None) -> Optional[dict]:
-        """Load a checkpoint dict or None when the directory is empty."""
-        path = path or self.latest_path()
-        if path is None:
-            return None
+        """Load a checkpoint dict or ``None`` when the directory is empty.
+
+        An explicitly requested ``path`` raises on corruption; the
+        latest-checkpoint default FALLS BACK through the older retained
+        checkpoints instead — ``keep > 1`` exists precisely so one
+        torn/truncated newest file cannot permanently break resume."""
+        if path is not None:
+            return self._load(path)
+        candidates = self._paths_by_iteration()
+        for p in reversed(candidates):
+            try:
+                return self._load(p)
+            except CheckpointVersionError:
+                raise  # intact but incompatible: not corruption
+            except Exception as e:  # truncated/torn file: try older
+                logger.warning(
+                    "checkpoint %s unreadable (%s: %s); falling back to "
+                    "the previous retained checkpoint", p,
+                    type(e).__name__, e)
+                # QUARANTINE the proven-bad file out of the numbered
+                # namespace: left in place, _prune would keep treating
+                # it as 'newest' and delete every VALID checkpoint the
+                # resumed run writes below its iteration
+                try:
+                    os.replace(p, os.path.join(
+                        os.path.dirname(p),
+                        ".bad_" + os.path.basename(p)))
+                except OSError:
+                    pass
+        return None
+
+    @staticmethod
+    def _load(path: str) -> dict:
         with np.load(path, allow_pickle=False) as z:
             if str(z["version"]) != FORMAT_VERSION:
-                raise ValueError(f"unsupported checkpoint version {z['version']}")
+                raise CheckpointVersionError(
+                    f"unsupported checkpoint version {z['version']}"
+                )
             return {
                 "iteration": int(z["iteration"]),
                 "weights": z["weights"],

@@ -271,10 +271,23 @@ def save_labeled_points(path: str, points, num_partitions: int = 1) -> None:
 
 def _take_rows(X, idx):
     """Row-select helper shared by the fold utilities: fancy indexing for
-    dense arrays, host-side relayout for sparse (BCOO) features."""
+    dense arrays, host-side relayout for sparse (BCOO) features.  Bounds
+    are validated for BOTH layouts — numpy would resolve a negative
+    index to the tail row and the split would silently train on the
+    wrong rows (the sparse path raises the same error inside
+    ``take_rows_bcoo``)."""
     from tpu_sgd.ops.sparse import is_sparse, take_rows_bcoo
 
-    return take_rows_bcoo(X, idx) if is_sparse(X) else X[idx]
+    if is_sparse(X):
+        return take_rows_bcoo(X, idx)
+    idx = np.asarray(idx)
+    n = np.asarray(X).shape[0]
+    if idx.size and (idx.min() < 0 or idx.max() >= n):
+        raise IndexError(
+            f"row indices must lie in [0, {n}); got range "
+            f"[{idx.min()}, {idx.max()}]"
+        )
+    return X[idx]
 
 
 def _num_rows(X) -> int:
