@@ -198,3 +198,75 @@ def test_predict_streamed_sparse_bcoo():
     full = np.asarray(model.predict(Xs))
     chunked = model.predict_streamed(Xs, batch_rows=250)
     np.testing.assert_allclose(chunked, full, rtol=1e-6, atol=1e-7)
+
+
+def test_linear_train_static_positional_parity(rng):
+    """Reference static: train(input, numIterations, stepSize,
+    miniBatchFraction) — the 4th positional is the FRACTION (there is no
+    regParam slot); a ported call must not silently set reg instead."""
+    X, y, _ = linear_data(2000, 6, seed=11)
+    m_pos = LinearRegressionWithSGD.train((X, y), 60, 0.5, 0.25)
+    m_kw = LinearRegressionWithSGD.train(
+        (X, y), num_iterations=60, step_size=0.5, mini_batch_fraction=0.25)
+    np.testing.assert_array_equal(np.asarray(m_pos.weights),
+                                  np.asarray(m_kw.weights))
+
+
+def test_logistic_train_static_positional_parity(rng):
+    """Reference static: train(input, numIterations, stepSize,
+    miniBatchFraction[, initialWeights]) and the companion object trains
+    UNREGULARIZED (regParam 0.0, though the class default is 0.01)."""
+    from tpu_sgd.models.classification import LogisticRegressionWithSGD
+
+    X = rng.normal(size=(800, 5)).astype(np.float32)
+    w = rng.uniform(-1, 1, 5).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    m_static = LogisticRegressionWithSGD.train((X, y), 40, 1.0, 1.0)
+    alg = LogisticRegressionWithSGD(1.0, 40, 0.0, 1.0)  # reg 0.0 explicit
+    m_class = alg.run((X, y))
+    np.testing.assert_array_equal(np.asarray(m_static.weights),
+                                  np.asarray(m_class.weights))
+
+
+def test_multinomial_intercept_warm_start_and_state(rng):
+    """A trained multinomial intercept model's own weights must warm-start
+    a continuation run (they carry per-class bias slots), and the run must
+    not pollute the algorithm's num_features with the post-bias width."""
+    from tpu_sgd.models.classification import LogisticRegressionWithLBFGS
+
+    X = rng.normal(size=(600, 4)).astype(np.float32)
+    W = rng.uniform(-1, 1, size=(2, 4)).astype(np.float32)
+    logits = np.concatenate([np.zeros((600, 1)), X @ W.T], axis=1)
+    y = np.argmax(logits, axis=1).astype(np.float32)
+
+    alg = LogisticRegressionWithLBFGS(max_num_iterations=8)
+    alg.set_num_classes(3).set_intercept(True)
+    model = alg.run((X, y))
+    assert model.weights.shape[-1] == 2 * 5  # (K-1)*(d+1) bias slots
+    # continuation: the model's own weights round-trip through run_warm
+    model2 = alg.run_warm((X, y), model)
+    assert model2.weights.shape == model.weights.shape
+    acc = float(np.mean(np.asarray(model2.predict(X)) == y))
+    assert acc > 0.8
+    # ...and fresh (K-1)*d weights still work (bias slots added inside)
+    model3 = alg.run((X, y), np.zeros((2 * 4,), np.float32))
+    assert model3.weights.shape == model.weights.shape
+    # state hygiene: a later non-intercept run on the same object works
+    alg.set_intercept(False)
+    model4 = alg.run((X, y))
+    assert model4.weights.shape[-1] == 2 * 4
+
+
+def test_multinomial_intercept_honors_schedule_contract(rng):
+    """set_schedule must not be silently ignored on the multinomial
+    intercept branch: a schedule that cannot apply raises exactly as it
+    does on every other path."""
+    from tpu_sgd.models.classification import LogisticRegressionWithLBFGS
+
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (rng.integers(0, 3, size=200)).astype(np.float32)
+    alg = LogisticRegressionWithLBFGS(max_num_iterations=3)
+    alg.set_num_classes(3).set_intercept(True)
+    alg.set_schedule("resident_gram")
+    with pytest.raises(ValueError):
+        alg.run((X, y))

@@ -155,6 +155,24 @@ class LogisticRegressionWithSGD(_BinaryClassifierWithSGD):
     _gradient_cls = LogisticGradient
     _model_cls = LogisticRegressionModel
 
+    @classmethod
+    def train(cls, data, num_iterations: int = 100, step_size: float = 1.0,
+              mini_batch_fraction: float = 1.0, initial_weights=None,
+              reg_param: float = 0.0, **kw):
+        """Reference static parity ([U] object LogisticRegressionWithSGD):
+        ``train(input, numIterations, stepSize, miniBatchFraction[,
+        initialWeights])`` — ``miniBatchFraction`` is the FOURTH
+        positional and the STATIC trains UNREGULARIZED (the reference's
+        companion object hardcodes regParam 0.0; the class constructor
+        keeps the 0.01 class default).  ``reg_param`` and the TPU-side
+        extensions are keyword-only here.  (``SVMWithSGD.train`` keeps
+        the base signature: the reference's SVM static takes regParam as
+        its own fourth positional.)"""
+        return super().train(
+            data, num_iterations, step_size, reg_param=reg_param,
+            mini_batch_fraction=mini_batch_fraction,
+            initial_weights=initial_weights, **kw)
+
 
 class SVMWithSGD(_BinaryClassifierWithSGD):
     """Linear SVM via hinge-loss SGD (config 3, BASELINE.json:9)."""
@@ -292,30 +310,52 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
                 from tpu_sgd.feature import StandardScaler
 
                 scaler = StandardScaler(with_mean=False, with_std=True).fit(X)
-                X = scaler.transform(X)
+                X = scaler.transform(X)  # host input stays on host
             X = append_bias_auto(X)
-            self.num_features = X.shape[1]
             K = self.num_classes
             if initial_weights is None:
                 w0 = np.zeros(((K - 1), d), np.float32)
+                has_bias_slots = False
             else:
-                # User convention: (K-1)*D weights (no bias slots), same as
-                # the non-intercept path; bias slots are added here.
+                # Accept BOTH layouts: (K-1)*d (fresh weights, bias slots
+                # added here) and (K-1)*(d+1) (a trained intercept model's
+                # own weights — the warm-start/continuation contract:
+                # run_warm passes model.weights straight back in).
                 w0 = np.asarray(initial_weights, np.float32)
-                if w0.size != (K - 1) * d:
+                if w0.size == (K - 1) * (d + 1):
+                    w0 = w0.reshape(K - 1, d + 1)
+                    has_bias_slots = True
+                elif w0.size == (K - 1) * d:
+                    w0 = w0.reshape(K - 1, d)
+                    has_bias_slots = False
+                else:
                     raise ValueError(
                         f"initial_weights has size {w0.size} but expected "
-                        f"{(K - 1) * d} ((num_classes-1) * num_features)"
+                        f"{(K - 1) * d} ((num_classes-1) * num_features) "
+                        f"or {(K - 1) * (d + 1)} (with per-class bias "
+                        "slots, e.g. a trained intercept model's weights)"
                     )
-                w0 = w0.reshape(K - 1, d)
             if scaler is not None:
                 # User initial weights arrive in original space; the inverse
-                # of the weight-rescale below moves them into scaled space.
-                w0 = np.asarray(w0 * np.asarray(scaler.std)[None, :], np.float32)
-            bias0 = np.full((K - 1, 1), float(initial_intercept), np.float32)
-            w0 = np.concatenate([w0, bias0], axis=1).reshape(-1)
+                # of the weight-rescale below moves them into scaled space
+                # (feature slots only — bias slots are unscaled).
+                std = np.asarray(scaler.std)
+                if has_bias_slots:
+                    w0 = w0.copy()
+                    w0[:, :d] = w0[:, :d] * std[None, :]
+                else:
+                    w0 = np.asarray(w0 * std[None, :], np.float32)
+            if not has_bias_slots:
+                bias0 = np.full((K - 1, 1), float(initial_intercept),
+                                np.float32)
+                w0 = np.concatenate([w0, bias0], axis=1)
+            w0 = np.asarray(w0, np.float32).reshape(-1)
             if self.validate_data:
                 self.validators(X, y)
+            # the schedule contract holds on this branch too: zero-flag
+            # runs auto-plan, set_schedule forces or raises — exactly as
+            # the harness path does
+            self._auto_plan(X, np.asarray(y))
             weights = self.optimizer.optimize((X, np.asarray(y)), w0)
             if scaler is not None:
                 W = np.array(weights, np.float32).reshape(K - 1, d + 1)

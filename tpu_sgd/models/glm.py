@@ -260,6 +260,9 @@ class GeneralizedLinearAlgorithm:
             from tpu_sgd.feature import StandardScaler
 
             scaler = StandardScaler(with_mean=False, with_std=True).fit(X)
+            # host numpy input stays on host inside transform (the
+            # device round-trip would triple the transfer); device and
+            # sparse inputs keep their layout
             X = scaler.transform(X)
             d = int(np.asarray(scaler.std).shape[0])
             w0 = np.asarray(

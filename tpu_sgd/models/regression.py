@@ -128,6 +128,22 @@ class _RegressionWithSGD(GeneralizedLinearAlgorithm):
 class LinearRegressionWithSGD(_RegressionWithSGD):
     """Least squares, no regularization (config 1, BASELINE.json:7)."""
 
+    @classmethod
+    def train(cls, data, num_iterations: int = 100, step_size: float = 1.0,
+              mini_batch_fraction: float = 1.0, initial_weights=None, **kw):
+        """Reference static parity ([U] object LinearRegressionWithSGD,
+        SURVEY.md §3.1): ``train(input, numIterations, stepSize,
+        miniBatchFraction, initialWeights)`` — ``miniBatchFraction`` is
+        the FOURTH positional (there is no regParam slot; the simple
+        updater ignores regularization).  A ported reference call like
+        ``train(data, 100, 1.0, 0.1)`` must mean frac=0.1, not a
+        silently-ignored reg_param=0.1 with full-batch sampling.  The
+        TPU-side extensions stay keyword-only."""
+        return super().train(
+            data, num_iterations, step_size,
+            mini_batch_fraction=mini_batch_fraction,
+            initial_weights=initial_weights, **kw)
+
 
 class LassoWithSGD(_RegressionWithSGD):
     """Least squares + L1 prox updater."""

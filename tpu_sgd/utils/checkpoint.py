@@ -50,6 +50,22 @@ class CheckpointManager:
                     os.remove(stale)
             except OSError:
                 pass
+        # quarantined corrupt files (.bad_ckpt_*, restore()'s fallback)
+        # are kept for forensics but BOUNDED — a flaky job must not leak
+        # one model-sized file per torn checkpoint forever
+        def _mtime(p):
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0  # vanished concurrently: sorts first, skipped
+
+        bad = sorted(glob.glob(os.path.join(directory, ".bad_ckpt_*.npz")),
+                     key=_mtime)
+        for p in bad[:-max(1, keep)]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def _path(self, iteration: int) -> str:
         return os.path.join(self.directory, f"ckpt_{iteration:08d}.npz")

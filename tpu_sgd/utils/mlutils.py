@@ -281,7 +281,7 @@ def _take_rows(X, idx):
     if is_sparse(X):
         return take_rows_bcoo(X, idx)
     idx = np.asarray(idx)
-    n = np.asarray(X).shape[0]
+    n = np.shape(X)[0]  # no device->host copy just to read a shape
     if idx.size and (idx.min() < 0 or idx.max() >= n):
         raise IndexError(
             f"row indices must lie in [0, {n}); got range "
