@@ -186,3 +186,56 @@ def test_mesh_config_builds_mesh():
 
     m = MeshConfig(data=4, model=2).build()
     assert dict(m.shape) == {DATA_AXIS: 4, MODEL_AXIS: 2}
+
+
+def test_2d_mesh_warm_start_initial_reg_is_global():
+    """Iteration-1's loss carries the INITIAL regVal; on a 2-D mesh each
+    model shard holds only its weight block, so the probe regVal must
+    psum over the model axis — a warm-started regularized run previously
+    recorded one block's share."""
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+    from tpu_sgd.parallel.model_parallel import dp_mp_optimize
+
+    X, y, _ = linear_data(512, 16, seed=23)
+    w0 = (0.5 * np.ones(16, np.float32))  # warm start, reg_val0 > 0
+    cfg = SGDConfig(step_size=0.1, num_iterations=5, convergence_tol=0.0,
+                    reg_param=0.3)
+    opt = GradientDescent(LeastSquaresGradient(), SquaredL2Updater(), cfg)
+    _, h_single = opt.optimize_with_history((X, y), w0)
+    mesh = make_mesh(n_data=4, n_model=2)
+    _, h_2d, _ = dp_mp_optimize(
+        LeastSquaresGradient(), SquaredL2Updater(), cfg, mesh, w0, X, y)
+    np.testing.assert_allclose(np.asarray(h_2d)[0], h_single[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_as_data_mesh_flattens_trivial_axes():
+    """The canonical make_mesh shape is 2-D with model=1; the data-only
+    builders must accept it (flattened) and reject a REAL model axis."""
+    from tpu_sgd.parallel.mesh import as_data_mesh
+
+    m = make_mesh(n_data=8, n_model=1)
+    flat = as_data_mesh(m)
+    assert dict(flat.shape) == {"data": 8}
+    assert as_data_mesh(flat) is flat  # already 1-D: passthrough
+    with pytest.raises(NotImplementedError):
+        as_data_mesh(make_mesh(n_data=4, n_model=2))
+
+
+def test_normal_streamed_accepts_trivial_model_mesh():
+    """NormalEquations' streamed totals route must work on the canonical
+    2-D mesh with a trivial model axis (it previously raised
+    NotImplementedError exactly on production-sized runs)."""
+    from tpu_sgd import NormalEquations
+
+    X, y, _ = linear_data(4096, 8, seed=29)
+    opt = (NormalEquations(reg_param=0.01)
+           .set_mesh(make_mesh(n_data=8, n_model=1))
+           .set_host_streaming(True))
+    w = opt.optimize((np.asarray(X), np.asarray(y)), np.zeros(8, np.float32))
+    ref = (NormalEquations(reg_param=0.01)
+           .set_host_streaming(True)
+           .optimize((np.asarray(X), np.asarray(y)),
+                     np.zeros(8, np.float32)))
+    np.testing.assert_allclose(np.asarray(w), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

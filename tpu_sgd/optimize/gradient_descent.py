@@ -207,6 +207,11 @@ def make_run(
         _, reg_val0 = updater.compute(
             w0, jnp.zeros_like(w0), 0.0, jnp.asarray(1, jnp.int32), cfg.reg_param
         )
+        if model_axis_name is not None:
+            # the reg value sums over FEATURES, and each model shard holds
+            # only its block of w0 — combine like make_step's new_reg, or
+            # a warm-started 2-D run records a block-local iteration-1 loss
+            reg_val0 = jax.lax.psum(reg_val0, model_axis_name)
         losses0 = jnp.full((cfg.num_iterations,), jnp.nan, jnp.float32)
 
         def cond(carry):

@@ -69,9 +69,18 @@ def _solve(A, b, yty, n, reg_param: float):
         lower=True,
         transpose_a=True,
     )[:, 0]
+    # HIGHEST-precision loss dots (ops/gram.py contract): near the optimum
+    # the loss is the near-zero difference of ~||y||^2-magnitude terms,
+    # and TPU default-precision (bf16-pass) dots would report garbage —
+    # the streamed path accumulates its totals at HIGHEST only to throw
+    # that precision away here otherwise
+    from tpu_sgd.ops.gram import _dot_hi
+
+    sd = A.dtype
     loss = (
-        0.5 * (jnp.dot(w, A @ w) - 2.0 * jnp.dot(w, b) + yty) / n
-        + 0.5 * reg_param * jnp.dot(w, w)
+        0.5 * (_dot_hi(w, _dot_hi(A, w, sd), sd) - 2.0 * _dot_hi(w, b, sd)
+               + yty) / n
+        + 0.5 * reg_param * _dot_hi(w, w, sd)
     )
     return w, loss
 
@@ -223,13 +232,41 @@ class NormalEquations(Optimizer):
 
             shape = np.shape(X)
             budget, _src = device_budget()
+            multihost = False
             if self.mesh is not None:
+                from tpu_sgd.optimize.streamed_costfun import (
+                    mesh_spans_processes,
+                )
                 from tpu_sgd.parallel.mesh import DATA_AXIS
 
-                budget *= dict(self.mesh.shape).get(DATA_AXIS, 1)
+                multihost = mesh_spans_processes(self.mesh)
+                if multihost:
+                    # each process holds only ITS rows, spread over its
+                    # LOCAL devices — scaling by the global shard count
+                    # would over-commit HBM by process_count
+                    budget *= max(1, len(self.mesh.local_devices))
+                else:
+                    budget *= dict(self.mesh.shape).get(DATA_AXIS, 1)
             itemsize = np.dtype(getattr(X, "dtype", np.float32)).itemsize
             data_bytes = shape[0] * shape[1] * itemsize + shape[0] * 4.0
             stream = data_bytes > budget
+            if stream and multihost:
+                # the streamed totals builder is single-host; AUTO must
+                # not pick a path it cannot run — take the resident route
+                # and SAY that it may not fit, rather than crash later
+                # blaming a choice the user never made
+                import warnings
+
+                warnings.warn(
+                    f"data ({data_bytes / 1e9:.2f} GB/process) exceeds "
+                    f"the local-device budget ({budget / 1e9:.2f} GB) "
+                    "but the streamed totals build is single-host; "
+                    "committing resident and it may exhaust device "
+                    "memory — shrink the per-process rows or stream on "
+                    "a local mesh",
+                    RuntimeWarning, stacklevel=3,
+                )
+                stream = False
             if stream:
                 from tpu_sgd.plan import logger
 
@@ -298,9 +335,22 @@ class NormalEquations(Optimizer):
             yh = yh.astype(np.float32)
         n = Xh.shape[0]
         if self.mesh is not None:
+            from tpu_sgd.optimize.streamed_costfun import (
+                mesh_spans_processes,
+            )
             from tpu_sgd.parallel.gram_parallel import (
                 build_streamed_total_stats,
             )
+
+            if mesh_spans_processes(self.mesh):
+                # the per-device streamed builder device_puts to every
+                # mesh device, which crashes on non-addressable remote
+                # devices — fail with a real message instead
+                raise NotImplementedError(
+                    "streamed normal totals build single-host; on a "
+                    "multi-host job run the resident meshed path, or "
+                    "stream on a mesh of this process's devices"
+                )
 
             data = build_streamed_total_stats(
                 self.mesh, Xh, yh,

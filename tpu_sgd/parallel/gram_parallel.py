@@ -34,7 +34,8 @@ from tpu_sgd.config import SGDConfig
 from tpu_sgd.ops.gram import (DEFAULT_BLOCK_ROWS, GramData,
                               GramLeastSquaresGradient)
 from tpu_sgd.ops.updaters import Updater
-from tpu_sgd.parallel.mesh import DATA_AXIS, shard_map_fn
+from tpu_sgd.parallel.mesh import (DATA_AXIS, as_data_mesh,
+                                   shard_map_fn)
 
 #: leading shard axis + per-element rank of each GramData stats leaf
 _STATS_SPECS = (
@@ -150,11 +151,7 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
 
     from jax.sharding import NamedSharding
 
-    if set(mesh.shape) != {DATA_AXIS}:
-        raise NotImplementedError(
-            "streamed statistics compose with a 1-D 'data' mesh; "
-            f"got axes {tuple(mesh.shape)}"
-        )
+    mesh = as_data_mesh(mesh)  # trivial extra axes flatten; real ones raise
     k = mesh.shape[DATA_AXIS]
     n, d = Xh.shape
     n_local = n // k
@@ -232,12 +229,10 @@ def dp_virtual_gram_run_fn(
 
 
 def _validate_data_mesh(mesh):
-    if set(mesh.shape) != {DATA_AXIS}:
-        raise NotImplementedError(
-            "total statistics compose with a 1-D 'data' mesh; "
-            f"got axes {tuple(mesh.shape)}"
-        )
-    return mesh.shape[DATA_AXIS]
+    """``(mesh, k)``: the 1-D data view (the canonical 2-D mesh with a
+    TRIVIAL model axis flattens; a real model axis raises)."""
+    mesh = as_data_mesh(mesh)
+    return mesh, mesh.shape[DATA_AXIS]
 
 
 def build_sharded_total_stats(mesh, Xd, yd,
@@ -259,7 +254,7 @@ def build_sharded_total_stats(mesh, Xd, yd,
 
     import numpy as np
 
-    k = _validate_data_mesh(mesh)
+    mesh, k = _validate_data_mesh(mesh)
     # Host inputs stay numpy until shard_dataset places each shard on its
     # own device — jnp.asarray here would stage the whole (possibly
     # beyond-one-HBM) matrix through the default device first.
@@ -319,7 +314,7 @@ def build_streamed_total_stats(mesh, Xh, yh,
     """
     import numpy as np
 
-    k = _validate_data_mesh(mesh)
+    mesh, k = _validate_data_mesh(mesh)
     Xh = np.asarray(Xh)
     yh = np.asarray(yh)
     n, d = Xh.shape

@@ -54,6 +54,26 @@ def has_model_axis(mesh) -> bool:
     return mesh is not None and dict(mesh.shape).get(MODEL_AXIS, 1) > 1
 
 
+def as_data_mesh(mesh):
+    """The 1-D data view of a mesh: a data-only mesh passes through;
+    TRIVIAL (size-1) extra axes are flattened away — the canonical
+    ``make_mesh``/``MeshConfig`` shape is 2-D with ``model=1``, and the
+    data-only builders must accept it rather than raise; a genuinely
+    sharded extra axis raises the builders' NotImplementedError."""
+    if mesh is None or set(mesh.shape) == {DATA_AXIS}:
+        return mesh
+    extra = {k: v for k, v in dict(mesh.shape).items() if k != DATA_AXIS}
+    if DATA_AXIS in dict(mesh.shape) and all(v == 1 for v in extra.values()):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(mesh.devices).reshape(-1), (DATA_AXIS,))
+    raise NotImplementedError(
+        f"this operation composes with a 1-D '{DATA_AXIS}' mesh; "
+        f"got axes {tuple(mesh.shape)}"
+    )
+
+
 def shard_map_fn(mesh, fn, in_specs, out_specs, check_vma=False):
     """Version-tolerant shard_map wrapper (jax.shard_map vs experimental)."""
     try:

@@ -84,7 +84,8 @@ class JsonLinesEventLog(SGDListener):
         self._f = open(path, "a")
 
     def _write(self, kind: str, payload: dict):
-        self._f.write(json.dumps({"kind": kind, "ts": time.time(), **payload}) + "\n")
+        self._f.write(json.dumps({"kind": kind, "ts": time.time(),
+                                  **payload}, default=float) + "\n")
         self._f.flush()
 
     def on_run_start(self, config):
@@ -127,16 +128,23 @@ class StepTimer:
         import jax
 
         t0 = time.perf_counter()
-        out = fn(*args, **kwargs)
-        out = jax.block_until_ready(out)
-        self.times.append(time.perf_counter() - t0)
+        try:
+            out = fn(*args, **kwargs)
+            out = jax.block_until_ready(out)
+        finally:
+            # same contract as time(): failed work still spent the clock
+            self.times.append(time.perf_counter() - t0)
         return out
 
     @contextlib.contextmanager
     def time(self):
         t0 = time.perf_counter()
-        yield
-        self.times.append(time.perf_counter() - t0)
+        try:
+            yield
+        finally:
+            # a raising timed block still spent the wall clock; dropping
+            # it would skew mean_s optimistic
+            self.times.append(time.perf_counter() - t0)
 
     @property
     def mean_s(self) -> float:

@@ -21,23 +21,53 @@ FORMAT_VERSION = "1.0"
 
 def save_glm_model(path: str, model) -> None:
     """Persist a GLM model directory: ``metadata.json`` + ``data.npz``."""
+    import glob as _glob
+    import uuid
+
     os.makedirs(path, exist_ok=True)
+    for stale in _glob.glob(os.path.join(path, ".*.tmp")):
+        try:  # a crash mid-save orphaned these; sweep before writing
+            os.remove(stale)
+        except OSError:
+            pass
     weights = np.asarray(model.weights)
+    save_id = uuid.uuid4().hex
     meta = {
         "class": type(model).__name__,
         "version": FORMAT_VERSION,
         "numFeatures": int(getattr(model, "num_features", weights.shape[-1])),
         "intercept": float(model.intercept),
         "threshold": getattr(model, "threshold", None),
+        "saveId": save_id,
     }
     if hasattr(model, "num_classes"):
         meta["numClasses"] = int(model.num_classes)
         meta["hasInterceptColumn"] = bool(
             getattr(model, "has_intercept_column", False)
         )
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(meta, f)
-    np.savez(os.path.join(path, "data.npz"), weights=weights)
+    # tmp + fsync + atomic rename per file (the checkpoint manager's
+    # durability pattern), with a shared saveId as the cross-file
+    # transaction marker: each file is torn-proof on its own, and a
+    # crash BETWEEN the two replaces (new weights + stale metadata)
+    # surfaces as a clear mismatch error at load instead of silently
+    # returning the wrong intercept/threshold with the new weights
+    def _durable_write(name, writer):
+        final = os.path.join(path, name)
+        tmp = os.path.join(path, "." + name + ".tmp")
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    _durable_write(
+        "data.npz",
+        lambda f: np.savez(f, weights=weights,
+                           save_id=np.asarray(save_id)),
+    )
+    _durable_write(
+        "metadata.json", lambda f: f.write(json.dumps(meta).encode())
+    )
 
 
 def load_glm_model(path: str, cls, strict_class: bool = True):
@@ -53,6 +83,13 @@ def load_glm_model(path: str, cls, strict_class: bool = True):
             f"model at {path} is a {meta['class']}, expected {cls.__name__}"
         )
     data = np.load(os.path.join(path, "data.npz"))
+    if "save_id" in data.files and "saveId" in meta:
+        if str(data["save_id"]) != meta["saveId"]:
+            raise ValueError(
+                f"model directory {path!r} is torn: metadata.json and "
+                "data.npz come from different saves (a crash interrupted "
+                "an overwrite) — re-save the model"
+            )
     import inspect
 
     accepts_classes = "num_classes" in inspect.signature(cls.__init__).parameters
