@@ -190,3 +190,17 @@ class TestModelIntegration:
         scores = np.asarray(model.predict(X))
         m = BinaryClassificationMetrics(scores, y)
         assert m.area_under_roc > 0.95
+
+
+def test_binary_metrics_rejects_plus_minus_one_labels():
+    """LIBSVM's -1/+1 convention must raise clearly — each negative would
+    otherwise count as 2 false positives and every curve silently skews."""
+    with pytest.raises(ValueError, match="map -1/\\+1"):
+        BinaryClassificationMetrics([0.9, 0.1, 0.8], [1.0, -1.0, 1.0])
+
+
+def test_multiclass_metrics_rejects_fractional_classes():
+    """astype(int32) would floor 0.7 and 1.2 into the wrong bins and
+    report perfect accuracy for all-wrong predictions."""
+    with pytest.raises(ValueError, match="integers"):
+        MulticlassMetrics([0.7, 1.2], [0.2, 1.9], num_classes=2)

@@ -386,3 +386,52 @@ def test_save_labeled_points_refuses_existing(tmp_path):
     out.mkdir()
     with pytest.raises(FileExistsError):
         save_labeled_points(str(out), [], num_partitions=2)
+
+
+def test_libsvm_save_round_trips_float32_exactly(tmp_path):
+    """%.9g writes full float32 precision: save-then-load must reproduce
+    every value bitwise (the old %.6g perturbed each by ~1e-6)."""
+    X = np.asarray([[0.123456789, 0.0], [1e-38, 3.14159274]], np.float32)
+    y = np.asarray([0.333333343, 1.0], np.float32)
+    p = str(tmp_path / "rt")
+    save_as_libsvm_file(p, X, y)
+    X2, y2 = load_libsvm_file(p)
+    np.testing.assert_array_equal(X2.astype(np.float32), X)
+    np.testing.assert_array_equal(y2.astype(np.float32), y)
+
+
+def test_libsvm_load_rejects_duplicate_indices(tmp_path):
+    """One file must not load to three different matrices: dense was
+    last-wins, CSR kept both entries (summing in matvecs)."""
+    p = tmp_path / "dup.txt"
+    p.write_text("1 2:1.0 2:3.0\n")
+    with pytest.raises(ValueError, match="duplicate feature index 2"):
+        load_libsvm_file(str(p))
+    with pytest.raises(ValueError, match="duplicate feature index 2"):
+        load_libsvm_file(str(p), dense=False)
+
+
+def test_sgd_config_direct_construction_validates():
+    """replace()/direct construction must enforce the same ranges the
+    fluent setters do (frac=0 silently trains nothing)."""
+    from tpu_sgd.config import SGDConfig
+
+    with pytest.raises(ValueError, match="mini_batch_fraction"):
+        SGDConfig(mini_batch_fraction=0.0)
+    with pytest.raises(ValueError, match="num_iterations"):
+        SGDConfig(num_iterations=0)
+    with pytest.raises(ValueError, match="step_size"):
+        SGDConfig(step_size=-1.0)
+    with pytest.raises(ValueError, match="convergence_tol"):
+        SGDConfig().replace(convergence_tol=-0.1)
+
+
+def test_vectors_parse_rejects_corrupt_sparse_text():
+    """np.fromstring silently truncated at the first bad token; the
+    strict parse raises like the dense branch."""
+    from tpu_sgd.linalg import Vectors
+
+    with pytest.raises(ValueError):
+        Vectors.parse("(3,[0,x],[1,x])")
+    v = Vectors.parse("(3,[0,2],[1.5,2.5])")
+    assert v.size == 3 and list(np.asarray(v.indices)) == [0, 2]

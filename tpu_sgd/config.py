@@ -57,10 +57,35 @@ class SGDConfig:
     sampling: str = "bernoulli"
 
     def __post_init__(self):
+        # the same range checks the fluent setters enforce: direct
+        # construction and replace() must not smuggle in values that
+        # silently train wrong (frac=0 samples empty batches forever)
         if self.sampling not in ("bernoulli", "indexed", "sliced"):
             raise ValueError(
                 "sampling must be 'bernoulli', 'indexed' or 'sliced', "
                 f"got {self.sampling!r}"
+            )
+        if not (0.0 < self.mini_batch_fraction <= 1.0):
+            raise ValueError(
+                "mini_batch_fraction must be in (0, 1], got "
+                f"{self.mini_batch_fraction}"
+            )
+        if self.num_iterations < 1:
+            raise ValueError(
+                f"num_iterations must be >= 1, got {self.num_iterations}"
+            )
+        if self.step_size <= 0.0:
+            raise ValueError(
+                f"step_size must be positive, got {self.step_size}"
+            )
+        if self.reg_param < 0.0:
+            raise ValueError(
+                f"reg_param must be >= 0, got {self.reg_param}"
+            )
+        if not (0.0 <= self.convergence_tol <= 1.0):
+            raise ValueError(
+                "convergence_tol must be in [0, 1], got "
+                f"{self.convergence_tol}"
             )
 
     def replace(self, **kwargs) -> "SGDConfig":

@@ -86,8 +86,12 @@ def _binary_curves(scores, labels):
     order = jnp.argsort(-scores)
     s = scores[order]
     pos = labels[order]
-    cum_tp = jnp.cumsum(pos)
-    cum_fp = jnp.cumsum(1.0 - pos)
+    # integer count accumulation: float32 cumsum silently stops
+    # incrementing at 2^24 examples of one class (counts are exact in
+    # int32 to 2^31; downstream ratios cast to f32 after)
+    pos_i = (pos > 0.5).astype(jnp.int32)
+    cum_tp = jnp.cumsum(pos_i).astype(jnp.float32)
+    cum_fp = jnp.cumsum(1 - pos_i).astype(jnp.float32)
     idx = jnp.arange(n)
     boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
     group_end = jax.lax.cummin(
@@ -121,6 +125,17 @@ class BinaryClassificationMetrics:
             raise ValueError("empty input")
         if num_bins < 0:
             raise ValueError(f"num_bins must be >= 0, got {num_bins}")
+        lv = np.asarray(labels)
+        bad = (lv != 0.0) & (lv != 1.0)
+        if bad.any():
+            # LIBSVM files commonly carry -1/+1: cum_fp would count each
+            # negative as 2 and num_pos as pos-neg, making every curve
+            # and AUC silently wrong
+            raise ValueError(
+                "labels must be 0/1; found "
+                f"{np.unique(lv[bad])[:5]} (map -1/+1 labels first, "
+                "e.g. y = (y > 0).astype('float32'))"
+            )
         s, cum_tp, cum_fp, boundary = _binary_curves(scores, labels)
         self._num_pos = float(cum_tp[-1])
         self._num_neg = float(cum_fp[-1])
@@ -204,11 +219,13 @@ class BinaryClassificationMetrics:
 @partial(jax.jit, static_argnums=(2,))
 def _confusion(pred, obs, k):
     flat = obs.astype(jnp.int32) * k + pred.astype(jnp.int32)
+    # int32 cells: float32 scatter-adds stop counting at 2^24 per cell
     return (
-        jnp.zeros((k * k,), jnp.float32)
+        jnp.zeros((k * k,), jnp.int32)
         .at[flat]
-        .add(1.0, mode="drop")
+        .add(1, mode="drop")
         .reshape(k, k)
+        .astype(jnp.float32)
     )
 
 
@@ -231,13 +248,14 @@ class MulticlassMetrics:
         k = int(num_classes) if num_classes > 0 else int(
             max(pred.max(), obs.max())
         ) + 1
-        bad = (pred < 0) | (pred >= k) | (obs < 0) | (obs >= k)
+        bad = ((pred < 0) | (pred >= k) | (obs < 0) | (obs >= k)
+               | (pred != np.floor(pred)) | (obs != np.floor(obs)))
         if bad.any():
             # Silent scatter-drop would deflate accuracy while _n still
             # counts the sample; the reference includes every observed
             # label, so out-of-range input is a caller error here.
             raise ValueError(
-                f"labels/predictions must lie in [0, {k}); found "
+                f"labels/predictions must be integers in [0, {k}); found "
                 f"{np.unique(np.concatenate([pred[bad], obs[bad]]))[:5]}"
             )
         self.num_classes = k

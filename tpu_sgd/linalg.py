@@ -128,10 +128,22 @@ class Vectors:
             idx_str = rest[li + 1:ri]
             val_part = rest[ri + 1:]
             vals_str = val_part[val_part.index("[") + 1:val_part.index("]")]
-            idx = (np.fromstring(idx_str, sep=",", dtype=np.int64)
-                   if idx_str.strip() else np.zeros((0,), np.int64))
-            vals = (np.fromstring(vals_str, sep=",", dtype=np.float32)
-                    if vals_str.strip() else np.zeros((0,), np.float32))
+            # strict token-wise parse, like the dense branch: fromstring
+            # silently TRUNCATES at the first corrupt token, loading
+            # wrong shorter vectors from a damaged file with no error
+            idx = np.asarray(
+                [int(t) for t in idx_str.split(",") if t.strip()],
+                np.int64,
+            )
+            vals = np.asarray(
+                [float(t) for t in vals_str.split(",") if t.strip()],
+                np.float32,
+            )
+            if idx.shape[0] != vals.shape[0]:
+                raise ValueError(
+                    f"sparse vector text has {idx.shape[0]} indices but "
+                    f"{vals.shape[0]} values: {s!r}"
+                )
             return SparseVector(int(size_str), idx, vals)
         raise ValueError(f"cannot parse vector text {s!r}")
 
@@ -141,7 +153,12 @@ class BLAS:
 
     @staticmethod
     def dot(x: Vector, y: Vector) -> float:
-        xv = _values_of(x, getattr(x, "size", None) or len(x))
+        size = getattr(x, "size", None)
+        if size is None:  # a falsy-or would send size-0 vectors to len()
+            size = len(x)
+        xv = _values_of(x, size)
+        # empty @ empty is already 0.0; empty @ non-empty must keep
+        # raising (a silent 0.0 would mask the caller's shape bug)
         return float(xv @ _values_of(y, xv.shape[0]))
 
     @staticmethod

@@ -137,7 +137,11 @@ def _pearson(X):
     n = X.shape[0]
     Xc = X - jnp.mean(X, axis=0)
     # One MXU Gram pass replaces the reference's pairwise column cogroup.
-    cov = (Xc.T @ Xc) / jnp.maximum(n - 1, 1)
+    # HIGHEST precision: the TPU default runs bf16 passes and puts ~5e-4
+    # absolute error into every correlation entry (measured), while the
+    # sparse path computes at 1e-7 — the two corr() paths must agree.
+    cov = jnp.dot(Xc.T, Xc,
+                  precision=jax.lax.Precision.HIGHEST) / jnp.maximum(n - 1, 1)
     sd = jnp.sqrt(jnp.diag(cov))
     denom = jnp.outer(sd, sd)
     corr = jnp.where(denom > 0, cov / jnp.maximum(denom, 1e-38), jnp.nan)

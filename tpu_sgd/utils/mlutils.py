@@ -128,12 +128,26 @@ def load_libsvm_file(
         max_idx = max(p[4] for p in parts)
     d = num_features if num_features is not None else max_idx
     n = labels.shape[0]
+    if rows.size:
+        order0 = np.lexsort((cols, rows))
+        rs, cs = rows[order0], cols[order0]
+        dup = (rs[1:] == rs[:-1]) & (cs[1:] == cs[:-1])
+        if dup.any():
+            # the dense path would silently last-win while the CSR/BCOO
+            # path kept BOTH entries (summing in matvecs) — one file,
+            # three different matrices; the reference rejects it too
+            j = int(np.nonzero(dup)[0][0])
+            raise ValueError(
+                f"duplicate feature index {int(cs[j]) + 1} on data line "
+                f"{int(rs[j]) + 1} (LIBSVM rows need unique indices)"
+            )
     if dense:
         X = np.zeros((n, d), dtype)
         X[rows, cols] = vals
         return X, labels
-    # CSR without scipy
-    order = np.lexsort((cols, rows))
+    # CSR without scipy (order0 computed by the duplicate check above;
+    # rows/cols are unchanged since)
+    order = order0 if rows.size else np.zeros((0,), np.int64)
     rows, cols, vals = rows[order], cols[order], vals[order]
     indptr = np.zeros((n + 1,), np.int64)
     np.add.at(indptr, rows + 1, 1)
@@ -205,17 +219,17 @@ def save_as_libsvm_file(path: str, X, y: np.ndarray,
         with open(path, "w") as f:
             for i in range(n):
                 feats = " ".join(
-                    f"{cols_l[k] + 1}:{vals_l[k]:.6g}"
+                    f"{cols_l[k] + 1}:{vals_l[k]:.9g}"
                     for k in range(starts[i], ends[i])
                 )
-                f.write(f"{y_l[i]:.6g} {feats}\n")
+                f.write(f"{y_l[i]:.9g} {feats}\n")
         return
     X = np.asarray(X)
     with open(path, "w") as f:
         for i in range(X.shape[0]):
             nz = np.nonzero(X[i])[0]
-            feats = " ".join(f"{j + 1}:{X[i, j]:.6g}" for j in nz)
-            f.write(f"{y[i]:.6g} {feats}\n")
+            feats = " ".join(f"{j + 1}:{X[i, j]:.9g}" for j in nz)
+            f.write(f"{y[i]:.9g} {feats}\n")
 
 
 def load_labeled_points(path: str):
@@ -259,14 +273,14 @@ def save_labeled_points(path: str, points, num_partitions: int = 1) -> None:
             feats = lp.features
             if isinstance(feats, SparseVector):
                 idx = ",".join(str(int(i)) for i in feats.indices)
-                val = ",".join(f"{float(v):.6g}" for v in feats.values)
-                f.write(f"({lp.label:.6g},({feats.size},[{idx}],[{val}]))\n")
+                val = ",".join(f"{float(v):.9g}" for v in feats.values)
+                f.write(f"({lp.label:.9g},({feats.size},[{idx}],[{val}]))\n")
             else:
                 arr = np.asarray(
                     feats.to_array() if hasattr(feats, "to_array") else feats
                 ).ravel()
-                body = ",".join(f"{float(v):.6g}" for v in arr)
-                f.write(f"({lp.label:.6g},[{body}])\n")
+                body = ",".join(f"{float(v):.9g}" for v in arr)
+                f.write(f"({lp.label:.9g},[{body}])\n")
 
 
 def _take_rows(X, idx):

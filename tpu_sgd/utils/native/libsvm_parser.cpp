@@ -94,7 +94,16 @@ int64_t parse_libsvm_fill(const char* path, float* labels, int64_t* rows,
         if (after == cur || *after != ':') return -2;  // malformed token
         if (idx < 1) return -3;                        // 1-based on disk
         cur = after + 1;
+        char* vstart = cur;
+        if (*vstart == ' ' || *vstart == '\t') return -2;  // "5: 2.0" —
+                                       // strtof would skip the space and
+                                       // eat the NEXT token
         float v = std::strtof(cur, &cur);
+        if (cur == vstart) return -2;  // empty value token ("5:"): the
+                                       // Python parser raises; accepting
+                                       // 0.0 here would make corrupt
+                                       // files load only when the .so
+                                       // happens to be built
         rows[k] = row;
         cols[k] = idx - 1;
         vals[k] = v;
