@@ -277,3 +277,27 @@ def test_streaming_resume_family_mismatch_warns(tmp_path):
         StreamingLogisticRegressionWithSGD.resume_from(str(tmp_path))
     assert any("construct the same streaming" in str(r.message)
                for r in rec)
+
+
+def test_checkpoint_history_tail_bounds_persisted_history(tmp_path, rng):
+    """history_tail caps per-checkpoint serialization for unbounded
+    streams (full-history default stays bitwise; the tail trades the
+    resumed history's head for O(N) instead of O(N^2) cumulative I/O)."""
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+
+    alg = (StreamingLinearRegressionWithSGD(step_size=0.3,
+                                            num_iterations=5)
+           .set_initial_weights(np.zeros(4, np.float32))
+           .set_checkpoint(str(tmp_path / "ck"), every=1, history_tail=3))
+    w = rng.uniform(-1, 1, 4).astype(np.float32)
+    for i in range(6):
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X @ w).astype(np.float32)
+        alg.train_on_batch(X, y)
+    assert len(alg.loss_history) == 6  # in-memory history stays full
+    st = CheckpointManager(str(tmp_path / "ck")).restore()
+    assert st["iteration"] == 6
+    assert len(st["loss_history"]) == 3  # persisted history bounded
+    with pytest.raises(ValueError, match="history_tail"):
+        StreamingLinearRegressionWithSGD().set_checkpoint(
+            str(tmp_path / "ck2"), history_tail=0)

@@ -43,6 +43,7 @@ class StreamingLinearAlgorithm:
         self.loss_history: list = []
         self.checkpoint_manager = None
         self.checkpoint_every = 1
+        self.checkpoint_history_tail = None
         self._resume_skip = 0
 
     def latest_model(self) -> GeneralizedLinearModel:
@@ -59,12 +60,21 @@ class StreamingLinearAlgorithm:
         )
         return self
 
-    def set_checkpoint(self, manager_or_directory, every: int = 1):
+    def set_checkpoint(self, manager_or_directory, every: int = 1,
+                       history_tail: int = None):
         """Persist (latest model, batch index, cumulative loss history)
         every ``every`` micro-batches — the DStream-checkpointing analogue
         (SURVEY.md §5.4c): kill the driver mid-stream and
         :meth:`resume_from` restarts from the newest checkpoint.  Accepts
-        a ``CheckpointManager`` or a directory path."""
+        a ``CheckpointManager`` or a directory path.
+
+        ``history_tail`` bounds the persisted loss history to its last N
+        entries.  The default (None, full history) keeps resume BITWISE
+        identical to the uninterrupted run — but re-serializes the whole
+        unbounded history every checkpoint, which is O(N²) cumulative
+        I/O over a long-lived stream; an UNBOUNDED stream with frequent
+        checkpoints should set a tail (the resumed run's history then
+        starts at the tail, weights still exact)."""
         import os
 
         from tpu_sgd.utils.checkpoint import CheckpointManager
@@ -74,6 +84,12 @@ class StreamingLinearAlgorithm:
                 str(manager_or_directory))
         self.checkpoint_manager = manager_or_directory
         self.checkpoint_every = max(1, int(every))
+        if history_tail is not None and int(history_tail) < 1:
+            raise ValueError(
+                f"history_tail must be positive, got {history_tail}"
+            )
+        self.checkpoint_history_tail = (
+            None if history_tail is None else int(history_tail))
         return self
 
     @classmethod
@@ -134,7 +150,12 @@ class StreamingLinearAlgorithm:
                 self._batch_count,  # = batches consumed (stream position)
                 np.asarray(m.weights),
                 0.0,
-                np.asarray(self.loss_history, np.float64),
+                np.asarray(
+                    self.loss_history if self.checkpoint_history_tail
+                    is None
+                    else self.loss_history[-self.checkpoint_history_tail:],
+                    np.float64,
+                ),
                 config_key=f"stream:{type(self.algorithm).__name__}",
                 extras={
                     "intercept": np.asarray(m.intercept, np.float64),

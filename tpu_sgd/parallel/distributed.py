@@ -37,6 +37,13 @@ def initialize_distributed(
     other platforms pass them explicitly.  The DCN transport underneath is
     the functional replacement for the reference's Netty RPC fabric.
     """
+    if jax.distributed.is_initialized():
+        # TRUE no-op, not error-message matching: once any computation
+        # has run, a second initialize() raises a message ("must be
+        # called before any JAX calls...") that matching would re-raise
+        # — breaking the idempotent contract exactly when a second
+        # entry point defensively re-initializes mid-job
+        return
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -46,12 +53,7 @@ def initialize_distributed(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
-    try:
-        jax.distributed.initialize(**kwargs)
-    except RuntimeError as e:  # double-init is fine (idempotent contract)
-        msg = str(e).lower()
-        if "already initialized" not in msg and "only be called once" not in msg:
-            raise
+    jax.distributed.initialize(**kwargs)
 
 
 def global_data_mesh():
