@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Microbenchmark: serving throughput + tail latency under offered load.
+
+Drives the tpu_sgd.serve endpoint (micro-batcher + bucketed compiled
+predict) with an open-loop request generator at three offered-load
+levels, and reports per level:
+
+  * achieved throughput (rows/sec completed),
+  * p50 / p99 end-to-end latency (submit -> result, ms),
+  * reject count (backpressure sheds, not silent drops),
+  * mean coalesced batch size (how well the batcher amortizes calls).
+
+Writes ``BENCH_SERVE.json`` (same driver-style shape as BENCH_r0*.json:
+a ``parsed`` one-line result plus diagnostics) and prints ONE JSON line
+on stdout; diagnostics go to stderr.
+
+Env knobs: BENCH_SERVE_DIM (default 64), BENCH_SERVE_SECONDS per level
+(default 2.0), BENCH_SERVE_LOADS (comma rps list, default
+"500,2500,10000").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+DIM = int(os.environ.get("BENCH_SERVE_DIM", "64"))
+SECONDS = float(os.environ.get("BENCH_SERVE_SECONDS", "2.0"))
+LOADS = [
+    int(v) for v in os.environ.get(
+        "BENCH_SERVE_LOADS", "500,2500,10000"
+    ).split(",")
+]
+MAX_LATENCY_S = float(os.environ.get("BENCH_SERVE_MAX_LATENCY", "0.002"))
+MAX_QUEUE = int(os.environ.get("BENCH_SERVE_MAX_QUEUE", "4096"))
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_level(server, rows, offered_rps: float, seconds: float) -> dict:
+    """Open-loop load: submit single-row requests on a fixed schedule
+    (bursting to catch up after GIL stalls), collect completion latencies
+    from the futures."""
+    from tpu_sgd.serve import BackpressureError
+
+    n_rows = rows.shape[0]
+    latencies, futures = [], []
+    rejects = submitted = 0
+    # credit-based pacing with bounded bursts: sleeping between bursts
+    # keeps the flush thread scheduled (an uncapped catch-up loop would
+    # monopolize the GIL/queue lock and measure its own convoy, not the
+    # server), and the credit cap sheds arrivals the generator itself
+    # fell behind on rather than compounding them into a thundering herd
+    tick = 0.002
+    max_credit = offered_rps * 0.05  # at most 50 ms of backlogged arrivals
+    t_start = time.perf_counter()
+    deadline = t_start + seconds
+    t_last = t_start
+    credit = 0.0
+    i = 0
+    while True:
+        time.sleep(tick)
+        now = time.perf_counter()
+        if now >= deadline:
+            break
+        credit = min(credit + (now - t_last) * offered_rps, max_credit)
+        t_last = now
+        while credit >= 1.0:
+            credit -= 1.0
+            t_sub = time.perf_counter()
+            try:
+                fut = server.submit(rows[i % n_rows])
+            except BackpressureError:
+                rejects += 1
+            else:
+                submitted += 1
+                fut.add_done_callback(
+                    lambda f, t=t_sub: latencies.append(
+                        time.perf_counter() - t)
+                )
+                futures.append(fut)
+            i += 1
+    # drain: wait for everything submitted to resolve
+    done = 0
+    for fut in futures:
+        try:
+            fut.result(timeout=30)
+            done += 1
+        except Exception:
+            pass
+    # result() wakes before done-callbacks run, so give the flush
+    # thread's latency-recording callbacks a moment to finish tallying
+    t_wait = time.perf_counter() + 5.0
+    while len(latencies) < done and time.perf_counter() < t_wait:
+        time.sleep(0.001)
+    elapsed = time.perf_counter() - t_start
+    lat = np.sort(np.asarray(latencies)) if latencies else np.zeros(1)
+
+    def pct(p):
+        return float(lat[min(len(lat) - 1, int(p / 100.0 * len(lat)))])
+
+    return {
+        "offered_rps": offered_rps,
+        "achieved_rps": round(len(latencies) / elapsed, 1),
+        "submitted": submitted,
+        "rejects": rejects,
+        "p50_ms": round(pct(50) * 1e3, 3),
+        "p99_ms": round(pct(99) * 1e3, 3),
+    }
+
+
+def main() -> int:
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import Server
+
+    rng = np.random.default_rng(0)
+    model = LinearRegressionModel(
+        rng.normal(size=DIM).astype(np.float32), 0.1
+    )
+    rows = rng.normal(size=(1024, DIM)).astype(np.float32)
+
+    server = Server(
+        model, max_latency_s=MAX_LATENCY_S, max_queue=MAX_QUEUE,
+        max_batch=256,
+    )
+    # warm the compiled bucket programs so measurement never pays XLA
+    # compile time (a real endpoint warms at deploy, not per request)
+    for b in server.engine.buckets:
+        server.engine.predict_batch(model, rows[:b])
+    log(f"warmed {server.engine.compile_count} compiled programs "
+        f"(buckets {server.engine.buckets})")
+
+    levels = []
+    with server:
+        # prime the queued path end-to-end (first flush pays one-time
+        # lazy imports — jax.experimental.sparse via stack_rows — which
+        # would otherwise stall the first measured level by ~1s)
+        server.predict(rows[0], timeout=30)
+        for rps in LOADS:
+            before_batches = server.batcher.batch_count
+            before_reqs = server.metrics.snapshot()["total_requests"]
+            res = run_level(server, rows, rps, SECONDS)
+            snap = server.metrics.snapshot()
+            d_batches = server.batcher.batch_count - before_batches
+            d_reqs = snap["total_requests"] - before_reqs
+            res["mean_batch_size"] = round(
+                d_reqs / d_batches, 2) if d_batches else 0.0
+            levels.append(res)
+            log(f"offered {rps} rps: achieved {res['achieved_rps']} rows/s, "
+                f"p50 {res['p50_ms']} ms, p99 {res['p99_ms']} ms, "
+                f"rejects {res['rejects']}, "
+                f"mean batch {res['mean_batch_size']}")
+
+    top = max(levels, key=lambda r: r["achieved_rps"])
+    parsed = {
+        "metric": f"serve_rows_per_sec_dense_{DIM}d",
+        "value": top["achieved_rps"],
+        "unit": "rows/sec",
+        "p99_ms_at_peak": top["p99_ms"],
+    }
+    result = {
+        "cmd": "python bench_serving.py",
+        "rc": 0,
+        "dim": DIM,
+        "seconds_per_level": SECONDS,
+        "max_latency_s": MAX_LATENCY_S,
+        "levels": levels,
+        "parsed": parsed,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_SERVE.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(parsed))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(main())

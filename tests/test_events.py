@@ -1,0 +1,129 @@
+"""Observability layer (tpu_sgd/utils/events.py): JSONL event log
+round-trip, listener dispatch, StepTimer semantics."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.utils.events import (
+    CollectingListener,
+    IterationEvent,
+    JsonLinesEventLog,
+    RunEvent,
+    ServeBatchEvent,
+    ServeReloadEvent,
+    StepTimer,
+)
+
+
+def _iteration(i=1):
+    return IterationEvent(
+        iteration=i, loss=0.5 / i, weight_delta_norm=0.1,
+        mini_batch_size=128, wall_time_s=0.002,
+    )
+
+
+def test_jsonl_event_log_round_trip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = JsonLinesEventLog(path)
+
+    class _Cfg:
+        """Minimal dataclass stand-in for on_run_start's asdict(config)."""
+    import dataclasses
+    cfg = dataclasses.make_dataclass("Cfg", [("step_size", float)])(0.5)
+
+    log.on_run_start(cfg)
+    log.on_iteration(_iteration(1))
+    log.on_iteration(_iteration(2))
+    log.on_run_end(RunEvent(event="run_completed", num_iterations=2,
+                            final_loss=0.25, wall_time_s=0.01))
+    log.on_serve_batch(ServeBatchEvent(
+        queue_depth=3, batch_size=8, padded_size=8,
+        latency_s=0.004, reject_count=0, model_version=7,
+    ))
+    log.on_serve_reload(ServeReloadEvent(
+        event="reloaded", version=7, previous_version=6,
+    ))
+    log.close()
+
+    events = [json.loads(line) for line in open(path)]
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["run_started", "iteration", "iteration",
+                     "run_completed", "serve_batch", "serve_reload"]
+    assert all("ts" in e for e in events)
+    assert events[0]["config"] == {"step_size": 0.5}
+    assert events[1]["iteration"] == 1 and events[1]["loss"] == 0.5
+    assert events[3]["final_loss"] == 0.25
+    assert events[4]["batch_size"] == 8 and events[4]["model_version"] == 7
+    assert events[5]["event"] == "reloaded"
+    assert events[5]["previous_version"] == 6
+
+
+def test_jsonl_event_log_appends(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    for i in range(2):
+        log = JsonLinesEventLog(path)
+        log.on_iteration(_iteration(i + 1))
+        log.close()
+    events = [json.loads(line) for line in open(path)]
+    assert [e["iteration"] for e in events] == [1, 2]
+
+
+def test_collecting_listener_buffers_all_event_families():
+    listener = CollectingListener()
+    listener.on_run_start(None)
+    listener.on_iteration(_iteration())
+    listener.on_run_end(RunEvent(event="run_completed", num_iterations=1))
+    listener.on_serve_batch(ServeBatchEvent(
+        queue_depth=0, batch_size=1, padded_size=1,
+        latency_s=0.001, reject_count=0, model_version=-1,
+    ))
+    listener.on_serve_reload(ServeReloadEvent(event="load_failed",
+                                              version=3, error="torn"))
+    assert len(listener.iterations) == 1
+    assert [r.event for r in listener.runs] == ["run_started",
+                                                "run_completed"]
+    assert listener.serve_batches[0].batch_size == 1
+    assert listener.serve_reloads[0].error == "torn"
+
+
+def test_step_timer_timed_call_blocks_and_records():
+    import jax.numpy as jnp
+
+    timer = StepTimer()
+    out = timer.timed_call(lambda a: jnp.asarray(a) * 2.0,
+                           np.ones(4, np.float32))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.full(4, 2.0, np.float32))
+    assert len(timer.times) == 1 and timer.times[0] > 0
+    assert timer.mean_s == pytest.approx(timer.times[0])
+
+
+def test_step_timer_records_failed_calls():
+    timer = StepTimer()
+
+    def boom():
+        time.sleep(0.01)
+        raise ValueError("exploded")
+
+    with pytest.raises(ValueError):
+        timer.timed_call(boom)
+    with pytest.raises(ValueError):
+        with timer.time():
+            boom()
+    # both failures still spent wall clock; dropping them would skew mean_s
+    assert len(timer.times) == 2
+    assert all(t >= 0.01 for t in timer.times)
+
+
+def test_step_timer_context_manager_measures_block():
+    timer = StepTimer()
+    with timer.time():
+        time.sleep(0.005)
+    with timer.time():
+        time.sleep(0.005)
+    assert len(timer.times) == 2
+    assert timer.mean_s >= 0.005
+    assert StepTimer().mean_s == 0.0  # empty timer: no ZeroDivisionError

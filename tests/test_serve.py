@@ -1,0 +1,562 @@
+"""Serving subsystem (tpu_sgd/serve): dynamic micro-batching, bucketed
+compiled predict, hot model reload with rollback, and metrics events."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import (
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    MultinomialLogisticRegressionModel,
+    StreamingLinearRegressionWithSGD,
+    SVMModel,
+)
+from tpu_sgd.serve import (
+    BackpressureError,
+    MicroBatcher,
+    ModelRegistry,
+    NoModelError,
+    PredictEngine,
+    Server,
+)
+from tpu_sgd.utils import CheckpointManager, JsonLinesEventLog
+
+
+def _linear_model(rng, d=12):
+    return LinearRegressionModel(
+        rng.normal(size=d).astype(np.float32), 0.25
+    )
+
+
+# -- (a) coalescing --------------------------------------------------------
+def test_single_rows_coalesce_into_one_batch_call(rng):
+    """Requests queued before the flush thread starts coalesce into ONE
+    compiled batch call (counting wrapper on the batch fn)."""
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    calls = []
+
+    def counted(X):
+        calls.append(int(X.shape[0]))
+        return engine.predict_batch(model, X)
+
+    batcher = MicroBatcher(counted, max_batch=64, max_latency_s=0.01)
+    X = rng.normal(size=(9, 12)).astype(np.float32)
+    futs = [batcher.submit(X[i]) for i in range(9)]
+    with batcher:
+        got = np.asarray([f.result(timeout=10) for f in futs])
+    assert calls == [9]  # one coalesced call, not nine
+    np.testing.assert_array_equal(got, np.asarray(model.predict(X)))
+
+
+# -- (b) deadline flush ----------------------------------------------------
+def test_lone_request_answered_within_deadline(rng):
+    """A single queued request must flush at the max-latency deadline even
+    though the batch never fills and the queue stays empty."""
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    with MicroBatcher(
+        lambda X: engine.predict_batch(model, X),
+        max_batch=512, max_latency_s=0.05, max_queue=64,
+    ) as batcher:
+        t0 = time.perf_counter()
+        out = batcher.predict(
+            rng.normal(size=12).astype(np.float32), timeout=10
+        )
+        elapsed = time.perf_counter() - t0
+    assert np.isfinite(out)
+    # deadline (0.05s) + scoring + scheduling slack; far below the 10s
+    # future timeout, so a dead flush thread cannot pass this
+    assert elapsed < 5.0
+
+
+# -- (c) backpressure ------------------------------------------------------
+def test_full_queue_rejects_with_backpressure(rng):
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    batcher = MicroBatcher(
+        lambda X: engine.predict_batch(model, X),
+        max_batch=512, max_latency_s=1.0, max_queue=4,
+    )
+    x = rng.normal(size=12).astype(np.float32)
+    futs = [batcher.submit(x) for _ in range(4)]  # not started: queue holds
+    with pytest.raises(BackpressureError):
+        batcher.submit(x)
+    assert batcher.reject_count == 1
+    with batcher:  # drain on stop answers the queued four
+        pass
+    assert all(np.isfinite(f.result(timeout=10)) for f in futs)
+
+
+# -- (d) hot reload + rollback --------------------------------------------
+def _stream_batch(rng, w_true, n=256):
+    d = w_true.shape[0]
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = X @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+    return X, y
+
+
+def test_hot_reload_and_corrupt_rollback(rng, tmp_path):
+    d = 8
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=15)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+
+    registry = ModelRegistry(str(tmp_path), alg.algorithm.create_model)
+    alg.add_model_update_listener(registry.on_model_update)
+    server = Server(registry=registry, max_latency_s=0.005).start()
+    try:
+        x = rng.normal(size=d).astype(np.float32)
+
+        alg.train_on_batch(*_stream_batch(rng, w_true))
+        v1 = registry.current_version
+        p1 = server.predict(x, timeout=10)
+        assert p1 == pytest.approx(float(alg.latest_model().predict(x)))
+
+        # trainer publishes a new version; serving must pick it up
+        alg.train_on_batch(*_stream_batch(rng, w_true))
+        v2 = registry.current_version
+        assert v2 > v1
+        p2 = server.predict(x, timeout=10)
+        assert p2 == pytest.approx(float(alg.latest_model().predict(x)))
+        assert p2 != p1  # new weights actually serve
+
+        # a corrupt newer checkpoint must roll back to previous-good
+        bad_version = v2 + 3
+        bad = tmp_path / f"ckpt_{bad_version:08d}.npz"
+        bad.write_bytes(b"\x00garbage, not an npz")
+        assert registry.maybe_reload() is False
+        assert registry.current_version == v2
+        assert bad_version in registry.bad_versions
+        assert server.predict(x, timeout=10) == p2
+        # the bad version is never retried
+        assert registry.maybe_reload() is False
+    finally:
+        server.stop()
+
+
+def test_registry_pin_and_unpin(rng, tmp_path):
+    d = 6
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=10)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    registry = ModelRegistry(str(tmp_path), alg.algorithm.create_model)
+    alg.train_on_batch(*_stream_batch(rng, w_true))
+    alg.train_on_batch(*_stream_batch(rng, w_true))
+
+    registry.pin(1)
+    assert registry.current_version == 1 and registry.pinned
+    assert registry.maybe_reload() is False  # pinned: no auto-reload
+    assert registry.current_version == 1
+    registry.unpin()
+    assert registry.maybe_reload() is True
+    assert registry.current_version == 2
+
+    with pytest.raises(FileNotFoundError):
+        registry.pin(99)
+
+
+def test_registry_empty_directory_raises(tmp_path):
+    registry = ModelRegistry(
+        str(tmp_path), lambda w, b: LinearRegressionModel(w, b)
+    )
+    with pytest.raises(NoModelError):
+        registry.model()
+
+
+# -- (e) padded/bucketed exactness ----------------------------------------
+def test_bucketed_predictions_match_model_predict_exactly(rng):
+    """Padded bucket scoring is bitwise identical to ``model.predict`` for
+    dense float32, across all three GLM families and odd batch sizes."""
+    engine = PredictEngine()
+    d = 10
+    models = [
+        _linear_model(rng, d),
+        LogisticRegressionModel(rng.normal(size=d).astype(np.float32), -0.1),
+        SVMModel(rng.normal(size=d).astype(np.float32), 0.2),
+        MultinomialLogisticRegressionModel(
+            rng.normal(size=3 * (d + 1)).astype(np.float32),
+            0.0, num_classes=4, num_features=d + 1,
+            has_intercept_column=True,
+        ),
+    ]
+    raw = LogisticRegressionModel(
+        rng.normal(size=d).astype(np.float32), 0.3
+    ).clear_threshold()
+    models.append(raw)
+    for model in models:
+        din = d
+        for n in (1, 3, 5, 8, 31, 200, 700):  # 700 > max bucket: chunked
+            X = rng.normal(size=(n, din)).astype(np.float32)
+            got = engine.predict_batch(model, X)
+            want = np.asarray(model.predict(X))
+            np.testing.assert_array_equal(
+                got, want,
+                err_msg=f"{type(model).__name__} at n={n}",
+            )
+
+
+def test_sparse_bucketed_predictions_match(rng):
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    engine = PredictEngine()
+    d = 20
+    dense = np.where(
+        rng.random((13, d)) < 0.25, rng.normal(size=(13, d)), 0.0
+    ).astype(np.float32)
+    Xs = BCOO.fromdense(jnp.asarray(dense))
+    for model in (
+        _linear_model(rng, d),
+        LogisticRegressionModel(rng.normal(size=d).astype(np.float32), 0.1),
+        MultinomialLogisticRegressionModel(
+            rng.normal(size=2 * d).astype(np.float32), 0.0,
+            num_classes=3, num_features=d,
+        ),
+    ):
+        np.testing.assert_allclose(
+            engine.predict_batch(model, Xs),
+            np.asarray(model.predict(Xs)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+
+def test_single_sparse_vector_predict_batch(rng):
+    """A 1-D BCOO vector through the engine must match model.predict on
+    the same vector (routed through a (1, d) row matrix, not misread as
+    d rows)."""
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    d = 700  # > max bucket: also exercises the wide-vector path
+    model = _linear_model(rng, d)
+    row = BCOO.fromdense(jnp.asarray(np.where(
+        rng.random(d) < 0.05, rng.normal(size=d), 0.0
+    ).astype(np.float32)))
+    engine = PredictEngine()
+    got = engine.predict_batch(model, row)
+    assert got.shape == (1,)
+    np.testing.assert_allclose(
+        got[0], float(model.predict(row)), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_reentrant_reload_listener_does_not_deadlock(rng, tmp_path):
+    """A listener that calls back into the registry must not deadlock
+    (events are emitted after the registry lock is released)."""
+    d = 4
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+
+    registry = ModelRegistry(str(tmp_path), alg.algorithm.create_model)
+
+    class _Reentrant:
+        def record_reload(self, event):
+            registry.maybe_reload()  # would deadlock if emitted under lock
+            registry.clear_bad_versions()
+
+    registry.metrics = _Reentrant()
+    assert registry.maybe_reload() is True
+    assert registry.current_version == 1
+
+
+def test_sparse_rows_coalesce(rng):
+    import jax.numpy as jnp
+    from jax.experimental.sparse import BCOO
+
+    d = 16
+    model = _linear_model(rng, d)
+    rows = [
+        BCOO.fromdense(jnp.asarray(np.where(
+            rng.random(d) < 0.3, rng.normal(size=d), 0.0
+        ).astype(np.float32)))
+        for _ in range(5)
+    ]
+    with Server(model, max_latency_s=0.02) as server:
+        futs = [server.submit(r) for r in rows]
+        got = [f.result(timeout=10) for f in futs]
+    want = [float(model.predict(r)) for r in rows]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+# -- metrics / event log ---------------------------------------------------
+def test_serving_metrics_in_jsonl_event_log(rng, tmp_path):
+    model = _linear_model(rng)
+    path = str(tmp_path / "serve_events.jsonl")
+    log = JsonLinesEventLog(path)
+    server = Server(
+        model, max_latency_s=0.01, max_queue=2, event_log=log
+    )
+    X = rng.normal(size=(8, 12)).astype(np.float32)
+    futs = [server.submit(X[i]) for i in range(2)]
+    with pytest.raises(BackpressureError):  # queue bounded at 2
+        server.submit(X[2])
+    with server:
+        [f.result(timeout=10) for f in futs]
+    log.close()
+
+    events = [json.loads(line) for line in open(path)]
+    batches = [e for e in events if e["kind"] == "serve_batch"]
+    assert batches, "serve_batch events must be logged"
+    for e in batches:
+        for field in ("queue_depth", "batch_size", "padded_size",
+                      "latency_s", "reject_count", "model_version"):
+            assert field in e
+    assert batches[-1]["reject_count"] == 1
+    assert batches[0]["batch_size"] == 2
+    assert batches[0]["padded_size"] == 8  # bucketed up
+
+    snap = server.metrics.snapshot()
+    assert snap["total_requests"] == 2
+    assert snap["total_rejects"] == 1
+    assert snap["p99_latency_s"] >= snap["p50_latency_s"] >= 0.0
+
+
+def test_cancelled_future_does_not_kill_flush_thread(rng):
+    """A client cancelling a queued request must not crash the flush
+    thread (set_result on a cancelled Future raises InvalidStateError)."""
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    batcher = MicroBatcher(
+        lambda X: engine.predict_batch(model, X),
+        max_batch=64, max_latency_s=0.01,
+    )
+    X = rng.normal(size=(3, 12)).astype(np.float32)
+    keep1 = batcher.submit(X[0])
+    doomed = batcher.submit(X[1])
+    assert doomed.cancel()
+    with batcher:
+        assert np.isfinite(keep1.result(timeout=10))
+        # flush thread must still be alive for subsequent requests
+        keep2 = batcher.submit(X[2])
+        assert np.isfinite(keep2.result(timeout=10))
+
+
+def test_predict_margin_traces_under_jit_and_vmap(rng):
+    """The bucketed canonical path is host-side; tracers must fall back
+    to the pure-jnp margin so user jit/vmap around predict still works."""
+    import jax
+
+    model = _linear_model(rng)
+    X = rng.normal(size=(6, 12)).astype(np.float32)
+    eager = np.asarray(model.predict_margin(X))
+    jitted = np.asarray(jax.jit(model.predict_margin)(X))
+    np.testing.assert_allclose(jitted, eager, rtol=1e-6, atol=1e-7)
+    vmapped = np.asarray(jax.vmap(lambda x: model._margin(x[None, :])[0])(X))
+    np.testing.assert_allclose(vmapped, eager, rtol=1e-6, atol=1e-7)
+
+
+def test_multinomial_predict_traces_under_jit(rng):
+    """The multinomial dense bucketed path must fall back to pure jnp for
+    tracers, like the GLM margin does."""
+    import jax
+
+    d = 6
+    model = MultinomialLogisticRegressionModel(
+        rng.normal(size=3 * d).astype(np.float32), 0.0,
+        num_classes=4, num_features=d,
+    )
+    X = rng.normal(size=(5, d)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(model.predict)(X)), np.asarray(model.predict(X))
+    )
+
+
+def test_pin_survives_reload_attempts(rng, tmp_path):
+    """maybe_reload must never undo a pin (checked under the registry
+    lock), and unpin re-enables auto-reload."""
+    d = 4
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    for _ in range(2):
+        alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+    registry = ModelRegistry(str(tmp_path), alg.algorithm.create_model)
+    registry.pin(1)
+    for _ in range(3):
+        assert registry.maybe_reload() is False
+        assert registry.pinned and registry.current_version == 1
+    registry.unpin()
+    assert registry.maybe_reload() is True
+    assert registry.current_version == 2
+
+
+def test_server_rejects_max_batch_beyond_buckets(rng):
+    with pytest.raises(ValueError, match="max_batch"):
+        Server(_linear_model(rng), max_batch=1024)
+
+
+def test_predict_margin_grad_over_weights(rng):
+    """Differentiating predict through the WEIGHTS must also take the
+    traceable path (the weights are the tracer, not the input)."""
+    import jax
+    import jax.numpy as jnp
+
+    X = rng.normal(size=(4, 6)).astype(np.float32)
+
+    def loss(w):
+        return LinearRegressionModel(w, 0.0).predict_margin(X).sum()
+
+    g = np.asarray(jax.grad(loss)(jnp.ones(6, jnp.float32)))
+    np.testing.assert_allclose(g, X.sum(axis=0), rtol=1e-5)
+
+
+def test_raising_listener_does_not_kill_flush_thread(rng):
+    class _Boom:
+        def on_serve_batch(self, event):
+            raise ValueError("listener exploded")
+
+        def on_serve_reload(self, event):
+            raise ValueError("listener exploded")
+
+    model = _linear_model(rng)
+    with Server(model, max_latency_s=0.005, event_log=_Boom()) as server:
+        X = rng.normal(size=(3, 12)).astype(np.float32)
+        assert np.isfinite(server.predict(X[0], timeout=10))
+        # the flush thread survived the listener's exception
+        assert np.isfinite(server.predict(X[1], timeout=10))
+
+
+def test_event_log_close_is_safe_under_writes(rng, tmp_path):
+    """Closing the event log while the server still flushes must neither
+    tear a line nor raise in the flush thread."""
+    model = _linear_model(rng)
+    log = JsonLinesEventLog(str(tmp_path / "ev.jsonl"))
+    with Server(model, max_latency_s=0.005, event_log=log) as server:
+        x = rng.normal(size=12).astype(np.float32)
+        server.predict(x, timeout=10)
+        log.close()  # out of order on purpose: writes become no-ops
+        assert np.isfinite(server.predict(x, timeout=10))
+    lines = [json.loads(l) for l in open(log.path)]  # every line whole
+    assert all("kind" in e for e in lines)
+
+
+def test_custom_engine_buckets_are_honored(rng):
+    engine = PredictEngine(buckets=(4, 16))
+    assert engine.bucket_for(5) == 16
+    assert engine.max_batch == 16
+    model = _linear_model(rng)
+    X = rng.normal(size=(6, 12)).astype(np.float32)
+    # custom buckets pad to different compiled shapes: tight tolerance
+    np.testing.assert_allclose(
+        engine.predict_batch(model, X), np.asarray(model.predict(X)),
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_stack_rows_promotes_mixed_dtypes_and_rejects_bad_shapes(rng):
+    from tpu_sgd.serve import stack_rows
+
+    out = stack_rows([
+        np.array([1, 2, 3]),  # integer request
+        np.array([0.5, 1.5, 2.5], np.float32),
+    ])
+    # promotion, not truncation: the float neighbor keeps its fractions
+    np.testing.assert_allclose(out[1], [0.5, 1.5, 2.5])
+    np.testing.assert_allclose(out[0], [1.0, 2.0, 3.0])
+    with pytest.raises(ValueError, match="mixed or mis-shaped"):
+        stack_rows([np.zeros(3, np.float32), np.zeros(4, np.float32)])
+
+
+def test_raising_reload_listener_does_not_break_reload(rng, tmp_path):
+    d = 4
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+
+    class _BoomMetrics:
+        def record_reload(self, event):
+            raise ValueError("reload listener exploded")
+
+    registry = ModelRegistry(
+        str(tmp_path), alg.algorithm.create_model, metrics=_BoomMetrics()
+    )
+    assert registry.maybe_reload() is True  # swap survives the listener
+    assert registry.current_version == 1
+
+
+def test_stop_with_drain_before_start_answers_queue(rng):
+    """stop(drain=True) on a never-started batcher must still answer the
+    queued requests (synchronous drain), not strand their futures."""
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    batcher = MicroBatcher(
+        lambda X: engine.predict_batch(model, X),
+        max_batch=4, max_latency_s=1.0,
+    )
+    X = rng.normal(size=(6, 12)).astype(np.float32)
+    futs = [batcher.submit(X[i]) for i in range(6)]  # > max_batch: 2 flushes
+    batcher.stop(drain=True)
+    got = np.asarray([f.result(timeout=10) for f in futs])
+    np.testing.assert_array_equal(got, np.asarray(model.predict(X)))
+
+
+def test_batch_failure_fails_futures_not_server(rng):
+    boom = RuntimeError("bad batch")
+
+    def exploding(X):
+        raise boom
+
+    with MicroBatcher(exploding, max_latency_s=0.005) as batcher:
+        fut = batcher.submit(np.zeros(4, np.float32))
+        with pytest.raises(RuntimeError, match="bad batch"):
+            fut.result(timeout=10)
+        # the flush thread survives a failing batch
+        fut2 = batcher.submit(np.zeros(4, np.float32))
+        with pytest.raises(RuntimeError, match="bad batch"):
+            fut2.result(timeout=10)
+
+
+# -- satellite: streaming on_model_update hook -----------------------------
+def test_streaming_on_model_update_hook(rng):
+    d = 5
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    seen = []
+    alg.add_model_update_listener(
+        lambda model, batch_index: seen.append(
+            (batch_index, float(np.asarray(model.weights)[0]))
+        )
+    )
+    alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+    alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+    assert [s[0] for s in seen] == [1, 2]
+    # empty batches advance the stream position but do NOT fire the hook
+    alg.train_on_batch(np.zeros((0, d), np.float32), np.zeros(0, np.float32))
+    assert len(seen) == 2
+    with pytest.raises(TypeError):
+        alg.add_model_update_listener("not-callable")
+
+
+# -- satellite: checkpoint load-by-version ---------------------------------
+def test_checkpoint_versions_and_restore_version(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    for it in (3, 7, 12):
+        mgr.save(it, np.full(4, float(it), np.float32), 0.0, [1.0])
+    assert mgr.versions() == [3, 7, 12]
+    assert mgr.latest_version() == 12
+    ck = mgr.restore_version(7)
+    np.testing.assert_array_equal(ck["weights"], np.full(4, 7.0, np.float32))
+    with pytest.raises(FileNotFoundError, match="retained"):
+        mgr.restore_version(5)
+    # explicit version requests never fall back through corruption
+    (tmp_path / "ckpt_00000012.npz").write_bytes(b"torn")
+    with pytest.raises(Exception):
+        mgr.restore_version(12)
+    # ...but the latest-default restore still does (satellite hardening)
+    ck = mgr.restore()
+    assert ck["iteration"] == 7

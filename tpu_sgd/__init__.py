@@ -33,6 +33,9 @@ from tpu_sgd.parallel import data_mesh, make_mesh
 from tpu_sgd.plan import (CostModel, Plan, device_budget, plan_for,
                           plan_quasi_newton)
 from tpu_sgd.stat import MultivariateStatisticalSummary, col_stats, corr
+# serving subsystem (imported last: it builds on models + utils above)
+from tpu_sgd.serve import (BackpressureError, ModelRegistry, PredictEngine,
+                           Server)
 
 __version__ = "0.1.0"
 
@@ -48,5 +51,6 @@ __all__ = (
        "Normalizer", "StandardScaler", "StandardScalerModel",
        "RegressionMetrics", "BinaryClassificationMetrics",
        "MulticlassMetrics",
-       "col_stats", "corr", "MultivariateStatisticalSummary"]
+       "col_stats", "corr", "MultivariateStatisticalSummary",
+       "Server", "ModelRegistry", "PredictEngine", "BackpressureError"]
 )

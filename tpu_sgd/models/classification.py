@@ -199,7 +199,40 @@ class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
         #: explicitly so predict never guesses from input width.
         self.has_intercept_column = bool(has_intercept_column)
 
+    def _check_width(self, width: int) -> None:
+        expect = self.num_features - (1 if self.has_intercept_column else 0)
+        if width != expect:
+            raise ValueError(
+                f"expected {expect}-feature input, got {width}"
+            )
+
+    def predict_dense_bucketed(self, X, buckets=None) -> np.ndarray:
+        """The SINGLE home of the dense multinomial decision path —
+        validation, bias column, per-class margins through the shared
+        bucketed program (ops/bucketed.py), host-side pivot argmax
+        (ops/gradients.py).  ``model.predict`` and the serving engine
+        both route here, which is what makes serving results identical
+        to ad-hoc prediction; the engine passes its own ``buckets``."""
+        import jax.numpy as jnp
+
+        from tpu_sgd.ops.gradients import pivot_class_host
+        from tpu_sgd.ops.bucketed import DEFAULT_BUCKETS, bucketed_matvec
+
+        X = np.atleast_2d(np.asarray(X))  # batch-shaped: (d,) scores as (1,)
+        self._check_width(int(X.shape[-1]))
+        if self.has_intercept_column:
+            from tpu_sgd.utils.mlutils import append_bias
+
+            X = append_bias(X)
+        K = self.num_classes
+        W = jnp.asarray(self.weights).reshape(K - 1, X.shape[-1])
+        margins = bucketed_matvec(
+            X, W.T, 0.0, DEFAULT_BUCKETS if buckets is None else buckets
+        )
+        return pivot_class_host(margins)
+
     def predict(self, X):
+        import jax.core
         import jax.numpy as jnp
 
         from tpu_sgd.ops.gradients import MultinomialLogisticGradient
@@ -207,22 +240,32 @@ class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
                                         row_matrix_bcoo)
 
         sparse = is_sparse(X)
-        if not sparse:
+        tracer = (isinstance(X, jax.core.Tracer)
+                  or isinstance(self.weights, jax.core.Tracer))
+        if not sparse and tracer:
             X = jnp.asarray(X)
-        single = X.ndim == 1
-        if sparse:
-            Xb = row_matrix_bcoo(X)
+        single = (X.ndim if sparse or tracer else np.ndim(X)) == 1
+        if sparse or tracer:
+            # sparse batches and tracers (user jit/vmap/grad around
+            # predict, over the input OR the weights) take the pure-jnp
+            # rule; the bucketed host path below cannot trace
+            Xb = row_matrix_bcoo(X) if sparse else jnp.atleast_2d(X)
+            self._check_width(int(Xb.shape[-1]))
+            if self.has_intercept_column:
+                if sparse:
+                    Xb = append_bias_auto(Xb)
+                else:  # traced dense: append the bias column in-trace
+                    Xb = jnp.concatenate(
+                        [Xb, jnp.ones((Xb.shape[0], 1), Xb.dtype)], axis=1
+                    )
+            g = MultinomialLogisticGradient(self.num_classes)
+            out = g.predict_class(Xb, self.weights)
         else:
-            Xb = jnp.atleast_2d(X)
-        expect = self.num_features - (1 if self.has_intercept_column else 0)
-        if Xb.shape[-1] != expect:
-            raise ValueError(
-                f"expected {expect}-feature input, got {Xb.shape[-1]}"
+            # concrete dense input: stay host-side (the bucketed program
+            # pads in numpy; a device round-trip here is pure waste)
+            out = jnp.asarray(
+                self.predict_dense_bucketed(np.atleast_2d(np.asarray(X)))
             )
-        if self.has_intercept_column:
-            Xb = append_bias_auto(Xb)
-        g = MultinomialLogisticGradient(self.num_classes)
-        out = g.predict_class(Xb, self.weights)
         return out[0] if single else out
 
 

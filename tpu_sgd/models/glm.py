@@ -41,15 +41,48 @@ class GeneralizedLinearModel:
 
     def _margin(self, X):
         if not is_sparse(X):
+            import jax.core
+            from tpu_sgd.ops.bucketed import DEFAULT_BUCKETS
+
+            if (not isinstance(X, jax.core.Tracer)
+                    and not isinstance(self.weights, jax.core.Tracer)
+                    and np.ndim(X) == 2
+                    and 0 < np.shape(X)[0] <= DEFAULT_BUCKETS[-1]):
+                # Canonical shape-bucketed margin program (ops/bucketed.py):
+                # pads the row count to a fixed bucket set and reuses one
+                # compiled program per bucket, so ad-hoc predict and the
+                # serving endpoint score the same batch through the SAME
+                # executable — bitwise-identical dense predictions, and no
+                # per-batch-size recompiles.  Tracers (a user's jit/vmap/
+                # grad around predict, over the input OR the weights) stay
+                # on the pure-jnp path below — the host-side pad cannot
+                # trace.
+                from tpu_sgd.ops.bucketed import bucketed_matvec
+
+                return jnp.asarray(
+                    bucketed_matvec(X, self.weights, self.intercept)
+                )
+            # tracers, empty input, and beyond-max-bucket batches (the
+            # training-scale case) stay pure device: one eager matmul at
+            # the natural shape, no host round-trip
             X = jnp.asarray(X)
         return X @ self.weights + self.intercept
 
     def predict_margin(self, X):
         """Raw margin(s) ``x.w + b`` for a single vector or a batch; always
         returns a batch-shaped result (a single vector yields shape (1,))."""
+        import jax.core
+
         if is_sparse(X):
             return self._margin(row_matrix_bcoo(X))
-        return self._margin(jnp.atleast_2d(jnp.asarray(X)))
+        if isinstance(X, jax.core.Tracer):
+            return self._margin(jnp.atleast_2d(X))
+        if np.ndim(X) == 1:
+            # a single row is tiny: shape it host-side for the bucketed
+            # path (2-D inputs pass through untouched — _margin decides
+            # device vs host by batch size without materializing)
+            return self._margin(np.atleast_2d(np.asarray(X)))
+        return self._margin(X)
 
     def predict_point(self, margin):
         raise NotImplementedError
@@ -58,9 +91,7 @@ class GeneralizedLinearModel:
         """Predict for one feature vector or a batch (parity with the
         reference's ``predict(Vector)`` / ``predict(RDD[Vector])``); accepts
         dense arrays or sparse (BCOO) features."""
-        if not is_sparse(X):
-            X = jnp.asarray(X)
-        single = X.ndim == 1
+        single = np.ndim(X) == 1  # attribute-based: no device transfer
         out = self.predict_point(self.predict_margin(X))
         return out[0] if single else out
 

@@ -45,6 +45,7 @@ class StreamingLinearAlgorithm:
         self.checkpoint_every = 1
         self.checkpoint_history_tail = None
         self._resume_skip = 0
+        self._model_update_listeners: list = []
 
     def latest_model(self) -> GeneralizedLinearModel:
         if self.model is None:
@@ -141,6 +142,29 @@ class StreamingLinearAlgorithm:
         self._resume_skip = self._batch_count
         return self
 
+    def add_model_update_listener(self, callback):
+        """Register ``callback(model, batch_index)`` to fire after every
+        micro-batch that updates the model — AFTER the checkpoint write
+        for that batch (if any), so a listener that consumes the durable
+        artifact (e.g. ``tpu_sgd.serve.ModelRegistry.on_model_update``)
+        sees the published version.  Listener exceptions propagate to the
+        training loop: a broken publisher should fail loudly, not train
+        silently unpublished."""
+        if not callable(callback):
+            raise TypeError(f"callback must be callable, got {callback!r}")
+        self._model_update_listeners.append(callback)
+        return self
+
+    def remove_model_update_listener(self, callback):
+        self._model_update_listeners.remove(callback)
+        return self
+
+    def on_model_update(self):
+        """Fire the registered model-update listeners with the current
+        model and stream position."""
+        for cb in self._model_update_listeners:
+            cb(self.model, self._batch_count)
+
     def _maybe_checkpoint(self):
         if (self.checkpoint_manager is not None
                 and self.model is not None
@@ -183,6 +207,7 @@ class StreamingLinearAlgorithm:
         if hist is not None and len(hist):
             self.loss_history.append(float(hist[-1]))
         self._maybe_checkpoint()
+        self.on_model_update()
         return self.model
 
     def train_on(self, stream: Iterable[Batch],

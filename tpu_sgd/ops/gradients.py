@@ -454,8 +454,30 @@ class MultinomialLogisticGradient:
     def predict_class(self, X: Array, weights: Array) -> Array:
         K = self.num_classes
         W = weights.reshape(K - 1, X.shape[-1])
-        margins = X @ W.T
-        logits = jnp.concatenate(
-            [jnp.zeros((X.shape[0], 1), margins.dtype), margins], axis=-1
-        )
-        return jnp.argmax(logits, axis=-1).astype(jnp.float32)
+        return pivot_class_traced(X @ W.T)
+
+
+def pivot_class_traced(margins: Array) -> Array:
+    """Multinomial decision rule (pivot class 0 with an implicit zero
+    logit): per-class margins -> predicted class as float32.  The SINGLE
+    traced home of the rule — the serving kernels and ``predict_class``
+    both call it, so a pivot/tie-breaking change can never diverge
+    serving from training-side prediction."""
+    logits = jnp.concatenate(
+        [jnp.zeros((margins.shape[0], 1), margins.dtype), margins], axis=-1
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.float32)
+
+
+def pivot_class_host(margins) -> "np.ndarray":
+    """Host-numpy twin of :func:`pivot_class_traced` for the bucketed
+    dense predict paths, where an eager jnp concat/argmax would compile
+    one throwaway program per batch size.  np.argmax and jnp.argmax share
+    first-max tie-breaking, so the two variants agree exactly."""
+    import numpy as np
+
+    margins = np.asarray(margins)
+    logits = np.concatenate(
+        [np.zeros((margins.shape[0], 1), margins.dtype), margins], axis=-1
+    )
+    return np.argmax(logits, axis=-1).astype(np.float32)

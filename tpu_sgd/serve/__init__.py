@@ -1,0 +1,175 @@
+"""tpu_sgd.serve: online model serving for trained GLM families.
+
+The inference half of the stack (ROADMAP north star: "serves heavy
+traffic"): a trained or streaming-trained model becomes a low-latency
+endpoint with
+
+  * dynamic micro-batching — single-row requests coalesce into
+    bucket-padded TPU batches under a max-latency deadline, with bounded
+    queueing and explicit backpressure (:mod:`tpu_sgd.serve.batcher`);
+  * a jit-compiled, shape-bucketed predict path shared by the dense and
+    sparse feature layouts of all GLM families
+    (:mod:`tpu_sgd.serve.engine`);
+  * hot model reload — a ``StreamingLinearAlgorithm`` training loop
+    publishes checkpoints and the serving side atomically swaps to the
+    newest loadable version, rolling back past corrupt files
+    (:mod:`tpu_sgd.serve.registry`);
+  * observability into the shared event-log contract
+    (:mod:`tpu_sgd.serve.metrics`).
+
+Quickstart::
+
+    from tpu_sgd.serve import Server
+
+    server = Server(model, max_latency_s=0.002)     # static model
+    with server:
+        y = server.predict(x_row)
+
+    registry = ModelRegistry(ckpt_dir, algorithm.create_model)
+    with Server(registry=registry) as server:        # hot-reloading
+        fut = server.submit(x_row)                   # async handle
+        y = fut.result()
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from tpu_sgd.serve.batcher import BackpressureError, MicroBatcher
+from tpu_sgd.serve.engine import DEFAULT_BUCKETS, PredictEngine, stack_rows
+from tpu_sgd.serve.metrics import ServingMetrics
+from tpu_sgd.serve.registry import ModelRegistry, NoModelError
+
+
+class Server:
+    """Facade wiring engine + batcher + registry + metrics into one
+    endpoint.  Exactly one of ``model`` (static) or ``registry``
+    (hot-reloading) must be given."""
+
+    def __init__(
+        self,
+        model=None,
+        *,
+        registry: Optional[ModelRegistry] = None,
+        buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+        max_batch: Optional[int] = None,
+        max_latency_s: float = 0.005,
+        max_queue: int = 1024,
+        event_log=None,
+        auto_reload: bool = True,
+        reload_interval_s: float = 0.1,
+    ):
+        if (model is None) == (registry is None):
+            raise ValueError("pass exactly one of model= or registry=")
+        self._model = model
+        self.registry = registry
+        self.auto_reload = bool(auto_reload) and registry is not None
+        self.reload_interval_s = float(reload_interval_s)
+        self._last_reload_check = float("-inf")
+        self.engine = PredictEngine(buckets)
+        if max_batch is None:
+            max_batch = self.engine.max_batch
+        elif max_batch > self.engine.max_batch:
+            # a coalesced batch beyond the largest bucket would fall off
+            # the compiled-program cache onto the per-size eager path
+            # (and the padded_size metric would lie)
+            raise ValueError(
+                f"max_batch={max_batch} exceeds the largest engine "
+                f"bucket ({self.engine.max_batch}); raise buckets= or "
+                "lower max_batch"
+            )
+        self.metrics = ServingMetrics(listener=event_log)
+        if registry is not None:
+            if registry.metrics is None:
+                # adopt the registry into this server's metrics stream;
+                # a metrics object the user attached themselves (or a
+                # previous server's) is left in place — reload events
+                # keep flowing wherever they already flow
+                registry.metrics = self.metrics
+            self.metrics.version_source = lambda: (
+                -1 if registry.current_version is None
+                else registry.current_version
+            )
+        self.batcher = MicroBatcher(
+            self._predict_batch,
+            max_batch=max_batch,
+            max_latency_s=max_latency_s,
+            max_queue=max_queue,
+            metrics=self.metrics,
+            padded_size_fn=self.engine.bucket_for,
+        )
+
+    # -- model access ------------------------------------------------------
+    @property
+    def model(self):
+        if self.registry is not None:
+            return self.registry.model()
+        return self._model
+
+    @property
+    def model_version(self) -> Optional[int]:
+        return None if self.registry is None else self.registry.current_version
+
+    def reload(self) -> bool:
+        """Explicitly check for and swap to a newer checkpoint version."""
+        if self.registry is None:
+            return False
+        return self.registry.maybe_reload()
+
+    def _predict_batch(self, X):
+        if self.auto_reload:
+            # throttled directory scan (not per batch, never per request):
+            # a slow/hung filesystem listing must not sit on the serving
+            # critical path, and ``reload_interval_s`` bounds staleness;
+            # a trainer wired through add_model_update_listener ->
+            # registry.on_model_update swaps immediately regardless
+            import time
+
+            now = time.monotonic()
+            if now - self._last_reload_check >= self.reload_interval_s:
+                self._last_reload_check = now
+                self.registry.maybe_reload()
+        return self.engine.predict_batch(self.model, X)
+
+    # -- request path ------------------------------------------------------
+    def submit(self, x):
+        """Async single-row predict; returns a ``concurrent.futures.Future``.
+        Raises :class:`BackpressureError` when the queue is full."""
+        return self.batcher.submit(x)
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Blocking single-row predict through the micro-batching path."""
+        return self.batcher.predict(x, timeout)
+
+    def predict_batch(self, X):
+        """Direct batch predict through the bucketed compiled path,
+        bypassing the queue (bulk/offline scoring against the same
+        serving model)."""
+        return self._predict_batch(X)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.batcher.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        self.batcher.stop(drain=drain)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+__all__ = [
+    "BackpressureError",
+    "DEFAULT_BUCKETS",
+    "MicroBatcher",
+    "ModelRegistry",
+    "NoModelError",
+    "PredictEngine",
+    "Server",
+    "ServingMetrics",
+    "stack_rows",
+]

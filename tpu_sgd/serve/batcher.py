@@ -1,0 +1,227 @@
+"""Dynamic micro-batching request queue.
+
+Single-row requests arrive at wire rate; the TPU predict path wants
+bucket-sized batches (serve/engine.py).  The batcher bridges the two with
+the classic serving trade: coalesce queued requests into one batch call,
+flushing when EITHER the batch is full OR the oldest queued request has
+waited ``max_latency_s`` — so an idle endpoint still answers a lone
+request within the deadline, and a saturated one amortizes the per-call
+overhead across ``max_batch`` rows (the AdaBatch observation, arxiv
+1711.01761, applied to inference).
+
+Overload is explicit, not silent: the queue is bounded at ``max_queue``
+pending requests and ``submit`` raises :class:`BackpressureError` when
+full — the caller (a frontend) sheds load instead of building an
+unbounded latency balloon.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+from tpu_sgd.serve.engine import stack_rows
+
+
+class BackpressureError(RuntimeError):
+    """The serving queue is full; the request was rejected, not queued."""
+
+
+class _Request:
+    __slots__ = ("x", "future", "t_enqueue")
+
+    def __init__(self, x):
+        self.x = x
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatcher:
+    """Bounded request queue + background flush thread.
+
+    ``predict_batch`` receives the stacked feature matrix of one coalesced
+    batch and returns per-row predictions in order.  Requests submitted
+    before :meth:`start` queue up and coalesce into the first flush —
+    which is also what makes the coalescing behavior deterministic to
+    test.
+    """
+
+    def __init__(
+        self,
+        predict_batch: Callable,
+        *,
+        max_batch: int = 128,
+        max_latency_s: float = 0.005,
+        max_queue: int = 1024,
+        metrics=None,
+        padded_size_fn: Optional[Callable[[int], int]] = None,
+    ):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        if max_latency_s < 0:
+            raise ValueError(f"max_latency_s must be >= 0, got {max_latency_s}")
+        self.predict_batch = predict_batch
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_s)
+        self.max_queue = int(max_queue)
+        self.metrics = metrics
+        self.padded_size_fn = padded_size_fn or (lambda n: n)
+        self._pending: deque = deque()
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self.reject_count = 0
+        self.batch_count = 0
+
+    # -- client side -------------------------------------------------------
+    def submit(self, x) -> Future:
+        """Enqueue one feature row; resolves to its prediction."""
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("batcher is stopped")
+            if len(self._pending) >= self.max_queue:
+                self.reject_count += 1
+                if self.metrics is not None:
+                    self.metrics.record_reject()
+                raise BackpressureError(
+                    f"serving queue full ({self.max_queue} pending); "
+                    "request rejected"
+                )
+            req = _Request(x)
+            self._pending.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Blocking single-row convenience wrapper around :meth:`submit`."""
+        return self.submit(x).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-sgd-serve-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the flush thread; with ``drain`` (default) queued requests
+        are answered first, otherwise they fail with CancelledError."""
+        with self._cond:
+            if self._stopped and self._thread is None:
+                return
+            self._stopped = True
+            if not drain:
+                while self._pending:
+                    self._pending.popleft().future.cancel()
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            if t.is_alive():
+                # flush wedged (first-batch compile on a slow host, NFS
+                # checkpoint scan): keep the handle so a restart cannot
+                # spawn a SECOND flush thread over the same queue, and
+                # fail loudly instead of silently stranding futures
+                raise RuntimeError(
+                    "flush thread did not stop within 10s (a batch is "
+                    "still in flight); call stop() again to re-join"
+                )
+            self._thread = None
+        elif drain:
+            # never started: no flush thread exists to honor the drain
+            # promise, so drain synchronously here — a waiter blocked on
+            # fut.result() must not hang forever
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    break
+                if batch:
+                    self._flush(batch)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- flush thread ------------------------------------------------------
+    def _run(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if batch:
+                self._flush(batch)
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block until a flushable batch exists: full, past the oldest
+        request's deadline, or stopping (drain).  None means exit."""
+        with self._cond:
+            while not self._pending and not self._stopped:
+                # untimed: submit() and stop() both notify, so a timeout
+                # here would only wake an idle endpoint for nothing
+                self._cond.wait()
+            if not self._pending:
+                return None  # stopped and drained
+            deadline = self._pending[0].t_enqueue + self.max_latency_s
+            while (
+                len(self._pending) < self.max_batch
+                and not self._stopped
+            ):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            depth = len(self._pending)
+            batch = [
+                self._pending.popleft()
+                for _ in range(min(depth, self.max_batch))
+            ]
+            # claim each future NOW (running state): a client cancel() from
+            # here on fails instead of racing set_result into an
+            # InvalidStateError that would kill the flush thread; already-
+            # cancelled requests are dropped from the batch
+            return [
+                r for r in batch
+                if r.future.set_running_or_notify_cancel()
+            ]
+
+    def _flush(self, batch: List[_Request]):
+        t_done = None
+        try:
+            X = stack_rows([r.x for r in batch])
+            out = self.predict_batch(X)
+            t_done = time.perf_counter()
+        except Exception as e:  # one bad row fails its batch, not the server
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        self.batch_count += 1
+        for i, r in enumerate(batch):
+            r.future.set_result(out[i])
+        if self.metrics is not None:
+            try:
+                self.metrics.record_batch(
+                    queue_depth=len(self._pending),
+                    batch_size=len(batch),
+                    padded_size=self.padded_size_fn(len(batch)),
+                    latencies=[t_done - r.t_enqueue for r in batch],
+                    reject_count=self.reject_count,
+                )
+            except Exception:  # observability must never kill serving
+                logging.getLogger("tpu_sgd.serve.batcher").warning(
+                    "serving metrics/listener raised; event dropped",
+                    exc_info=True,
+                )

@@ -1,0 +1,267 @@
+"""Jit-compiled, shape-bucketed batch prediction for serving.
+
+The training side compiles ONE program per optimizer run; serving instead
+sees an endless stream of small, irregularly-sized batches.  Recompiling
+``predict`` per batch size would stall the endpoint (XLA compiles in
+hundreds of ms), so every dense batch is padded up to a small fixed set
+of row-count *buckets* and scored by one cached program per
+``(bucket, feature layout, weight layout)`` — after warm-up, every
+request size hits a cached executable.  Weights and intercept are
+*traced arguments*, never compile-time constants, so a hot model reload
+(serve/registry.py) swaps weights without a single recompile.
+
+Exactness contract: XLA tiles a matvec differently per compiled shape,
+so two differently-shaped programs can disagree at 1 ulp — padding per
+se is harmless (each output row depends only on its own input row), but
+"same rows, different batch shape" is not bitwise-stable.  The engine
+therefore does NOT keep a private predict implementation: the canonical
+bucketed matvec (``tpu_sgd/ops/bucketed.py`` — ops layer, so the models
+never depend on serving) is the dense margin path that
+``GeneralizedLinearModel.predict`` itself routes through, so the serving
+endpoint and an ad-hoc ``model.predict`` on the same batch run the
+*same compiled program* and agree bitwise for dense float32
+(tests/test_serve.py asserts this).  One qualification: for the sigmoid
+family the engine fuses the activation into the bucket program while the
+model applies it eagerly on the sliced margin — validated bitwise on the
+CPU backend; on backends where XLA fuses differently this pair is
+tight-tolerance, not guaranteed-bitwise (the margin and multinomial
+families share literally every op either way).
+
+Sparse (BCOO) feature batches are served through the same row buckets
+with a second axis of buckets on ``nse`` (padded with explicit zeros at
+coordinate (0, 0), which BCOO matvec sums in as +0.0); sparse scoring
+matches the models' eager sparse path to tight tolerance, not bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.ops.bucketed import (DEFAULT_BUCKETS, bucket_for,
+                                  bucketed_matvec, program_cache_size)
+from tpu_sgd.ops.sparse import is_sparse
+
+
+def stack_rows(rows):
+    """Stack single-row feature vectors (dense 1-D arrays or 1-D BCOO
+    vectors) into one batch matrix — the coalescing step of the
+    micro-batcher.  All rows must share layout and width."""
+    if not rows:
+        raise ValueError("cannot stack an empty request list")
+    if is_sparse(rows[0]):
+        from jax.experimental.sparse import BCOO
+
+        d = rows[0].shape[-1]
+        datas, idxs = [], []
+        for r, x in enumerate(rows):
+            if not is_sparse(x) or x.ndim != 1 or x.shape[-1] != d:
+                raise ValueError(
+                    "mixed or mis-shaped sparse rows in one batch"
+                )
+            nse = x.data.shape[0]
+            row_ids = jnp.full((nse, 1), r, dtype=jnp.int32)
+            idxs.append(
+                jnp.concatenate(
+                    [row_ids, x.indices.astype(jnp.int32)], axis=1
+                )
+            )
+            datas.append(x.data)
+        return BCOO(
+            (jnp.concatenate(datas), jnp.concatenate(idxs)),
+            shape=(len(rows), int(d)),
+        )
+    arrs = [np.asarray(x) for x in rows]
+    d = arrs[0].shape[-1]
+    for a in arrs:
+        if a.ndim != 1 or a.shape[-1] != d:
+            raise ValueError("mixed or mis-shaped dense rows in one batch")
+    # promote, never truncate: one int-typed request must not silently
+    # floor a float neighbor coalesced into the same batch (float32 floor
+    # so integer rows score like everywhere else in the stack)
+    out = np.empty((len(arrs), d), np.result_type(np.float32, *arrs))
+    for i, a in enumerate(arrs):
+        out[i] = a
+    return out
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+class PredictEngine:
+    """Bucket-padded jit predict for every GLM family.
+
+    Dense batches route through the models' own canonical bucketed path
+    (:func:`bucketed_matvec` — shared program cache, bitwise-identical
+    results); the engine adds the sparse bucketed kernels, oversized-batch
+    chunking, and the call/compile counters the serving metrics read.  It
+    is stateless with respect to the model, so the registry can swap
+    models freely — a new model of the same family/width reuses every
+    cached executable.
+    """
+
+    def __init__(self, buckets: Tuple[int, ...] = DEFAULT_BUCKETS):
+        bs = sorted({int(b) for b in buckets})
+        if not bs or bs[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.buckets = tuple(bs)
+        self.max_batch = self.buckets[-1]
+        self._sparse_compiled = {}
+        self.call_count = 0
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(n, self.buckets)
+
+    @property
+    def compile_count(self) -> int:
+        """Compiled programs reachable from this engine (shared dense
+        cache + this engine's sparse kernels)."""
+        return program_cache_size() + len(self._sparse_compiled)
+
+    # -- public entry ------------------------------------------------------
+    def predict_batch(self, model, X) -> np.ndarray:
+        """Score a batch through the bucketed compiled path; returns a host
+        numpy array of per-row predictions, identical to
+        ``model.predict(X)`` (bitwise for dense inputs when this engine
+        uses the canonical ``DEFAULT_BUCKETS`` — a custom bucket set pads
+        to different compiled shapes, which XLA may tile at 1-ulp
+        variance)."""
+        self.call_count += 1
+        if not is_sparse(X):
+            X = np.asarray(X)
+            if X.ndim == 1:
+                X = X[None, :]
+            if X.shape[0] == 0:
+                return np.zeros((0,), np.float32)
+            return self._score_dense(model, X)
+        if X.ndim == 1:  # single sparse vector -> (1, d) row matrix
+            from tpu_sgd.ops.sparse import row_matrix_bcoo
+
+            X = row_matrix_bcoo(X)
+        return self._predict_sparse(model, X)
+
+    def _score_dense(self, model, X: np.ndarray) -> np.ndarray:
+        """Family dispatch over the shared bucketed matvec, honoring THIS
+        engine's bucket set; with the default buckets every program and
+        every host-side op is identical to ``model.predict``'s own path,
+        which is what makes the results bitwise-equal."""
+        kind = self._kind(model)
+        if kind == "multinomial":
+            # one shared implementation of the dense decision path —
+            # the model owns it, the engine only supplies its buckets
+            return model.predict_dense_bucketed(X, self.buckets)
+        scores = bucketed_matvec(
+            X, model.weights, model.intercept, self.buckets,
+            activation="sigmoid" if kind == "sigmoid" else None,
+        )
+        return self._finalize(model, scores)
+
+    # -- sparse path -------------------------------------------------------
+    def _predict_sparse(self, model, X) -> np.ndarray:
+        n = int(X.shape[0])
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        if n > self.max_batch:
+            from tpu_sgd.ops.sparse import take_rows_bcoo
+
+            return np.concatenate([
+                self._score_sparse(
+                    model,
+                    take_rows_bcoo(X, np.arange(s, min(s + self.max_batch, n))),
+                )
+                for s in range(0, n, self.max_batch)
+            ])
+        return self._score_sparse(model, X)
+
+    @staticmethod
+    def _kind(model) -> str:
+        from tpu_sgd.models.classification import (
+            LogisticRegressionModel,
+            MultinomialLogisticRegressionModel,
+        )
+
+        if isinstance(model, MultinomialLogisticRegressionModel):
+            return "multinomial"
+        if isinstance(model, LogisticRegressionModel):
+            return "sigmoid"
+        return "margin"  # SVM + regression: the score IS the margin
+
+    def _sparse_kernel(self, key):
+        fn = self._sparse_compiled.get(key)
+        if fn is not None:
+            return fn
+        kind, _rows, _d, _dt, _nse, K, has_bias = key
+
+        if kind == "multinomial":
+            # BCOO lacks a cheap bias-column append; fold the per-class
+            # bias weights in after the sparse matmul instead (same math;
+            # sparse batches are matched by allclose, not bitwise)
+            from tpu_sgd.ops.gradients import pivot_class_traced
+
+            def score(X, w, b):
+                del b
+                d_in = X.shape[-1]
+                W = w.reshape(K - 1, d_in + (1 if has_bias else 0))
+                margins = X @ W[:, :d_in].T
+                if has_bias:
+                    margins = margins + W[:, d_in]
+                return pivot_class_traced(margins)
+        elif kind == "sigmoid":
+            def score(X, w, b):
+                return jax.nn.sigmoid(X @ w + b)
+        else:
+            def score(X, w, b):
+                return X @ w + b
+
+        fn = jax.jit(score)
+        self._sparse_compiled[key] = fn
+        return fn
+
+    @staticmethod
+    def _pad_sparse(X, rows: int, nse: int):
+        from jax.experimental.sparse import BCOO
+
+        data = np.asarray(X.data)
+        idx = np.asarray(X.indices, np.int32)
+        if data.shape[0] < nse:
+            extra = nse - data.shape[0]
+            data = np.concatenate([data, np.zeros((extra,), data.dtype)])
+            idx = np.concatenate(
+                [idx, np.zeros((extra, 2), np.int32)], axis=0
+            )
+        return BCOO(
+            (jnp.asarray(data), jnp.asarray(idx)),
+            shape=(rows, int(X.shape[1])),
+        )
+
+    def _score_sparse(self, model, X) -> np.ndarray:
+        n = int(X.shape[0])
+        rows = self.bucket_for(n)
+        kind = self._kind(model)
+        K = int(getattr(model, "num_classes", 0))
+        has_bias = bool(getattr(model, "has_intercept_column", False))
+        nse = _next_pow2(max(int(np.asarray(X.data).shape[0]), 1))
+        Xp = self._pad_sparse(X, rows, nse)
+        key = (kind, rows, int(X.shape[1]), str(Xp.data.dtype), nse, K,
+               has_bias)
+        fn = self._sparse_kernel(key)
+        out = fn(
+            Xp, jnp.asarray(model.weights),
+            jnp.asarray(model.intercept, jnp.float32),
+        )
+        return self._finalize(model, np.asarray(out[:n]))
+
+    @staticmethod
+    def _finalize(model, scores: np.ndarray) -> np.ndarray:
+        """Host-side thresholding — mirrors
+        ``_ThresholdedModel.predict_point`` exactly (same comparison on
+        the same float32 scores) so a ``set_threshold`` /
+        ``clear_threshold`` flip never recompiles."""
+        thr = getattr(model, "threshold", None)
+        if thr is None:
+            return scores
+        return (scores > np.float32(thr)).astype(np.float32)

@@ -135,6 +135,28 @@ class CheckpointManager:
         paths = self._paths_by_iteration()
         return paths[-1] if paths else None
 
+    def versions(self):
+        """Retained checkpoint iterations, ascending — the serving
+        registry's load-by-version surface (serve/registry.py)."""
+        return [self._iteration_of(p) for p in self._paths_by_iteration()]
+
+    def latest_version(self) -> Optional[int]:
+        p = self.latest_path()
+        return None if p is None else self._iteration_of(p)
+
+    def restore_version(self, iteration: int) -> dict:
+        """Load exactly the checkpoint written at ``iteration``.  Explicit
+        version requests raise on a missing or corrupt file (the caller
+        named a specific version, so silently serving another would be
+        wrong) — the latest-default :meth:`restore` keeps its fallback."""
+        path = self._path(int(iteration))
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no checkpoint for iteration {iteration} in "
+                f"{self.directory!r} (retained: {self.versions()})"
+            )
+        return self._load(path)
+
     def restore(self, path: Optional[str] = None) -> Optional[dict]:
         """Load a checkpoint dict or ``None`` when the directory is empty.
 

@@ -49,6 +49,34 @@ class RunEvent:
     wall_time_s: float = 0.0
 
 
+@dataclass
+class ServeBatchEvent:
+    """One coalesced serving batch (tpu_sgd/serve) — the observability
+    record for the micro-batching path: how deep the queue ran, how many
+    requests coalesced, the padded bucket actually compiled against, the
+    oldest request's end-to-end latency, cumulative rejects, and which
+    model version answered."""
+
+    queue_depth: int
+    batch_size: int
+    padded_size: int
+    latency_s: float
+    reject_count: int
+    model_version: int
+
+
+@dataclass
+class ServeReloadEvent:
+    """A serving model hot-reload attempt (serve/registry.py): either a
+    successful atomic swap to ``version`` or a rejected load (corrupt /
+    unreadable checkpoint) with the retained previous-good version."""
+
+    event: str  # "reloaded" | "load_failed"
+    version: int
+    previous_version: Optional[int] = None
+    error: Optional[str] = None
+
+
 class SGDListener:
     """Override any subset; attached via ``GradientDescent.set_listener``."""
 
@@ -58,6 +86,10 @@ class SGDListener:
 
     def on_run_end(self, event: RunEvent) -> None: ...
 
+    def on_serve_batch(self, event: ServeBatchEvent) -> None: ...
+
+    def on_serve_reload(self, event: ServeReloadEvent) -> None: ...
+
 
 class CollectingListener(SGDListener):
     """Buffers every event in memory (test/introspection helper)."""
@@ -65,6 +97,8 @@ class CollectingListener(SGDListener):
     def __init__(self):
         self.iterations: List[IterationEvent] = []
         self.runs: List[RunEvent] = []
+        self.serve_batches: List[ServeBatchEvent] = []
+        self.serve_reloads: List[ServeReloadEvent] = []
 
     def on_run_start(self, config):
         self.runs.append(RunEvent(event="run_started"))
@@ -75,18 +109,34 @@ class CollectingListener(SGDListener):
     def on_run_end(self, event):
         self.runs.append(event)
 
+    def on_serve_batch(self, event):
+        self.serve_batches.append(event)
+
+    def on_serve_reload(self, event):
+        self.serve_reloads.append(event)
+
 
 class JsonLinesEventLog(SGDListener):
     """Append-only JSONL event log (the ``spark.eventLog`` analogue)."""
 
     def __init__(self, path: str):
+        import threading
+
         self.path = path
         self._f = open(path, "a")
+        # the serving subsystem logs from its flush thread while user
+        # threads log reloads/bulk scores through the same instance; the
+        # lock keeps every JSONL line whole (a torn line breaks replay)
+        self._write_lock = threading.Lock()
 
     def _write(self, kind: str, payload: dict):
-        self._f.write(json.dumps({"kind": kind, "ts": time.time(),
-                                  **payload}, default=float) + "\n")
-        self._f.flush()
+        line = json.dumps({"kind": kind, "ts": time.time(),
+                           **payload}, default=float) + "\n"
+        with self._write_lock:
+            if self._f.closed:
+                return  # closed mid-shutdown: drop, don't raise in servers
+            self._f.write(line)
+            self._f.flush()
 
     def on_run_start(self, config):
         self._write("run_started", {"config": asdict(config)})
@@ -97,8 +147,15 @@ class JsonLinesEventLog(SGDListener):
     def on_run_end(self, event: RunEvent):
         self._write("run_completed", asdict(event))
 
+    def on_serve_batch(self, event: ServeBatchEvent):
+        self._write("serve_batch", asdict(event))
+
+    def on_serve_reload(self, event: ServeReloadEvent):
+        self._write("serve_reload", asdict(event))
+
     def close(self):
-        self._f.close()
+        with self._write_lock:  # never close out from under a writer
+            self._f.close()
 
 
 @contextlib.contextmanager
