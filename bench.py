@@ -544,6 +544,25 @@ def _streamed_measure() -> dict:
     return _streamed_body()
 
 
+def ingest_pipeline_config() -> dict:
+    """The io-pipeline configuration the streamed measurement would run
+    under RIGHT NOW — persisted inside the streamed capture so a code or
+    config change invalidates the cached measurement (a capture taken
+    under the sync feed must not masquerade as the pipelined rate; see
+    the reuse check in ``main``).  ``wire_dtype`` is the EFFECTIVE wire:
+    the north-star host set is bf16, so the default (data-dtype) wire is
+    bf16."""
+    from tpu_sgd.io import DEFAULT_PREFETCH_DEPTH
+
+    return {
+        "pipelined": True,
+        "prefetch_depth": int(
+            os.environ.get("BENCH_STREAM_PREFETCH",
+                           str(DEFAULT_PREFETCH_DEPTH))),
+        "wire_dtype": "bfloat16",  # host data dtype == wire dtype
+    }
+
+
 def streamed_host_dataset(rows, dim):
     """The config-4 host-resident dataset: bf16 X, f32 y, fixed seeds —
     shared by the streamed bench legs and the standalone streamed-gram
@@ -599,6 +618,8 @@ def _streamed_body() -> dict:
         sampling="sliced",
     )
 
+    io_cfg = ingest_pipeline_config()
+
     def run_once(tag, resident_rows, feed_label, aggregate="median"):
         listener = CollectingListener()
         t0 = time.perf_counter()
@@ -606,6 +627,7 @@ def _streamed_body() -> dict:
             LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
             np.zeros((DIM,), np.float32), listener=listener,
             resident_rows=resident_rows,
+            prefetch_depth=io_cfg["prefetch_depth"],
         )
         total_s = time.perf_counter() - t0
         iter_walls = [ev.wall_time_s for ev in listener.iterations]
@@ -621,6 +643,9 @@ def _streamed_body() -> dict:
         return s
 
     summary = run_once("streamed", 0, "feed")
+    # the io-pipeline fingerprint rides in the capture: a config/code
+    # change invalidates the persisted measurement on the next run
+    summary["io_pipeline"] = io_cfg
 
     # Partial residency: keep as much of the dataset on the device as HBM
     # allows and slice those windows on-device — per-epoch feed traffic
@@ -1082,8 +1107,26 @@ def main():
             prev = {}
         prev_streamed = enrich_from_prev(prev, record, result,
                                          tpu["epochs_per_sec"])
-        if (os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"
-                or os.environ.get("BENCH_STREAMED", "1") == "0"):
+        streamed_enabled = os.environ.get("BENCH_STREAMED", "1") != "0"
+        # Staleness gate (io-pipeline config): a capture measured under a
+        # DIFFERENT ingest configuration (sync feed, other wire dtype or
+        # prefetch depth — or pre-io-layer code, which recorded no config
+        # at all) must not be reused as if it measured the current one;
+        # BENCH_STREAM_REFRESH=1 is no longer needed to see an ingest
+        # change's effect.  A skipped leg (BENCH_STREAMED=0) still keeps
+        # the prior capture rather than destroying it.
+        stale_io = (
+            streamed_enabled
+            and prev_streamed is not None
+            and prev_streamed.get("io_pipeline") != ingest_pipeline_config()
+        )
+        if stale_io:
+            log("streamed: persisted capture's io_pipeline "
+                f"{prev_streamed.get('io_pipeline')} != current "
+                f"{ingest_pipeline_config()}; re-measuring")
+        if not stale_io and (
+                os.environ.get("BENCH_STREAM_REFRESH", "0") != "1"
+                or not streamed_enabled):
             # Not refreshing — or refresh+skip, which is contradictory and
             # resolves to "keep what we have".
             record["streamed"] = prev_streamed

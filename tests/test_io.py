@@ -1,0 +1,533 @@
+"""Ingestion-layer tests (``tpu_sgd/io``): chunk planner math, prefetcher
+ordering/exception semantics, wire format round-trips, pipelined-vs-sync
+build equality (f32 bitwise), and the one-compiled-program contract."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.io import (DEFAULT_PREFETCH_DEPTH, Prefetcher, pad_rows,
+                        plan_chunks, resolve_wire_dtype, wire_cast)
+
+
+# ---- chunk planner ---------------------------------------------------------
+
+def test_plan_chunks_fixed_shapes_cover_rows():
+    plan = plan_chunks(1000, 256, round_to=32)
+    chunks = list(plan)
+    assert [c.rows for c in chunks] == [256] * 4
+    assert chunks[0].start == 0 and chunks[-1].stop == 1000
+    # contiguous cover, no overlap
+    for a, b in zip(chunks, chunks[1:]):
+        assert b.start == a.start + a.rows
+    # only the tail pads, and it pads to the fixed shape
+    assert [c.pad for c in chunks] == [0, 0, 0, 24]
+    assert plan.pad_rows == 24
+
+
+def test_plan_chunks_clamps_to_span():
+    # one small dataset: chunk shrinks to the (block-rounded) span
+    # instead of emitting a mostly-pad transfer
+    plan = plan_chunks(64, 1024, round_to=16)
+    (c,) = list(plan)
+    assert c.rows == 64 and c.pad == 0
+    # ragged span rounds up to whole blocks only
+    plan = plan_chunks(70, 1024, round_to=16)
+    (c,) = list(plan)
+    assert c.rows == 80 and c.valid == 70 and c.pad == 10
+
+
+def test_plan_chunks_offset_resume_alignment():
+    full = [c.start for c in plan_chunks(1000, 256, round_to=32)]
+    resumed = plan_chunks(1000, 256, offset=512, round_to=32)
+    assert [c.start for c in resumed] == [s for s in full if s >= 512]
+    with pytest.raises(ValueError, match="multiple of round_to"):
+        plan_chunks(1000, 256, offset=100, round_to=32)
+    with pytest.raises(ValueError, match="outside"):
+        plan_chunks(100, 32, offset=101)
+
+
+def test_plan_chunks_honors_streamed_totals_caps():
+    """The ``batch_rows`` caps from ``streamed_totals_chunking`` flow
+    into the planner unchanged: the capped chunk is the fixed shape."""
+    from tpu_sgd.ops.gram import streamed_totals_chunking
+
+    B, chunk = streamed_totals_chunking(100_000, 8192, 500)
+    assert B <= 500 and chunk <= 500  # the cap is exact
+    plan = plan_chunks(100_000, chunk, round_to=B)
+    chunks = list(plan)
+    assert all(c.rows == plan.chunk_rows <= 500 for c in chunks)
+    assert chunks[-1].stop == 100_000
+    assert plan.chunk_rows % B == 0
+
+
+def test_pad_rows_zero_copy_and_cast():
+    a = np.ones((8, 3), np.float32)
+    assert pad_rows(a, 8) is a  # right shape + dtype: zero-copy
+    p = pad_rows(a, 10)
+    assert p.shape == (10, 3) and np.all(p[8:] == 0) and p.dtype == a.dtype
+    import ml_dtypes
+
+    q = pad_rows(a, 10, dtype=ml_dtypes.bfloat16)  # pad + wire cast, one pass
+    assert q.dtype == ml_dtypes.bfloat16 and np.all(
+        np.asarray(q[:8], np.float32) == 1.0)
+    with pytest.raises(ValueError, match="do not fit"):
+        pad_rows(a, 4)
+
+
+# ---- prefetcher ------------------------------------------------------------
+
+def test_prefetcher_preserves_order():
+    def produce(i):
+        time.sleep(0.002 * (5 - i % 5))  # jittered production times
+        return i * i
+
+    assert list(Prefetcher(produce, range(12), depth=3)) == [
+        i * i for i in range(12)]
+
+
+def test_prefetcher_runs_producer_off_thread():
+    main = threading.get_ident()
+    seen = []
+
+    def produce(i):
+        seen.append(threading.get_ident())
+        return i
+
+    list(Prefetcher(produce, range(4), depth=2))
+    assert all(t != main for t in seen)
+    # depth=0 is the synchronous passthrough: producer on the caller
+    seen.clear()
+    list(Prefetcher(produce, range(4), depth=0))
+    assert all(t == main for t in seen)
+
+
+def test_prefetcher_exception_propagates_in_order():
+    def produce(i):
+        if i == 3:
+            raise RuntimeError("wedged at 3")
+        return i
+
+    pf = Prefetcher(produce, range(6), depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="wedged at 3"):
+        for v in pf:
+            got.append(v)
+    assert got == [0, 1, 2]  # items before the failure arrived intact
+    # the prefetcher closed itself: iteration is over, not wedged
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_close_cancels_lookahead():
+    produced = []
+
+    def produce(i):
+        produced.append(i)
+        time.sleep(0.01)
+        return i
+
+    pf = Prefetcher(produce, range(100), depth=2)
+    assert next(pf) == 0
+    pf.close()  # early exit (convergence): queued work must not run on
+    time.sleep(0.05)  # let any stray producer call finish
+    assert len(produced) <= 4  # 0 consumed + bounded lookahead, no more
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_bounded_lookahead():
+    """depth bounds TOTAL materialized chunks (held + staged): depth=2
+    must never have more than ONE result staged ahead of the consumer —
+    the staging budget ``choose_streamed_build`` sizes is depth chunks,
+    not depth+1 (code-review finding)."""
+    in_flight = []
+
+    def produce(i):
+        in_flight.append(i)
+        return i
+
+    pf = Prefetcher(produce, range(50), depth=2)
+    time.sleep(0.05)
+    assert len(in_flight) <= 1  # only the lookahead window, pre-consume
+    assert next(pf) == 0
+    time.sleep(0.05)
+    # consumer holds 0; at most ONE more may be staged/in production
+    assert len(in_flight) <= 2
+    pf.close()
+
+    with pytest.raises(ValueError, match="depth"):
+        Prefetcher(produce, range(3), depth=-1)
+
+
+# ---- wire format -----------------------------------------------------------
+
+def test_resolve_wire_dtype():
+    import ml_dtypes
+
+    assert resolve_wire_dtype(None, np.float32) is None
+    # wire == data dtype: nothing to cast
+    assert resolve_wire_dtype("bfloat16", ml_dtypes.bfloat16) is None
+    wd = resolve_wire_dtype("bfloat16", np.float32)
+    assert wd == np.dtype(ml_dtypes.bfloat16)
+    with pytest.raises(ValueError, match="floating"):
+        resolve_wire_dtype("int32", np.float32)
+
+
+def test_wire_cast_round_trip_tolerance():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(256, 8)).astype(np.float32)
+    assert wire_cast(a, None) is a  # f32 wire: zero-copy identity
+    wd = resolve_wire_dtype("bfloat16", a.dtype)
+    back = np.asarray(wire_cast(a, wd), np.float32)
+    # bf16 keeps 8 mantissa bits: ~0.4% relative
+    np.testing.assert_allclose(back, a, rtol=8e-3, atol=1e-6)
+
+
+# ---- pipelined vs legacy sync builds ---------------------------------------
+
+def _build_data(rng, n=1000, d=12):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.uniform(-1, 1, d).astype(np.float32)).astype(np.float32)
+    return X, y
+
+
+def test_pipelined_prefix_build_bitwise_equals_sync(rng):
+    """f32 wire, padded tail chunk (960 rows into 256-row chunks): the
+    pipelined build must be BIT-identical to the legacy sync loop —
+    zero blocks contribute exact zeros and valid blocks run the same
+    (B, d) matmuls."""
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng)
+    ref = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=64, batch_rows=256, pipeline=False)
+    pip = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=64, batch_rows=256, pipeline=True)
+    assert list(pip.data.PG.shape) == list(ref.data.PG.shape)
+    for leaf in ("PG", "Pb", "Pyy", "G_tot", "b_tot", "yy_tot"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(pip.data, leaf)),
+            np.asarray(getattr(ref.data, leaf)), err_msg=leaf)
+
+
+def test_pipelined_bf16_wire_build_within_tolerance(rng):
+    """bf16 wire rounds the INPUTS (~0.4% relative); accumulation stays
+    f32, so the statistics track the f32-wire build at input-rounding
+    tolerance."""
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng)
+    ref = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=64, batch_rows=256)
+    bw = GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=64, batch_rows=256, wire_dtype="bfloat16")
+    G0 = np.asarray(ref.data.G_tot)
+    np.testing.assert_allclose(np.asarray(bw.data.G_tot), G0,
+                               rtol=2e-2, atol=2e-2 * np.abs(G0).max())
+
+
+def test_pipelined_totals_exact(rng):
+    """Whole-block row counts: bitwise.  Ragged counts: the final
+    partial block's matmul runs at the padded shape — same values at
+    reassociation tolerance (documented in ``_streamed_totals``)."""
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng, n=1024)
+    sd = np.dtype("float32")
+    for n in (1024, 1000):
+        ref = GramLeastSquaresGradient._streamed_totals(
+            X[:n], y[:n], 64, sd, 256, pipeline=False)
+        pip = GramLeastSquaresGradient._streamed_totals(
+            X[:n], y[:n], 64, sd, 256, pipeline=True)
+        for a, b in zip(ref, pip):
+            if n % 64 == 0:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            else:
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-6, atol=1e-3)
+
+
+def test_pipelined_build_compiles_one_body_program(rng):
+    """THE shape-discipline assertion: a pipelined build with a padded
+    tail runs exactly ONE compiled per-chunk stats program (fixed-shape
+    chunks; the tail padded in host numpy) — the legacy loop compiled a
+    second program for every distinct tail shape."""
+    from tpu_sgd.ops import gram as gram_mod
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng, n=990)
+    # unique (B, dtype, donate) key so other tests' compiles don't count
+    B = 33
+    gram_mod._streamed_stats_fn.cache_clear()
+    GramLeastSquaresGradient.build_streamed(
+        X, y, block_rows=B, batch_rows=4 * B, pipeline=True)
+    fn = gram_mod._streamed_stats_fn(B, "float32", False)
+    assert fn._cache_size() == 1  # one body program, padded tail reuses it
+
+    gram_mod._streamed_totals_fn.cache_clear()
+    GramLeastSquaresGradient._streamed_totals(
+        X, y, 33, np.dtype("float32"), 4 * 33, pipeline=True)
+    fn = gram_mod._streamed_totals_fn(33, "float32", False)
+    assert fn._cache_size() == 1
+
+
+def test_pipelined_sharded_totals_match_sync(rng):
+    """Meshed streamed totals: pipelined feed + the jitted donated
+    per-shard accumulate must reproduce the legacy sync build."""
+    from tpu_sgd import data_mesh
+    from tpu_sgd.parallel.gram_parallel import build_streamed_total_stats
+
+    mesh = data_mesh()
+    k = mesh.shape["data"]
+    n, d = k * 130 + 7, 6  # ragged: remainder rides the last shard
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n,)).astype(np.float32)
+    ref = build_streamed_total_stats(mesh, X, y, block_rows=32,
+                                     batch_rows=64, pipeline=False)
+    pip = build_streamed_total_stats(mesh, X, y, block_rows=32,
+                                     batch_rows=64, pipeline=True)
+    for leaf in ("G_tot", "b_tot", "yy_tot"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(pip, leaf)),
+            np.asarray(getattr(ref, leaf)), rtol=1e-6, atol=1e-4,
+            err_msg=leaf)
+
+
+def test_pipelined_sharded_prefix_matches_sync(rng):
+    from tpu_sgd import data_mesh
+    from tpu_sgd.parallel.gram_parallel import (
+        build_streamed_sharded_gram_stats,
+    )
+
+    mesh = data_mesh()
+    k = mesh.shape["data"]
+    X = rng.normal(size=(k * 160, 5)).astype(np.float32)
+    y = rng.normal(size=(k * 160,)).astype(np.float32)
+    ref, _, _ = build_streamed_sharded_gram_stats(
+        mesh, X, y, block_rows=32, batch_rows=64, pipeline=False)
+    pip, _, _ = build_streamed_sharded_gram_stats(
+        mesh, X, y, block_rows=32, batch_rows=64, pipeline=True)
+    for a, b in zip(ref, pip):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- host-streamed SGD lookahead ------------------------------------------
+
+@pytest.mark.parametrize("mode", ["sliced", "indexed", "bernoulli"])
+def test_host_streamed_prefetch_trajectory_bitwise(rng, mode):
+    """The lookahead worker must not change WHAT is sampled — only
+    where the assembly runs: depth=2 and the synchronous depth=0 feed
+    produce bit-identical weights and loss history in every sampling
+    mode (the indexed mode's gather is the satellite fix: it now rides
+    the worker)."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.streamed import optimize_host_streamed
+
+    X, y = _build_data(rng, n=2000, d=16)
+    cfg = SGDConfig(step_size=0.2, num_iterations=8,
+                    mini_batch_fraction=0.25, convergence_tol=0.0,
+                    sampling=mode)
+
+    def run(depth):
+        return optimize_host_streamed(
+            LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+            np.zeros(16, np.float32), prefetch_depth=depth)
+
+    w2, h2 = run(2)
+    w0, h0 = run(0)
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w0))
+    np.testing.assert_array_equal(h2, h0)
+
+
+def test_host_streamed_bf16_wire_converges(rng):
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.streamed import optimize_host_streamed
+
+    X, y = _build_data(rng, n=2000, d=16)
+    cfg = SGDConfig(step_size=0.2, num_iterations=12,
+                    mini_batch_fraction=0.25, convergence_tol=0.0,
+                    sampling="sliced")
+    w, hist = optimize_host_streamed(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+        np.zeros(16, np.float32), wire_dtype="bfloat16")
+    assert hist[-1] < hist[0] * 0.5  # halves the bytes, still trains
+
+
+def test_host_streamed_early_convergence_closes_prefetcher(rng):
+    """A convergence early-exit must not leave worker lookahead running
+    (the prefetcher is closed in the driver's finally)."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.streamed import optimize_host_streamed
+
+    X, y = _build_data(rng, n=512, d=8)
+    cfg = SGDConfig(step_size=1e-6, num_iterations=500,
+                    mini_batch_fraction=0.5, convergence_tol=0.5,
+                    sampling="sliced")
+    before = threading.active_count()
+    _, hist = optimize_host_streamed(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+        np.zeros(8, np.float32), prefetch_depth=2)
+    assert len(hist) < 500  # converged early
+    time.sleep(0.05)
+    assert threading.active_count() <= before + 1  # no leaked workers
+
+
+def test_host_streamed_resume_completed_checkpoint_returns(rng, tmp_path):
+    """Re-running with a checkpoint saved at the FINAL iteration must
+    return the restored weights, not raise StopIteration from an empty
+    prefetch range (code-review finding)."""
+    from tpu_sgd.config import SGDConfig
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SimpleUpdater
+    from tpu_sgd.optimize.streamed import optimize_host_streamed
+    from tpu_sgd.utils.checkpoint import CheckpointManager
+
+    X, y = _build_data(rng, n=256, d=6)
+    cfg = SGDConfig(step_size=0.2, num_iterations=4,
+                    mini_batch_fraction=0.5, convergence_tol=0.0,
+                    sampling="sliced")
+    cm = CheckpointManager(str(tmp_path))
+    w1, h1 = optimize_host_streamed(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+        np.zeros(6, np.float32), checkpoint_manager=cm,
+        checkpoint_every=1)
+    # the run completed and checkpointed at i == num_iterations; a rerun
+    # restores start_iter = 5 > 4 and must just hand the weights back
+    w2, h2 = optimize_host_streamed(
+        LeastSquaresGradient(), SimpleUpdater(), cfg, X, y,
+        np.zeros(6, np.float32), checkpoint_manager=cm,
+        checkpoint_every=1)
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w1))
+    np.testing.assert_array_equal(h2, h1)
+
+
+def test_streamed_build_resume_rejects_wire_change(rng, tmp_path):
+    """A build killed mid-pass must refuse to resume under a DIFFERENT
+    wire dtype — the halves would silently mix f32-wire and bf16-wire
+    statistics (code-review finding)."""
+    from tpu_sgd.ops import gram as gram_mod
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng, n=512, d=5)
+    resume_dir = str(tmp_path / "ckpt")
+    calls = {"n": 0}
+    real = gram_mod._chunk_prefix
+
+    def dying(*args):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated wedge")
+        return real(*args)
+
+    gram_mod._chunk_prefix = dying
+    try:
+        with pytest.raises(RuntimeError, match="wedge"):
+            GramLeastSquaresGradient.build_streamed(
+                X, y, block_rows=32, batch_rows=64,
+                resume_dir=resume_dir)
+    finally:
+        gram_mod._chunk_prefix = real
+    with pytest.raises(ValueError, match="different build"):
+        GramLeastSquaresGradient.build_streamed(
+            X, y, block_rows=32, batch_rows=64, resume_dir=resume_dir,
+            wire_dtype="bfloat16")
+
+
+# ---- optimizer knob plumbing ----------------------------------------------
+
+def test_set_ingest_options_validates_and_invalidates_cache(rng):
+    from tpu_sgd import GradientDescent
+
+    opt = GradientDescent()
+    assert opt.ingest_prefetch_depth == DEFAULT_PREFETCH_DEPTH
+    opt.set_ingest_options(wire_dtype="bfloat16", prefetch_depth=3,
+                           pipeline=True)
+    assert opt.ingest_wire_dtype == "bfloat16"
+    assert opt.ingest_prefetch_depth == 3
+    assert {"wire_dtype", "prefetch_depth",
+            "pipeline"} <= opt._user_gram_opts
+    with pytest.raises(ValueError, match="floating"):
+        opt.set_ingest_options(wire_dtype="int8")
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        opt.set_ingest_options(prefetch_depth=-1)
+
+    # a wire change must invalidate the identity-cached streamed build:
+    # the statistics DEPEND on the wire dtype
+    X, y = _build_data(rng, n=512, d=8)
+    opt = (GradientDescent().set_num_iterations(2)
+           .set_streamed_stats(True, block_rows=64))
+    opt.optimize((X, y), np.zeros(8, np.float32))
+    entry1 = opt._streamed_gram_entry
+    opt.optimize((X, y), np.zeros(8, np.float32))
+    assert opt._streamed_gram_entry is entry1  # same config: cached
+    opt.set_ingest_options(wire_dtype="bfloat16")
+    opt.optimize((X, y), np.zeros(8, np.float32))
+    assert opt._streamed_gram_entry is not entry1  # wire change: rebuilt
+
+
+def test_streamed_stats_pipeline_off_matches_on(rng):
+    """set_streamed_stats trains identically through the pipelined and
+    legacy feeds (f32 wire is bitwise at the build, so the trajectories
+    are bitwise too)."""
+    from tpu_sgd import GradientDescent
+
+    X, y = _build_data(rng, n=1024, d=8)
+
+    def run(pipeline):
+        opt = (GradientDescent().set_num_iterations(10)
+               .set_step_size(0.2).set_streamed_stats(True, block_rows=64))
+        opt.set_ingest_options(pipeline=pipeline)
+        return opt.optimize_with_history((X, y), np.zeros(8, np.float32))
+
+    w1, h1 = run(True)
+    w0, h0 = run(False)
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w0))
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h0))
+
+
+def test_host_streamed_pipeline_off_disables_wire(rng):
+    """pipeline=False is the bitwise legacy A/B feed: it must null the
+    wire cast too, not just the lookahead (code-review finding) —
+    matching the gram builders' effective-wire reduction."""
+    from tpu_sgd import GradientDescent
+
+    X, y = _build_data(rng, n=1024, d=8)
+
+    def run(**ingest):
+        opt = (GradientDescent().set_num_iterations(6).set_step_size(0.2)
+               .set_mini_batch_fraction(0.25).set_sampling("sliced")
+               .set_host_streaming(True))
+        if ingest:
+            opt.set_ingest_options(**ingest)
+        return opt.optimize_with_history((X, y), np.zeros(8, np.float32))
+
+    w_legacy, h_legacy = run()  # default pipelined f32 == legacy values
+    w_off, h_off = run(wire_dtype="bfloat16", pipeline=False)
+    np.testing.assert_array_equal(np.asarray(w_off), np.asarray(w_legacy))
+    np.testing.assert_array_equal(h_off, h_legacy)
+
+
+def test_plan_apply_respects_user_ingest_knobs():
+    from tpu_sgd import GradientDescent
+    from tpu_sgd.plan import Plan
+
+    opt = GradientDescent().set_ingest_options(wire_dtype="bfloat16",
+                                               prefetch_depth=4)
+    Plan("host_streamed", "test").apply(opt)
+    # user knobs survive the plan (the planner never silently rounds)
+    assert opt.ingest_wire_dtype == "bfloat16"
+    assert opt.ingest_prefetch_depth == 4
+
+    opt2 = GradientDescent()
+    Plan("host_streamed", "test", prefetch_depth=3).apply(opt2)
+    assert opt2.ingest_wire_dtype is None  # plan default: wire OFF
+    assert opt2.ingest_prefetch_depth == 3

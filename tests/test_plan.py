@@ -892,16 +892,17 @@ def test_lbfgs_streamed_stats_guards(rng):
 
 
 def test_choose_streamed_build_budgets_chunk():
-    """The streamed build's device footprint is stack + in-flight chunk;
-    both must fit (review r4: the 64-block default chunk at a
-    stack-forced large B exceeded the budget by itself)."""
+    """The streamed build's device footprint is stack + TWO in-flight
+    chunks — the double-buffered ingest pipeline stages chunk k+1 while
+    chunk k's kernel consumes its buffer (review r4 established the
+    single-chunk accounting; the io-layer prefetcher doubles it)."""
     from tpu_sgd.plan import _stack_bytes, choose_streamed_build
 
     B, batch = choose_streamed_build(100_000_000, 1000, 2, 12 * GB)
     assert B is not None and batch is not None
     stack = _stack_bytes(100_000_000, B, 1000)
     chunk = batch * (1000 * 2 + 4)
-    assert stack + chunk <= 12 * GB
+    assert stack + 2 * chunk <= 12 * GB  # double-buffer staging
     assert batch >= B  # at least one whole block per transfer
     # impossible O(d^2) stack: nothing fits
     assert choose_streamed_build(1_000_000, 100_000, 2,

@@ -1,0 +1,53 @@
+"""Shared host→device ingestion layer.
+
+Every streaming path in this codebase ultimately does the same three
+things: cut a host-resident dataset into chunks, move each chunk to a
+device, and hand it to a compiled consumer.  Before this package each
+path hand-rolled that loop — synchronous full-width ``device_put`` with
+zero transfer/compute overlap, and a differently-shaped tail chunk that
+recompiled the per-chunk kernels (the eager-op shape-compile trap).
+``BENCH_LAST_TPU.json`` puts the cost on the record: the streamed
+statistics build is feed-bound at ``build_s=248.2 s`` while the compute
+side idles at 0.024 ms/iter.
+
+Three pieces, composed by the streaming consumers (``ops/gram.py``
+builders, ``parallel/gram_parallel.py`` meshed builders,
+``optimize/streamed.py`` host-streamed SGD):
+
+* :mod:`tpu_sgd.io.chunking` — a chunk planner that emits FIXED-SHAPE
+  chunks; the tail is padded in host numpy so the device-side consumer
+  compiles exactly one body program (MLlib keeps the pipeline full
+  between stages, arXiv:1505.06807 — our stage boundary is the host
+  link).
+* :mod:`tpu_sgd.io.prefetch` — a bounded-lookahead background producer:
+  chunk ``k+1``'s host assembly + ``device_put`` runs on a worker
+  thread while chunk ``k``'s kernel executes.  ``depth=2`` is the
+  classic double buffer (one chunk being consumed + one in flight), so
+  the staging footprint is ~2× one chunk — size ``batch_rows``
+  accordingly (``plan.choose_streamed_build`` does).
+* :mod:`tpu_sgd.io.wire` — an opt-in bf16 wire format: cast on host,
+  transfer half the bytes, upcast/accumulate in f32 on device (the
+  SparCML shrink-bytes-on-the-wire move, arXiv:1802.08021, applied to
+  the host→HBM hop).
+
+See README "Ingestion pipeline" for when the bf16 wire is safe and how
+``batch_rows`` interacts with the double buffer's 2× staging footprint.
+"""
+
+from tpu_sgd.io.chunking import Chunk, ChunkPlan, pad_rows, plan_chunks
+from tpu_sgd.io.prefetch import Prefetcher
+from tpu_sgd.io.wire import resolve_wire_dtype, wire_cast
+
+#: default lookahead of every pipelined streaming path (double buffer)
+DEFAULT_PREFETCH_DEPTH = 2
+
+__all__ = [
+    "Chunk",
+    "ChunkPlan",
+    "DEFAULT_PREFETCH_DEPTH",
+    "Prefetcher",
+    "pad_rows",
+    "plan_chunks",
+    "resolve_wire_dtype",
+    "wire_cast",
+]

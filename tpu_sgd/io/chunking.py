@@ -1,0 +1,135 @@
+"""Fixed-shape chunk planning for host→device streaming.
+
+The planner's one job is shape discipline: every chunk it emits has the
+SAME row count, so the per-chunk device programs (stats kernel, prefix
+scan, donated accumulate) compile exactly once per build — the tail is
+padded with zero rows in HOST numpy (never an eager device op; see the
+eager-op shape-compile trap note in ``tpu_sgd/serve/engine.py``).  Zero
+rows are exact for every consumer in this codebase: they contribute
+exact zeros to Gram sums, and the prefix running sum repeats its carry
+through zero blocks, so padded prefix rows hold the same value as the
+last valid row.
+
+``round_to`` aligns the fixed shape to the consumer's block size ``B``
+so a padded tail is whole zero BLOCKS — the valid blocks then run
+through bit-identical ``(B, d)`` matmuls and the f32-wire pipelined
+build is bitwise equal to the legacy sync build (asserted in
+``tests/test_io.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Chunk:
+    """One planned chunk: source rows ``[start, stop)`` materialized at
+    the plan's fixed ``rows`` shape (``pad`` trailing zero rows)."""
+
+    index: int
+    start: int
+    stop: int
+    rows: int
+
+    @property
+    def valid(self) -> int:
+        return self.stop - self.start
+
+    @property
+    def pad(self) -> int:
+        return self.rows - self.valid
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """Fixed-shape cover of host rows ``[offset, n)``.
+
+    Every chunk is ``chunk_rows`` rows (a multiple of ``round_to``);
+    only the LAST chunk may carry padding, always trailing, never
+    interleaved with valid rows.  When the covered span is itself a
+    multiple of ``round_to`` (the prefix builds: ``n_used = nbf · B``),
+    the pad is whole zero groups — the bitwise-equality guarantee.  A
+    ragged span (the totals builds, which count every row) leaves ONE
+    group partially valid, zero-padded to the group boundary; consumers
+    that truncate to whole groups (``valid // B``) must feed
+    group-aligned spans.
+    """
+
+    n: int
+    offset: int
+    chunk_rows: int
+    round_to: int
+
+    @property
+    def n_chunks(self) -> int:
+        span = self.n - self.offset
+        return -(-span // self.chunk_rows) if span > 0 else 0
+
+    @property
+    def pad_rows(self) -> int:
+        """Zero rows appended to the final chunk."""
+        span = self.n - self.offset
+        return self.n_chunks * self.chunk_rows - span
+
+    def __iter__(self) -> Iterator[Chunk]:
+        for i in range(self.n_chunks):
+            start = self.offset + i * self.chunk_rows
+            yield Chunk(index=i, start=start,
+                        stop=min(start + self.chunk_rows, self.n),
+                        rows=self.chunk_rows)
+
+
+def plan_chunks(n: int, chunk_rows: int, *, offset: int = 0,
+                round_to: int = 1) -> ChunkPlan:
+    """Plan fixed-shape chunks over rows ``[offset, n)``.
+
+    ``chunk_rows`` is rounded down to a multiple of ``round_to`` (the
+    consumer's block size), then CLAMPED so a dataset smaller than one
+    requested chunk gets one right-sized chunk instead of a mostly-pad
+    transfer (``streamed_totals_chunking``'s ``batch_rows`` caps flow in
+    here unchanged — the cap bounds the fixed shape, the clamp keeps the
+    shape tight).  ``offset`` supports resumed builds: checkpoints save
+    at chunk boundaries, so a resumed plan's chunks land on the same
+    rows as the uninterrupted plan's remaining chunks.
+    """
+    n = int(n)
+    offset = int(offset)
+    round_to = max(1, int(round_to))
+    if not 0 <= offset <= n:
+        raise ValueError(f"offset {offset} outside [0, {n}]")
+    if offset % round_to:
+        raise ValueError(
+            f"offset {offset} is not a multiple of round_to={round_to} "
+            "(resume checkpoints save at block boundaries)"
+        )
+    chunk_rows = max(round_to, (int(chunk_rows) // round_to) * round_to)
+    span = n - offset
+    span_rounded = -(-span // round_to) * round_to  # pad only to blocks
+    chunk_rows = min(chunk_rows, max(span_rounded, round_to))
+    return ChunkPlan(n=n, offset=offset, chunk_rows=chunk_rows,
+                     round_to=round_to)
+
+
+def pad_rows(a: np.ndarray, rows: int,
+             dtype: Optional[np.dtype] = None) -> np.ndarray:
+    """Fixed-shape host-numpy padding (+ optional wire cast).
+
+    Returns ``a`` itself (zero-copy) when it already has ``rows`` rows
+    and the target dtype; otherwise allocates a ``rows``-row zero buffer
+    of the target dtype and copies ``a`` in (numpy casts on assignment,
+    so pad and wire cast are one host pass).  All shape-dependent work
+    happens HERE, on host — the device only ever sees the fixed shape.
+    """
+    a = np.asarray(a)
+    dt = np.dtype(dtype) if dtype is not None else a.dtype
+    if a.shape[0] == rows and a.dtype == dt:
+        return a
+    if a.shape[0] > rows:
+        raise ValueError(f"{a.shape[0]} rows do not fit a {rows}-row chunk")
+    out = np.zeros((rows,) + a.shape[1:], dt)
+    out[: a.shape[0]] = a
+    return out

@@ -1,0 +1,107 @@
+"""Bounded-lookahead background producer (the double buffer).
+
+One worker thread runs ``producer(item)`` — host-side chunk assembly
+(slice / gather / pad / wire cast) plus the ``device_put`` dispatch —
+while the consumer thread runs the current chunk's device kernel.  The
+worker holds no JAX state of its own: ``device_put`` and jit dispatch
+are thread-safe, and numpy releases the GIL for the bulk copies, so the
+two genuinely overlap (measured on this repo's serving threads and in
+``bench_ingest.py``).
+
+Semantics the consumers rely on:
+
+* ORDER — one worker thread, FIFO submission: results arrive in item
+  order, always.
+* EXCEPTIONS — a producer error re-raises at the consumer's ``next()``
+  for exactly that item (not earlier, not swallowed); the prefetcher
+  then closes itself, cancelling queued work.
+* BOUNDED STAGING — at most ``depth`` chunks are materialized at once
+  INCLUDING the one the consumer holds (the default 2 = one being
+  consumed + one in flight — at most ``depth - 1`` staged ahead), so
+  the device-side staging footprint is ``depth``× one chunk.
+  ``plan.choose_streamed_build`` budgets for the default 2; deeper
+  depths grow the footprint proportionally — shrink ``batch_rows``
+  when raising depth on a tight device.
+* ``depth<=1`` — synchronous passthrough (no thread): one chunk
+  materialized at a time, the exact legacy serial loop, kept for
+  bitwise A/B tests, debugging, and single-chunk memory budgets.
+"""
+
+from __future__ import annotations
+
+import collections
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class Prefetcher:
+    """Iterate ``producer(item) for item in items`` with background
+    lookahead.  Use as an iterator; call :meth:`close` (or leave a
+    ``with`` block) to cancel outstanding work on early exit — a
+    convergence break must not leave a worker streaming chunks nobody
+    will consume."""
+
+    def __init__(self, producer: Callable[[T], R], items: Iterable[T],
+                 depth: int = 2):
+        if int(depth) < 0:
+            raise ValueError(f"depth must be >= 0, got {depth}")
+        self._producer = producer
+        self._items = iter(items)
+        self._depth = int(depth)
+        self._pending = collections.deque()
+        self._pool = None
+        self._exhausted = False
+        if self._depth > 1:  # <=1: serial — one chunk live at a time
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-sgd-ingest")
+            self._fill()
+
+    def _fill(self) -> None:
+        # pending is capped at depth-1: the consumer's in-hand chunk plus
+        # the pending window together stay within the depth-chunk staging
+        # budget (a cap of depth here would materialize depth+1 chunks)
+        cap = self._depth - 1
+        while not self._exhausted and len(self._pending) < cap:
+            try:
+                item = next(self._items)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._pending.append(self._pool.submit(self._producer, item))
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> R:
+        if self._depth <= 1:  # synchronous passthrough
+            return self._producer(next(self._items))
+        if self._pool is None:
+            raise StopIteration  # closed
+        if not self._pending:
+            self.close()
+            raise StopIteration
+        fut = self._pending.popleft()
+        self._fill()  # keep the lookahead window full while we wait
+        try:
+            return fut.result()
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        """Cancel queued work and release the worker.  Idempotent; the
+        in-flight producer call (if any) is left to finish — its result
+        is dropped."""
+        pool, self._pool = self._pool, None
+        self._pending.clear()
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

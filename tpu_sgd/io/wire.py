@@ -1,0 +1,80 @@
+"""Opt-in wire format for the host→device hop.
+
+The streamed paths are feed-bound (the 248 s statistics build moves
+20 GB through a 0.03–0.16 GB/s link), so bytes-on-the-wire IS the
+build time — SparCML's observation (arXiv:1802.08021) applied to the
+host→HBM hop instead of the inter-node one.  ``wire_dtype="bfloat16"``
+casts each chunk in HOST numpy, transfers half the bytes, and the
+device-side consumers upcast to the f32 (or wider) stats dtype before
+accumulating — accumulation precision is unchanged; only the INPUT
+values are rounded to bf16 (~0.4% relative).
+
+When that is safe: the north-star host dataset is already bf16 (zero
+rounding — the wire matches the data), and SGD on f32 data tolerates
+input rounding far below its own sampling noise.  When it is not: runs
+that must be bit-reproducible against an f32 resident build, or data
+whose information lives below bf16's 8 mantissa bits.  The default is
+always OFF (``wire_dtype=None`` = transfer at the data dtype).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _as_dtype(name) -> np.dtype:
+    """``np.dtype`` that also understands ``"bfloat16"`` (an ml_dtypes
+    extension type plain numpy cannot name)."""
+    if isinstance(name, str) and name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _is_floating(dt: np.dtype) -> bool:
+    """Floating check that covers the ml_dtypes extension types (bf16 is
+    not an ``np.floating`` subtype — ``np.issubdtype`` alone rejects
+    exactly the dtype this module exists for)."""
+    if np.issubdtype(dt, np.floating):
+        return True
+    try:
+        import ml_dtypes
+
+        ml_dtypes.finfo(dt)  # raises for non-float extension types
+        return True
+    except Exception:
+        return False
+
+
+def resolve_wire_dtype(wire_dtype, data_dtype) -> Optional[np.dtype]:
+    """The host-side cast target for a streaming path, or None for
+    "transfer as-is" (no cast, bit-identical wire).
+
+    ``None`` passes through; a wire dtype equal to the data dtype also
+    resolves to None (nothing to cast — e.g. bf16 wire on the bf16
+    north-star host set is already free).  Non-floating wire dtypes
+    raise: an int wire would silently truncate every element.
+    """
+    if wire_dtype is None:
+        return None
+    wd = _as_dtype(wire_dtype)
+    if not _is_floating(wd):
+        raise ValueError(
+            f"wire_dtype must be a floating dtype, got {wd}; "
+            "use 'bfloat16' (half the bytes) or None (data dtype)"
+        )
+    if wd == np.dtype(data_dtype):
+        return None
+    return wd
+
+
+def wire_cast(a: np.ndarray, wire: Optional[np.dtype]) -> np.ndarray:
+    """Host-numpy cast to the resolved wire dtype (identity when the
+    wire is None or already matches — zero-copy)."""
+    a = np.asarray(a)
+    if wire is None or a.dtype == wire:
+        return a
+    return a.astype(wire)

@@ -368,7 +368,8 @@ class _TotalsBuildCheckpoint:
     geometry + dataset fingerprint) — negligible next to the host feed
     the resume exists to protect."""
 
-    def __init__(self, path, *, n, d, B, chunk, sd_name, fingerprint=""):
+    def __init__(self, path, *, n, d, B, chunk, sd_name, fingerprint="",
+                 wire="none"):
         import os
 
         self.path = path
@@ -376,6 +377,9 @@ class _TotalsBuildCheckpoint:
             "class": "TotalsBuildCheckpoint",
             "n": int(n), "d": int(d), "B": int(B), "chunk": int(chunk),
             "stats_dtype": sd_name, "fingerprint": fingerprint,
+            # the EFFECTIVE wire dtype: chunks accumulated under one wire
+            # must never silently mix with a resumed pass under another
+            "wire": wire,
         }
         os.makedirs(path, exist_ok=True)
         self._state_path = os.path.join(path, "totals.npz")
@@ -422,7 +426,7 @@ class _PrefixBuildCheckpoint:
     identical to an uninterrupted one."""
 
     def __init__(self, path, *, n_used, d, B, sd_name, chunk,
-                 fingerprint=""):
+                 fingerprint="", wire="none"):
         import os
 
         self.path = path
@@ -431,16 +435,20 @@ class _PrefixBuildCheckpoint:
             "n_used": int(n_used), "d": int(d), "B": int(B),
             "stats_dtype": sd_name, "chunk": int(chunk),
             "fingerprint": fingerprint,
+            # effective wire dtype: a resumed pass under a DIFFERENT wire
+            # would silently mix f32-wire and bf16-wire chunk statistics
+            "wire": wire,
             "high_water_rows": 0,
         }
         os.makedirs(path, exist_ok=True)
         self._meta_path = os.path.join(path, "meta.json")
-        # geometry AND dataset identity: a stale resume_dir from a
-        # different same-shaped dataset would otherwise silently mix
-        # two datasets' statistics
+        # geometry AND dataset identity AND wire: a stale resume_dir from
+        # a different same-shaped dataset (or another wire dtype) would
+        # otherwise silently mix two builds' statistics
         on_disk = _validate_or_write_meta(
             self._meta_path, self.meta,
-            ("class", "n_used", "d", "B", "stats_dtype", "fingerprint"))
+            ("class", "n_used", "d", "B", "stats_dtype", "fingerprint",
+             "wire"))
         if on_disk is not self.meta:
             self.meta["high_water_rows"] = int(
                 on_disk.get("high_water_rows", 0))
@@ -495,27 +503,38 @@ class _PrefixBuildCheckpoint:
         shutil.rmtree(self.path, ignore_errors=True)
 
 
+def _donate_chunks_ok() -> bool:
+    """Whether the per-chunk kernels should DONATE their chunk buffers
+    (the prefetcher's staging buffer is consumed exactly once, so
+    donation hands its HBM back for the next in-flight chunk).  CPU has
+    no donation — requesting it there only emits a warning per call."""
+    return jax.default_backend() != "cpu"
+
+
 @lru_cache(maxsize=16)
-def _streamed_totals_fn(B, sd_name):
+def _streamed_totals_fn(B, sd_name, donate=False):
     """Jitted per-chunk TOTALS kernel, memoized per (block size, stats
     dtype) so the per-shard mesh builder compiles once, not once per
     device per build (compile stalls are a real cost on the remote-TPU
-    tunnel)."""
-    return jax.jit(partial(
+    tunnel).  ``donate=True`` (the pipelined ingest path off-CPU)
+    donates the chunk buffers — see :func:`_donate_chunks_ok`."""
+    fn = partial(
         GramLeastSquaresGradient._total_stats,
         B=B, stats_dtype=jnp.dtype(sd_name),
-    ))
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 @lru_cache(maxsize=16)
-def _streamed_stats_fn(B, sd_name):
+def _streamed_stats_fn(B, sd_name, donate=False):
     """Jitted per-chunk block-stats kernel, memoized per (block size,
     stats dtype) so the per-shard mesh builder compiles once, not once
-    per shard."""
-    return jax.jit(partial(
+    per shard.  ``donate`` as in :func:`_streamed_totals_fn`."""
+    fn = partial(
         GramLeastSquaresGradient._block_stats,
         B=B, stats_dtype=jnp.dtype(sd_name),
-    ))
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 class GramLeastSquaresGradient(LeastSquaresGradient):
@@ -732,6 +751,9 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                        batch_rows: Optional[int] = None,
                        stats_dtype=None,
                        resume_dir: Optional[str] = None,
+                       wire_dtype=None,
+                       prefetch_depth: int = 2,
+                       pipeline: bool = True,
                        ) -> "GramLeastSquaresGradient":
         """Statistics for a HOST-resident dataset too large for HBM.
 
@@ -751,6 +773,17 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         each chunk's prefix rows persist to atomic part files so a build
         killed mid-stream (a wedged host link) restarts from its
         high-water block, bitwise identical (see ``_streamed_prefix``).
+
+        Ingest pipeline (``tpu_sgd/io``; README "Ingestion pipeline"):
+        ``pipeline=True`` (default) streams FIXED-SHAPE chunks with
+        chunk ``k+1``'s host assembly + ``device_put`` overlapping chunk
+        ``k``'s kernel — f32-wire results are BITWISE identical to the
+        legacy sync loop (``pipeline=False``).  ``wire_dtype="bfloat16"``
+        (opt-in) halves the bytes on the wire; the kernels still
+        accumulate in the f32+ stats dtype, so only the input values are
+        bf16-rounded.  ``prefetch_depth`` chunks may be staged ahead
+        (2 = double buffer; its staging footprint rides INSIDE the
+        ``batch_rows`` budget the planner sizes).
         """
         import numpy as np
 
@@ -768,8 +801,10 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         sd = cls._resolve_stats_dtype(data_dtype, stats_dtype)
         chunk_blocks = max(1, int(batch_rows) // B) if batch_rows else 64
         chunk = chunk_blocks * B
-        PG, Pb, Pyy = cls._streamed_prefix(Xh, yh, B, sd, chunk,
-                                           resume_dir=resume_dir)
+        PG, Pb, Pyy = cls._streamed_prefix(
+            Xh, yh, B, sd, chunk, resume_dir=resume_dir,
+            wire_dtype=wire_dtype, prefetch_depth=prefetch_depth,
+            pipeline=pipeline)
         jax.block_until_ready((PG, Pb, Pyy))
         data = GramData(
             None, PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1], B,
@@ -780,7 +815,8 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
 
     @classmethod
     def _streamed_prefix(cls, Xh, yh, B, sd, chunk, device=None,
-                         resume_dir=None):
+                         resume_dir=None, wire_dtype=None,
+                         prefetch_depth=2, pipeline=True):
         """Chunked host->device streaming prefix build on ``device``
         (default placement when None) — shared by :meth:`build_streamed`
         and the per-shard mesh builder (``parallel/gram_parallel.py``).
@@ -793,6 +829,20 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         RESOURCE_EXHAUSTED at 10Mx1000 on a fragmented 16 GB chip; this
         form peaks at prefix + one chunk (~5.5 GB there).
 
+        ``pipeline=True`` (default) routes the feed through the shared
+        ingest layer (``tpu_sgd/io``): FIXED-shape chunks from the chunk
+        planner (the tail padded with whole zero BLOCKS in host numpy, so
+        the stats kernel and prefix scan compile exactly one body program
+        — zero blocks contribute exact zeros and the running sum repeats
+        its carry through them, keeping the result BITWISE equal to the
+        ``pipeline=False`` legacy sync loop on an f32 wire), with chunk
+        ``k+1``'s assembly + ``device_put`` prefetched on a worker thread
+        while chunk ``k``'s kernel runs (``prefetch_depth=2`` = double
+        buffer).  ``wire_dtype`` opts into the narrow wire format
+        (``tpu_sgd/io/wire.py``).  Off the CPU backend the chunk buffers
+        are DONATED into the kernel, so the staging footprint stays at
+        ~``prefetch_depth`` chunks.
+
         ``resume_dir`` (opt-in): after each chunk, persist that chunk's
         prefix rows to an atomic part file (plus a meta record), so a
         build killed mid-pass — this environment's host link has wedged
@@ -802,13 +852,21 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         The analogue of RDD lineage replay resuming from persisted
         partitions (SURVEY.md §5.3).  Costs one device→host readback of
         each chunk's prefix rows — enable it when the feed is flaky, not
-        by default."""
+        by default.  Part files hold VALID prefix rows only (pad rows
+        never persist), so checkpoints interoperate across both modes.
+        """
         import numpy as np
+
+        from tpu_sgd.io import (Prefetcher, pad_rows, plan_chunks,
+                                resolve_wire_dtype, wire_cast)
 
         n_used = (Xh.shape[0] // B) * B
         nbf = n_used // B
         d = Xh.shape[1]
-        stats_fn = _streamed_stats_fn(B, jnp.dtype(sd).name)
+        sd_np = np.dtype(jnp.dtype(sd).name)
+        # effective wire (legacy sync feed transfers at the data dtype)
+        wd = resolve_wire_dtype(wire_dtype, Xh.dtype) if pipeline else None
+        wire_name = "none" if wd is None else str(np.dtype(wd))
 
         def put(a):
             return jax.device_put(a, device)
@@ -832,6 +890,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                 resume_dir, n_used=n_used, d=d, B=B,
                 sd_name=jnp.dtype(sd).name, chunk=chunk,
                 fingerprint=_dataset_fingerprint(Xh, yh, n_used),
+                wire=wire_name,
             )
             s, parts = ckpt.restore()
             for start_block, (pGh, pbh, pyyh) in parts:
@@ -840,23 +899,64 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                     PG, Pb, Pyy, pG, pb, pyy,
                     jnp.asarray(start_block + 1, jnp.int32))
                 cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
-        while s < n_used:
-            e = min(s + chunk, n_used)
-            if (e - s) % B:  # last partial chunk: shrink to whole blocks
-                e = s + ((e - s) // B) * B
-            Xc = put(Xh[s:e])
-            # y rides at the RESOLVED stats dtype (>= f32): f64 data under
-            # jax_enable_x64 keeps f64 b/yy statistics, matching the
-            # resident build()'s _resolve_stats_dtype contract.
-            yc = put(np.asarray(yh[s:e], np.dtype(sd)))
-            Gc, bc, yyc = stats_fn(Xc, yc)
-            pG, pb, pyy = _chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
-            cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
-            PG, Pb, Pyy = _write_prefix(PG, Pb, Pyy, pG, pb, pyy,
-                                        jnp.asarray(s // B + 1, jnp.int32))
+        if not pipeline:
+            stats_fn = _streamed_stats_fn(B, jnp.dtype(sd).name, False)
+            while s < n_used:
+                e = min(s + chunk, n_used)
+                if (e - s) % B:  # last partial chunk: whole blocks only
+                    e = s + ((e - s) // B) * B
+                Xc = put(Xh[s:e])
+                # y rides at the RESOLVED stats dtype (>= f32): f64 data
+                # under jax_enable_x64 keeps f64 b/yy statistics, matching
+                # the resident build()'s _resolve_stats_dtype contract.
+                yc = put(np.asarray(yh[s:e], sd_np))
+                Gc, bc, yyc = stats_fn(Xc, yc)
+                pG, pb, pyy = _chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
+                cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
+                PG, Pb, Pyy = _write_prefix(
+                    PG, Pb, Pyy, pG, pb, pyy,
+                    jnp.asarray(s // B + 1, jnp.int32))
+                if ckpt is not None:
+                    ckpt.save_part(s // B, pG, pb, pyy, high_water_rows=e)
+                s = e
             if ckpt is not None:
-                ckpt.save_part(s // B, pG, pb, pyy, high_water_rows=e)
-            s = e
+                ckpt.finalize()
+            return PG, Pb, Pyy
+
+        stats_fn = _streamed_stats_fn(B, jnp.dtype(sd).name,
+                                      _donate_chunks_ok())
+        plan = plan_chunks(n_used, chunk, offset=s, round_to=B)
+        cb_blocks = plan.chunk_rows // B
+
+        def produce(c):
+            # Host-side assembly on the prefetch worker: slice, wire
+            # cast, fixed-shape pad (all host numpy — the device only
+            # ever sees ONE chunk shape), then the async device_put.
+            Xc = wire_cast(Xh[c.start:c.stop], wd)
+            if c.pad:
+                Xc = pad_rows(Xc, c.rows)
+            yc = pad_rows(np.asarray(yh[c.start:c.stop], sd_np), c.rows)
+            return c, put(Xc), put(yc)
+
+        pf = Prefetcher(produce, plan, depth=prefetch_depth)
+        try:
+            for c, Xc, yc in pf:
+                Gc, bc, yyc = stats_fn(Xc, yc)
+                pG, pb, pyy = _chunk_prefix(cG, cb, cyy, Gc, bc, yyc)
+                # pad blocks contribute exact zeros, so the padded tail
+                # rows repeat the carry: pG[-1] IS the last valid row
+                cG, cb, cyy = pG[-1], pb[-1], pyy[-1]
+                vb = c.valid // B
+                if vb != cb_blocks:  # padded tail: write valid rows only
+                    pG, pb, pyy = pG[:vb], pb[:vb], pyy[:vb]
+                PG, Pb, Pyy = _write_prefix(
+                    PG, Pb, Pyy, pG, pb, pyy,
+                    jnp.asarray(c.start // B + 1, jnp.int32))
+                if ckpt is not None:
+                    ckpt.save_part(c.start // B, pG, pb, pyy,
+                                   high_water_rows=c.stop)
+        finally:
+            pf.close()
         if ckpt is not None:
             ckpt.finalize()
         return PG, Pb, Pyy
@@ -864,12 +964,23 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
     @classmethod
     def _streamed_totals(cls, Xh, yh, B, sd, chunk, device=None,
                          resume_dir=None, checkpoint_every: int = 4,
-                         finalize: bool = True):
+                         finalize: bool = True, wire_dtype=None,
+                         prefetch_depth=2, pipeline=True):
         """Chunked host→device streaming TOTALS accumulation on
         ``device`` — like :meth:`_streamed_prefix` but with an O(d²)
         carry instead of a prefix stack (the quasi-Newton CostFun reads
-        only totals), and EXACT: every row contributes (the tail chunk
-        is a second static shape, not a drop).
+        only totals), and EXACT: every row contributes (padded zero rows
+        add exact zeros, never a drop).
+
+        ``pipeline``/``wire_dtype``/``prefetch_depth`` as in
+        :meth:`_streamed_prefix`: fixed-shape chunks (tail zero-padded in
+        host numpy to whole blocks — one compiled kernel), double-
+        buffered prefetch, opt-in narrow wire.  Totals are exact either
+        way; when ``n`` is not a multiple of ``B`` the final partial
+        block's matmul runs at the padded ``(B, d)`` shape instead of the
+        legacy ragged one, so pipelined-vs-sync agreement there is
+        reassociation-level, not bitwise (whole-block datasets ARE
+        bitwise; asserted in ``tests/test_io.py``).
 
         ``resume_dir`` (opt-in): persist the tiny carry after each chunk
         so a build killed mid-pass resumes from its high-water row,
@@ -877,12 +988,16 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
         (the state is one (d, d) matrix, not a GB-scale stack)."""
         import numpy as np
 
+        from tpu_sgd.io import (Prefetcher, pad_rows, plan_chunks,
+                                resolve_wire_dtype, wire_cast)
+
         n, d = Xh.shape
         zeros_fn = partial(jnp.zeros, device=device)
         G = zeros_fn((d, d), sd)
         b = zeros_fn((d,), sd)
         yy = zeros_fn((), sd)
-        tot_fn = _streamed_totals_fn(B, jnp.dtype(sd).name)
+        # effective wire (legacy sync feed transfers at the data dtype)
+        wd = resolve_wire_dtype(wire_dtype, Xh.dtype) if pipeline else None
         s = 0
         ckpt = None
         if resume_dir is not None:
@@ -890,6 +1005,7 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                 resume_dir, n=n, d=d, B=B, chunk=chunk,
                 sd_name=jnp.dtype(sd).name,
                 fingerprint=_dataset_fingerprint(Xh, yh, n),
+                wire="none" if wd is None else str(np.dtype(wd)),
             )
             s, carry = ckpt.restore()
             if carry is not None:
@@ -897,20 +1013,56 @@ class GramLeastSquaresGradient(LeastSquaresGradient):
                 b = jax.device_put(carry[1], device)
                 yy = jax.device_put(carry[2], device)
         chunks_since_save = 0
-        while s < n:
-            e = min(s + chunk, n)
-            Xc = jax.device_put(Xh[s:e], device)
-            yc = jax.device_put(np.asarray(yh[s:e]), device)
-            dG, db, dyy = tot_fn(Xc, yc)
-            G, b, yy = _acc_totals(G, b, yy, dG, db, dyy)
-            chunks_since_save += 1
-            # every-N saves keep the async overlap (each save blocks on a
-            # device->host readback); a crash re-streams at most N chunks
-            if (ckpt is not None
-                    and (chunks_since_save >= checkpoint_every or e >= n)):
-                ckpt.save(e, G, b, yy)
-                chunks_since_save = 0
-            s = e
+        if not pipeline:
+            tot_fn = _streamed_totals_fn(B, jnp.dtype(sd).name, False)
+            while s < n:
+                e = min(s + chunk, n)
+                Xc = jax.device_put(Xh[s:e], device)
+                yc = jax.device_put(np.asarray(yh[s:e]), device)
+                dG, db, dyy = tot_fn(Xc, yc)
+                G, b, yy = _acc_totals(G, b, yy, dG, db, dyy)
+                chunks_since_save += 1
+                # every-N saves keep the async overlap (each save blocks
+                # on a device->host readback); a crash re-streams at most
+                # N chunks
+                if (ckpt is not None
+                        and (chunks_since_save >= checkpoint_every
+                             or e >= n)):
+                    ckpt.save(e, G, b, yy)
+                    chunks_since_save = 0
+                s = e
+            if ckpt is not None and finalize:
+                ckpt.finalize()
+            return G, b, yy
+
+        tot_fn = _streamed_totals_fn(B, jnp.dtype(sd).name,
+                                     _donate_chunks_ok())
+        # resume offsets land on chunk boundaries (saves happen at chunk
+        # ends), which the planner requires only to be block-aligned; the
+        # final save is at row n itself — an already-complete restore
+        # must not be asked to block-align it
+        plan = plan_chunks(n, chunk, offset=s, round_to=B) if s < n else ()
+
+        def produce(c):
+            Xc = wire_cast(Xh[c.start:c.stop], wd)
+            if c.pad:
+                Xc = pad_rows(Xc, c.rows)
+            yc = pad_rows(np.asarray(yh[c.start:c.stop]), c.rows)
+            return c, jax.device_put(Xc, device), jax.device_put(yc, device)
+
+        pf = Prefetcher(produce, plan, depth=prefetch_depth)
+        try:
+            for c, Xc, yc in pf:
+                dG, db, dyy = tot_fn(Xc, yc)
+                G, b, yy = _acc_totals(G, b, yy, dG, db, dyy)
+                chunks_since_save += 1
+                if (ckpt is not None
+                        and (chunks_since_save >= checkpoint_every
+                             or c.stop >= n)):
+                    ckpt.save(c.stop, G, b, yy)
+                    chunks_since_save = 0
+        finally:
+            pf.close()
         if ckpt is not None and finalize:
             ckpt.finalize()
         return G, b, yy

@@ -283,6 +283,13 @@ class GradientDescent(Optimizer):
         self.gram_batch_rows = None
         self.gram_aligned = False
         self.gram_chunk_iters = None
+        #: ingest-pipeline knobs (tpu_sgd/io; set_ingest_options): wire
+        #: dtype for the host→device hop (None = data dtype), prefetch
+        #: lookahead (2 = double buffer, 0 = synchronous), and the
+        #: pipelined-build master switch (False = legacy sync loops)
+        self.ingest_wire_dtype = None
+        self.ingest_prefetch_depth = 2
+        self.ingest_pipeline = True
         #: gram-knob fields the USER set via set_gram_options /
         #: set_streamed_stats — the planner preserves these and resets
         #: only plan-owned fields (Plan.apply)
@@ -376,7 +383,14 @@ class GradientDescent(Optimizer):
         device) — rows ``[0, resident_rows)`` are placed on the device once
         and windows inside that prefix are sliced on-device, cutting
         per-epoch host->device traffic by ~``resident_rows/n`` with an
-        unchanged window sequence (see ``optimize_host_streamed``)."""
+        unchanged window sequence (see ``optimize_host_streamed``).
+
+        The per-iteration feed runs through the shared ingest pipeline
+        (``tpu_sgd/io``): iteration ``i+1``'s batch assembles and
+        transfers on a worker thread while ``i`` computes, and
+        ``set_ingest_options(wire_dtype="bfloat16")`` halves the bytes on
+        the wire — see README "Ingestion pipeline" for the knobs and the
+        bf16 safety notes."""
         self._clear_planned_schedule()
         self.host_streaming = bool(flag)
         self.streaming_resident_rows = int(resident_rows)
@@ -459,6 +473,32 @@ class GradientDescent(Optimizer):
                               chunk_iters=chunk_iters)
         return self
 
+    def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
+                           pipeline=None):
+        """Tuning knobs for the host→device ingest pipeline
+        (``tpu_sgd/io``; README "Ingestion pipeline") — they apply to
+        every streaming schedule: ``set_host_streaming``,
+        ``set_streamed_stats`` (single-device and meshed), and the
+        planner's streamed choices.
+
+        ``wire_dtype="bfloat16"`` casts each transferred chunk on host
+        and moves half the bytes; the device side still accumulates in
+        f32+ (see ``tpu_sgd/io/wire.py`` for when that is safe).
+        ``prefetch_depth`` caps the chunks materialized at once,
+        INCLUDING the one being consumed (2 = double buffer — the 2×
+        staging footprint the planner budgets ``batch_rows`` for;
+        depths above 2 grow that footprint proportionally, so shrink
+        ``batch_rows`` to match on a tight device); ``0``/``1`` and
+        ``pipeline=False`` fall back to the synchronous legacy feed
+        (bitwise A/B, one chunk live at a time; ``pipeline=False`` also
+        disables the wire cast)."""
+        from tpu_sgd.plan import apply_user_ingest_options
+
+        apply_user_ingest_options(self, wire_dtype=wire_dtype,
+                                  prefetch_depth=prefetch_depth,
+                                  pipeline=pipeline)
+        return self
+
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
         """Beyond-HBM least squares via streamed statistics: ONE host-
         streaming pass builds the block-prefix Gram stack on device
@@ -471,7 +511,13 @@ class GradientDescent(Optimizer):
         sorted/grouped data; use ``set_host_streaming`` for exact-window
         streaming.  Applies to exactly ``LeastSquaresGradient`` on dense
         single-device data with sliced or full-batch sampling; the build is
-        identity-cached per ``(X, y)`` like ``set_sufficient_stats``."""
+        identity-cached per ``(X, y)`` like ``set_sufficient_stats``.
+
+        The one-time build pass streams through the shared ingest
+        pipeline (``tpu_sgd/io``): double-buffered fixed-shape chunks
+        (f32 wire bitwise-identical to the legacy sync feed), with an
+        opt-in bf16 wire via ``set_ingest_options`` — see README
+        "Ingestion pipeline"."""
         self._clear_planned_schedule()
         self.streamed_stats = bool(flag)
         if block_rows is not None:
@@ -645,6 +691,13 @@ class GradientDescent(Optimizer):
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every,
                 resident_rows=self.streaming_resident_rows,
+                # pipeline=False is the LEGACY feed: no wire cast, no
+                # lookahead — the bitwise A/B contract (the gram
+                # builders make the same reduction)
+                wire_dtype=(self.ingest_wire_dtype
+                            if self.ingest_pipeline else None),
+                prefetch_depth=(self.ingest_prefetch_depth
+                                if self.ingest_pipeline else 0),
             )
             self._loss_history = hist
             if self.check_numerics:
@@ -901,7 +954,8 @@ class GradientDescent(Optimizer):
         Xh = np.asarray(X)
         d = Xh.shape[1]
         entry = getattr(self, "_streamed_gram_dp_entry", None)
-        opts = (self.gram_block_rows, self.gram_batch_rows)
+        opts = (self.gram_block_rows, self.gram_batch_rows,
+                self._ingest_opts())
         if (entry is not None and entry[0] is X and entry[1] is y
                 and entry[2] is self.mesh and entry[4] == opts):
             stats, B, n_used, yd = entry[3]
@@ -910,6 +964,9 @@ class GradientDescent(Optimizer):
                 self.mesh, Xh, np.asarray(y),
                 block_rows=self.gram_block_rows,
                 batch_rows=self.gram_batch_rows,
+                wire_dtype=self.ingest_wire_dtype,
+                prefetch_depth=self.ingest_prefetch_depth,
+                pipeline=self.ingest_pipeline,
             )
             k = self.mesh.shape[DATA_AXIS]
             n_local_host = Xh.shape[0] // k
@@ -945,13 +1002,21 @@ class GradientDescent(Optimizer):
             _raise_if_nonfinite(self._loss_history)
         return w, self._loss_history
 
+    def _ingest_opts(self):
+        """The ingest-pipeline knobs as a cache-key tuple — a wire/depth
+        change must invalidate the identity-cached streamed builds (the
+        statistics DEPEND on the wire dtype)."""
+        return (self.ingest_wire_dtype, self.ingest_prefetch_depth,
+                self.ingest_pipeline)
+
     def _route_streamed_stats(self, X, y):
         """Identity-cached single-device build for ``set_streamed_stats``
         (guards already checked)."""
         from tpu_sgd.ops.gram import GramLeastSquaresGradient
 
         entry = self._streamed_gram_entry
-        opts = (self.gram_block_rows, self.gram_batch_rows)
+        opts = (self.gram_block_rows, self.gram_batch_rows,
+                self._ingest_opts())
         if (entry is not None and entry[0] is X and entry[1] is y
                 and entry[3] == opts):
             return entry[2]
@@ -963,6 +1028,9 @@ class GradientDescent(Optimizer):
             np.asarray(X), np.asarray(y),
             block_rows=self.gram_block_rows,
             batch_rows=self.gram_batch_rows,
+            wire_dtype=self.ingest_wire_dtype,
+            prefetch_depth=self.ingest_prefetch_depth,
+            pipeline=self.ingest_pipeline,
         )
         self._streamed_gram_entry = (X, y, g, opts)
         return g

@@ -341,6 +341,11 @@ class LBFGS(Optimizer):
         self.stream_batch_rows = None
         self.gram_block_rows = DEFAULT_BLOCK_ROWS
         self.gram_batch_rows = None
+        #: ingest-pipeline knobs (tpu_sgd/io; set_ingest_options) — the
+        #: streamed statistics builds feed through the shared prefetcher
+        self.ingest_wire_dtype = None
+        self.ingest_prefetch_depth = 2
+        self.ingest_pipeline = True
         #: gram-knob fields the USER set (planner preserves these; see
         #: GradientDescent._user_gram_opts)
         self._user_gram_opts = frozenset()
@@ -472,6 +477,21 @@ class LBFGS(Optimizer):
                               batch_rows=batch_rows)
         return self
 
+    def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
+                           pipeline=None):
+        """Host→device ingest-pipeline knobs for the streamed builds
+        (``tpu_sgd/io``; README "Ingestion pipeline"): opt-in bf16 wire
+        (half the bytes per chunk, f32+ accumulation unchanged),
+        prefetch lookahead (2 = double buffer), and the pipelined-feed
+        master switch — same contract as
+        ``GradientDescent.set_ingest_options``."""
+        from tpu_sgd.plan import apply_user_ingest_options
+
+        apply_user_ingest_options(self, wire_dtype=wire_dtype,
+                                  prefetch_depth=prefetch_depth,
+                                  pipeline=pipeline)
+        return self
+
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
         """Beyond-HBM quasi-Newton least squares: ONE host-streaming pass
         builds the block-prefix statistics on device
@@ -481,7 +501,10 @@ class LBFGS(Optimizer):
         sums are EXACT from the totals; the only deviation is the
         dropped ``n % block_rows`` tail rows (<0.1% at scale).  Applies
         to exactly ``LeastSquaresGradient`` on dense single-device data;
-        the build is identity-cached per ``(X, y)``."""
+        the build is identity-cached per ``(X, y)``.  The build pass
+        feeds through the shared double-buffered ingest pipeline
+        (``tpu_sgd/io``; knobs via ``set_ingest_options``, bf16-wire
+        safety in README "Ingestion pipeline")."""
         self._clear_planned_schedule()
         self.streamed_stats = bool(flag)
         if block_rows is not None:
@@ -508,7 +531,10 @@ class LBFGS(Optimizer):
         data mesh and per-chunk sums psum over ICI.
 
         ``batch_rows`` caps the chunk size (default ~256 MB of rows;
-        the execution planner sets it from the probed HBM budget)."""
+        the execution planner sets it from the probed HBM budget).
+        Note: the chunked CostFun keeps its own feed — the
+        ``set_ingest_options`` knobs apply to the streamed STATISTICS
+        builds (``set_streamed_stats``), not to this mode."""
         self._clear_planned_schedule()
         self.host_streaming = bool(flag)
         if batch_rows is not None:
@@ -592,7 +618,10 @@ class LBFGS(Optimizer):
                 "use set_host_streaming for beyond-HBM non-LS losses"
             )
         entry = self._streamed_gram_entry
-        opts = (self.gram_block_rows, self.gram_batch_rows, self.mesh)
+        ingest = (self.ingest_wire_dtype, self.ingest_prefetch_depth,
+                  self.ingest_pipeline)
+        opts = (self.gram_block_rows, self.gram_batch_rows, self.mesh,
+                ingest)
         if (entry is not None and entry[0] is X and entry[1] is y
                 and entry[3] == opts):
             return entry[2]
@@ -611,6 +640,9 @@ class LBFGS(Optimizer):
                 self.mesh, np.asarray(X), np.asarray(y),
                 block_rows=self.gram_block_rows,
                 batch_rows=self.gram_batch_rows,
+                wire_dtype=self.ingest_wire_dtype,
+                prefetch_depth=self.ingest_prefetch_depth,
+                pipeline=self.ingest_pipeline,
             )
             g = GramLeastSquaresGradient(data)
         else:
@@ -618,6 +650,9 @@ class LBFGS(Optimizer):
                 np.asarray(X), np.asarray(y),
                 block_rows=self.gram_block_rows,
                 batch_rows=self.gram_batch_rows,
+                wire_dtype=self.ingest_wire_dtype,
+                prefetch_depth=self.ingest_prefetch_depth,
+                pipeline=self.ingest_pipeline,
             )
         if self._streamed_gram_entry is not None:
             # new dataset displaces the old bundle: drop evaluators

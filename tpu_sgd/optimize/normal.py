@@ -136,7 +136,13 @@ class NormalEquations(Optimizer):
         The DEFAULT is AUTO: with no flag set, ``optimize`` streams
         whenever the host data exceeds the probed device budget (and
         runs resident otherwise) — ``set_host_streaming(False)`` forces
-        the resident path."""
+        the resident path.
+
+        The chunk feed runs through the shared double-buffered ingest
+        pipeline (``tpu_sgd/io``; README "Ingestion pipeline"): chunk
+        ``k+1`` transfers while chunk ``k`` accumulates, and the
+        ``batch_rows`` budget should allow for the two in-flight
+        chunks."""
         self.host_streaming = bool(flag)
         if batch_rows is not None:
             if int(batch_rows) < 1:

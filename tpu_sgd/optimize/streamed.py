@@ -6,10 +6,14 @@ answer is to keep the dataset in host RAM, sample each iteration's
 mini-batch host-side (the per-iteration seeded sample, same determinism
 contract: ``default_rng(seed + i)``), and overlap iteration ``i``'s device
 compute with iteration ``i+1``'s host-side batch assembly + transfer: the
-jitted step is dispatched asynchronously BEFORE the next batch is gathered,
-so only the final ``block_until_ready`` waits on the device — the analogue
-of the reference's executors reading partitions while the driver schedules
-the next job (SURVEY.md §3.1), without the per-iteration scheduling cost.
+sample sequence is deterministic in ``(seed, i)``, so the shared ingest
+prefetcher (``tpu_sgd/io``) assembles and ``device_put``s iteration
+``i+1``'s batch on a worker thread while iteration ``i``'s dispatched step
+computes — only the final ``block_until_ready`` waits on the device — the
+analogue of the reference's executors reading partitions while the driver
+schedules the next job (SURVEY.md §3.1), without the per-iteration
+scheduling cost.  An opt-in bf16 wire format (``wire_dtype``) halves the
+transferred bytes on the feed-bound paths.
 
 The device-side step is the SAME ``make_step`` the resident paths use
 (frac=1.0 over the transferred batch; normalization by the realized batch
@@ -66,6 +70,8 @@ def optimize_host_streamed(
     checkpoint_manager=None,
     checkpoint_every: int = 10,
     resident_rows: int = 0,
+    wire_dtype=None,
+    prefetch_depth: int = 2,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -88,9 +94,22 @@ def optimize_host_streamed(
     cutting per-epoch feed bytes by the same factor while drawing the
     identical window sequence (the sampler's RNG stream is unchanged).
     Sliced sampling, single device (``mesh=None``) only.
+
+    Ingest pipeline (``tpu_sgd/io``; README "Ingestion pipeline"): the
+    window/index sequence is deterministic in ``(seed, i)``, so iteration
+    ``i+1``'s whole host-side assembly — the sliced window copy, the
+    INDEXED row gather, the bernoulli mask + gather, padding, wire cast,
+    and the ``device_put`` dispatch — runs on a prefetch worker thread
+    while iteration ``i`` computes on the device (``prefetch_depth=2`` =
+    double buffer; ``0`` = the legacy inline assembly, bitwise the same
+    trajectory).  ``wire_dtype="bfloat16"`` (opt-in) halves the bytes of
+    every transferred batch; the step then consumes bf16 rows, which is
+    exactly the north-star host dtype (see the wire-safety notes in
+    ``tpu_sgd/io/wire.py``).
     """
     import time as _time
 
+    from tpu_sgd.io import Prefetcher, resolve_wire_dtype, wire_cast
     from tpu_sgd.optimize.gradient_descent import make_step
     from tpu_sgd.utils.events import IterationEvent, RunEvent
 
@@ -101,6 +120,7 @@ def optimize_host_streamed(
         w = w.astype(jnp.float32)
     if n == 0:
         return w, np.zeros((0,), np.float32)
+    wd = resolve_wire_dtype(wire_dtype, X.dtype)
 
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
@@ -170,8 +190,10 @@ def optimize_host_streamed(
         # One-time placement of the resident prefix; windows inside it are
         # sliced on-device by the SAME step math (identical window sequence
         # and mask/count ops; the two compiled programs may fuse
-        # differently, so trajectories agree to reassociation noise).
-        Xres = jax.device_put(X[:R], device)
+        # differently, so trajectories agree to reassociation noise).  The
+        # slab rides at the WIRE dtype so the resident and transferred
+        # windows feed the same compiled step.
+        Xres = jax.device_put(wire_cast(X[:R], wd), device)
         yres = jax.device_put(y[:R], device)
         ones_mask = jnp.ones((m_fixed,), bool)
 
@@ -217,15 +239,19 @@ def optimize_host_streamed(
         """Per-iteration host-side sample honoring ``config.sampling`` —
         bernoulli (RDD.sample parity), indexed (fixed-size gather with
         replacement), or sliced (contiguous window) — deterministic in
-        ``default_rng(seed + i)`` and padded to the fixed cap.
+        ``default_rng(seed + i)`` and padded to the fixed cap.  Runs on
+        the prefetch worker: everything here (gather, pad, wire cast,
+        ``device_put`` dispatch) overlaps the previous iteration's device
+        step.
 
         Returns a tagged pair: ``("resident", start)`` for an on-device
         window of the resident prefix, or ``("batch", (Xb, yb, valid))``
         for a transferred batch — explicit dispatch, no type-sniffing."""
         rng = np.random.default_rng(cfg.seed + i)
         if frac < 1.0 and cfg.sampling == "sliced":
-            # Contiguous window: a plain slice (zero-copy view), never the
-            # row gather — sequential host I/O is this mode's entire point.
+            # Contiguous window: a plain slice (zero-copy view on an f32
+            # wire), never the row gather — sequential host I/O is this
+            # mode's entire point.
             start = int(rng.integers(0, max(1, n - m_fixed + 1)))
             if start + m_fixed <= R:
                 # window lies in the device-resident prefix: no transfer;
@@ -233,11 +259,12 @@ def optimize_host_streamed(
                 # changes WHERE a window is read from, never WHICH windows
                 # are drawn
                 return ("resident", start)
-            Xb, yb = X[start:start + m_fixed], y[start:start + m_fixed]
+            Xb = wire_cast(X[start:start + m_fixed], wd)
+            yb = y[start:start + m_fixed]
             valid = np.ones((cap,), bool)
             if cap > m_fixed:  # mesh shard padding: one tail memcpy
                 valid[m_fixed:] = False
-                Xp = np.zeros((cap, X.shape[1]), X.dtype)
+                Xp = np.zeros((cap, X.shape[1]), Xb.dtype)
                 Xp[:m_fixed] = Xb
                 yp = np.zeros((cap,), y.dtype)
                 yp[:m_fixed] = yb
@@ -249,13 +276,15 @@ def optimize_host_streamed(
             ))
         if frac >= 1.0:
             if _full_batch[0] is None:
+                Xw = wire_cast(X, wd)
                 if cap == n:
                     # no shard padding: stream the rows as they are —
-                    # no host copy at all
-                    _full_batch[0] = (X, y, np.ones((cap,), bool))
+                    # no host copy at all (f32 wire; the bf16 wire cast
+                    # above is the one host pass, paid once and cached)
+                    _full_batch[0] = (Xw, y, np.ones((cap,), bool))
                 else:
-                    Xp = np.zeros((cap, X.shape[1]), X.dtype)
-                    Xp[:n] = X
+                    Xp = np.zeros((cap, X.shape[1]), Xw.dtype)
+                    Xp[:n] = Xw
                     yp = np.zeros((cap,), y.dtype)
                     yp[:n] = y
                     valid = np.zeros((cap,), bool)
@@ -278,8 +307,10 @@ def optimize_host_streamed(
         valid[: idx.shape[0]] = True
         pad = np.zeros((cap,), np.int64)
         pad[: idx.shape[0]] = idx
+        # the gather itself rides the prefetch worker (the i+1 lookahead),
+        # so this host pass overlaps iteration i's device step
         return ("batch", (
-            jax.device_put(_gather(X, pad), row_sharding),
+            jax.device_put(wire_cast(_gather(X, pad), wd), row_sharding),
             jax.device_put(y[pad], mask_sharding),
             jax.device_put(valid, mask_sharding),
         ))
@@ -307,59 +338,76 @@ def optimize_host_streamed(
             start_iter = state["iteration"] + 1
     t_run = _time.perf_counter()
     converged = False
-    nxt = sample(start_iter)
-    i = start_iter
-    while i <= cfg.num_iterations and not converged:
-        t0 = _time.perf_counter()
-        # Dispatch the device step FIRST (async), then assemble the next
-        # batch on the host while the device computes — this is the overlap;
-        # only the final block_until_ready waits on the device.
-        kind, payload = nxt
-        if kind == "resident":
-            new_w, loss_i, new_reg, c = resident_step(
-                w, Xres, yres, jnp.asarray(payload, jnp.int32),
-                jnp.asarray(i, jnp.int32),
-                jnp.asarray(reg_val, jnp.float32),
-            )
-        else:
-            Xb, yb, valid = payload
-            new_w, loss_i, new_reg, c = step(
-                w, Xb, yb, jnp.asarray(i, jnp.int32),
-                jnp.asarray(reg_val, jnp.float32),
-                valid,
-            )
-        if i < cfg.num_iterations:
-            nxt = sample(i + 1)
-        new_w = jax.block_until_ready(new_w)
-        dt = _time.perf_counter() - t0
-        if int(c) > 0:
-            losses.append(float(loss_i))
-            reg_val = float(new_reg)
-            delta = float(jnp.linalg.norm(new_w - w))
-            if listener is not None:
-                listener.on_iteration(
-                    IterationEvent(
-                        iteration=i,
-                        loss=losses[-1],
-                        weight_delta_norm=delta,
-                        mini_batch_size=int(c),
-                        wall_time_s=dt,
+    # Lookahead prefetcher: the sample sequence is deterministic in
+    # (seed, i), so sample(i+1) — gather/pad/cast/put, the whole host
+    # side — runs on the worker thread while iteration i computes.
+    # depth=0 degrades to the legacy inline assembly (same trajectory
+    # either way; only WHERE the host work runs changes).
+    prefetch = Prefetcher(sample, range(start_iter, cfg.num_iterations + 1),
+                          depth=prefetch_depth)
+    try:
+        # a checkpoint restored at the final iteration leaves nothing to
+        # sample — the loop below is skipped and the restored weights
+        # return as-is
+        nxt = (next(prefetch) if start_iter <= cfg.num_iterations
+               else None)
+        i = start_iter
+        while i <= cfg.num_iterations and not converged:
+            t0 = _time.perf_counter()
+            # Dispatch the device step FIRST (async), then pull the next
+            # prefetched batch while the device computes — only the final
+            # block_until_ready waits on the device.
+            kind, payload = nxt
+            if kind == "resident":
+                new_w, loss_i, new_reg, c = resident_step(
+                    w, Xres, yres, jnp.asarray(payload, jnp.int32),
+                    jnp.asarray(i, jnp.int32),
+                    jnp.asarray(reg_val, jnp.float32),
+                )
+            else:
+                Xb, yb, valid = payload
+                new_w, loss_i, new_reg, c = step(
+                    w, Xb, yb, jnp.asarray(i, jnp.int32),
+                    jnp.asarray(reg_val, jnp.float32),
+                    valid,
+                )
+            if i < cfg.num_iterations:
+                nxt = next(prefetch)
+            new_w = jax.block_until_ready(new_w)
+            dt = _time.perf_counter() - t0
+            if int(c) > 0:
+                losses.append(float(loss_i))
+                reg_val = float(new_reg)
+                delta = float(jnp.linalg.norm(new_w - w))
+                if listener is not None:
+                    listener.on_iteration(
+                        IterationEvent(
+                            iteration=i,
+                            loss=losses[-1],
+                            weight_delta_norm=delta,
+                            mini_batch_size=int(c),
+                            wall_time_s=dt,
+                        )
                     )
-                )
-            if cfg.convergence_tol > 0 and i > 1:
-                converged = delta < cfg.convergence_tol * max(
-                    float(jnp.linalg.norm(new_w)), 1.0
-                )
-            w = new_w
-            if checkpoint_manager is not None and (
-                i % checkpoint_every == 0
-                or converged
-                or i == cfg.num_iterations
-            ):
-                checkpoint_manager.save(
-                    i, np.asarray(w), reg_val, np.asarray(losses), config_key
-                )
-        i += 1
+                if cfg.convergence_tol > 0 and i > 1:
+                    converged = delta < cfg.convergence_tol * max(
+                        float(jnp.linalg.norm(new_w)), 1.0
+                    )
+                w = new_w
+                if checkpoint_manager is not None and (
+                    i % checkpoint_every == 0
+                    or converged
+                    or i == cfg.num_iterations
+                ):
+                    checkpoint_manager.save(
+                        i, np.asarray(w), reg_val, np.asarray(losses),
+                        config_key
+                    )
+            i += 1
+    finally:
+        # convergence exits early: cancel the worker's queued lookahead —
+        # nobody will consume those batches
+        prefetch.close()
     if listener is not None:
         listener.on_run_end(
             RunEvent(

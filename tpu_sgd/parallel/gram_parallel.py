@@ -117,7 +117,9 @@ def dp_gram_run_fn(
 
 
 def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BLOCK_ROWS,
-                                      batch_rows=None, resume_dir=None):
+                                      batch_rows=None, resume_dir=None,
+                                      wire_dtype=None, prefetch_depth=2,
+                                      pipeline=True):
     """Per-shard VIRTUAL statistics from HOST-resident rows — the
     beyond-HBM statistics build composed with the data mesh (config 4's
     literal "8-way data-parallel" shape at full 10M×1000 scale,
@@ -144,6 +146,12 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
     checkpoints under ``resume_dir/shard_i`` (see
     ``GramLeastSquaresGradient._streamed_prefix``), so a mid-pass kill
     resumes every shard from its own high-water block.
+
+    ``wire_dtype``/``prefetch_depth``/``pipeline`` route each shard's
+    feed through the shared ingest layer (``tpu_sgd/io``; README
+    "Ingestion pipeline"): fixed-shape chunks with the next chunk's
+    host assembly + ``device_put`` overlapping the current chunk's
+    kernel, and an opt-in bf16 wire halving the bytes on the hop.
 
     Returns ``(stats_leaves, B, n_used_local)``.
     """
@@ -177,6 +185,8 @@ def build_streamed_sharded_gram_stats(mesh, Xh, yh, block_rows: int = DEFAULT_BL
             device=dev,
             resume_dir=(None if resume_dir is None
                         else os.path.join(resume_dir, f"shard_{i}")),
+            wire_dtype=wire_dtype, prefetch_depth=prefetch_depth,
+            pipeline=pipeline,
         )
         per_dev.append((PG, Pb, Pyy, PG[-1], Pb[-1], Pyy[-1]))
     jax.block_until_ready(per_dev)
@@ -298,7 +308,9 @@ def build_sharded_total_stats(mesh, Xd, yd,
 
 def build_streamed_total_stats(mesh, Xh, yh,
                                block_rows: int = DEFAULT_BLOCK_ROWS,
-                               batch_rows=None, resume_dir=None):
+                               batch_rows=None, resume_dir=None,
+                               wire_dtype=None, prefetch_depth=2,
+                               pipeline=True):
     """Replicated EXACT total statistics of HOST-resident rows — the
     quasi-Newton beyond-HBM build composed with the data mesh.
 
@@ -341,6 +353,8 @@ def build_streamed_total_stats(mesh, Xh, yh,
                         else os.path.join(resume_dir, f"shard_{i}")),
             finalize=False,  # a later shard's crash must not force the
             # completed shards to re-stream — clean up only when ALL done
+            wire_dtype=wire_dtype, prefetch_depth=prefetch_depth,
+            pipeline=pipeline,
         ))
     jax.block_until_ready(totals)
     if resume_dir is not None:
@@ -348,14 +362,19 @@ def build_streamed_total_stats(mesh, Xh, yh,
 
         shutil.rmtree(resume_dir, ignore_errors=True)
     dev0 = devices[0]
-    G, b, yy = totals[0]
-    G = jax.device_put(G, dev0)
-    b = jax.device_put(b, dev0)
-    yy = jax.device_put(yy, dev0)
+    from tpu_sgd.ops.gram import _acc_totals
+
+    G, b, yy = (jax.device_put(t, dev0) for t in totals[0])
     for Gi, bi, yyi in totals[1:]:
-        G = G + jax.device_put(Gi, dev0)
-        b = b + jax.device_put(bi, dev0)
-        yy = yy + jax.device_put(yyi, dev0)
+        # ONE jitted donated accumulate per shard (ops/gram._acc_totals)
+        # instead of three eager per-shard adds, each of which compiled
+        # and launched its own one-op program
+        G, b, yy = _acc_totals(
+            G, b, yy,
+            jax.device_put(Gi, dev0),
+            jax.device_put(bi, dev0),
+            jax.device_put(yyi, dev0),
+        )
     return GramLeastSquaresGradient.totals_only_data(
         G, b, yy, n, d, data_dtype
     )

@@ -270,6 +270,13 @@ class Plan:
     #: None = the per-iteration driver — the default until the hardware
     #: decomposition capture settles the win)
     chunk_iters: Optional[int] = None
+    #: ingest-pipeline knobs for the streaming schedules (tpu_sgd/io):
+    #: wire_dtype stays None — the bf16 wire is a documented opt-in, the
+    #: planner never silently rounds the user's inputs; prefetch_depth=2
+    #: is the double buffer whose 2× staging footprint
+    #: choose_streamed_build budgets for
+    wire_dtype: Optional[str] = None
+    prefetch_depth: int = 2
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -343,6 +350,12 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
     if ("chunk_iters" not in user
             and hasattr(optimizer, "gram_chunk_iters")):
         optimizer.gram_chunk_iters = p.chunk_iters or None
+    if ("wire_dtype" not in user
+            and hasattr(optimizer, "ingest_wire_dtype")):
+        optimizer.ingest_wire_dtype = p.wire_dtype
+    if ("prefetch_depth" not in user
+            and hasattr(optimizer, "ingest_prefetch_depth")):
+        optimizer.ingest_prefetch_depth = int(p.prefetch_depth)
 
 
 #: THE user-facing gram knob table: name -> (optimizer attribute,
@@ -383,6 +396,42 @@ def apply_user_gram_knobs(optimizer, **knobs) -> None:
     optimizer._plan_key = None
 
 
+def apply_user_ingest_options(optimizer, wire_dtype=None,
+                              prefetch_depth=None, pipeline=None) -> None:
+    """Validate-all-then-apply for USER-set ingest-pipeline knobs (the
+    ``set_ingest_options`` body, shared by GradientDescent and LBFGS) —
+    the ingest sibling of :func:`apply_user_gram_knobs`, with the same
+    contract: a bad later argument leaves earlier knobs untouched, every
+    applied knob is recorded user-owned in ``_user_gram_opts`` so the
+    planner preserves it, and the repeat-run plan key invalidates.
+
+    ``wire_dtype``: ``"bfloat16"`` (half the bytes on the host→device
+    hop; see ``tpu_sgd/io/wire.py`` for when that is safe) or any
+    floating dtype name; validated eagerly so a typo fails HERE, not
+    mid-build.  ``prefetch_depth``: chunks staged ahead (0 = synchronous
+    legacy feed, 2 = double buffer).  ``pipeline``: False reverts the
+    streamed builds to the legacy sync loop (A/B debugging)."""
+    from tpu_sgd.io import resolve_wire_dtype
+
+    provided = {}
+    if wire_dtype is not None:
+        resolve_wire_dtype(wire_dtype, "float32")  # validate, keep name
+        provided["wire_dtype"] = ("ingest_wire_dtype", str(wire_dtype))
+    if prefetch_depth is not None:
+        if int(prefetch_depth) < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0, got {prefetch_depth}"
+            )
+        provided["prefetch_depth"] = ("ingest_prefetch_depth",
+                                      int(prefetch_depth))
+    if pipeline is not None:
+        provided["pipeline"] = ("ingest_pipeline", bool(pipeline))
+    for attr, val in provided.values():
+        setattr(optimizer, attr, val)
+    optimizer._user_gram_opts = optimizer._user_gram_opts | set(provided)
+    optimizer._plan_key = None
+
+
 def reset_plan_owned_gram_knobs(optimizer) -> None:
     """The clearing counterpart of :func:`apply_gram_knobs`: restore
     every gram knob the USER did not set (``_user_gram_opts``) to its
@@ -407,6 +456,14 @@ def reset_plan_owned_gram_knobs(optimizer) -> None:
     if ("stream_batch_rows" not in user
             and hasattr(optimizer, "stream_batch_rows")):
         optimizer.stream_batch_rows = None
+    if ("wire_dtype" not in user
+            and hasattr(optimizer, "ingest_wire_dtype")):
+        optimizer.ingest_wire_dtype = None
+    if ("prefetch_depth" not in user
+            and hasattr(optimizer, "ingest_prefetch_depth")):
+        from tpu_sgd.io import DEFAULT_PREFETCH_DEPTH
+
+        optimizer.ingest_prefetch_depth = DEFAULT_PREFETCH_DEPTH
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
@@ -434,17 +491,20 @@ def choose_streamed_build(n_local: int, d: int, itemsize: int,
                           budget: float, start: int = 4096):
     """``(block_rows, batch_rows)`` for a STREAMED statistics build whose
     whole device footprint fits ``budget`` — the prefix stack PLUS the
-    in-flight host→device chunk that is co-resident during the build
+    in-flight host→device chunks that are co-resident during the build
     (``build_streamed`` defaults the chunk to 64 blocks, which at the
     large block sizes a tight stack budget forces can exceed the stack
     itself).  The stack gets ~2/3 of the budget; the chunk is capped to
-    the remainder (never above the builder's 64-block default).  Returns
-    ``(None, None)`` when no split fits."""
+    the remainder divided by TWO — the double-buffered ingest pipeline
+    (``tpu_sgd/io``) stages chunk ``k+1`` while chunk ``k``'s kernel
+    consumes its buffer, so two chunks are live at the peak (never above
+    the builder's 64-block default).  Returns ``(None, None)`` when no
+    split fits."""
     B = choose_block_rows(n_local, d, budget * 2.0 / 3.0, start=start)
     if B is None:
         return None, None
     chunk_budget = budget - _stack_bytes(n_local, B, d)
-    rows = int(chunk_budget // max(1, d * itemsize + 4))
+    rows = int(chunk_budget // max(1, 2 * (d * itemsize + 4)))
     if rows < B:  # cannot hold even one block alongside the stack
         return None, None
     return B, int(min(rows, 64 * B))
@@ -611,7 +671,10 @@ def plan(
                            gram_iter_s=gram_iter_s,
                            gram_build_s=build_s,
                            build_amortize_iters=amortize,
-                           stack_bytes=_stack_bytes(n_local, B, d))
+                           stack_bytes=_stack_bytes(n_local, B, d),
+                           # double-buffered ingest: two chunks live
+                           staging_bytes=2.0 * batch_rows
+                           * (d * itemsize + 4.0))
                 if amortize <= num_iterations:
                     chosen = Plan(
                         "streamed_virtual_gram",
