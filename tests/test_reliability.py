@@ -1,0 +1,683 @@
+"""Reliability subsystem (tpu_sgd/reliability): fault injection, retry/
+backoff/breaker policies, preemption-safe supervised training, health
+monitoring — and the measured-no-op contract for disabled failpoints."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tpu_sgd.reliability.failpoints as fp
+from tpu_sgd.reliability import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjected,
+    Heartbeat,
+    HealthMonitor,
+    RetriesExhausted,
+    RetryPolicy,
+    TrainingPreempted,
+    TrainingSupervisor,
+    fail_nth,
+    fail_prob,
+    inject_faults,
+    inject_latency,
+)
+from tpu_sgd.utils.checkpoint import CheckpointManager
+from tpu_sgd.utils.events import (
+    CollectingListener,
+    JsonLinesEventLog,
+    ReliabilityEvent,
+)
+
+
+def _build_data(rng, n=512, d=8):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    y = (X @ w + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def _streamed_opt(iters=16, sampling="sliced", seed=7):
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    return (GradientDescent()
+            .set_num_iterations(iters).set_step_size(0.1)
+            .set_mini_batch_fraction(0.5).set_sampling(sampling)
+            .set_convergence_tol(0.0).set_seed(seed)
+            .set_host_streaming(True))
+
+
+# -- (a) failpoints ---------------------------------------------------------
+
+def test_fail_nth_is_one_shot():
+    with inject_faults({"t.site": fail_nth(2)}):
+        fp.failpoint("t.site")  # hit 1: pass
+        with pytest.raises(FaultInjected):
+            fp.failpoint("t.site")  # hit 2: trigger
+        fp.failpoint("t.site")  # hit 3: healed (one-shot)
+        assert fp.hits("t.site") == 3
+        assert fp.triggers("t.site") == 1
+    assert not fp.is_enabled()
+    assert fp.hits("t.site") == 0  # counters cleared on deactivate
+
+
+def test_fail_prob_replays_bitwise_from_seed():
+    def pattern():
+        out = []
+        with inject_faults({"t.p": fail_prob(0.3, seed=5)}):
+            for _ in range(64):
+                try:
+                    fp.failpoint("t.p")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+        return out
+
+    a, b = pattern(), pattern()
+    assert a == b  # seeded stream: identical schedule
+    assert 0 < sum(a) < 64  # actually fires, not always
+
+
+def test_inject_latency_delays_without_raising():
+    with inject_faults({"t.l": inject_latency(30.0)}):
+        t0 = time.perf_counter()
+        fp.failpoint("t.l")
+        assert time.perf_counter() - t0 >= 0.025
+
+
+def test_custom_exception_class():
+    with inject_faults({"t.e": fail_nth(1, exc=OSError)}):
+        with pytest.raises(OSError):
+            fp.failpoint("t.e")
+
+
+def test_spec_rejects_conflicting_modes():
+    with pytest.raises(ValueError):
+        fp.FailpointSpec(nth=2, prob=0.5)
+    with pytest.raises(ValueError):
+        fp.FailpointSpec(prob=1.5)
+
+
+def test_disabled_failpoint_is_a_measured_noop():
+    """Acceptance criterion: the disabled-mode cost is one global load
+    and a branch — sub-microsecond per call even on this noisy 2-core
+    host (the bound is ~20x the measured mean for CI headroom)."""
+    assert not fp.is_enabled()
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fp.failpoint("io.prefetch.produce")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 2e-6, f"disabled failpoint costs {per_call*1e9:.0f}ns"
+
+
+def test_streamed_build_unaffected_by_inactive_registry(rng):
+    """Acceptance criterion: a streamed statistics build with the
+    failpoint registry present-but-inactive matches the same build with
+    the hooks compiled out entirely (monkeypatched to a no-op lambda) —
+    i.e. the pre-PR build path — within ambient noise.  The 2-core
+    harness is DRAM-wall noisy (bimodal up to ~1.7x on overlap paths),
+    so the bound is deliberately loose; the tight per-call bound above
+    is the real no-op evidence."""
+    from tpu_sgd.io import prefetch as prefetch_mod
+    from tpu_sgd.ops.gram import GramLeastSquaresGradient
+
+    X, y = _build_data(rng, n=4096, d=16)
+
+    def build_time():
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            GramLeastSquaresGradient.build_streamed(
+                X, y, block_rows=256, batch_rows=512)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    GramLeastSquaresGradient.build_streamed(  # warm the jit caches
+        X, y, block_rows=256, batch_rows=512)
+    with_hooks = build_time()
+    saved = prefetch_mod.failpoint
+    try:
+        prefetch_mod.failpoint = lambda name: None  # hooks compiled out
+        without_hooks = build_time()
+    finally:
+        prefetch_mod.failpoint = saved
+    assert with_hooks < max(without_hooks * 2.0, without_hooks + 0.05), (
+        f"inactive failpoints slowed the build: {with_hooks:.4f}s vs "
+        f"{without_hooks:.4f}s without hooks")
+
+
+# -- (b) retry / deadline / breaker ----------------------------------------
+
+def test_retry_policy_heals_transient_fault():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        fp.failpoint("t.r")
+        return 42
+
+    pol = RetryPolicy(max_attempts=3, base_backoff_s=1e-4, seed=0)
+    with inject_faults({"t.r": fail_nth(1)}):
+        assert pol.call(flaky) == 42
+    assert len(calls) == 2
+
+
+def test_retry_policy_exhausts_with_cause():
+    pol = RetryPolicy(max_attempts=3, base_backoff_s=1e-4)
+
+    def always():
+        raise OSError("disk on fire")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        pol.call(always)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_policy_nonretryable_propagates_immediately():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_backoff_s=1e-4).call(fatal)
+    assert len(calls) == 1  # no retry burned on a non-transient error
+
+
+def test_retry_backoff_seeded_and_capped():
+    a = RetryPolicy(base_backoff_s=0.1, multiplier=2.0, max_backoff_s=0.3,
+                    jitter=0.5, seed=3)
+    b = RetryPolicy(base_backoff_s=0.1, multiplier=2.0, max_backoff_s=0.3,
+                    jitter=0.5, seed=3)
+    seq_a = [a.backoff_s(k) for k in range(1, 6)]
+    seq_b = [b.backoff_s(k) for k in range(1, 6)]
+    assert seq_a == seq_b  # same seed, same schedule
+    assert all(0 < s <= 0.3 for s in seq_a)  # cap holds through jitter
+    # jitter scales in [1 - j, 1]: retry 1 sleeps at least half the base
+    assert seq_a[0] >= 0.05
+
+
+def test_deadline_check_and_retry_integration():
+    d = Deadline(0.05)
+    assert d.remaining_s > 0 and not d.expired
+    time.sleep(0.06)
+    assert d.expired
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit test")
+    # an expired deadline stops the retry loop before the next attempt
+    pol = RetryPolicy(max_attempts=10, base_backoff_s=1e-4)
+    calls = []
+
+    def failing():
+        calls.append(1)
+        raise OSError("x")
+
+    with pytest.raises(DeadlineExceeded):
+        pol.call(failing, deadline=d)
+    assert len(calls) == 0
+
+
+def test_circuit_breaker_lifecycle():
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=0.05)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.state == "half_open" and br.allow()  # cooldown: one probe
+    br.record_failure()  # failed probe: re-open with fresh cooldown
+    assert br.state == "open" and br.total_opens == 2
+    time.sleep(0.06)
+    br.record_success()  # successful probe closes
+    assert br.state == "closed" and br.allow()
+
+
+# -- (c) prefetcher reliability --------------------------------------------
+
+def test_prefetcher_retry_heals_producer_fault():
+    from tpu_sgd.io import Prefetcher
+
+    pol = RetryPolicy(max_attempts=3, base_backoff_s=1e-4)
+    with inject_faults({"io.prefetch.produce": fail_nth(2)}):
+        with Prefetcher(lambda i: i * i, range(6), depth=2,
+                        retry_policy=pol) as pf:
+            assert list(pf) == [i * i for i in range(6)]  # order kept
+
+
+def test_prefetcher_fault_propagates_without_retry():
+    from tpu_sgd.io import Prefetcher
+
+    with inject_faults({"io.prefetch.produce": fail_nth(2)}):
+        with pytest.raises(FaultInjected):
+            list(Prefetcher(lambda i: i, range(6), depth=2))
+
+
+def test_prefetcher_heartbeat_ticks_per_chunk():
+    from tpu_sgd.io import Prefetcher
+
+    hb = Heartbeat("ingest")
+    with Prefetcher(lambda i: i, range(5), depth=2, heartbeat=hb) as pf:
+        list(pf)
+    assert hb.count == 5
+    assert hb.age_s() is not None
+
+
+# -- (d) checkpoint reliability (satellite) --------------------------------
+
+def test_checkpoint_save_fault_leaves_no_partial_files(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    with inject_faults({"checkpoint.save": fail_nth(1)}):
+        with pytest.raises(FaultInjected):
+            cm.save(1, np.ones(4), 0.0, np.zeros(1))
+    assert os.listdir(str(tmp_path)) == []  # injected BEFORE any byte
+    cm.save(1, np.ones(4), 0.0, np.zeros(1))  # healed
+    assert cm.latest_version() == 1
+
+
+def test_double_corrupt_restore_falls_back_and_names_quarantined(
+        tmp_path, caplog):
+    """Satellite: the latest TWO checkpoints torn — restore must fall
+    back to the third, quarantine both, and name them in the warning
+    and the on_corruption hook (no more silent skips)."""
+    import logging
+
+    seen = []
+    cm = CheckpointManager(
+        str(tmp_path), on_corruption=lambda p, q, e: seen.append((p, q)))
+    for i in (1, 2, 3):
+        cm.save(i, np.full(4, float(i)), 0.0, np.zeros(1))
+    for i in (2, 3):
+        p = cm._path(i)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+    with caplog.at_level(logging.WARNING, logger="tpu_sgd.checkpoint"):
+        state = cm.restore()
+    assert state is not None and state["iteration"] == 1
+    np.testing.assert_array_equal(state["weights"], np.full(4, 1.0))
+    assert len(seen) == 2
+    for orig, quarantined in seen:
+        assert quarantined is not None
+        assert os.path.exists(quarantined)  # kept for forensics
+        assert os.path.basename(quarantined).startswith(".bad_")
+        assert quarantined in caplog.text  # warning names the new path
+    assert cm.versions() == [1]  # bad files left the numbered namespace
+
+
+def test_checkpoint_load_failpoint_exercises_fallback(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    for i in (1, 2):
+        cm.save(i, np.full(4, float(i)), 0.0, np.zeros(1))
+    # one-shot load fault hits the NEWEST first; fallback lands on v1
+    with inject_faults({"checkpoint.load": fail_nth(1)}):
+        state = cm.restore()
+    assert state["iteration"] == 1
+
+
+def test_restore_transient_io_error_does_not_quarantine(tmp_path):
+    """Review finding: a one-off OSError (NFS hiccup) on a fully VALID
+    newest checkpoint must fall back for THIS restore but never
+    quarantine the file — the next restore gets it back (same
+    transient/corruption carve-out as the serve registry)."""
+    seen = []
+    cm = CheckpointManager(
+        str(tmp_path), on_corruption=lambda p, q, e: seen.append(p))
+    for i in (1, 2):
+        cm.save(i, np.full(4, float(i)), 0.0, np.zeros(1))
+    with inject_faults({"checkpoint.load": fail_nth(1, exc=OSError)}):
+        state = cm.restore()
+    assert state["iteration"] == 1  # fell back past the hiccup
+    assert seen == []  # not reported as corruption
+    assert cm.versions() == [1, 2]  # newest checkpoint untouched
+    assert cm.restore()["iteration"] == 2  # healed: newest loads again
+
+
+# -- (e) event log (satellite) ---------------------------------------------
+
+def test_event_log_read_skips_torn_tail(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = JsonLinesEventLog(path, fsync=True)  # durability knob
+    log.on_reliability(ReliabilityEvent(kind="heartbeat", source="t",
+                                        value=1.0))
+    log.on_reliability(ReliabilityEvent(kind="retry", source="t"))
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "torn_mid')  # crash-truncated tail
+    events = JsonLinesEventLog.read(path)
+    assert [e["kind"] for e in events] == [
+        "reliability_heartbeat", "reliability_retry"]
+    assert events[0]["source"] == "t" and events[0]["value"] == 1.0
+
+
+def test_event_log_read_raises_on_mid_file_corruption(tmp_path):
+    import json
+
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:
+        f.write('{"kind": "a"}\nnot json\n{"kind": "b"}\n')
+    with pytest.raises(json.JSONDecodeError):
+        JsonLinesEventLog.read(path)  # only the TAIL is forgivable
+
+
+def test_event_log_read_raises_on_terminated_bad_last_line(tmp_path):
+    """Review finding: a newline-TERMINATED bad final line is a fully
+    written corrupt record (writer bug / manual edit), not a torn
+    tail — read() must raise, not silently drop it."""
+    import json
+
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:
+        f.write('{"kind": "a"}\nnot json\n')  # complete but corrupt
+    with pytest.raises(json.JSONDecodeError):
+        JsonLinesEventLog.read(path)
+
+
+# -- (f) serve-side reliability --------------------------------------------
+
+def _trained_registry_dir(tmp_path, rng, iters=6):
+    X, y = _build_data(rng, n=256, d=6)
+    opt = _streamed_opt(iters=iters)
+    opt.set_checkpoint(CheckpointManager(str(tmp_path)), every=2)
+    opt.optimize_with_history((X, y), np.zeros(6, np.float32))
+    return X
+
+
+def test_registry_breaker_opens_and_short_circuits(tmp_path, rng):
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import ModelRegistry
+
+    _trained_registry_dir(tmp_path, rng)
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=30.0)
+    registry = ModelRegistry(
+        str(tmp_path), lambda w, b: LinearRegressionModel(w, b),
+        breaker=br)
+    # every reload attempt faults: transient branch, breaker counts
+    with inject_faults({"serve.registry.reload": fail_prob(1.0, seed=0)}):
+        assert registry.maybe_reload() is False
+        assert registry.maybe_reload() is False
+        assert br.state == "open"
+        hits_when_open = fp.hits("serve.registry.reload")
+        # OPEN: no directory walk, no load attempt, no failpoint hit
+        assert registry.maybe_reload() is False
+        assert fp.hits("serve.registry.reload") == hits_when_open
+    assert registry.healthz()["breaker"]["state"] == "open"
+
+
+def test_registry_degrades_to_previous_good_model(tmp_path, rng):
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import ModelRegistry
+
+    _trained_registry_dir(tmp_path, rng)
+    registry = ModelRegistry(
+        str(tmp_path), lambda w, b: LinearRegressionModel(w, b))
+    registry.maybe_reload()
+    v0 = registry.current_version
+    assert v0 is not None
+    model_before = registry.model()
+    # a NEWER checkpoint appears but every load of it faults: serving
+    # keeps the previous-good model (rollback is the absence of a swap)
+    cm = registry.manager
+    cm.save(v0 + 10, np.zeros(6, np.float32), 0.0, np.zeros(1))
+    with inject_faults({"serve.registry.reload": fail_prob(1.0, seed=0)}):
+        assert registry.maybe_reload() is False
+        assert registry.current_version == v0
+        assert registry.model() is model_before
+    assert registry.maybe_reload() is True  # faults gone: catches up
+    assert registry.current_version == v0 + 10
+
+
+def test_server_healthz_snapshot(tmp_path, rng):
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import ModelRegistry, Server
+
+    X = _trained_registry_dir(tmp_path, rng)
+    registry = ModelRegistry(
+        str(tmp_path), lambda w, b: LinearRegressionModel(w, b),
+        breaker=CircuitBreaker())
+    with Server(registry=registry, max_latency_s=0.002) as server:
+        server.predict(X[0], timeout=10)
+        h = server.healthz()
+    assert h["serving"] is True
+    assert h["model_version"] == registry.current_version
+    assert h["queue_depth"] == 0
+    assert h["batch_count"] >= 1
+    assert h["flush_heartbeat_age_s"] is not None
+    assert h["registry"]["pinned"] is False
+    assert h["registry"]["breaker"]["state"] == "closed"
+    assert server.healthz()["serving"] is False  # stopped
+
+
+def test_batcher_enqueue_failpoint_sheds_single_request(rng):
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import Server
+
+    model = LinearRegressionModel(
+        rng.normal(size=6).astype(np.float32), 0.0)
+    X = rng.normal(size=(4, 6)).astype(np.float32)
+    with Server(model, max_latency_s=0.002) as server:
+        with inject_faults({"serve.batcher.enqueue": fail_nth(2)}):
+            a = server.submit(X[0])
+            with pytest.raises(FaultInjected):
+                server.submit(X[1])  # admission fault: this one sheds
+            b = server.submit(X[2])
+            got = [a.result(timeout=10), b.result(timeout=10)]
+    want = np.asarray(model.predict(X[[0, 2]]))
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+# -- (g) supervisor: crash-resume + preemption (satellite) ------------------
+
+@pytest.mark.parametrize("mode", ["sliced", "indexed", "bernoulli"])
+def test_kill_and_resume_bitwise_all_sampling_modes(tmp_path, mode, rng):
+    """Satellite: failpoint-crash a streamed GD run mid-iteration,
+    resume under the supervisor, and require the final weights AND the
+    full loss trajectory bitwise equal to the fault-free run."""
+    X, y = _build_data(rng)
+    w0 = np.zeros(8, np.float32)
+    w_ref, h_ref = _streamed_opt(sampling=mode).optimize_with_history(
+        (X, y), w0)
+    sup = TrainingSupervisor(
+        _streamed_opt(sampling=mode),
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+        checkpoint_every=3,
+        retry=RetryPolicy(max_attempts=4, base_backoff_s=1e-4),
+        install_signal_handlers=False)
+    with inject_faults({"optimize.streamed.step": fail_nth(9)}):
+        res = sup.run((X, y), w0)
+    assert res.completed and res.attempts == 2
+    np.testing.assert_array_equal(np.asarray(res.weights),
+                                  np.asarray(w_ref))
+    np.testing.assert_array_equal(res.loss_history, h_ref)
+
+
+def test_supervisor_preempt_checkpoints_and_resumes_bitwise(tmp_path, rng):
+    X, y = _build_data(rng)
+    w0 = np.zeros(8, np.float32)
+    w_ref, h_ref = _streamed_opt().optimize_with_history((X, y), w0)
+
+    events = CollectingListener()
+    opt = _streamed_opt()
+    sup = TrainingSupervisor(
+        opt, checkpoint_manager=CheckpointManager(str(tmp_path)),
+        checkpoint_every=100,  # cadence never fires: preempt must save
+        listener=events, install_signal_handlers=False)
+
+    count = [0]
+
+    class Stopper:
+        def on_run_start(self, c): ...
+
+        def on_iteration(self, ev):
+            count[0] += 1
+            if count[0] == 5:
+                sup.request_preempt()
+
+        def on_run_end(self, ev): ...
+
+    opt.set_listener(Stopper())
+    res = sup.run((X, y), w0)
+    assert res.status == "preempted" and res.preempted_at == 5
+    # the preemption-path save captured iteration 5 exactly
+    assert CheckpointManager(str(tmp_path)).latest_version() == 5
+    assert any(e.kind == "preempted" for e in events.reliability)
+    opt.set_listener(None)
+    res2 = sup.run((X, y), w0)  # fresh run(): preempt flag cleared
+    assert res2.completed
+    np.testing.assert_array_equal(np.asarray(res2.weights),
+                                  np.asarray(w_ref))
+    np.testing.assert_array_equal(res2.loss_history, h_ref)
+
+
+def test_supervisor_stepwise_path_preempts_too(tmp_path, rng):
+    """set_stop_signal also covers the resident observed (listener/
+    checkpoint) path — preempt there checkpoints the current iteration
+    and the rerun resumes to the same final weights."""
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    X, y = _build_data(rng, n=256, d=6)
+    w0 = np.zeros(6, np.float32)
+
+    def make():
+        return (GradientDescent().set_num_iterations(12)
+                .set_step_size(0.1).set_convergence_tol(0.0))
+
+    ref = make()
+    ref.set_checkpoint(CheckpointManager(str(tmp_path / "ref")), every=50)
+    w_ref, h_ref = ref.optimize_with_history((X, y), w0)
+
+    opt = make()
+    sup = TrainingSupervisor(
+        opt, checkpoint_manager=CheckpointManager(str(tmp_path / "s")),
+        checkpoint_every=50, install_signal_handlers=False)
+    n = [0]
+
+    class Stop:
+        def on_run_start(self, c): ...
+
+        def on_iteration(self, ev):
+            n[0] += 1
+            if n[0] == 4:
+                sup.request_preempt()
+
+        def on_run_end(self, ev): ...
+
+    opt.set_listener(Stop())
+    res = sup.run((X, y), w0)
+    assert res.status == "preempted" and res.preempted_at == 4
+    opt.set_listener(None)
+    res2 = sup.run((X, y), w0)
+    assert res2.completed
+    np.testing.assert_array_equal(np.asarray(res2.weights),
+                                  np.asarray(w_ref))
+    np.testing.assert_array_equal(res2.loss_history, h_ref)
+
+
+def test_supervisor_gives_up_after_retry_budget(tmp_path, rng):
+    X, y = _build_data(rng, n=256, d=6)
+    sup = TrainingSupervisor(
+        _streamed_opt(iters=8),
+        checkpoint_manager=CheckpointManager(str(tmp_path)),
+        retry=RetryPolicy(max_attempts=2, base_backoff_s=1e-4),
+        install_signal_handlers=False)
+    with inject_faults(
+            {"optimize.streamed.step": fail_prob(1.0, seed=0)}):
+        with pytest.raises(FaultInjected):
+            sup.run((X, y), np.zeros(6, np.float32))
+
+
+def test_supervisor_retry_only_wraps_lbfgs(rng):
+    """LBFGS has no checkpoint path: the supervisor still gives it
+    crash-retry from scratch (deterministic full-batch — a restart
+    reproduces the same result)."""
+    from tpu_sgd.optimize.lbfgs import LBFGS
+
+    X, y = _build_data(rng, n=256, d=6)
+    w0 = np.zeros(6, np.float32)
+    w_ref, _ = LBFGS(max_num_iterations=6).optimize_with_history(
+        (X, y), w0)
+    crashed = [False]
+
+    class CrashOnce(LBFGS):
+        def optimize_with_history(self, data, w):
+            if not crashed[0]:
+                crashed[0] = True
+                raise FaultInjected("boom")
+            return super().optimize_with_history(data, w)
+
+    sup = TrainingSupervisor(
+        CrashOnce(max_num_iterations=6),
+        retry=RetryPolicy(max_attempts=3, base_backoff_s=1e-4),
+        install_signal_handlers=False)
+    res = sup.run((X, y), w0)
+    assert res.completed and res.attempts == 2
+    np.testing.assert_array_equal(np.asarray(res.weights),
+                                  np.asarray(w_ref))
+
+
+def test_ingest_retry_option_heals_device_put_fault(rng):
+    """set_ingest_options(retry=...) heals a transient transfer fault in
+    place — same weights as the fault-free run, no supervisor needed."""
+    X, y = _build_data(rng)
+    w0 = np.zeros(8, np.float32)
+    w_ref, h_ref = _streamed_opt().optimize_with_history((X, y), w0)
+    opt = _streamed_opt().set_ingest_options(
+        retry=RetryPolicy(max_attempts=3, base_backoff_s=1e-4))
+    with inject_faults({"io.device_put": fail_nth(3)}):
+        w, h = opt.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+    np.testing.assert_array_equal(h, h_ref)
+
+
+def test_ingest_options_validates_retry():
+    with pytest.raises(TypeError):
+        _streamed_opt().set_ingest_options(retry="not a policy")
+    opt = _streamed_opt().set_ingest_options(retry=RetryPolicy())
+    assert opt.ingest_retry_policy is not None
+    opt.set_ingest_options(retry=False)
+    assert opt.ingest_retry_policy is None
+
+
+# -- (h) health monitor -----------------------------------------------------
+
+def test_health_monitor_emits_heartbeat_queue_and_straggler_events():
+    sink = CollectingListener()
+    mon = HealthMonitor(listener=sink, stall_after_s=0.01)
+    hb = mon.watch_heartbeat(Heartbeat("worker"))
+    mon.watch_queue("q", lambda: 7)
+    assert mon.sample_once() == [
+        ev for ev in sink.reliability]  # pre-beat: queue event only
+    assert [e.kind for e in sink.reliability] == ["queue_depth"]
+    assert sink.reliability[0].value == 7
+    hb.beat()
+    time.sleep(0.02)  # long enough to cross the stall threshold
+    mon.sample_once()
+    kinds = [e.kind for e in sink.reliability]
+    assert "heartbeat" in kinds and "straggler" in kinds
+    assert mon.straggler_count >= 1
+
+
+def test_health_monitor_background_thread_lifecycle():
+    sink = CollectingListener()
+    with HealthMonitor(listener=sink, interval_s=0.01) as mon:
+        mon.watch_queue("q", lambda: 1)
+        time.sleep(0.06)
+    n = len(sink.reliability)
+    assert n >= 2  # sampled on the interval
+    time.sleep(0.03)
+    assert len(sink.reliability) == n  # stopped for real
+
+
+# -- (i) the chaos soak (slow; excluded from tier-1) ------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_seed0():
+    from scripts.chaos_soak import soak
+
+    summary = soak(seed=0, iters=40, verbose=False)
+    assert summary["ok"]
+    assert summary["served"] > 0

@@ -25,6 +25,14 @@ Semantics the consumers rely on:
 * ``depth<=1`` — synchronous passthrough (no thread): one chunk
   materialized at a time, the exact legacy serial loop, kept for
   bitwise A/B tests, debugging, and single-chunk memory budgets.
+
+Reliability (``tpu_sgd/reliability``): every producer call passes the
+``io.prefetch.produce`` failpoint (fault-injection hook for chaos
+tests), an optional ``retry_policy`` re-runs a failed producer call
+with seeded backoff before the error propagates (transient
+``device_put``/disk faults heal without killing a 200-second build),
+and an optional ``heartbeat`` ticks per produced chunk so a
+``HealthMonitor`` can flag a wedged feed as a straggler.
 """
 
 from __future__ import annotations
@@ -32,6 +40,8 @@ from __future__ import annotations
 import collections
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, TypeVar
+
+from tpu_sgd.reliability.failpoints import failpoint
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -45,10 +55,12 @@ class Prefetcher:
     will consume."""
 
     def __init__(self, producer: Callable[[T], R], items: Iterable[T],
-                 depth: int = 2):
+                 depth: int = 2, *, retry_policy=None, heartbeat=None):
         if int(depth) < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
         self._producer = producer
+        self._retry_policy = retry_policy
+        self._heartbeat = heartbeat
         self._items = iter(items)
         self._depth = int(depth)
         self._pending = collections.deque()
@@ -58,6 +70,22 @@ class Prefetcher:
             self._pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tpu-sgd-ingest")
             self._fill()
+
+    def _run_producer(self, item: T) -> R:
+        """One produce, through the failpoint (inside the retry scope,
+        so an injected one-shot fault is healed by the retry — the
+        contract the reliability tests pin) and the heartbeat."""
+        def attempt():
+            failpoint("io.prefetch.produce")
+            return self._producer(item)
+
+        if self._retry_policy is not None:
+            out = self._retry_policy.call(attempt)
+        else:
+            out = attempt()
+        if self._heartbeat is not None:
+            self._heartbeat.beat()
+        return out
 
     def _fill(self) -> None:
         # pending is capped at depth-1: the consumer's in-hand chunk plus
@@ -70,14 +98,14 @@ class Prefetcher:
             except StopIteration:
                 self._exhausted = True
                 return
-            self._pending.append(self._pool.submit(self._producer, item))
+            self._pending.append(self._pool.submit(self._run_producer, item))
 
     def __iter__(self) -> "Prefetcher":
         return self
 
     def __next__(self) -> R:
         if self._depth <= 1:  # synchronous passthrough
-            return self._producer(next(self._items))
+            return self._run_producer(next(self._items))
         if self._pool is None:
             raise StopIteration  # closed
         if not self._pending:

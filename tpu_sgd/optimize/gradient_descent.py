@@ -290,6 +290,12 @@ class GradientDescent(Optimizer):
         self.ingest_wire_dtype = None
         self.ingest_prefetch_depth = 2
         self.ingest_pipeline = True
+        #: reliability knobs (tpu_sgd/reliability): a RetryPolicy for
+        #: transient host-feed faults (set_ingest_options(retry=...))
+        #: and the cooperative preemption probe (set_stop_signal — the
+        #: TrainingSupervisor installs it)
+        self.ingest_retry_policy = None
+        self._stop_signal = None
         #: gram-knob fields the USER set via set_gram_options /
         #: set_streamed_stats — the planner preserves these and resets
         #: only plan-owned fields (Plan.apply)
@@ -474,7 +480,7 @@ class GradientDescent(Optimizer):
         return self
 
     def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
-                           pipeline=None):
+                           pipeline=None, retry=None):
         """Tuning knobs for the host→device ingest pipeline
         (``tpu_sgd/io``; README "Ingestion pipeline") — they apply to
         every streaming schedule: ``set_host_streaming``,
@@ -491,12 +497,35 @@ class GradientDescent(Optimizer):
         ``batch_rows`` to match on a tight device); ``0``/``1`` and
         ``pipeline=False`` fall back to the synchronous legacy feed
         (bitwise A/B, one chunk live at a time; ``pipeline=False`` also
-        disables the wire cast)."""
+        disables the wire cast).
+
+        ``retry`` (the reliability knob; README "Reliability"): a
+        ``tpu_sgd.reliability.RetryPolicy`` that re-runs a failed
+        host-side batch assembly/transfer with seeded backoff before
+        the error propagates — transient ``device_put``/disk faults
+        heal in place on the ``set_host_streaming`` feed.  Retries do
+        not change WHAT is sampled (the sample is deterministic in
+        ``(seed, i)``), so a healed run stays bitwise identical.  For
+        whole-run crash-resume and preemption safety wrap the run in a
+        ``tpu_sgd.reliability.TrainingSupervisor``."""
         from tpu_sgd.plan import apply_user_ingest_options
 
         apply_user_ingest_options(self, wire_dtype=wire_dtype,
                                   prefetch_depth=prefetch_depth,
-                                  pipeline=pipeline)
+                                  pipeline=pipeline, retry=retry)
+        return self
+
+    def set_stop_signal(self, stop_signal):
+        """Install a zero-arg callable polled once per iteration on the
+        observed (listener/checkpoint) and host-streamed paths: when it
+        returns True the current state is checkpointed (if a manager is
+        attached) and the run unwinds with ``TrainingPreempted`` — the
+        cooperative half of preemption-safe training.  Pass ``None`` to
+        clear.  Installed automatically by
+        ``tpu_sgd.reliability.TrainingSupervisor``; the fused
+        single-program paths (no per-iteration host hop) cannot poll
+        and simply run to completion."""
+        self._stop_signal = stop_signal
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -698,6 +727,8 @@ class GradientDescent(Optimizer):
                             if self.ingest_pipeline else None),
                 prefetch_depth=(self.ingest_prefetch_depth
                                 if self.ingest_pipeline else 0),
+                retry_policy=self.ingest_retry_policy,
+                stop_signal=self._stop_signal,
             )
             self._loss_history = hist
             if self.check_numerics:
@@ -1216,6 +1247,16 @@ class GradientDescent(Optimizer):
                              config_key)
             if converged_early:
                 break
+            if self._stop_signal is not None and self._stop_signal():
+                # cooperative preemption (set_stop_signal): checkpoint
+                # the CURRENT iteration, then unwind cleanly — the
+                # supervised resume replays from exactly here
+                from tpu_sgd.reliability.supervisor import TrainingPreempted
+
+                if mgr is not None:
+                    mgr.save(i, np.asarray(w), reg_val, np.asarray(losses),
+                             config_key)
+                raise TrainingPreempted(i)
             i += 1
 
         if self.listener is not None:

@@ -346,6 +346,7 @@ class LBFGS(Optimizer):
         self.ingest_wire_dtype = None
         self.ingest_prefetch_depth = 2
         self.ingest_pipeline = True
+        self.ingest_retry_policy = None
         #: gram-knob fields the USER set (planner preserves these; see
         #: GradientDescent._user_gram_opts)
         self._user_gram_opts = frozenset()
@@ -478,18 +479,20 @@ class LBFGS(Optimizer):
         return self
 
     def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
-                           pipeline=None):
+                           pipeline=None, retry=None):
         """Host→device ingest-pipeline knobs for the streamed builds
         (``tpu_sgd/io``; README "Ingestion pipeline"): opt-in bf16 wire
         (half the bytes per chunk, f32+ accumulation unchanged),
         prefetch lookahead (2 = double buffer), and the pipelined-feed
         master switch — same contract as
-        ``GradientDescent.set_ingest_options``."""
+        ``GradientDescent.set_ingest_options``, including the ``retry``
+        reliability knob (a ``tpu_sgd.reliability.RetryPolicy``; heals
+        transient host-feed faults on the host-streamed schedules)."""
         from tpu_sgd.plan import apply_user_ingest_options
 
         apply_user_ingest_options(self, wire_dtype=wire_dtype,
                                   prefetch_depth=prefetch_depth,
-                                  pipeline=pipeline)
+                                  pipeline=pipeline, retry=retry)
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):

@@ -72,6 +72,8 @@ def optimize_host_streamed(
     resident_rows: int = 0,
     wire_dtype=None,
     prefetch_depth: int = 2,
+    retry_policy=None,
+    stop_signal=None,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -106,11 +108,24 @@ def optimize_host_streamed(
     every transferred batch; the step then consumes bf16 rows, which is
     exactly the north-star host dtype (see the wire-safety notes in
     ``tpu_sgd/io/wire.py``).
+
+    Reliability (``tpu_sgd/reliability``): ``retry_policy`` re-runs a
+    failed host-side sample/transfer with seeded backoff (transient
+    ``device_put`` faults heal in place).  ``stop_signal`` is a zero-arg
+    callable polled once per iteration — the ``TrainingSupervisor``'s
+    cooperative preemption hook: when it returns True the CURRENT state
+    is checkpointed and ``TrainingPreempted`` unwinds cleanly; a later
+    run with the same checkpoint manager resumes and, because every
+    iteration is deterministic in ``(seed, i)``, finishes with
+    bitwise-identical final weights (f32 wire).  The iteration body and
+    the transfer pass the ``optimize.streamed.step`` /
+    ``io.device_put`` failpoints.
     """
     import time as _time
 
     from tpu_sgd.io import Prefetcher, resolve_wire_dtype, wire_cast
     from tpu_sgd.optimize.gradient_descent import make_step
+    from tpu_sgd.reliability.failpoints import failpoint
     from tpu_sgd.utils.events import IterationEvent, RunEvent
 
     cfg = config
@@ -235,6 +250,17 @@ def optimize_host_streamed(
     # doubles the host feed cost the overlap exists to hide)
     _full_batch = [None]
 
+    def _put_batch(Xb, yb, valid):
+        """The host→device hop of one assembled batch — THE transfer
+        fault-injection site (``io.device_put``); retries, when
+        configured, wrap the whole sample via the prefetcher."""
+        failpoint("io.device_put")
+        return ("batch", (
+            jax.device_put(Xb, row_sharding),
+            jax.device_put(yb, mask_sharding),
+            jax.device_put(valid, mask_sharding),
+        ))
+
     def sample(i: int):
         """Per-iteration host-side sample honoring ``config.sampling`` —
         bernoulli (RDD.sample parity), indexed (fixed-size gather with
@@ -269,11 +295,7 @@ def optimize_host_streamed(
                 yp = np.zeros((cap,), y.dtype)
                 yp[:m_fixed] = yb
                 Xb, yb = Xp, yp
-            return ("batch", (
-                jax.device_put(Xb, row_sharding),
-                jax.device_put(yb, mask_sharding),
-                jax.device_put(valid, mask_sharding),
-            ))
+            return _put_batch(Xb, yb, valid)
         if frac >= 1.0:
             if _full_batch[0] is None:
                 Xw = wire_cast(X, wd)
@@ -291,11 +313,7 @@ def optimize_host_streamed(
                     valid[:n] = True
                     _full_batch[0] = (Xp, yp, valid)
             Xb, yb, valid = _full_batch[0]
-            return ("batch", (
-                jax.device_put(Xb, row_sharding),
-                jax.device_put(yb, mask_sharding),
-                jax.device_put(valid, mask_sharding),
-            ))
+            return _put_batch(Xb, yb, valid)
         if cfg.sampling == "indexed":
             idx = rng.integers(0, n, size=m_fixed)
         else:  # bernoulli
@@ -309,11 +327,7 @@ def optimize_host_streamed(
         pad[: idx.shape[0]] = idx
         # the gather itself rides the prefetch worker (the i+1 lookahead),
         # so this host pass overlaps iteration i's device step
-        return ("batch", (
-            jax.device_put(wire_cast(_gather(X, pad), wd), row_sharding),
-            jax.device_put(y[pad], mask_sharding),
-            jax.device_put(valid, mask_sharding),
-        ))
+        return _put_batch(wire_cast(_gather(X, pad), wd), y[pad], valid)
 
     if listener is not None:
         listener.on_run_start(cfg)
@@ -344,7 +358,7 @@ def optimize_host_streamed(
     # depth=0 degrades to the legacy inline assembly (same trajectory
     # either way; only WHERE the host work runs changes).
     prefetch = Prefetcher(sample, range(start_iter, cfg.num_iterations + 1),
-                          depth=prefetch_depth)
+                          depth=prefetch_depth, retry_policy=retry_policy)
     try:
         # a checkpoint restored at the final iteration leaves nothing to
         # sample — the loop below is skipped and the restored weights
@@ -354,6 +368,10 @@ def optimize_host_streamed(
         i = start_iter
         while i <= cfg.num_iterations and not converged:
             t0 = _time.perf_counter()
+            # mid-iteration fault-injection site: a crash here loses the
+            # iterations since the last checkpoint, which the supervised
+            # resume replays deterministically (chaos-soak contract)
+            failpoint("optimize.streamed.step")
             # Dispatch the device step FIRST (async), then pull the next
             # prefetched batch while the device computes — only the final
             # block_until_ready waits on the device.
@@ -403,6 +421,23 @@ def optimize_host_streamed(
                         i, np.asarray(w), reg_val, np.asarray(losses),
                         config_key
                     )
+            if (not converged and stop_signal is not None
+                    and stop_signal()):
+                # cooperative preemption (TrainingSupervisor): persist
+                # the CURRENT iteration — not just the last cadence
+                # save — then unwind cleanly; the save is atomic, so a
+                # SIGKILL racing this still leaves the previous
+                # checkpoint intact
+                from tpu_sgd.reliability.supervisor import (
+                    TrainingPreempted,
+                )
+
+                if checkpoint_manager is not None:
+                    checkpoint_manager.save(
+                        i, np.asarray(w), reg_val, np.asarray(losses),
+                        config_key
+                    )
+                raise TrainingPreempted(i)
             i += 1
     finally:
         # convergence exits early: cancel the worker's queued lookahead —

@@ -397,7 +397,8 @@ def apply_user_gram_knobs(optimizer, **knobs) -> None:
 
 
 def apply_user_ingest_options(optimizer, wire_dtype=None,
-                              prefetch_depth=None, pipeline=None) -> None:
+                              prefetch_depth=None, pipeline=None,
+                              retry=None) -> None:
     """Validate-all-then-apply for USER-set ingest-pipeline knobs (the
     ``set_ingest_options`` body, shared by GradientDescent and LBFGS) —
     the ingest sibling of :func:`apply_user_gram_knobs`, with the same
@@ -410,10 +411,26 @@ def apply_user_ingest_options(optimizer, wire_dtype=None,
     floating dtype name; validated eagerly so a typo fails HERE, not
     mid-build.  ``prefetch_depth``: chunks staged ahead (0 = synchronous
     legacy feed, 2 = double buffer).  ``pipeline``: False reverts the
-    streamed builds to the legacy sync loop (A/B debugging)."""
+    streamed builds to the legacy sync loop (A/B debugging).
+    ``retry``: a ``tpu_sgd.reliability.RetryPolicy`` healing transient
+    host-feed faults on the host-streamed SGD path (``False`` clears a
+    previously set policy); retries never change the sampled sequence,
+    so results are unaffected."""
     from tpu_sgd.io import resolve_wire_dtype
 
     provided = {}
+    if retry is not None:
+        if retry is False:
+            provided["retry"] = ("ingest_retry_policy", None)
+        else:
+            from tpu_sgd.reliability.retry import RetryPolicy
+
+            if not isinstance(retry, RetryPolicy):
+                raise TypeError(
+                    f"retry must be a RetryPolicy or False, got "
+                    f"{type(retry).__name__}"
+                )
+            provided["retry"] = ("ingest_retry_policy", retry)
     if wire_dtype is not None:
         resolve_wire_dtype(wire_dtype, "float32")  # validate, keep name
         provided["wire_dtype"] = ("ingest_wire_dtype", str(wire_dtype))

@@ -1,0 +1,76 @@
+"""tpu_sgd.reliability: the failure-handling backbone.
+
+The Spark reference inherited fault tolerance from RDD lineage and task
+re-execution (MLlib, arXiv:1505.06807); the JAX port kept the math and
+dropped that safety layer.  This package restores it as four explicit,
+composable pieces threaded through the real hot paths (io, optimize,
+serve, utils):
+
+* :mod:`~tpu_sgd.reliability.failpoints` — named, seeded, deterministic
+  fault injection at the production hook sites (zero-overhead no-ops
+  when disabled); the substrate every reliability test and the chaos
+  soak (``scripts/chaos_soak.py``) stand on.
+* :mod:`~tpu_sgd.reliability.retry` — ``RetryPolicy`` (bounded attempts,
+  exponential backoff, seeded jitter), ``Deadline`` (no-hang budgets),
+  and ``CircuitBreaker`` (the serve registry degrades to the last-good
+  model instead of hammering a corrupt checkpoint directory).
+* :mod:`~tpu_sgd.reliability.supervisor` — ``TrainingSupervisor``:
+  auto-checkpoint cadence, SIGTERM-preemption that checkpoints and
+  exits cleanly, and crash-resume that replays to **bitwise-identical**
+  final weights (every iteration is deterministic in ``(seed, i)``).
+* :mod:`~tpu_sgd.reliability.health` — heartbeats and straggler/queue
+  monitors emitting ``reliability_*`` events into the shared
+  ``JsonLinesEventLog`` contract.
+
+Quickstart (see ``examples/reliability_quickstart.py``)::
+
+    from tpu_sgd.reliability import RetryPolicy, TrainingSupervisor
+
+    sup = TrainingSupervisor(opt, checkpoint_manager=ckpt_dir,
+                             checkpoint_every=5,
+                             retry=RetryPolicy(max_attempts=5, seed=0))
+    result = sup.run((X, y), w0)     # survives crashes and SIGTERM
+"""
+
+from tpu_sgd.reliability.failpoints import (
+    FailpointSpec,
+    FaultInjected,
+    fail_nth,
+    fail_prob,
+    failpoint,
+    inject_faults,
+    inject_latency,
+)
+from tpu_sgd.reliability.health import Heartbeat, HealthMonitor
+from tpu_sgd.reliability.retry import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetriesExhausted,
+    RetryPolicy,
+)
+from tpu_sgd.reliability.supervisor import (
+    SupervisedResult,
+    TrainingPreempted,
+    TrainingSupervisor,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "FailpointSpec",
+    "FaultInjected",
+    "Heartbeat",
+    "HealthMonitor",
+    "RetriesExhausted",
+    "RetryPolicy",
+    "SupervisedResult",
+    "TrainingPreempted",
+    "TrainingSupervisor",
+    "fail_nth",
+    "fail_prob",
+    "failpoint",
+    "inject_faults",
+    "inject_latency",
+]

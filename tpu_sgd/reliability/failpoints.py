@@ -1,0 +1,224 @@
+"""Named, seedable, deterministic fault injection.
+
+The Spark reference got fault-tolerance testing almost for free: kill an
+executor and RDD lineage re-runs the lost tasks (MLlib, arXiv:1505.06807).
+This port has no lineage, so faults must be *manufactured* instead —
+every failure-handling path (retry, resume, rollback, breaker) needs a
+switch that makes the failure happen on demand, deterministically, in
+the real production code path rather than in a mock.
+
+A **failpoint** is a named hook site compiled into a hot path::
+
+    from tpu_sgd.reliability.failpoints import failpoint
+    failpoint("io.prefetch.produce")     # zero-overhead when disabled
+
+and a **spec** arms it from a test / chaos harness::
+
+    from tpu_sgd.reliability import failpoints as fp
+    with fp.inject_faults({"io.prefetch.produce": fp.fail_nth(3)}):
+        ...   # the 3rd produce call raises FaultInjected, then it heals
+
+Specs are deterministic: ``fail_nth(k)`` triggers on exactly the k-th
+hit (one-shot — the retry that follows succeeds, which is the behavior
+under test); ``fail_prob(p, seed)`` draws from a private seeded stream
+so a chaos soak replays bit-identically from its seed; and
+``inject_latency(ms)`` delays without raising (straggler simulation for
+the health monitor).  The exception class is configurable per spec so a
+site can be made to throw exactly what its caller claims to tolerate
+(``OSError`` for the checkpoint reader, ``TimeoutError`` for a feed…).
+
+Cost when disabled — the only state a production process ever runs in —
+is one module-global load and a falsy branch per hit (measured in
+``tests/test_reliability.py``); no dict lookup, no lock, no allocation.
+
+Hook sites wired in this codebase (the chaos soak exercises all of
+them; see ``scripts/chaos_soak.py``):
+
+===============================  ============================================
+name                             site
+===============================  ============================================
+``io.prefetch.produce``          Prefetcher worker, before each producer call
+``io.device_put``                host→device transfer in the streamed-SGD feed
+``optimize.streamed.step``       top of each host-streamed SGD iteration
+``checkpoint.save``              CheckpointManager.save, before the tmp write
+``checkpoint.load``              CheckpointManager._load (restore / reload)
+``serve.registry.reload``        ModelRegistry.maybe_reload, per load attempt
+``serve.batcher.enqueue``        MicroBatcher.submit, before queueing
+===============================  ============================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Dict, Optional, Type
+
+
+class FaultInjected(RuntimeError):
+    """The default exception a triggered failpoint raises.  A transient
+    fault by construction: retry policies treat it as retryable."""
+
+
+class FailpointSpec:
+    """Arming rule for one site.  Exactly one trigger mode:
+
+    * ``nth``  — trigger on the nth hit (1-based), ONE-SHOT: later hits
+      pass, so a retry/resume after the injected fault succeeds.
+    * ``prob`` — trigger each hit with probability ``prob`` from a
+      private ``random.Random(seed)`` stream (deterministic replay).
+
+    On trigger: sleep ``latency_s`` (if set), then raise ``exc`` — or
+    return normally when ``exc`` is None (latency-only fault).
+    """
+
+    def __init__(self, *, nth: int = 0, prob: float = 0.0, seed: int = 0,
+                 latency_s: float = 0.0,
+                 exc: Optional[Type[BaseException]] = FaultInjected):
+        if nth and prob:
+            raise ValueError("pass nth= or prob=, not both")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {prob}")
+        if nth < 0 or latency_s < 0:
+            raise ValueError("nth and latency_s must be >= 0")
+        self.nth = int(nth)
+        self.prob = float(prob)
+        self.seed = int(seed)
+        self.latency_s = float(latency_s)
+        self.exc = exc
+        # armed state (reset on every activation)
+        self.hits = 0
+        self.triggers = 0
+        self._rng = random.Random(self.seed)
+
+    def _rearm(self) -> "FailpointSpec":
+        self.hits = 0
+        self.triggers = 0
+        self._rng = random.Random(self.seed)
+        return self
+
+    def _on_hit(self, name: str) -> None:
+        self.hits += 1
+        if self.nth:
+            fire = self.hits == self.nth
+        elif self.prob:
+            fire = self._rng.random() < self.prob
+        else:
+            fire = True  # bare spec: every hit
+        if not fire:
+            return
+        self.triggers += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.exc is not None:
+            raise self.exc(
+                f"failpoint {name!r} triggered (hit {self.hits})"
+            )
+
+
+def fail_nth(k: int, exc: Type[BaseException] = FaultInjected,
+             latency_ms: float = 0.0) -> FailpointSpec:
+    """Trigger on exactly the k-th hit (1-based), once."""
+    return FailpointSpec(nth=k, exc=exc, latency_s=latency_ms / 1e3)
+
+
+def fail_prob(p: float, seed: int = 0,
+              exc: Type[BaseException] = FaultInjected,
+              latency_ms: float = 0.0) -> FailpointSpec:
+    """Trigger each hit with probability ``p`` from a ``seed``-keyed
+    private stream — bit-identical replay for a fixed seed."""
+    return FailpointSpec(prob=p, seed=seed, exc=exc,
+                         latency_s=latency_ms / 1e3)
+
+
+def inject_latency(ms: float, *, nth: int = 0, prob: float = 0.0,
+                   seed: int = 0) -> FailpointSpec:
+    """Delay without raising — straggler simulation.  By default every
+    hit sleeps; ``nth``/``prob`` restrict which hits do."""
+    return FailpointSpec(nth=nth, prob=prob, seed=seed,
+                         latency_s=ms / 1e3, exc=None)
+
+
+# -- registry ---------------------------------------------------------------
+
+#: fast-path gate: ``failpoint()`` reads this ONE module global and
+#: returns when falsy — the entire disabled-mode cost.
+_ENABLED = False
+
+_SPECS: Dict[str, FailpointSpec] = {}
+_HITS: Dict[str, int] = {}  # per-site hit counters while enabled
+_LOCK = threading.RLock()   # specs fire from prefetch/serve worker threads
+
+
+def failpoint(name: str) -> None:
+    """Hook-site entry: no-op unless a spec for ``name`` is armed.
+
+    This function sits on hot paths (per-iteration, per-request); keep
+    the disabled branch to the single global check."""
+    if not _ENABLED:
+        return
+    _hit(name)
+
+
+def _hit(name: str) -> None:
+    with _LOCK:
+        _HITS[name] = _HITS.get(name, 0) + 1
+        spec = _SPECS.get(name)
+        if spec is not None:
+            spec._on_hit(name)
+
+
+def configure(name: str, spec: FailpointSpec) -> None:
+    """Arm ``spec`` at site ``name`` and enable the registry."""
+    global _ENABLED
+    with _LOCK:
+        _SPECS[name] = spec._rearm()
+        _ENABLED = True
+
+
+def deactivate() -> None:
+    """Disarm every site and restore the zero-overhead disabled mode."""
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = False
+        _SPECS.clear()
+        _HITS.clear()
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def hits(name: str) -> int:
+    """Hits recorded at ``name`` while the registry was enabled (counts
+    every hit at an armed REGISTRY, even for sites with no spec — the
+    chaos soak uses this to prove each hook site was actually reached)."""
+    with _LOCK:
+        return _HITS.get(name, 0)
+
+
+def triggers(name: str) -> int:
+    """Times the spec at ``name`` actually fired."""
+    with _LOCK:
+        spec = _SPECS.get(name)
+        return 0 if spec is None else spec.triggers
+
+
+@contextlib.contextmanager
+def inject_faults(config: Dict[str, FailpointSpec]):
+    """Arm a set of sites for the duration of a ``with`` block::
+
+        with inject_faults({"checkpoint.save": fail_nth(2)}):
+            ...
+
+    Deactivates (and clears counters) on exit, even on error.  Not
+    reentrant — nested activations share the one global registry, so the
+    inner exit disarms everything; chaos harnesses use one flat dict."""
+    with _LOCK:
+        for name, spec in config.items():
+            configure(name, spec)
+    try:
+        yield
+    finally:
+        deactivate()

@@ -44,7 +44,17 @@ from tpu_sgd.serve.registry import ModelRegistry, NoModelError
 class Server:
     """Facade wiring engine + batcher + registry + metrics into one
     endpoint.  Exactly one of ``model`` (static) or ``registry``
-    (hot-reloading) must be given."""
+    (hot-reloading) must be given.
+
+    Reliability (README "Reliability"; ``tpu_sgd/reliability``): pass
+    the registry a ``CircuitBreaker`` (``ModelRegistry(...,
+    breaker=...)``) so repeated corrupt/unreadable reloads stop
+    hammering disk and serving degrades to the current (or pinned)
+    model; :meth:`healthz` is the ops-probe snapshot (version, pinned?,
+    queue depth, breaker state), and the batcher's ``heartbeat`` plugs
+    into a ``reliability.HealthMonitor`` for straggler detection.
+    Retry/backoff policy for the surrounding training feed lives on
+    ``GradientDescent.set_ingest_options(retry=...)``."""
 
     def __init__(
         self,
@@ -146,6 +156,24 @@ class Server:
         bypassing the queue (bulk/offline scoring against the same
         serving model)."""
         return self._predict_batch(X)
+
+    def healthz(self) -> dict:
+        """Liveness/readiness snapshot for ops probes: the serving
+        version and pin state, queue pressure, flush-thread liveness,
+        and (when a registry is attached) the reload/breaker picture.
+        Cheap enough to scrape per second — no locks beyond the
+        registry's own, no model access, never raises."""
+        h = {
+            "serving": self.batcher._thread is not None,
+            "model_version": self.model_version,
+            "queue_depth": self.batcher.queue_depth,
+            "reject_count": self.batcher.reject_count,
+            "batch_count": self.batcher.batch_count,
+            "flush_heartbeat_age_s": self.batcher.heartbeat.age_s(),
+        }
+        if self.registry is not None:
+            h["registry"] = self.registry.healthz()
+        return h
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

@@ -24,6 +24,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
+from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.reliability.health import Heartbeat
 from tpu_sgd.serve.engine import stack_rows
 
 
@@ -76,10 +78,17 @@ class MicroBatcher:
         self._stopped = False
         self.reject_count = 0
         self.batch_count = 0
+        #: ticked once per flushed batch — register with a
+        #: ``reliability.HealthMonitor`` to flag a wedged flush thread
+        #: as a straggler (tpu_sgd/reliability/health.py)
+        self.heartbeat = Heartbeat("serve.batcher")
 
     # -- client side -------------------------------------------------------
     def submit(self, x) -> Future:
-        """Enqueue one feature row; resolves to its prediction."""
+        """Enqueue one feature row; resolves to its prediction.  Passes
+        the ``serve.batcher.enqueue`` failpoint (admission-side fault
+        injection) before touching the queue."""
+        failpoint("serve.batcher.enqueue")
         with self._cond:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
@@ -209,6 +218,7 @@ class MicroBatcher:
                 r.future.set_exception(e)
             return
         self.batch_count += 1
+        self.heartbeat.beat()
         for i, r in enumerate(batch):
             r.future.set_result(out[i])
         if self.metrics is not None:

@@ -24,6 +24,7 @@ import os
 import threading
 from typing import Callable, Dict, Optional
 
+from tpu_sgd.reliability.failpoints import FaultInjected, failpoint
 from tpu_sgd.utils.checkpoint import CheckpointManager
 
 logger = logging.getLogger("tpu_sgd.serve.registry")
@@ -48,12 +49,19 @@ class ModelRegistry:
         model_factory: Callable,
         *,
         metrics=None,
+        breaker=None,
     ):
         if isinstance(manager_or_directory, (str, os.PathLike)):
             manager_or_directory = CheckpointManager(str(manager_or_directory))
         self.manager: CheckpointManager = manager_or_directory
         self.model_factory = model_factory
         self.metrics = metrics
+        #: optional tpu_sgd.reliability.CircuitBreaker: consecutive
+        #: reload failures OPEN it and maybe_reload short-circuits (no
+        #: directory scan, no load attempt) until the cooldown probe —
+        #: serving keeps degrading gracefully to the current/pinned
+        #: model instead of hammering a sick checkpoint directory
+        self.breaker = breaker
         self._lock = threading.Lock()
         self._model = None
         self._version: Optional[int] = None
@@ -118,6 +126,11 @@ class ModelRegistry:
         # released: a listener that calls back into the registry (pin,
         # clear_bad_versions, another reload) must not deadlock on the
         # non-reentrant lock the emitting thread still holds
+        if self.breaker is not None and not self.breaker.allow():
+            # OPEN breaker: the directory has been failing repeatedly —
+            # skip the scan entirely and keep serving the current model
+            # until the cooldown lets one probe through (HALF_OPEN)
+            return False
         emits = []
         swapped = False
         with self._lock:
@@ -132,20 +145,24 @@ class ModelRegistry:
                 if v in self.bad_versions:
                     continue
                 try:
+                    failpoint("serve.registry.reload")
                     ck = self.manager.restore_version(v)
                     model = self._build(ck)
                 except FileNotFoundError:
                     continue  # pruned between listing and load: no error
-                except OSError as e:
-                    # transient I/O (EMFILE, NFS hiccup): NOT corruption —
-                    # retry on the next reload attempt instead of
-                    # permanently blacklisting what may be the last
-                    # checkpoint a finished training run ever writes
+                except (OSError, FaultInjected) as e:
+                    # transient I/O (EMFILE, NFS hiccup) or an injected
+                    # chaos fault: NOT corruption — retry on the next
+                    # reload attempt instead of permanently blacklisting
+                    # what may be the last checkpoint a finished
+                    # training run ever writes
                     logger.warning(
                         "transient I/O error loading checkpoint version "
                         "%d (%s: %s); will retry", v, type(e).__name__, e,
                     )
                     emits.append(("load_failed", v, str(e)))
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
                     continue
                 except Exception as e:
                     self.bad_versions[v] = f"{type(e).__name__}: {e}"
@@ -155,14 +172,32 @@ class ModelRegistry:
                         v, type(e).__name__, e, self._version,
                     )
                     emits.append(("load_failed", v, str(e)))
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
                     continue
                 self._swap(v, model)
                 emits.append(("reloaded", v, None))
                 swapped = True
+                if self.breaker is not None:
+                    self.breaker.record_success()
                 break
         for kind, v, err in emits:
             self._emit_reload(kind, v, err)
         return swapped
+
+    def healthz(self) -> dict:
+        """Ops-probe snapshot: what is serving, is it pinned, what has
+        been rejected, and the breaker state (``Server.healthz`` wraps
+        this with the queue-side numbers)."""
+        return {
+            "current_version": self._version,
+            "previous_version": self._previous_version,
+            "pinned": self._pinned,
+            "bad_versions": dict(self.bad_versions),
+            "reload_count": self.reload_count,
+            "breaker": (None if self.breaker is None
+                        else self.breaker.snapshot()),
+        }
 
     def clear_bad_versions(self):
         """Forget recorded-bad versions so the next reload retries them —

@@ -15,9 +15,11 @@ from __future__ import annotations
 import glob
 import logging
 import os
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
+
+from tpu_sgd.reliability.failpoints import FaultInjected, failpoint
 
 logger = logging.getLogger("tpu_sgd.checkpoint")
 
@@ -30,11 +32,20 @@ class CheckpointVersionError(ValueError):
 
 
 class CheckpointManager:
-    """Numbered npz checkpoints in a directory, pruned to ``keep`` newest."""
+    """Numbered npz checkpoints in a directory, pruned to ``keep`` newest.
 
-    def __init__(self, directory: str, keep: int = 3):
+    ``on_corruption(path, quarantined_path, error)`` (optional) fires
+    whenever the latest-default :meth:`restore` skips an unreadable
+    checkpoint — the hook an ops pipeline uses to page on silent data
+    loss instead of discovering it in a post-mortem (wire it to a
+    ``ReliabilityEvent`` on your event log; ``scripts/chaos_soak.py``
+    audits quarantines through it)."""
+
+    def __init__(self, directory: str, keep: int = 3,
+                 on_corruption: Optional[Callable] = None):
         self.directory = directory
         self.keep = keep
+        self.on_corruption = on_corruption
         os.makedirs(directory, exist_ok=True)
         # a crash mid-save leaves .tmp_ckpt_* orphans (invisible to the
         # ckpt_*.npz glob but full model-sized files); sweep the STALE
@@ -101,6 +112,8 @@ class CheckpointManager:
         the versioned schema) — the streaming driver persists its
         ``intercept`` through this (its stream position rides the core
         ``iteration`` field)."""
+        failpoint("checkpoint.save")  # injected BEFORE any byte is
+        # written: a save fault never leaves a partial file behind
         path = self._path(iteration)
         # Temp prefix must NOT match the ckpt_*.npz glob, or a truncated
         # file left by a crash mid-write would be picked up by latest_path.
@@ -172,25 +185,44 @@ class CheckpointManager:
                 return self._load(p)
             except CheckpointVersionError:
                 raise  # intact but incompatible: not corruption
-            except Exception as e:  # truncated/torn file: try older
+            except (OSError, FaultInjected) as e:
+                # transient I/O (EMFILE, NFS hiccup, vanished file) or an
+                # injected chaos fault: NOT corruption — fall back to an
+                # older checkpoint for THIS restore but leave the file in
+                # place (same carve-out as serve/registry.maybe_reload;
+                # quarantining here would let a one-off hiccup destroy a
+                # finished run's final, fully valid checkpoint)
                 logger.warning(
-                    "checkpoint %s unreadable (%s: %s); falling back to "
-                    "the previous retained checkpoint", p,
-                    type(e).__name__, e)
+                    "checkpoint %s hit a transient I/O error (%s: %s); "
+                    "falling back to the previous retained checkpoint "
+                    "without quarantining", p, type(e).__name__, e)
+            except Exception as e:  # truncated/torn file: try older
                 # QUARANTINE the proven-bad file out of the numbered
                 # namespace: left in place, _prune would keep treating
                 # it as 'newest' and delete every VALID checkpoint the
                 # resumed run writes below its iteration
+                quarantined = os.path.join(
+                    os.path.dirname(p), ".bad_" + os.path.basename(p))
                 try:
-                    os.replace(p, os.path.join(
-                        os.path.dirname(p),
-                        ".bad_" + os.path.basename(p)))
+                    os.replace(p, quarantined)
                 except OSError:
-                    pass
+                    quarantined = None  # left in place (e.g. perms)
+                logger.warning(
+                    "checkpoint %s unreadable (%s: %s); quarantined as %s, "
+                    "falling back to the previous retained checkpoint", p,
+                    type(e).__name__, e, quarantined or "<unmoved>")
+                if self.on_corruption is not None:
+                    try:
+                        self.on_corruption(p, quarantined, e)
+                    except Exception:  # observer must not break resume
+                        logger.warning(
+                            "on_corruption hook raised; continuing",
+                            exc_info=True)
         return None
 
     @staticmethod
     def _load(path: str) -> dict:
+        failpoint("checkpoint.load")
         with np.load(path, allow_pickle=False) as z:
             if str(z["version"]) != FORMAT_VERSION:
                 raise CheckpointVersionError(

@@ -77,6 +77,20 @@ class ServeReloadEvent:
     error: Optional[str] = None
 
 
+@dataclass
+class ReliabilityEvent:
+    """One reliability observation (tpu_sgd/reliability): a component
+    heartbeat, a flagged straggler, a queue-depth sample, a supervisor
+    retry/preemption/resume, or a quarantined checkpoint.  Logged as
+    ``reliability_<kind>`` JSONL records so an incident replay can
+    filter them with one prefix match."""
+
+    kind: str    # "heartbeat" | "straggler" | "queue_depth" | "retry" | ...
+    source: str  # emitting component, e.g. "prefetcher" | "supervisor"
+    value: float = 0.0
+    detail: str = ""
+
+
 class SGDListener:
     """Override any subset; attached via ``GradientDescent.set_listener``."""
 
@@ -90,6 +104,8 @@ class SGDListener:
 
     def on_serve_reload(self, event: ServeReloadEvent) -> None: ...
 
+    def on_reliability(self, event: ReliabilityEvent) -> None: ...
+
 
 class CollectingListener(SGDListener):
     """Buffers every event in memory (test/introspection helper)."""
@@ -99,6 +115,7 @@ class CollectingListener(SGDListener):
         self.runs: List[RunEvent] = []
         self.serve_batches: List[ServeBatchEvent] = []
         self.serve_reloads: List[ServeReloadEvent] = []
+        self.reliability: List[ReliabilityEvent] = []
 
     def on_run_start(self, config):
         self.runs.append(RunEvent(event="run_started"))
@@ -115,14 +132,25 @@ class CollectingListener(SGDListener):
     def on_serve_reload(self, event):
         self.serve_reloads.append(event)
 
+    def on_reliability(self, event):
+        self.reliability.append(event)
+
 
 class JsonLinesEventLog(SGDListener):
-    """Append-only JSONL event log (the ``spark.eventLog`` analogue)."""
+    """Append-only JSONL event log (the ``spark.eventLog`` analogue).
 
-    def __init__(self, path: str):
+    ``fsync=True`` forces each record to stable storage before the
+    write returns — the durability knob for post-mortem forensics (a
+    host preemption must not eat the events explaining it).  Default
+    off: an fsync per event is an O(ms) tax the serving flush thread
+    cannot afford in steady state.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
         import threading
 
         self.path = path
+        self.fsync = bool(fsync)
         self._f = open(path, "a")
         # the serving subsystem logs from its flush thread while user
         # threads log reloads/bulk scores through the same instance; the
@@ -137,6 +165,10 @@ class JsonLinesEventLog(SGDListener):
                 return  # closed mid-shutdown: drop, don't raise in servers
             self._f.write(line)
             self._f.flush()
+            if self.fsync:
+                import os
+
+                os.fsync(self._f.fileno())
 
     def on_run_start(self, config):
         self._write("run_started", {"config": asdict(config)})
@@ -153,9 +185,43 @@ class JsonLinesEventLog(SGDListener):
     def on_serve_reload(self, event: ServeReloadEvent):
         self._write("serve_reload", asdict(event))
 
+    def on_reliability(self, event: ReliabilityEvent):
+        payload = asdict(event)
+        # the record's kind IS the prefixed form; the raw sub-kind field
+        # would otherwise win the dict merge in _write and erase the
+        # reliability_ prefix replay filters key on
+        del payload["kind"]
+        self._write(f"reliability_{event.kind}", payload)
+
     def close(self):
         with self._write_lock:  # never close out from under a writer
             self._f.close()
+
+    @staticmethod
+    def read(path: str):
+        """Parse an event log back into a list of dicts.
+
+        A crash (or preemption, without ``fsync=True``) can leave the
+        final line torn mid-record; that trailing partial line is
+        SKIPPED — losing the last event is the expected cost of a crash,
+        not corruption.  Every record is written as one line ending in
+        ``\\n``, so a torn tail is recognizable by the MISSING final
+        newline; a malformed line that IS newline-terminated (anywhere,
+        including last) still raises: that is real corruption replay
+        must not paper over."""
+        events = []
+        with open(path) as f:
+            content = f.read()
+        lines = [ln for ln in content.split("\n") if ln.strip()]
+        unterminated_tail = bool(content) and not content.endswith("\n")
+        for i, ln in enumerate(lines):
+            try:
+                events.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if i == len(lines) - 1 and unterminated_tail:
+                    break  # crash-truncated tail: tolerate
+                raise
+        return events
 
 
 @contextlib.contextmanager
