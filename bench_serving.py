@@ -136,8 +136,16 @@ def main() -> int:
     log(f"warmed {server.engine.compile_count} compiled programs "
         f"(buckets {server.engine.buckets})")
 
+    from tpu_sgd.analysis import assert_compile_count
+
     levels = []
-    with server:
+    # jit-cache-growth guard: after the warm loop above, the measured
+    # levels must never reach the XLA compiler — a mid-bench compile is
+    # a ~100-200ms stall that silently wrecks the p99 AND means a shape
+    # escaped the bucket discipline.  Fail the bench loudly instead
+    # (assert_compile_count is graftlint's runtime twin, tpu_sgd/analysis).
+    with assert_compile_count(0, of=lambda: server.engine.compile_count), \
+            server:
         # prime the queued path end-to-end (first flush pays one-time
         # lazy imports — jax.experimental.sparse via stack_rows — which
         # would otherwise stall the first measured level by ~1s)

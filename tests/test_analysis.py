@@ -1,0 +1,718 @@
+"""graftlint (tpu_sgd/analysis): rule fixtures, suppressions, mutation
+checks against the REAL modules, and the runtime validators.
+
+The mutation tests are the load-bearing half: they take the actual
+source of ``io/prefetch.py`` / ``serve/batcher.py``, delete the exact
+thing each rule exists to protect (a ``failpoint(...)`` hook, a
+``with self._cond:``), and assert lint catches the seeded violation —
+proof the rules guard the real code, not just synthetic fixtures."""
+
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.analysis.core import (Config, Finding, KNOWN_RULES, ModuleFile,
+                                   run_lint)
+from tpu_sgd.analysis.rules_donation import DonationSafetyRule
+from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
+from tpu_sgd.analysis.rules_lock import LockDisciplineRule
+from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
+from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
+                                      LocksetRecorder, assert_compile_count,
+                                      instrument_object)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+def mod(src: str, relpath: str = "fixture_mod.py") -> ModuleFile:
+    return ModuleFile("/fixtures/" + relpath, relpath,
+                      textwrap.dedent(src))
+
+
+def lint(modules, rules, **cfg):
+    cfg.setdefault("root", "/fixtures")
+    if isinstance(modules, ModuleFile):
+        modules = [modules]
+    return run_lint(config=Config(**cfg), rules=rules, modules=modules)
+
+
+def by_rule(result, rule: str):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# -- shape-trap -------------------------------------------------------------
+
+def test_shape_trap_fires_on_eager_pad_and_concatenate():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host_assemble(X, tail):
+            Xp = jnp.pad(X, ((0, tail), (0, 0)))
+            return jnp.concatenate([Xp, Xp])
+    """), [ShapeTrapRule()])
+    found = by_rule(res, "shape-trap")
+    assert len(found) == 2
+    assert "per input shape" in found[0].message
+
+
+def test_shape_trap_fires_on_dynamic_slice_of_device_array():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def score(X, w, n):
+            out = jnp.matmul(X, w)
+            return out[:n]
+    """), [ShapeTrapRule()])
+    assert len(by_rule(res, "shape-trap")) == 1
+
+
+def test_shape_trap_silent_inside_jit_and_on_numpy():
+    res = lint(mod("""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def traced_pad(X):
+            return jnp.pad(X, ((0, 1), (0, 0)))
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def traced_cat(X, k):
+            def inner(A):
+                return jnp.concatenate([A, A])
+            return inner(X)[:k]
+
+        def wrapped(X):
+            return jnp.concatenate([X, X])
+
+        apply_wrapped = jax.vmap(wrapped)
+
+        def host_numpy(X, n):
+            Xp = np.pad(X, ((0, 3), (0, 0)))
+            return np.concatenate([Xp, Xp])[:n]
+
+        def lax_map_body(X, B):
+            def one(k):
+                return jnp.concatenate([X, X])
+            return jax.lax.map(one, jnp.arange(4))
+    """), [ShapeTrapRule()])
+    assert by_rule(res, "shape-trap") == []
+
+
+def test_shape_trap_silent_on_helper_called_from_traced_fn():
+    res = lint(mod("""
+        import jax
+        import jax.numpy as jnp
+
+        def helper(X):
+            return jnp.concatenate([X, X])
+
+        @jax.jit
+        def body(X):
+            return helper(X)
+    """), [ShapeTrapRule()])
+    assert by_rule(res, "shape-trap") == []
+
+
+def test_shape_trap_ignores_lax_dynamic_slice():
+    # lax.dynamic_slice* has STATIC sizes: eager use compiles once per
+    # input shape — it is the shape-stable idiom, not the trap
+    res = lint(mod("""
+        import jax
+        import jax.numpy as jnp
+
+        def window(X, k, B):
+            return jax.lax.dynamic_slice_in_dim(X, k * B, B, 0)
+    """), [ShapeTrapRule()])
+    assert by_rule(res, "shape-trap") == []
+
+
+# -- eager-in-loop ----------------------------------------------------------
+
+def test_eager_in_loop_fires_on_jit_constructed_per_iteration():
+    res = lint(mod("""
+        import jax
+        from functools import partial
+
+        def run(fs, X):
+            outs = []
+            for f in fs:
+                outs.append(jax.jit(f)(X))
+            while X.sum() < 0:
+                g = partial(jax.jit, donate_argnums=(0,))(fs[0])
+            return outs
+    """), [EagerInLoopRule()])
+    assert len(by_rule(res, "eager-in-loop")) == 2
+
+
+def test_eager_in_loop_silent_on_hoisted_and_memoized():
+    res = lint(mod("""
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=None)
+        def _program(B):
+            return jax.jit(lambda X: X * B)
+
+        compiled = jax.jit(lambda X: X + 1)
+
+        def run(chunks):
+            return [_program(c.shape[0])(c) for c in chunks]
+
+        def loop_defines_fn(chunks):
+            for c in chunks:
+                # the jit lives in a def only CALLED later, not built here
+                def build():
+                    return jax.jit(lambda X: X)
+                yield build
+    """), [EagerInLoopRule()])
+    assert by_rule(res, "eager-in-loop") == []
+
+
+# -- lock-discipline --------------------------------------------------------
+
+LOCKED_SRC = """
+    import threading
+
+    GRAFTLINT_LOCKS = {
+        "Box": {
+            "_val": "_lock",
+            "_ref": "_lock:w",
+        },
+    }
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._val = 0
+            self._ref = None
+
+        def good(self):
+            with self._lock:
+                self._val += 1
+                self._ref = object()
+
+        def read_ref(self):
+            return self._ref            # :w mode: bare read sanctioned
+"""
+
+
+def test_lock_discipline_clean_fixture():
+    res = lint(mod(LOCKED_SRC), [LockDisciplineRule()])
+    assert by_rule(res, "lock-discipline") == []
+
+
+def test_lock_discipline_flags_unlocked_access_and_w_mode_write():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"Box": {"_val": "_lock", "_ref": "_lock:w"}}
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._val = 0
+                self._ref = None
+
+            def bad_read(self):
+                return self._val
+
+            def bad_write(self):
+                self._ref = object()
+
+            def closure_leak(self):
+                def worker():
+                    self._val += 1
+                return worker
+    """), [LockDisciplineRule()])
+    found = by_rule(res, "lock-discipline")
+    assert len(found) == 3
+    assert any("read of guarded attribute self._val" in f.message
+               for f in found)
+    assert any("write of guarded attribute self._ref" in f.message
+               for f in found)
+
+
+def test_lock_discipline_init_exempt_and_declaration_drift():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {
+            "Ghost": {"_x": "_lock"},
+            "Real": {"_x": "_missing_lock"},
+        }
+
+        class Real:
+            def __init__(self):
+                self._x = 0
+    """), [LockDisciplineRule()])
+    found = by_rule(res, "lock-discipline")
+    msgs = " | ".join(f.message for f in found)
+    assert "no such class" in msgs            # Ghost
+    assert "never assigned" in msgs           # _missing_lock
+    # __init__'s unguarded self._x write itself is exempt
+    assert "guarded attribute" not in msgs
+
+
+# -- donation-safety --------------------------------------------------------
+
+def test_donation_safety_fires_on_read_after_donate():
+    res = lint(mod("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def acc(G, Gi):
+            return G + Gi
+
+        def build(chunks, G0):
+            G = G0
+            out = acc(G, chunks[0])
+            return G.sum() + out.sum()
+    """), [DonationSafetyRule()])
+    found = by_rule(res, "donation-safety")
+    assert len(found) == 1
+    assert "donated to `acc`" in found[0].message
+
+
+def test_donation_safety_silent_on_rebind_idiom():
+    res = lint(mod("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def acc(G, Gi):
+            return G + Gi
+
+        def build(chunks, G0):
+            G = G0
+            for c in chunks:
+                G = acc(G, c)
+            return G
+    """), [DonationSafetyRule()])
+    assert by_rule(res, "donation-safety") == []
+
+
+def test_donation_safety_resolves_cross_module_imports():
+    provider = mod("""
+        import jax
+
+        def _raw(G, Gi):
+            return G + Gi
+
+        acc = jax.jit(_raw, donate_argnums=(0,))
+    """, relpath="provider.py")
+    consumer = mod("""
+        from provider import acc
+
+        def build(G, Gi):
+            out = acc(G, Gi)
+            return G.sum()
+    """, relpath="consumer.py")
+    res = lint([provider, consumer], [DonationSafetyRule()])
+    found = by_rule(res, "donation-safety")
+    assert len(found) == 1
+    assert found[0].path == "consumer.py"
+
+
+# -- failpoint-coverage -----------------------------------------------------
+
+def test_failpoint_coverage_both_directions():
+    registry = {"io.feed": "feed.py"}
+    ok = mod("""
+        from tpu_sgd.reliability.failpoints import failpoint
+
+        def produce():
+            failpoint("io.feed")
+    """, relpath="feed.py")
+    res = lint([ok], [FailpointCoverageRule(registry=registry)])
+    assert by_rule(res, "failpoint-coverage") == []
+
+    missing = mod("""
+        def produce():
+            pass
+    """, relpath="feed.py")
+    res = lint([missing], [FailpointCoverageRule(registry=registry)])
+    found = by_rule(res, "failpoint-coverage")
+    assert len(found) == 1 and "deleted or never wired" in found[0].message
+
+    unregistered = mod("""
+        from tpu_sgd.reliability.failpoints import failpoint
+
+        def produce():
+            failpoint("io.feed")
+            failpoint("io.rogue_site")
+    """, relpath="feed.py")
+    res = lint([unregistered], [FailpointCoverageRule(registry=registry)])
+    found = by_rule(res, "failpoint-coverage")
+    assert len(found) == 1 and "not registered" in found[0].message
+
+
+def test_failpoint_coverage_points_at_moved_hook():
+    registry = {"io.feed": "feed.py"}
+    elsewhere = mod("""
+        from tpu_sgd.reliability.failpoints import failpoint
+
+        def produce():
+            failpoint("io.feed")
+    """, relpath="other.py")
+    empty = mod("def produce():\n    pass\n", relpath="feed.py")
+    res = lint([empty, elsewhere],
+               [FailpointCoverageRule(registry=registry)])
+    found = by_rule(res, "failpoint-coverage")
+    assert len(found) == 1 and "other.py" in found[0].message
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppression_same_line_with_reason():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host(X):
+            return jnp.concatenate([X, X])  # graftlint: disable=shape-trap -- fixture reason
+    """), [ShapeTrapRule()])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_suppression_standalone_line_above():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host(X):
+            # graftlint: disable=shape-trap -- fixture reason
+            return jnp.concatenate([X, X])
+    """), [ShapeTrapRule()])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_suppression_all_wildcard_and_wrong_rule():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host(X):
+            # graftlint: disable=all -- fixture reason
+            return jnp.concatenate([X, X])
+
+        def host2(X):
+            # graftlint: disable=lock-discipline -- wrong rule on purpose
+            return jnp.concatenate([X, X])
+    """), [ShapeTrapRule()])
+    assert len(by_rule(res, "shape-trap")) == 1  # host2 not covered
+    assert res.suppressed == 1
+
+
+def test_bare_suppression_and_unknown_rule_are_findings():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host(X):
+            # graftlint: disable=shape-trap
+            return jnp.concatenate([X, X])
+
+        def host2(X):
+            # graftlint: disable=shape_trap -- underscores, not a rule id
+            return jnp.concatenate([X, X])
+    """), [ShapeTrapRule()])
+    rules = {f.rule for f in res.findings}
+    assert "bare-suppression" in rules
+    assert "unknown-rule" in rules
+
+
+# -- mutation checks against the REAL modules -------------------------------
+
+def _real_module(relpath: str, transform=None) -> ModuleFile:
+    with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+        src = f.read()
+    if transform is not None:
+        mutated = transform(src)
+        assert mutated != src, "mutation did not apply"
+        src = mutated
+    return ModuleFile("/mutated/" + relpath, relpath, src)
+
+
+def test_mutation_deleted_failpoint_hook_fails_lint():
+    """Delete the prefetcher's failpoint call in a copy of the real
+    module: the failpoint-coverage rule must catch it."""
+    registry_mod = _real_module("tpu_sgd/reliability/failpoints.py")
+    intact = _real_module("tpu_sgd/io/prefetch.py")
+    res = lint([registry_mod, intact], [FailpointCoverageRule()])
+    baseline = by_rule(res, "failpoint-coverage")
+    assert [f for f in baseline
+            if "io.prefetch.produce" in f.message] == []
+
+    mutated = _real_module(
+        "tpu_sgd/io/prefetch.py",
+        lambda s: s.replace('failpoint("io.prefetch.produce")', "pass"))
+    res = lint([registry_mod, mutated], [FailpointCoverageRule()])
+    found = by_rule(res, "failpoint-coverage")
+    assert any("io.prefetch.produce" in f.message
+               and "deleted or never wired" in f.message for f in found)
+
+
+def test_mutation_deleted_lock_block_fails_lint():
+    """Replace ``submit``'s ``with self._cond:`` with ``if True:`` in a
+    copy of the real batcher: the lock-discipline rule must flag the
+    now-unguarded queue accesses."""
+    intact = _real_module("tpu_sgd/serve/batcher.py")
+    res = lint([intact], [LockDisciplineRule()])
+    assert by_rule(res, "lock-discipline") == []
+
+    mutated = _real_module(
+        "tpu_sgd/serve/batcher.py",
+        lambda s: s.replace("with self._cond:", "if True:", 1))
+    res = lint([mutated], [LockDisciplineRule()])
+    found = by_rule(res, "lock-discipline")
+    assert len(found) >= 2  # _stopped read + _pending touches in submit
+    assert all("outside `with self._cond:`" in f.message for f in found)
+
+
+def test_every_rule_fires_on_its_seeded_violation():
+    """One seeded violation per rule, one combined sweep: each of the
+    five rules must report exactly its own planted bug."""
+    registry = {"io.feed": "seeded.py"}
+    seeded = mod("""
+        import threading
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        GRAFTLINT_LOCKS = {"S": {"_q": "_lock"}}
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+            def racy(self):
+                return len(self._q)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def acc(G, Gi):
+            return G + Gi
+
+        def host(X, G, Gi):
+            Xp = jnp.pad(X, ((0, 1), (0, 0)))
+            out = acc(G, Gi)
+            use_after = G.sum()
+            for _ in range(2):
+                f = jax.jit(lambda a: a)
+            return Xp, out, use_after, f
+    """, relpath="seeded.py")
+    res = lint([seeded], [
+        ShapeTrapRule(), LockDisciplineRule(), DonationSafetyRule(),
+        FailpointCoverageRule(registry=registry), EagerInLoopRule()])
+    fired = {f.rule for f in res.findings}
+    assert set(KNOWN_RULES) <= fired, (
+        f"rules that failed to fire: {set(KNOWN_RULES) - fired}")
+
+
+# -- the repo itself is clean ----------------------------------------------
+
+def test_repo_lints_clean():
+    """The acceptance gate, as a test: zero unsuppressed findings over
+    the configured include set, and every suppression carries a reason."""
+    res = run_lint(root=REPO)
+    assert res.findings == [], "\n".join(str(f) for f in res.findings)
+    assert res.files > 50  # the sweep really walked the package
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from tpu_sgd.analysis import lint as lint_cli
+
+    assert lint_cli.main(["--root", REPO, "-q"]) == 0
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def host(X):
+            return jnp.pad(X, ((0, 1),))
+    """))
+    (tmp_path / "pyproject.toml").write_text("")
+    rc = lint_cli.main(["--root", str(tmp_path), str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "shape-trap" in out
+
+    # a typo'd explicit path must fail loudly (exit 2), never report
+    # clean with zero files checked
+    rc = lint_cli.main(["--root", REPO, "tpu_sgd/no_such_file_xyz.py"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "does not exist" in err
+
+    # same for a typo'd config include: a renamed package must not turn
+    # the CI lint gate vacuously green
+    with pytest.raises(FileNotFoundError, match="include"):
+        run_lint(config=Config(root=REPO, include=["tpu_sgd_renamed"]))
+
+
+# -- runtime: assert_compile_count -----------------------------------------
+
+class _FakeJitted:
+    def __init__(self):
+        self.n = 0
+
+    def _cache_size(self):
+        return self.n
+
+
+def test_assert_compile_count_exact_and_at_most():
+    fn = _FakeJitted()
+    with assert_compile_count(2, of=fn):
+        fn.n += 2
+    with assert_compile_count(2, of=fn, at_most=True):
+        fn.n += 1
+    with pytest.raises(CompileCountError, match="allows exactly 1"):
+        with assert_compile_count(1, of=fn):
+            fn.n += 3
+    with pytest.raises(CompileCountError, match="allows at most 0"):
+        with assert_compile_count(0, of=fn, at_most=True):
+            fn.n += 1
+
+
+def test_assert_compile_count_sums_mixed_sources():
+    fn, extra = _FakeJitted(), [0]
+    with assert_compile_count(3, of=[fn, lambda: extra[0]]):
+        fn.n += 1
+        extra[0] += 2
+    with pytest.raises(ValueError):
+        assert_compile_count(-1, of=fn).__enter__()
+    with pytest.raises(TypeError):
+        with assert_compile_count(0, of=object()):
+            pass
+
+
+def test_assert_compile_count_on_real_jit():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    with assert_compile_count(1, of=f):
+        f(jnp.zeros((3,)))
+    with assert_compile_count(0, of=f):  # warm shape: no growth
+        f(jnp.ones((3,)))
+    with assert_compile_count(1, of=f):  # new shape: exactly one
+        f(jnp.zeros((4,)))
+
+
+# -- runtime: InstrumentedLock / instrument_object --------------------------
+
+def test_instrumented_lock_tracks_holding_thread():
+    rec = LocksetRecorder()
+    lk = InstrumentedLock(threading.Lock(), name="L", recorder=rec)
+    assert not lk.held_by_current_thread()
+    with lk:
+        assert lk.held_by_current_thread()
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(lk.held_by_current_thread()))
+        t.start()
+        t.join()
+        assert seen == [False]  # held-ness is per-thread
+    assert not lk.held_by_current_thread()
+
+
+def test_instrument_object_records_unguarded_access():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._val = 0
+            self._ref = None
+
+        def good(self):
+            with self._lock:
+                self._val += 1
+
+        def bad(self):
+            self._val += 1
+
+        def write_ref_unlocked(self):
+            self._ref = object()
+
+        def read_ref_unlocked(self):
+            return self._ref
+
+    box = Box()
+    rec = instrument_object(box, {"_val": "_lock", "_ref": "_lock:w"})
+    box.good()
+    assert rec.violations == []
+    box.bad()
+    assert rec.violating_functions() == {"bad"}
+    box.read_ref_unlocked()          # :w — bare read sanctioned
+    assert rec.violating_functions() == {"bad"}
+    box.write_ref_unlocked()         # :w — write must lock
+    assert rec.violating_functions() == {"bad", "write_ref_unlocked"}
+
+
+def test_real_batcher_declaration_validates_at_runtime():
+    """The lock-discipline declaration in serve/batcher.py, validated
+    dynamically: a real submit/flush workload over an instrumented
+    MicroBatcher records NO unguarded access except the statically
+    suppressed racy readers (queue_depth / the metrics sample)."""
+    from tpu_sgd.serve.batcher import GRAFTLINT_LOCKS, MicroBatcher
+
+    b = MicroBatcher(lambda X: np.asarray(X).sum(axis=1),
+                     max_batch=4, max_latency_s=0.002)
+    rec = instrument_object(b, GRAFTLINT_LOCKS["MicroBatcher"])
+    futs = [b.submit(np.ones(3, np.float32)) for _ in range(9)]
+    with b:
+        got = [f.result(timeout=10) for f in futs]
+    assert [float(g) for g in got] == [3.0] * 9
+    depth = b.queue_depth  # the sanctioned racy read IS recorded
+    assert depth == 0
+    allowed = {"queue_depth", "_flush"}
+    assert rec.violating_functions() <= allowed, rec.violations
+    assert "queue_depth" in rec.violating_functions()
+    assert rec.checked_accesses > 20  # the workload really went through
+
+
+def test_real_eventlog_declaration_validates_at_runtime(tmp_path):
+    from tpu_sgd.utils.events import (GRAFTLINT_LOCKS, IterationEvent,
+                                      JsonLinesEventLog)
+
+    log = JsonLinesEventLog(str(tmp_path / "ev.jsonl"))
+    rec = instrument_object(log, GRAFTLINT_LOCKS["JsonLinesEventLog"])
+
+    def writer(i):
+        for j in range(20):
+            log.on_iteration(IterationEvent(
+                iteration=i * 100 + j, loss=0.0, weight_delta_norm=0.0,
+                mini_batch_size=1, wall_time_s=0.0))
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    assert rec.violations == []
+    events = JsonLinesEventLog.read(str(tmp_path / "ev.jsonl"))
+    assert len(events) == 60  # every line whole, none torn
+
+
+def test_instrumented_condition_wait_releases_lockset():
+    """Condition.wait releases the lock while blocked; the recorder must
+    not count the waiter as a holder during that window."""
+    rec = LocksetRecorder()
+    cond = InstrumentedLock(threading.Condition(), name="c", recorder=rec)
+    observed = []
+
+    def waiter():
+        with cond:
+            observed.append(("pre", cond.held_by_current_thread()))
+            cond.wait(timeout=5)
+            observed.append(("post", cond.held_by_current_thread()))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    with cond:  # acquirable because the waiter dropped it
+        assert cond.held_by_current_thread()
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert observed == [("pre", True), ("post", True)]

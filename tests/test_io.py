@@ -254,7 +254,10 @@ def test_pipelined_build_compiles_one_body_program(rng):
     """THE shape-discipline assertion: a pipelined build with a padded
     tail runs exactly ONE compiled per-chunk stats program (fixed-shape
     chunks; the tail padded in host numpy) — the legacy loop compiled a
-    second program for every distinct tail shape."""
+    second program for every distinct tail shape.  Asserted through the
+    shared ``assert_compile_count`` (tpu_sgd.analysis), the runtime twin
+    of graftlint's shape-trap rule."""
+    from tpu_sgd.analysis import assert_compile_count
     from tpu_sgd.ops import gram as gram_mod
     from tpu_sgd.ops.gram import GramLeastSquaresGradient
 
@@ -262,16 +265,16 @@ def test_pipelined_build_compiles_one_body_program(rng):
     # unique (B, dtype, donate) key so other tests' compiles don't count
     B = 33
     gram_mod._streamed_stats_fn.cache_clear()
-    GramLeastSquaresGradient.build_streamed(
-        X, y, block_rows=B, batch_rows=4 * B, pipeline=True)
-    fn = gram_mod._streamed_stats_fn(B, "float32", False)
-    assert fn._cache_size() == 1  # one body program, padded tail reuses it
+    with assert_compile_count(
+            1, of=gram_mod._streamed_stats_fn(B, "float32", False)):
+        GramLeastSquaresGradient.build_streamed(
+            X, y, block_rows=B, batch_rows=4 * B, pipeline=True)
 
     gram_mod._streamed_totals_fn.cache_clear()
-    GramLeastSquaresGradient._streamed_totals(
-        X, y, 33, np.dtype("float32"), 4 * 33, pipeline=True)
-    fn = gram_mod._streamed_totals_fn(33, "float32", False)
-    assert fn._cache_size() == 1
+    with assert_compile_count(
+            1, of=gram_mod._streamed_totals_fn(33, "float32", False)):
+        GramLeastSquaresGradient._streamed_totals(
+            X, y, 33, np.dtype("float32"), 4 * 33, pipeline=True)
 
 
 def test_pipelined_sharded_totals_match_sync(rng):

@@ -442,6 +442,26 @@ def test_event_log_close_is_safe_under_writes(rng, tmp_path):
     assert all("kind" in e for e in lines)
 
 
+def test_warm_engine_serves_every_size_without_compiling(rng):
+    """The serving shape contract as a compile-count assertion (shared
+    ``assert_compile_count`` helper, tpu_sgd.analysis): once the bucket
+    programs are warm, NO request size inside the bucket range may reach
+    the compiler — arbitrary batch sizes pad host-side onto cached
+    programs."""
+    from tpu_sgd.analysis import assert_compile_count
+
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    for b in engine.buckets:  # warm every bucket program once
+        engine.predict_batch(
+            model, rng.normal(size=(b, 12)).astype(np.float32))
+    with assert_compile_count(0, of=lambda: engine.compile_count):
+        for n in (1, 2, 3, 5, 9, 17, 33, 100, 200, 511):
+            out = engine.predict_batch(
+                model, rng.normal(size=(n, 12)).astype(np.float32))
+            assert out.shape == (n,)
+
+
 def test_custom_engine_buckets_are_honored(rng):
     engine = PredictEngine(buckets=(4, 16))
     assert engine.bucket_for(5) == 16
@@ -453,6 +473,22 @@ def test_custom_engine_buckets_are_honored(rng):
         engine.predict_batch(model, X), np.asarray(model.predict(X)),
         rtol=1e-6, atol=1e-7,
     )
+
+
+def test_stack_rows_handles_all_zero_sparse_row(rng):
+    """An nse=0 request (a client scoring the zero vector) must coalesce
+    into the batch, not crash it — regression for the host-side sparse
+    assembly's size-0 index array."""
+    from jax.experimental.sparse import BCOO
+
+    from tpu_sgd.serve import stack_rows
+
+    dense = np.zeros((2, 6), np.float32)
+    dense[0, [1, 4]] = (2.0, 3.0)
+    rows = [BCOO.fromdense(dense[0]), BCOO.fromdense(dense[1])]
+    X = stack_rows(rows)
+    assert X.shape == (2, 6)
+    np.testing.assert_allclose(np.asarray(X.todense()), dense)
 
 
 def test_stack_rows_promotes_mixed_dtypes_and_rejects_bad_shapes(rng):

@@ -1,0 +1,25 @@
+"""graftlint — project-native static analysis + runtime checkers.
+
+The invariants the last three PRs enforced by hand (host-side shape
+surgery on hot paths, lock-guarded shared state, failpoints compiled
+into every hot path, donation discipline, no jit construction in
+loops) as machine-checked lint rules over the AST, plus the runtime
+twins tests use to validate the declarations themselves.
+
+CLI: ``python -m tpu_sgd.analysis.lint``.  Suppress one line with
+``# graftlint: disable=<rule> -- <reason>``.  Config:
+``[tool.graftlint]`` in pyproject.toml.  See README "Static analysis".
+"""
+
+from tpu_sgd.analysis.core import (Finding, KNOWN_RULES, LintResult,
+                                   ModuleFile, Rule, load_config, run_lint)
+from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
+                                      LocksetRecorder, assert_compile_count,
+                                      instrument_object)
+
+__all__ = [
+    "Finding", "KNOWN_RULES", "LintResult", "ModuleFile", "Rule",
+    "load_config", "run_lint",
+    "CompileCountError", "InstrumentedLock", "LocksetRecorder",
+    "assert_compile_count", "instrument_object",
+]

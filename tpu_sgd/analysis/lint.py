@@ -1,0 +1,73 @@
+"""graftlint CLI: ``python -m tpu_sgd.analysis.lint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.  Output is one
+``path:line:col: rule: message`` line per finding (editor/CI-clickable)
+plus a summary line.  With no paths, the ``[tool.graftlint]`` include
+set from pyproject.toml is linted (this repo: ``tpu_sgd``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional, Sequence
+
+from tpu_sgd.analysis.core import (KNOWN_RULES, default_rules, load_config,
+                                   run_lint)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_sgd.analysis.lint",
+        description="graftlint: tracing-safety, lock-discipline, and "
+                    "failpoint-coverage analysis for tpu_sgd")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: [tool.graftlint] "
+             "include set)")
+    parser.add_argument(
+        "--root", default=None,
+        help="project root containing pyproject.toml (default: walk up "
+             "from cwd)")
+    parser.add_argument(
+        "--disable", default="", metavar="RULE[,RULE...]",
+        help="disable rules for this run (adds to the config's list)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule ids and exit")
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="findings only, no summary line")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in KNOWN_RULES:
+            print(r)
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        cfg = load_config(args.root)
+        cfg.disable = list(cfg.disable) + [
+            r.strip() for r in args.disable.split(",") if r.strip()]
+        result = run_lint(args.paths or None, config=cfg,
+                          rules=default_rules())
+    except (OSError, ValueError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+
+    for f in result.findings:
+        print(f)
+    if not args.quiet:
+        dt = time.perf_counter() - t0
+        status = ("clean" if result.ok
+                  else f"{len(result.findings)} finding(s)")
+        print(f"graftlint: {status} — {result.files} file(s), "
+              f"{len(result.rules)} rule(s), {result.suppressed} "
+              f"suppressed, {dt:.2f}s", file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""donation-safety: a donated buffer must not be read after the call.
+
+``donate_argnums`` hands an argument's device buffer to XLA for reuse —
+the donating call may scribble its output into that memory.  Reading
+the Python name afterwards is use-after-free at the buffer level; JAX
+raises on CPU but silently returns garbage-adjacent behavior in some
+sharded configurations, and either way the bug only fires at runtime.
+The accumulate idiom this repo uses everywhere is safe by construction::
+
+    G, b, yy = _acc_totals(G, b, yy, Gi, bi, yyi)   # rebinds the names
+
+and that rebinding is exactly what the rule checks: after a call that
+donates a plain-name argument, any *load* of that name later in the
+same function — with no intervening rebind — is a finding.
+
+Donating callables are discovered project-wide (the map is built over
+every linted module, then imports are resolved), from the two static
+spellings::
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))     # decorated def
+    acc = jax.jit(body, donate_argnums=(0,))        # assigned wrapper
+
+A wrapper whose ``donate_argnums`` is a runtime expression (e.g.
+``(0, 1) if donate else ()``) is invisible to the rule — such factories
+must keep their own discipline (and do: they are the reason the rule
+exists as a *backstop*, not a proof).  Line-granular rebind tracking
+means a read textually *before* an in-loop donating call (hit on the
+next iteration) is also missed; the accumulate idiom rebinds on the
+call statement itself, which the rule models exactly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tpu_sgd.analysis.core import Finding, ModuleFile, Rule
+from tpu_sgd.analysis.tracing import (build_parents, dotted_name,
+                                      enclosing, last_seg)
+
+
+def _const_argnums(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, int):
+        return (val,)
+    if isinstance(val, (tuple, list)) and all(
+            isinstance(v, int) for v in val):
+        return tuple(val)
+    return None
+
+
+def _donate_kw(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            if kw.arg == "donate_argnames":
+                return None  # name-keyed donation: out of static reach
+            return _const_argnums(kw.value)
+    return None
+
+
+def collect_donators(mod: ModuleFile) -> Dict[str, Tuple[int, ...]]:
+    """Names in ``mod`` bound to donating callables, with donated
+    positional indices."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    if mod.tree is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                if last_seg(dotted_name(dec.func)) not in (
+                        "partial", "jit", "pjit"):
+                    continue
+                nums = _donate_kw(dec)
+                if nums:
+                    out[node.name] = nums
+        elif isinstance(node, ast.Assign):
+            val = node.value
+            if (isinstance(val, ast.Call)
+                    and last_seg(dotted_name(val.func)) in ("jit", "pjit")):
+                nums = _donate_kw(val)
+                if nums:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = nums
+    return out
+
+
+class DonationSafetyRule(Rule):
+    name = "donation-safety"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        # pass 1: project-wide donator map, keyed by dotted module name
+        by_module: Dict[str, Dict[str, Tuple[int, ...]]] = {
+            mod.dotted: collect_donators(mod) for mod in modules}
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            local = dict(by_module.get(mod.dotted, {}))
+            # resolve `from x.y import name [as alias]` against the map
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ImportFrom) or node.level:
+                    continue
+                exported = by_module.get(node.module or "", {})
+                for a in node.names:
+                    if a.name in exported:
+                        local[a.asname or a.name] = exported[a.name]
+            if local:
+                yield from self._check_module(mod, local)
+
+    def _check_module(self, mod: ModuleFile,
+                      donators: Dict[str, Tuple[int, ...]]
+                      ) -> Iterable[Finding]:
+        parents = build_parents(mod.tree)
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            yield from self._check_scope(mod, fn, donators, parents)
+
+    def _check_scope(self, mod: ModuleFile, fn: ast.AST,
+                     donators: Dict[str, Tuple[int, ...]],
+                     parents) -> Iterable[Finding]:
+        """One function scope: donating calls, then later loads of the
+        donated names with no intervening rebind."""
+        own = self._scope_nodes(fn)
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[ast.Name]] = {}
+        donations: List[Tuple[str, int, ast.Call, str]] = []
+        for node in own:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node)
+                else:
+                    # a Store from an Assign whose VALUE contains the
+                    # donating call lands at the assignment's end line —
+                    # the rebind takes effect after the call returns
+                    stmt = enclosing(node, parents,
+                                     (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign, ast.For, ast.With,
+                                      ast.withitem)) or node
+                    line = getattr(stmt, "end_lineno", None) or node.lineno
+                    stores.setdefault(node.id, []).append(line)
+            elif isinstance(node, ast.Call):
+                callee = last_seg(dotted_name(node.func))
+                nums = donators.get(callee)
+                if not nums or dotted_name(node.func) is None:
+                    continue
+                for i in nums:
+                    if i < len(node.args) and isinstance(
+                            node.args[i], ast.Name):
+                        donations.append(
+                            (node.args[i].id,
+                             node.end_lineno or node.lineno, node, callee))
+        for name, call_end, call, callee in donations:
+            rebinds = stores.get(name, [])
+            for load in loads.get(name, []):
+                if load.lineno <= call_end:
+                    continue
+                if any(call_end <= r <= load.lineno for r in rebinds):
+                    continue
+                yield Finding(
+                    self.name, mod.relpath, load.lineno, load.col_offset,
+                    f"`{name}` was donated to `{callee}` on line "
+                    f"{call.lineno} (donate_argnums) and is read here "
+                    "afterwards; the buffer may already be reused — "
+                    "rebind the name from the call's result, copy "
+                    "before donating, or drop the donation")
+
+    @staticmethod
+    def _scope_nodes(fn: ast.AST) -> List[ast.AST]:
+        """Nodes belonging to ``fn``'s own scope (nested defs excluded —
+        a closure's loads run at a time the linear line model cannot
+        order)."""
+        out: List[ast.AST] = []
+        stack = [c for c in ast.iter_child_nodes(fn)]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out

@@ -1,0 +1,192 @@
+"""shape-trap and eager-in-loop: the per-shape-recompile rules.
+
+Both rules police the same underlying cost model, documented in
+``tpu_sgd/ops/bucketed.py`` and relearned by PR 1 and PR 2 the hard
+way: XLA compiles one program per input *shape*, and an **eager** jnp
+op on a host path — a ``jnp.pad`` of a ragged tail, a
+``jnp.concatenate`` of a coalesced batch, a ``[:n]`` slice of a device
+array with a data-dependent ``n`` — is itself such a program, costing
+~100-200ms per new shape.  On a hot path that sees arbitrary batch
+sizes this is a compile stall per request size; the fix is always the
+same: do the shape surgery in host numpy (or move the whole computation
+under jit, where the op fuses instead of compiling standalone).
+
+``shape-trap`` flags the eager ops themselves; ``eager-in-loop`` flags
+the second spelling of the same bug — ``jax.jit(...)`` (or ``vmap`` /
+``grad`` / ``shard_map`` ...) *constructed inside a loop body*, which
+hands every iteration a fresh callable with an empty program cache, so
+the compiler runs once per iteration no matter how stable the shapes
+are.  Memoized factories (``functools.lru_cache``-wrapped builders like
+``ops/gram._streamed_stats_fn``) are the sanctioned pattern and do not
+fire the rule: the rule matches direct constructor calls only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Sequence, Set
+
+from tpu_sgd.analysis.core import Finding, ModuleFile, Rule
+from tpu_sgd.analysis.tracing import (JIT_CONSTRUCTORS, TracedIndex,
+                                      _is_partial_of_tracer, build_parents,
+                                      dotted_name, enclosing, last_seg,
+                                      module_prefixes)
+
+#: eager jnp ops that reshape batch-shaped values (one program per shape).
+#: NOTE the lax dynamic-slice family is deliberately NOT here: its slice
+#: sizes are static arguments and only the start index is a runtime
+#: value, so an eager ``lax.dynamic_slice_in_dim`` compiles once per
+#: input shape — it is the shape-STABLE idiom, not the trap.  The trap
+#: spelling of dynamic slicing is basic indexing ``x[a:b]`` with Python
+#: ints, where every distinct (a, b) is a new output shape; the
+#: subscript check below catches that form.
+SHAPE_OPS = {"pad", "concatenate"}
+
+
+def _matches(prefixes: Set[str], name: str, ops: Set[str]) -> bool:
+    for p in prefixes:
+        for op in ops:
+            if name == f"{p}.{op}":
+                return True
+    return False
+
+
+class ShapeTrapRule(Rule):
+    name = "shape-trap"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            yield from self._check_module(mod)
+
+    def _check_module(self, mod: ModuleFile) -> Iterable[Finding]:
+        prefixes = module_prefixes(mod.tree)
+        if not (prefixes["jnp"] or prefixes["lax"]):
+            return
+        idx = TracedIndex(mod.tree)
+        # names assigned from jnp calls, per enclosing function: the
+        # dynamic-slicing half of the rule tracks these so `out[:n]` on
+        # a device array is caught while the same slice on numpy passes
+        jnp_named: Dict[ast.AST, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            if isinstance(val, ast.Call) and any(
+                    dotted_name(val.func) is not None
+                    and dotted_name(val.func).startswith(p + ".")
+                    for p in prefixes["jnp"]):
+                fn = idx.enclosing_function(node)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jnp_named.setdefault(fn, set()).add(t.id)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if not _matches(prefixes["jnp"], name, SHAPE_OPS):
+                    continue
+                hit = (f"eager `{name}` on a host path compiles one "
+                       "XLA program per input shape (~100-200ms "
+                       "each); pad/concatenate in host numpy, or "
+                       "move this under jit")
+                fn = idx.enclosing_function(node)
+                if fn is None:  # module level: runs once at import
+                    continue
+                if idx.is_traced(node):
+                    continue
+                yield Finding(self.name, mod.relpath, node.lineno,
+                              node.col_offset, hit)
+            elif isinstance(node, ast.Subscript):
+                yield from self._check_subscript(mod, idx, jnp_named, node)
+
+    def _check_subscript(self, mod: ModuleFile, idx: TracedIndex,
+                         jnp_named: Dict[ast.AST, Set[str]],
+                         node: ast.Subscript) -> Iterable[Finding]:
+        if not isinstance(node.value, ast.Name):
+            return
+        fn = idx.enclosing_function(node)
+        if fn is None or node.value.id not in jnp_named.get(fn, ()):
+            return
+        if idx.is_traced(node):
+            return
+        if not _dynamic_slice(node.slice):
+            return
+        yield Finding(
+            self.name, mod.relpath, node.lineno, node.col_offset,
+            f"dynamic slice of device array `{node.value.id}` outside "
+            "jit compiles one gather/slice program per bound value's "
+            "shape; slice the host numpy copy instead")
+
+
+def _dynamic_slice(sl: ast.AST) -> bool:
+    """A slice with a non-constant bound (``x[:n]``, ``x[i:j]``)."""
+    if isinstance(sl, ast.Tuple):
+        return any(_dynamic_slice(e) for e in sl.elts)
+    if isinstance(sl, ast.Slice):
+        return any(b is not None and not isinstance(b, ast.Constant)
+                   and not _negated_constant(b)
+                   for b in (sl.lower, sl.upper, sl.step))
+    return False  # plain index: row pick, not a shape-carrying slice
+
+
+def _negated_constant(node: ast.AST) -> bool:
+    return (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant))
+
+
+class EagerInLoopRule(Rule):
+    name = "eager-in-loop"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            # only the parent map is needed here — a full TracedIndex
+            # (seed + call-graph fixpoint) would be wasted work
+            parents = build_parents(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = last_seg(dotted_name(node.func))
+                is_ctor = (name in JIT_CONSTRUCTORS
+                           or _is_partial_of_tracer(node)
+                           or (isinstance(node.func, ast.Call)
+                               and _is_partial_of_tracer(node.func)))
+                if not is_ctor:
+                    continue
+                # partial(jax.jit, ...)(f): the OUTER call reports; the
+                # inner partial would double-count the same expression
+                parent = parents.get(node)
+                if (_is_partial_of_tracer(node)
+                        and isinstance(parent, ast.Call)
+                        and parent.func is node):
+                    continue
+                # the nearest loop must be closer than the nearest def:
+                # a jit inside a def that merely SITS in a loop runs
+                # when the def runs, not per loop iteration.
+                # Comprehensions count as loops — `[jax.jit(f) for f in
+                # fs]` constructs per iteration exactly like the for
+                # statement spelling.
+                loop_kinds = (ast.For, ast.While, ast.ListComp,
+                              ast.SetComp, ast.DictComp, ast.GeneratorExp)
+                blocker = enclosing(
+                    node, parents,
+                    loop_kinds + (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+                if isinstance(blocker, loop_kinds):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"`{dotted_name(node.func) or name}` constructed "
+                        "inside a loop body: every iteration gets a "
+                        "fresh callable with an empty program cache "
+                        "(recompiles each time); hoist it out of the "
+                        "loop or memoize the factory "
+                        "(functools.lru_cache)")

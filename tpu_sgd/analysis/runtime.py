@@ -1,0 +1,291 @@
+"""Runtime counterparts of the static rules.
+
+:func:`assert_compile_count` generalizes the ad-hoc ``fn._cache_size()``
+asserts that ``tests/test_io.py`` grew: wrap any code region and pin
+exactly how many NEW XLA programs it may compile, measured through any
+combination of jitted functions and cache-size callables.  This is the
+shape-trap rule's runtime twin — the static rule catches the eager-op
+*pattern*, the context manager catches the *effect* (cache growth) for
+paths the AST cannot see through.
+
+:class:`InstrumentedLock` + :class:`LocksetRecorder` +
+:func:`instrument_object` are the lock-discipline rule's runtime twin:
+wrap a live object's declared locks, swap in a checking subclass, run a
+real concurrent workload, and every guarded-attribute access that
+happens WITHOUT the declared lock held by the accessing thread is
+recorded (never raised — a checker must not kill the flush thread it is
+observing).  ``tests/test_analysis.py`` validates the modules' actual
+``GRAFTLINT_LOCKS`` declarations this way, including the helpers the
+lexical rule must take on faith (a callee running under its caller's
+lock passes here, because the lock really is held).
+
+This module itself is stdlib-only — ``assert_compile_count`` works
+through the ``_cache_size`` attribute jitted callables already expose
+(though reaching it via ``tpu_sgd.analysis`` imports the parent package,
+jax included, like everything else in this repo).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Union
+
+__all__ = [
+    "CompileCountError", "assert_compile_count",
+    "InstrumentedLock", "LocksetRecorder", "LockViolation",
+    "instrument_object",
+]
+
+
+class CompileCountError(AssertionError):
+    """The wrapped region compiled a different number of programs than
+    the contract allows."""
+
+
+CacheSource = Union[Callable[[], int], object]
+
+
+def _cache_size(of: CacheSource) -> int:
+    """Current compiled-program count behind ``of``: a jitted function
+    (``fn._cache_size()``), a zero-arg int callable, or an iterable of
+    either (summed)."""
+    size_fn = getattr(of, "_cache_size", None)
+    if callable(size_fn):
+        return int(size_fn())
+    if callable(of):
+        return int(of())
+    if isinstance(of, Iterable):
+        return sum(_cache_size(o) for o in of)
+    raise TypeError(
+        f"cannot read a compile-cache size from {of!r}: pass a jitted "
+        "function, a zero-arg callable returning an int, or an "
+        "iterable of those")
+
+
+@contextlib.contextmanager
+def assert_compile_count(expected: int, *, of: CacheSource,
+                         at_most: bool = False):
+    """Assert the region compiles exactly ``expected`` new programs.
+
+    ``of`` names what to measure: a jitted function, a callable like
+    ``tpu_sgd.ops.bucketed.program_cache_size`` (or
+    ``lambda: engine.compile_count``), or an iterable mixing both —
+    deltas are summed.  ``at_most=True`` relaxes equality to an upper
+    bound (warm-loop guards: "no growth" is ``expected=0``).
+
+    Replaces the hand-rolled pattern::
+
+        fn = _streamed_stats_fn(B, "float32", False)
+        ...build...
+        assert fn._cache_size() == 1
+
+    with::
+
+        with assert_compile_count(1, of=_streamed_stats_fn(B, "float32",
+                                                           False)):
+            ...build...
+    """
+    if expected < 0:
+        raise ValueError(f"expected must be >= 0, got {expected}")
+    start = _cache_size(of)
+    yield
+    delta = _cache_size(of) - start
+    if (delta > expected) if at_most else (delta != expected):
+        bound = "at most" if at_most else "exactly"
+        raise CompileCountError(
+            f"region compiled {delta} new XLA program(s); the contract "
+            f"allows {bound} {expected}.  A growing program cache on a "
+            "hot path usually means an eager jnp op or dynamic slice on "
+            "a batch-shaped value — pad/slice in host numpy instead "
+            "(see the shape-trap rule, tpu_sgd/analysis)")
+
+
+# -- lock instrumentation ---------------------------------------------------
+
+class LockViolation:
+    """One guarded-attribute access without its declared lock held."""
+
+    __slots__ = ("cls_name", "attr", "op", "thread", "function", "line")
+
+    def __init__(self, cls_name: str, attr: str, op: str, thread: str,
+                 function: str, line: int):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.op = op            # "read" | "write"
+        self.thread = thread
+        self.function = function  # code object name of the accessor
+        self.line = line
+
+    def __repr__(self) -> str:
+        return (f"LockViolation({self.cls_name}.{self.attr} {self.op} in "
+                f"{self.function}:{self.line} on thread {self.thread})")
+
+
+class LocksetRecorder:
+    """Thread-aware ledger: which instrumented locks does each thread
+    hold right now, and which guarded accesses happened without one."""
+
+    def __init__(self):
+        self._held = threading.local()
+        self._mu = threading.Lock()
+        self.violations: List[LockViolation] = []
+        self.checked_accesses = 0
+
+    # -- lockset -----------------------------------------------------------
+    def _counts(self) -> Dict[int, int]:
+        counts = getattr(self._held, "counts", None)
+        if counts is None:
+            counts = self._held.counts = {}
+        return counts
+
+    def acquired(self, lock: "InstrumentedLock") -> None:
+        c = self._counts()
+        c[id(lock)] = c.get(id(lock), 0) + 1
+
+    def released(self, lock: "InstrumentedLock") -> None:
+        c = self._counts()
+        n = c.get(id(lock), 0) - 1
+        if n <= 0:
+            c.pop(id(lock), None)
+        else:
+            c[id(lock)] = n
+
+    def holds(self, lock: "InstrumentedLock") -> bool:
+        return self._counts().get(id(lock), 0) > 0
+
+    # -- violations --------------------------------------------------------
+    def count_checked(self) -> None:
+        # under _mu: += from concurrent checked threads loses updates,
+        # a sloppiness a lock-discipline validator cannot afford itself
+        with self._mu:
+            self.checked_accesses += 1
+
+    def record(self, violation: LockViolation) -> None:
+        with self._mu:
+            self.violations.append(violation)
+
+    def violating_functions(self) -> set:
+        with self._mu:
+            return {v.function for v in self.violations}
+
+
+class InstrumentedLock:
+    """Wrap a Lock / RLock / Condition so acquisitions register in a
+    :class:`LocksetRecorder`.  Proxies everything else (``notify_all``,
+    ``wait_for``, ...) to the inner primitive; ``wait`` is intercepted
+    because a Condition.wait RELEASES the lock while blocked — the
+    recorder must not count the waiter as a holder."""
+
+    def __init__(self, inner, *, name: str = "?",
+                 recorder: Optional[LocksetRecorder] = None):
+        self._inner = inner
+        self.name = name
+        self.recorder = recorder or LocksetRecorder()
+
+    def held_by_current_thread(self) -> bool:
+        return self.recorder.holds(self)
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got is not False:  # Lock.acquire() returns True; timeouts False
+            self.recorder.acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self.recorder.released(self)
+
+    def __enter__(self):
+        self._inner.__enter__()
+        self.recorder.acquired(self)
+        return self
+
+    def __exit__(self, *exc):
+        out = self._inner.__exit__(*exc)
+        self.recorder.released(self)
+        return out
+
+    def wait(self, timeout=None):
+        self.recorder.released(self)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self.recorder.acquired(self)
+
+    def wait_for(self, predicate, timeout=None):
+        self.recorder.released(self)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self.recorder.acquired(self)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def instrument_object(obj, lock_map: Dict[str, str],
+                      recorder: Optional[LocksetRecorder] = None
+                      ) -> LocksetRecorder:
+    """Arm ``obj`` with the runtime lock-discipline check.
+
+    ``lock_map`` is one class's entry of a module ``GRAFTLINT_LOCKS``
+    declaration: ``{attr: "lock_attr[:w]"}``.  Each named lock attribute
+    on ``obj`` is wrapped in an :class:`InstrumentedLock` (idempotent),
+    and ``obj``'s class is swapped for a dynamically-built checking
+    subclass whose ``__getattribute__`` / ``__setattr__`` verify the
+    declared lock is held by the accessing thread; misses are recorded
+    on the returned recorder, never raised.  Accesses from within this
+    module's own machinery (the lock wrappers) are not counted.
+    """
+    from tpu_sgd.analysis.core import parse_guard
+
+    recorder = recorder or LocksetRecorder()
+    guards = {attr: parse_guard(spec) for attr, spec in lock_map.items()}
+    for lock_name in {ln for ln, _ in guards.values()}:
+        inner = getattr(obj, lock_name)
+        if not isinstance(inner, InstrumentedLock):
+            object.__setattr__(
+                obj, lock_name,
+                InstrumentedLock(inner, name=lock_name, recorder=recorder))
+        else:
+            inner.recorder = recorder
+
+    base = type(obj)
+
+    def _check(self, attr: str, op: str) -> None:
+        lock_name, mode = guards[attr]
+        if mode == "w" and op == "read":
+            return
+        lock = object.__getattribute__(self, lock_name)
+        recorder.count_checked()
+        if isinstance(lock, InstrumentedLock) and \
+                lock.held_by_current_thread():
+            return
+        frame = sys._getframe(2)
+        recorder.record(LockViolation(
+            base.__name__, attr, op,
+            threading.current_thread().name,
+            frame.f_code.co_name, frame.f_lineno))
+
+    class _Checked(base):  # type: ignore[misc, valid-type]
+        def __getattribute__(self, name):
+            if name in guards:
+                _check(self, name, "read")
+            return object.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):
+            if name in guards:
+                _check(self, name, "write")
+            object.__setattr__(self, name, value)
+
+        def __delattr__(self, name):
+            if name in guards:
+                _check(self, name, "write")
+            object.__delattr__(self, name)
+
+    _Checked.__name__ = base.__name__ + "LockChecked"
+    _Checked.__qualname__ = _Checked.__name__
+    obj.__class__ = _Checked
+    return recorder

@@ -1,0 +1,213 @@
+"""Traced-context resolution: which functions does JAX trace?
+
+``jnp.pad`` inside a jitted function is a fused op; the same call on a
+host path is its own per-shape XLA program (~100-200ms per new shape —
+the trap ``tpu_sgd/ops/bucketed.py`` documents).  Telling the two apart
+statically means deciding, per function, "does this body run under a
+tracer?".  We approximate with three module-local signals, closed
+transitively:
+
+1. **decorators** — ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+   ``@jax.jit(static_argnums=...)`` and friends mark the def traced;
+2. **wrap sites** — a function (or lambda) passed by name anywhere in
+   the module to ``jax.jit`` / ``vmap`` / ``grad`` / ``lax.scan`` /
+   ``shard_map`` / this repo's ``shard_map_fn`` / ... is traced;
+3. **closure** — defs nested inside a traced def, and defs *called*
+   from a traced def body (module-local call graph, iterated to
+   fixpoint), are traced.
+
+The closure errs on the side of "traced" (e.g. every def sharing a name
+is marked), so shape-trap stays quiet rather than crying wolf; genuinely
+cross-module traced helpers that it cannot see get an inline
+suppression with a reason — which is the documentation they needed
+anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+#: last path segment of a callable that TRACES its function argument(s)
+TRACE_ENTRY = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "scan", "fori_loop", "while_loop", "cond",
+    "switch", "associative_scan", "remat", "checkpoint", "custom_jvp",
+    "custom_vjp", "defjvp", "defvjp", "named_call", "shard_map",
+    "shard_map_fn", "xmap", "linearize", "vjp", "jvp", "make_jaxpr",
+    # lax.map traces its body like scan; the builtin map() collides, but
+    # over-marking errs toward silence — the right direction for lint
+    "map",
+}
+
+#: constructors whose RESULT is a fresh jit-compiled callable — building
+#: one per loop iteration is the eager-in-loop recompile trap
+JIT_CONSTRUCTORS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "shard_map", "shard_map_fn",
+}
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.numpy.pad`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_seg(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+              kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _is_partial_of_tracer(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jit, ...)``."""
+    if last_seg(dotted_name(call.func)) != "partial" or not call.args:
+        return False
+    return last_seg(dotted_name(call.args[0])) in TRACE_ENTRY
+
+
+def _is_tracer_callable(node: ast.AST) -> bool:
+    """Is ``node`` (a decorator or a call's func) jit-ish?
+
+    Covers the bare name (``jax.jit``), the configured factory call
+    (``jax.jit(static_argnums=...)`` as a decorator), and the partial
+    form (``partial(jax.jit, donate_argnums=...)``).
+    """
+    if last_seg(dotted_name(node)) in TRACE_ENTRY:
+        return True
+    if isinstance(node, ast.Call):
+        if _is_partial_of_tracer(node):
+            return True
+        return last_seg(dotted_name(node.func)) in TRACE_ENTRY
+    return False
+
+
+class TracedIndex:
+    """Per-module index answering :meth:`is_traced` for any node."""
+
+    def __init__(self, tree: ast.Module,
+                 parents: Optional[Dict[ast.AST, ast.AST]] = None):
+        self.tree = tree
+        self.parents = parents if parents is not None else \
+            build_parents(tree)
+        self._defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(node.name, []).append(node)
+        self._traced: Set[ast.AST] = set()
+        self._seed()
+        self._close()
+
+    # -- seeding -----------------------------------------------------------
+    def _seed(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_tracer_callable(d) for d in node.decorator_list):
+                    self._traced.add(node)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                traced_wrap = _is_tracer_callable(fn) or (
+                    # partial(jax.jit, ...)(body): the outer call's func
+                    # is itself the partial call
+                    isinstance(fn, ast.Call) and _is_partial_of_tracer(fn))
+                if not traced_wrap:
+                    continue
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        self._traced.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        for d in self._defs_by_name.get(arg.id, ()):
+                            self._traced.add(d)
+
+    def _close(self) -> None:
+        # (a) defs nested in traced defs are traced; (b) defs called by
+        # name from a traced body are traced — iterate to fixpoint
+        changed = True
+        while changed:
+            changed = False
+            for root in list(self._traced):
+                for node in ast.walk(root):
+                    if node is root:
+                        continue
+                    if isinstance(node,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                        if node not in self._traced:
+                            self._traced.add(node)
+                            changed = True
+                    elif isinstance(node, ast.Call):
+                        callee = last_seg(dotted_name(node.func))
+                        for d in self._defs_by_name.get(callee, ()):
+                            if d not in self._traced:
+                                self._traced.add(d)
+                                changed = True
+
+    # -- queries -----------------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        return enclosing(node, self.parents, FuncNode)
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """True when ``node`` sits (lexically) inside a traced function."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, FuncNode) and cur in self._traced:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+
+def module_prefixes(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Dotted prefixes that refer to jax.numpy / jax.lax in this file.
+
+    ``import jax.numpy as jnp`` -> ``jnp``; ``from jax import numpy``
+    -> ``numpy``; plain ``import jax`` -> ``jax.numpy`` (the dotted
+    spelling).  Callers match a call's dotted name against
+    ``prefix + "." + op``.
+    """
+    out: Dict[str, Set[str]] = {"jnp": set(), "lax": set()}
+    target = {"jax.numpy": "jnp", "jax.lax": "lax"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                key = target.get(a.name)
+                if key:
+                    # `import jax.numpy as jnp` binds jnp; bare
+                    # `import jax.numpy` binds jax -> dotted prefix
+                    out[key].add(a.asname or a.name)
+                if a.name == "jax":
+                    alias = a.asname or "jax"
+                    out["jnp"].add(alias + ".numpy")
+                    out["lax"].add(alias + ".lax")
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                full = f"{node.module}.{a.name}" if node.module else a.name
+                key = target.get(full)
+                if key:
+                    out[key].add(a.asname or a.name)
+    return out

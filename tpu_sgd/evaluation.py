@@ -153,13 +153,16 @@ class BinaryClassificationMetrics:
         one = jnp.ones((1,), jnp.float32)
         self.area_under_roc = float(
             _trapezoid(
+                # graftlint: disable=shape-trap -- one-shot metrics construction: one compile per dataset size, not a hot path
                 jnp.concatenate([zero, fpr]), jnp.concatenate([zero, tpr])
             )
         )
         # The reference anchors PR at (0, precision of the top group).
         self.area_under_pr = float(
             _trapezoid(
+                # graftlint: disable=shape-trap -- one-shot metrics construction: one compile per dataset size, not a hot path
                 jnp.concatenate([zero, tpr]),
+                # graftlint: disable=shape-trap -- one-shot metrics construction: one compile per dataset size, not a hot path
                 jnp.concatenate([prec[:1], prec]),
             )
         )

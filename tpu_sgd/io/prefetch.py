@@ -43,6 +43,15 @@ from typing import Callable, Iterable, TypeVar
 
 from tpu_sgd.reliability.failpoints import failpoint
 
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose, and load-bearing as documentation.  The prefetcher owns no
+#: lock because all mutable state (_pending, _items, _exhausted, _pool)
+#: is touched ONLY from the consumer thread; the worker thread receives
+#: work exclusively through executor submission and communicates back
+#: exclusively through Futures.  Adding shared state to this module
+#: means adding a lock AND declaring it here.
+GRAFTLINT_LOCKS: dict = {}
+
 T = TypeVar("T")
 R = TypeVar("R")
 

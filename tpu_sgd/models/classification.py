@@ -255,6 +255,7 @@ class MultinomialLogisticRegressionModel(GeneralizedLinearModel):
                 if sparse:
                     Xb = append_bias_auto(Xb)
                 else:  # traced dense: append the bias column in-trace
+                    # graftlint: disable=shape-trap -- tracer-only branch (guarded above): fuses into the user's jit, no eager compile
                     Xb = jnp.concatenate(
                         [Xb, jnp.ones((Xb.shape[0], 1), Xb.dtype)], axis=1
                     )

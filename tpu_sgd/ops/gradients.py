@@ -433,6 +433,7 @@ class MultinomialLogisticGradient:
             margins = margins.reshape(n, Tc, K - 1)
             if count is None:
                 count = jnp.asarray(n, margins.dtype)
+            # graftlint: disable=shape-trap -- traced by callers: lbfgs/streamed_costfun jit the sweep, the chunk loop unrolls at trace time
             logits = jnp.concatenate(
                 [jnp.zeros((n, Tc, 1), margins.dtype), margins], axis=-1
             )  # (n, Tc, K) with pivot logit 0
@@ -445,6 +446,7 @@ class MultinomialLogisticGradient:
             if mvec is not None:
                 losses = losses * mvec.astype(losses.dtype)[:, None]
             sums.append(jnp.sum(losses, axis=0))
+        # graftlint: disable=shape-trap -- traced by callers (see sweep note above); eager use is once per ladder config
         return jnp.concatenate(sums), count
 
     # Same window contract as the vector-weight gradients (duck-typed: only
@@ -463,6 +465,7 @@ def pivot_class_traced(margins: Array) -> Array:
     traced home of the rule — the serving kernels and ``predict_class``
     both call it, so a pivot/tie-breaking change can never diverge
     serving from training-side prediction."""
+    # graftlint: disable=shape-trap -- traced by callers, as the name says: the serving kernels and predict_class jit this rule
     logits = jnp.concatenate(
         [jnp.zeros((margins.shape[0], 1), margins.dtype), margins], axis=-1
     )

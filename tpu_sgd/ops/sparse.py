@@ -58,10 +58,23 @@ def row_matrix_bcoo(x):
 
     if x.ndim != 1:
         return x
+    import jax.core
+
     nse = x.data.shape[0]
-    idx = jnp.concatenate(
-        [jnp.zeros((nse, 1), x.indices.dtype), x.indices], axis=1
-    )
+    if isinstance(x.indices, jax.core.Tracer):
+        # traced caller (user jit/vmap around predict): stay in-trace —
+        # the concatenate fuses into the surrounding program
+        idx = jnp.concatenate(  # graftlint: disable=shape-trap -- tracer-only branch: fuses into the caller's program, no eager compile
+            [jnp.zeros((nse, 1), x.indices.dtype), x.indices], axis=1
+        )
+    else:
+        # concrete vector (the serving single-request path): build the
+        # row index host-side — an eager jnp.concatenate here compiled
+        # one XLA program PER DISTINCT nse, a ~100ms stall per novel
+        # request sparsity (found by graftlint's shape-trap rule)
+        ih = np.asarray(x.indices)
+        idx = jnp.asarray(np.concatenate(
+            [np.zeros((int(nse), 1), ih.dtype), ih], axis=1))
     return BCOO((x.data, idx), shape=(1, x.shape[0]))
 
 
@@ -189,7 +202,9 @@ def append_bias_bcoo(X):
         axis=1,
     )
     return BCOO(
+        # graftlint: disable=shape-trap -- once-per-dataset training assembly (serving folds the bias in-kernel); also reachable traced
         (jnp.concatenate([X.data, ones]),
+         # graftlint: disable=shape-trap -- once-per-dataset training assembly (serving folds the bias in-kernel); also reachable traced
          jnp.concatenate([X.indices, bias_idx], axis=0)),
         shape=(n, d + 1),
     )

@@ -31,20 +31,12 @@ Cost when disabled — the only state a production process ever runs in —
 is one module-global load and a falsy branch per hit (measured in
 ``tests/test_reliability.py``); no dict lookup, no lock, no allocation.
 
-Hook sites wired in this codebase (the chaos soak exercises all of
-them; see ``scripts/chaos_soak.py``):
-
-===============================  ============================================
-name                             site
-===============================  ============================================
-``io.prefetch.produce``          Prefetcher worker, before each producer call
-``io.device_put``                host→device transfer in the streamed-SGD feed
-``optimize.streamed.step``       top of each host-streamed SGD iteration
-``checkpoint.save``              CheckpointManager.save, before the tmp write
-``checkpoint.load``              CheckpointManager._load (restore / reload)
-``serve.registry.reload``        ModelRegistry.maybe_reload, per load attempt
-``serve.batcher.enqueue``        MicroBatcher.submit, before queueing
-===============================  ============================================
+Hook sites wired in this codebase are declared in :data:`HOOK_SITES`
+below — the authoritative site -> module table.  The chaos soak
+(``scripts/chaos_soak.py``) exercises every entry, and graftlint's
+``failpoint-coverage`` rule (``tpu_sgd/analysis``) statically verifies
+each declared module still compiles its hook in, so deleting a
+``failpoint(...)`` call fails lint, not a chaos run.
 """
 
 from __future__ import annotations
@@ -140,7 +132,24 @@ def inject_latency(ms: float, *, nth: int = 0, prob: float = 0.0,
                          latency_s=ms / 1e3, exc=None)
 
 
-# -- registry ---------------------------------------------------------------
+# -- hook-site registry -----------------------------------------------------
+
+#: every compiled-in hook site and the module that must contain its
+#: ``failpoint("<name>")`` call.  graftlint's failpoint-coverage rule
+#: checks this table against the AST in both directions (a declared
+#: site missing from its module, and an un-declared failpoint() call,
+#: both fail lint); the chaos soak iterates it to inject at every site.
+HOOK_SITES = {
+    "io.prefetch.produce": "tpu_sgd/io/prefetch.py",
+    "io.device_put": "tpu_sgd/optimize/streamed.py",
+    "optimize.streamed.step": "tpu_sgd/optimize/streamed.py",
+    "checkpoint.save": "tpu_sgd/utils/checkpoint.py",
+    "checkpoint.load": "tpu_sgd/utils/checkpoint.py",
+    "serve.registry.reload": "tpu_sgd/serve/registry.py",
+    "serve.batcher.enqueue": "tpu_sgd/serve/batcher.py",
+}
+
+# -- arming registry --------------------------------------------------------
 
 #: fast-path gate: ``failpoint()`` reads this ONE module global and
 #: returns when falsy — the entire disabled-mode cost.

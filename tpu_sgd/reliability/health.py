@@ -27,6 +27,22 @@ from typing import Callable, Dict, Optional
 
 from tpu_sgd.utils.events import ReliabilityEvent
 
+#: graftlint lock-discipline declaration (tpu_sgd/analysis).  Heartbeat
+#: state is written by worker threads and read by the monitor; the
+#: probe registries are mutated by user threads while the monitor
+#: thread snapshots them.  ``count`` is ``:w``: the read side tolerates
+#: a stale int (it rides into an event detail string), writes serialize.
+GRAFTLINT_LOCKS = {
+    "Heartbeat": {
+        "_last": "_lock",
+        "count": "_lock:w",
+    },
+    "HealthMonitor": {
+        "_heartbeats": "_lock",
+        "_queues": "_lock",
+    },
+}
+
 
 class Heartbeat:
     """A monotonic last-alive marker a worker ticks per unit of work.

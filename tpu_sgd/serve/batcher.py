@@ -29,6 +29,20 @@ from tpu_sgd.reliability.health import Heartbeat
 from tpu_sgd.serve.engine import stack_rows
 
 
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): the request
+#: queue and the stop flag are shared between client threads (submit),
+#: the flush thread (_collect/_flush), and the lifecycle caller (stop) —
+#: every touch must hold the condition's lock.  Validated statically by
+#: the lock-discipline rule and dynamically (InstrumentedLock) in
+#: tests/test_analysis.py.
+GRAFTLINT_LOCKS = {
+    "MicroBatcher": {
+        "_pending": "_cond",
+        "_stopped": "_cond",
+    },
+}
+
+
 class BackpressureError(RuntimeError):
     """The serving queue is full; the request was rejected, not queued."""
 
@@ -111,13 +125,20 @@ class MicroBatcher:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._pending)
+        # racy by design: an ops-probe sample of a deque whose len() is
+        # itself atomic under the GIL — taking the lock here would make
+        # every healthz scrape contend with the flush thread
+        return len(self._pending)  # graftlint: disable=lock-discipline -- atomic snapshot for ops probes; deque len is GIL-atomic
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         if self._thread is not None:
             return self
-        self._stopped = False
+        with self._cond:
+            # under the lock: a submit() racing this restart must see
+            # either the stopped batcher or the restarted one, never a
+            # torn flag (found by graftlint's lock-discipline rule)
+            self._stopped = False
         self._thread = threading.Thread(
             target=self._run, name="tpu-sgd-serve-batcher", daemon=True
         )
@@ -224,6 +245,7 @@ class MicroBatcher:
         if self.metrics is not None:
             try:
                 self.metrics.record_batch(
+                    # graftlint: disable=lock-discipline -- metrics sample only; GIL-atomic len, a stale depth is fine
                     queue_depth=len(self._pending),
                     batch_size=len(batch),
                     padded_size=self.padded_size_fn(len(batch)),

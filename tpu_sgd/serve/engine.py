@@ -62,16 +62,22 @@ def stack_rows(rows):
                 raise ValueError(
                     "mixed or mis-shaped sparse rows in one batch"
                 )
-            nse = x.data.shape[0]
-            row_ids = jnp.full((nse, 1), r, dtype=jnp.int32)
-            idxs.append(
-                jnp.concatenate(
-                    [row_ids, x.indices.astype(jnp.int32)], axis=1
-                )
-            )
-            datas.append(x.data)
+            # host-side assembly on purpose: requests are concrete, and
+            # an eager jnp.full/concatenate here would compile one XLA
+            # program per (nse, batch composition) — a compile stall per
+            # novel coalesced batch on the serving hot path (found by
+            # graftlint's shape-trap rule)
+            nse = int(x.data.shape[0])
+            idx = np.empty((nse, 2), np.int32)
+            idx[:, 0] = r
+            # explicit (nse, 1), not (nse, -1): -1 is ambiguous for an
+            # all-zero row's size-0 index array and would crash the batch
+            idx[:, 1:] = np.asarray(x.indices, np.int32).reshape(nse, 1)
+            idxs.append(idx)
+            datas.append(np.asarray(x.data))
         return BCOO(
-            (jnp.concatenate(datas), jnp.concatenate(idxs)),
+            (jnp.asarray(np.concatenate(datas)),
+             jnp.asarray(np.concatenate(idxs))),
             shape=(len(rows), int(d)),
         )
     arrs = [np.asarray(x) for x in rows]

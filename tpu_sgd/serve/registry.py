@@ -29,6 +29,23 @@ from tpu_sgd.utils.checkpoint import CheckpointManager
 
 logger = logging.getLogger("tpu_sgd.serve.registry")
 
+#: graftlint lock-discipline declaration (tpu_sgd/analysis).  The
+#: serving model is an atomic-reference swap: prediction threads READ
+#: ``_model``/``_version`` lock-free (old model or new, never torn — the
+#: documented design), so those are ``:w`` — only mutations serialize.
+#: ``bad_versions`` is a plain dict mutated during reload walks; copying
+#: or iterating it concurrently with an insert can raise, so both sides
+#: hold the lock.
+GRAFTLINT_LOCKS = {
+    "ModelRegistry": {
+        "_model": "_lock:w",
+        "_version": "_lock:w",
+        "_previous_version": "_lock:w",
+        "_pinned": "_lock:w",
+        "bad_versions": "_lock",
+    },
+}
+
 
 class NoModelError(RuntimeError):
     """No loadable checkpoint exists yet in the registry's directory."""
@@ -109,7 +126,11 @@ class ModelRegistry:
 
     def unpin(self):
         """Re-enable auto-reload (the next ``maybe_reload`` catches up)."""
-        self._pinned = False
+        with self._lock:
+            # under the lock like pin(): an unpin racing a maybe_reload
+            # must order against the pinned-check inside the reload's
+            # critical section (found by graftlint's lock-discipline rule)
+            self._pinned = False
         return self
 
     @property
@@ -189,11 +210,17 @@ class ModelRegistry:
         """Ops-probe snapshot: what is serving, is it pinned, what has
         been rejected, and the breaker state (``Server.healthz`` wraps
         this with the queue-side numbers)."""
+        with self._lock:
+            # the dict() copy of bad_versions iterates it — concurrent
+            # with a reload-walk insert that raises RuntimeError, so the
+            # snapshot takes the lock (found by graftlint's
+            # lock-discipline rule); the scalar reads ride along free
+            bad = dict(self.bad_versions)
         return {
             "current_version": self._version,
             "previous_version": self._previous_version,
             "pinned": self._pinned,
-            "bad_versions": dict(self.bad_versions),
+            "bad_versions": bad,
             "reload_count": self.reload_count,
             "breaker": (None if self.breaker is None
                         else self.breaker.snapshot()),
@@ -234,8 +261,11 @@ class ModelRegistry:
         """Caller holds ``self._lock`` and is responsible for emitting the
         'reloaded' event AFTER releasing it (re-entrant listeners)."""
         if self._version is not None and version != self._version:
+            # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
             self._previous_version = self._version
+        # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
         self._model = model  # atomic reference swap: readers see old or new
+        # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
         self._version = version
         self.reload_count += 1
         logger.info("serving model hot-swapped to version %d", version)

@@ -25,6 +25,17 @@ from dataclasses import asdict, dataclass
 from typing import List, Optional
 
 
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): the JSONL
+#: file handle is shared by the serving flush thread, user threads, and
+#: close() — every write/flush/close must hold the write lock so lines
+#: stay whole and close never races a writer.
+GRAFTLINT_LOCKS = {
+    "JsonLinesEventLog": {
+        "_f": "_write_lock",
+    },
+}
+
+
 @dataclass
 class IterationEvent:
     """One optimizer iteration (the analogue of a Spark job for one
