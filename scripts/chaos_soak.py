@@ -55,11 +55,15 @@ def _make_data(seed: int, n: int = 768, d: int = 12):
 def _make_opt(iters: int, sampling: str, retry=None):
     from tpu_sgd.optimize.gradient_descent import GradientDescent
 
+    # superstep=4 runs the FUSED executor under fire: crash-resume
+    # restarts land mid-grid (checkpoint cadence 5, K=4), so superstep
+    # regrouping after a resume is soaked too — the per-iteration math
+    # is grouping-independent, so the bitwise invariant must still hold
     opt = (GradientDescent()
            .set_num_iterations(iters).set_step_size(0.1)
            .set_mini_batch_fraction(0.5).set_sampling(sampling)
            .set_convergence_tol(0.0).set_seed(7)
-           .set_host_streaming(True))
+           .set_host_streaming(True).set_superstep(4))
     if retry is not None:
         opt.set_ingest_options(retry=retry)
     return opt
@@ -127,6 +131,10 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
             # straggler simulation on the feed worker (latency only)
             "io.prefetch.produce": inject_latency(2.0, prob=0.2,
                                                  seed=seed + 3),
+            # superchunk-assembly faults: healed by the same ingest
+            # retry (the sample is deterministic in (seed, i), so a
+            # re-assembled superchunk is identical)
+            "io.superstep": fail_prob(0.05, seed=seed + 6),
             # a save fault crashes the run BEFORE any byte is written
             "checkpoint.save": fail_prob(0.04, seed=seed + 4),
             # a load fault during resume: restore() quarantines and
@@ -175,7 +183,11 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
         kill_dir = os.path.join(work, "ckpt_kill")
         opt_kill = _make_opt(iters, "sliced")
         opt_kill.set_checkpoint(CheckpointManager(kill_dir), every=5)
-        crash_at = max(2, iters // 2)
+        # the iteration-body site fires once per DISPATCH — one per
+        # superstep under fusion — so aim the one-shot kill at the
+        # mid-run dispatch, which lands the resume mid-grid (cadence 5,
+        # K=4: superstep regrouping under test)
+        crash_at = max(2, (iters // opt_kill.superstep) // 2)
         with inject_faults(
                 {"optimize.streamed.step": fail_nth(crash_at)}):
             try:
@@ -186,7 +198,7 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
         w_res, h_res = opt_kill.optimize_with_history((X, y), w0)
         np.testing.assert_array_equal(np.asarray(w_res), w_ref)
         np.testing.assert_array_equal(h_res, h_ref)
-        say(f"kill at iteration {crash_at} + bare resume: bitwise equal")
+        say(f"kill at dispatch {crash_at} + bare resume: bitwise equal")
 
         # torn-write corruption (deterministic, not seed-dependent):
         # truncate the newest TWO checkpoints mid-file and require the

@@ -262,6 +262,24 @@ def main() -> int:
         f"{np.abs(w_c - w_a).max():.2e}, product "
         f"{np.abs(w_d - w_a).max():.2e})")
 
+    # THE follow-up gate (ISSUE 5 satellite): the chunked driver may
+    # only take the planner default if it BEATS the per-iteration
+    # contract AND reproduces its trajectory — a fast-but-divergent
+    # variant is not a candidate.  The verdict is recorded either way
+    # so the JSON closes its own follow-up.
+    product_wins = bool(agree_d and slope_d < slope_a)
+    verdict = (
+        "product_chunked WINS with weights_agree — flip the planner "
+        "default to chunk_iters (optimize/gram_driver.py)"
+        if product_wins else
+        f"product_chunked LOSES ({slope_d * 1e3:.3f} vs "
+        f"{slope_a * 1e3:.4f} ms/iter"
+        + ("" if agree_d else "; trajectories DIVERGE")
+        + ") — planner default stays the per-iteration driver; "
+        "chunk_iters remains opt-in"
+    )
+    log(f"verdict: {verdict}")
+
     record = {
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "platform": platform,
@@ -269,8 +287,8 @@ def main() -> int:
             "decomposition of the aligned-gram iteration; "
             "product_chunked is the SHIPPED chunked driver "
             "(set_gram_options(chunk_iters=K), optimize/gram_driver.py) "
-            "— if it beats full_contract with weights_agree, flipping "
-            "the planner default is the follow-up"
+            "— the weights_agree-gated comparison against full_contract "
+            "decides the planner default (see verdict)"
         ),
         "workload": {"rows": ROWS, "dim": DIM, "block_rows": BLOCK,
                      "frac": FRAC, "k_chunk": K_CHUNK},
@@ -285,6 +303,8 @@ def main() -> int:
         "bookkeeping_ms": (slope_a - slope_b) * 1e3,
         "weights_agree": {"bare": agree_b, "chunked": agree_c,
                           "product": agree_d},
+        "product_chunked_wins": product_wins,
+        "verdict": verdict,
     }
     if platform == "cpu":
         log("CPU fallback: not persisting")

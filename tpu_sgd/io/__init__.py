@@ -30,11 +30,19 @@ builders, ``parallel/gram_parallel.py`` meshed builders,
   SparCML shrink-bytes-on-the-wire move, arXiv:1802.08021, applied to
   the host→HBM hop).
 
+The superstep executor (``GradientDescent.set_superstep``; README
+"Fused stepping") composes with all three: ``stack_superchunk``
+(:mod:`tpu_sgd.io.chunking`) bundles K per-iteration batches into one
+fixed-shape *superchunk* on the prefetch worker, so both the transfer
+count AND the program-dispatch count drop K-fold — the AdaBatch
+aggregation lever (arXiv:1711.01761) applied to the dispatch tax.
+
 See README "Ingestion pipeline" for when the bf16 wire is safe and how
 ``batch_rows`` interacts with the double buffer's 2× staging footprint.
 """
 
-from tpu_sgd.io.chunking import Chunk, ChunkPlan, pad_rows, plan_chunks
+from tpu_sgd.io.chunking import (Chunk, ChunkPlan, pad_rows, plan_chunks,
+                                 stack_superchunk)
 from tpu_sgd.io.prefetch import Prefetcher
 from tpu_sgd.io.wire import resolve_wire_dtype, wire_cast
 
@@ -49,5 +57,6 @@ __all__ = [
     "pad_rows",
     "plan_chunks",
     "resolve_wire_dtype",
+    "stack_superchunk",
     "wire_cast",
 ]

@@ -20,9 +20,11 @@ build is bitwise equal to the legacy sync build (asserted in
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence
 
 import numpy as np
+
+from tpu_sgd.reliability.failpoints import failpoint
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +114,47 @@ def plan_chunks(n: int, chunk_rows: int, *, offset: int = 0,
     chunk_rows = min(chunk_rows, max(span_rounded, round_to))
     return ChunkPlan(n=n, offset=offset, chunk_rows=chunk_rows,
                      round_to=round_to)
+
+
+def stack_superchunk(xs: Sequence[np.ndarray], ys: Sequence[np.ndarray],
+                     valids: Sequence[np.ndarray], k: Optional[int] = None):
+    """Stack per-step host batches into ONE ``(K, ...)`` *superchunk*.
+
+    The superstep executor's host stage (README "Fused stepping"): K
+    consecutive iterations' cap-shaped batches become one contiguous
+    buffer per leaf, so the host→device hop is ONE ``device_put`` per
+    superstep instead of one per iteration.  All work is host numpy —
+    one memcpy per batch, never an eager device op (the shape-trap
+    rule) — and the output shape is FIXED at ``k`` steps: when fewer
+    than ``k`` batches are passed (the tail superstep of a run whose
+    iteration count ``k`` does not divide), the missing steps stay zero
+    rows with all-False valid masks, which the fused step's empty-batch
+    rule turns into no-op updates.  One shape → the fused scan program
+    compiles exactly once per build.
+
+    Passes the ``io.superstep`` failpoint (fault-injection site for the
+    chaos/reliability tests); assembly runs on the prefetch worker
+    inside the retry scope, so an armed fault here heals through the
+    feed's ``RetryPolicy`` like any other producer fault.
+
+    Returns ``(Xs, Ys, Vs)`` with shapes ``(k,) + batch.shape``.
+    """
+    failpoint("io.superstep")
+    if not xs or len(xs) != len(ys) or len(xs) != len(valids):
+        raise ValueError(
+            f"need matching non-empty batch lists, got "
+            f"{len(xs)}/{len(ys)}/{len(valids)}")
+    k = len(xs) if k is None else int(k)
+    if k < len(xs):
+        raise ValueError(f"{len(xs)} batches do not fit k={k} steps")
+    Xs = np.zeros((k,) + xs[0].shape, xs[0].dtype)
+    Ys = np.zeros((k,) + ys[0].shape, ys[0].dtype)
+    Vs = np.zeros((k,) + valids[0].shape, bool)
+    for t, (Xb, yb, vb) in enumerate(zip(xs, ys, valids)):
+        Xs[t] = Xb
+        Ys[t] = yb
+        Vs[t] = vb
+    return Xs, Ys, Vs
 
 
 def pad_rows(a: np.ndarray, rows: int,

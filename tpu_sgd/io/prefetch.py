@@ -1,8 +1,11 @@
 """Bounded-lookahead background producer (the double buffer).
 
 One worker thread runs ``producer(item)`` — host-side chunk assembly
-(slice / gather / pad / wire cast) plus the ``device_put`` dispatch —
-while the consumer thread runs the current chunk's device kernel.  The
+(slice / gather / pad / wire cast; for the superstep executor the item
+is a BASE iteration and the producer assembles the whole K-batch
+superchunk, ``tpu_sgd.io.stack_superchunk``) plus the ``device_put``
+dispatch — while the consumer thread runs the current chunk's device
+kernel.  The
 worker holds no JAX state of its own: ``device_put`` and jit dispatch
 are thread-safe, and numpy releases the GIL for the bulk copies, so the
 two genuinely overlap (measured on this repo's serving threads and in

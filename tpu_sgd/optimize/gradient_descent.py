@@ -251,6 +251,178 @@ def make_run(
     return run
 
 
+def make_superstep(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    axis_name: Optional[str] = None,
+    model_axis_name: Optional[str] = None,
+):
+    """Fuse K consecutive SGD iterations over PER-STEP batches into ONE
+    compiled program (``lax.scan`` over the superchunk's leading axis).
+
+    ``superstep(weights, reg_val, i0, Xs, ys, valids) ->
+    (carry_weights, ys_out)``: ``Xs``/``ys``/``valids`` stack K
+    per-iteration batches on axis 0 — the host-assembled *superchunk*
+    (``tpu_sgd.io.stack_superchunk``) that replaces K ``device_put`` +
+    dispatch round-trips with one of each.  The scan body is EXACTLY
+    ``make_step``: iteration ``i0 + t`` consumes batch ``t`` with the
+    same per-step math and the same deterministic sample sequence as
+    the per-iteration loop.  ``ys_out`` is the per-step ``(weights,
+    loss, reg_val, count, delta_norm, weight_norm)`` history:
+    everything the host loop used to read back one iteration at a time
+    (loss history, convergence norms, checkpoint state) now arrives as
+    one stacked fetch.
+
+    Trajectory contract (measured, tests/test_superstep.py): everything
+    SAME-PROGRAM is bitwise — a fused run replayed, resumed from a
+    checkpoint, or fed through a different prefetch depth reproduces
+    its weights exactly.  Against the per-iteration loop the math is
+    identical but XLA lowers the batch dot through a different emitter
+    inside a scanned program than as a standalone dispatch (measured 1
+    ulp/step on the CPU harness — even a scan over a ``(1, m, d)``
+    superchunk differs from the unscanned program), so fused-vs-legacy
+    trajectories agree to reassociation noise, with the loss-history
+    LENGTH, sampled sequence, and detected convergence iteration
+    exactly equal — the same cross-program caveat
+    ``optimize/streamed.py`` documents for the partial-residency
+    ``resident_step``.
+
+    The device program never branches on convergence or run length: a
+    tail superstep (K ∤ remaining iterations) rides all-False
+    ``valids`` rows, which ``make_step``'s empty-batch rule turns into
+    no-op updates, and the host truncates overshoot from the ys
+    (:func:`_replay_fused_steps`).  One shape -> exactly one fused-body
+    program per build (``assert_compile_count``-guarded in
+    tests/test_superstep.py).
+    """
+    step = make_step(gradient, updater, config, axis_name, model_axis_name)
+
+    def superstep(weights, reg_val, i0, Xs, ys, valids):
+        idx = i0 + jnp.arange(Xs.shape[0], dtype=jnp.int32)
+
+        def body(carry, xs):
+            w, rv = carry
+            i, Xb, yb, vb = xs
+            new_w, loss_i, new_rv, c = step(w, Xb, yb, i, rv, vb)
+            # per-step norms ride the ys so the host-side convergence
+            # check stays EXACTLY the legacy per-iteration rule
+            dn = jnp.linalg.norm(new_w - w)
+            wn = jnp.linalg.norm(new_w)
+            return (new_w, new_rv), (new_w, loss_i, new_rv, c, dn, wn)
+
+        (w, _), out = jax.lax.scan(body, (weights, reg_val),
+                                   (idx, Xs, ys, valids))
+        return w, out
+
+    return superstep
+
+
+def make_shared_batch_superstep(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    k: int,
+    axis_name: Optional[str] = None,
+    model_axis_name: Optional[str] = None,
+):
+    """The shared-batch variant of :func:`make_superstep`: K fused
+    iterations over ONE ``(X, y)`` — the resident/stepwise driver
+    (per-iteration sampling happens inside ``make_step``, on device)
+    and the streamed full-batch feed (every iteration's "sample" IS the
+    whole transferred batch, so it moves once and the scan reuses it).
+
+    Same return contract and the same one-program guarantee as
+    :func:`make_superstep`.  Steps past ``num_iterations`` in a tail
+    superstep run real updates here (there is no per-step valids row to
+    blank them); the caller discards the carry and takes the true last
+    iteration's weights from the ys — ≤ K-1 wasted updates once per
+    run.
+    """
+    step = make_step(gradient, updater, config, axis_name, model_axis_name)
+    K = int(k)
+
+    def superstep(weights, reg_val, i0, X, y, valid=None):
+        idx = i0 + jnp.arange(K, dtype=jnp.int32)
+
+        def body(carry, i):
+            w, rv = carry
+            new_w, loss_i, new_rv, c = step(w, X, y, i, rv, valid)
+            dn = jnp.linalg.norm(new_w - w)
+            wn = jnp.linalg.norm(new_w)
+            return (new_w, new_rv), (new_w, loss_i, new_rv, c, dn, wn)
+
+        (w, _), out = jax.lax.scan(body, (weights, reg_val), idx)
+        return w, out
+
+    return superstep
+
+
+def _replay_fused_steps(
+    ys_host, i0, steps, losses, reg_val, cfg, *,
+    listener=None, wall_dt=0.0, check_numerics=False,
+    save_cb=None, save_every=0,
+):
+    """Replay one superstep's scan ys with EXACTLY the per-iteration
+    loop's host bookkeeping — THE one definition of fused-mode
+    loss-history / convergence / checkpoint semantics, shared by the
+    host-streamed and stepwise drivers so they cannot drift.
+
+    ``ys_host`` is the numpy-fetched per-step ``(weights, loss, reg,
+    count, delta_norm, weight_norm)`` stack; ``steps`` bounds the
+    replay to the REAL iterations (a tail superstep's padded no-op
+    steps, and shared-batch overshoot past ``num_iterations``, are
+    never read).  Convergence is detected per STEP from the ys — the
+    true converged iteration, never the superstep boundary — with the
+    identical host float comparison the legacy loops make
+    (``delta < tol * max(||w||, 1)`` from the second update on), and
+    empty sampled batches (``count == 0``) skip the record exactly as
+    before.  ``save_cb(i, w_np, reg_val)`` fires on the legacy cadence
+    (``i % save_every == 0``, on convergence, and at the final
+    iteration) with the EXACT iteration-``i`` state from the ys, so
+    fused checkpoints are indistinguishable from per-iteration ones and
+    resume stays bitwise.
+
+    Returns ``(t_last, reg_val, converged)``; the caller truncates the
+    device program's overshoot by taking ``ys weights[t_last]`` as the
+    final state when the run ends mid-superstep.
+    """
+    import numpy as np
+
+    from tpu_sgd.utils.events import IterationEvent
+
+    ws, ls, rs, cs, dns, wns = ys_host
+    converged = False
+    t_last = 0
+    for t in range(steps):
+        i = i0 + t
+        t_last = t
+        if int(cs[t]) > 0:
+            loss_f = float(ls[t])
+            if check_numerics and not np.isfinite(loss_f):
+                _raise_if_nonfinite([loss_f], first_iteration=i)
+            losses.append(loss_f)
+            reg_val = float(rs[t])
+            if listener is not None:
+                listener.on_iteration(IterationEvent(
+                    iteration=i,
+                    loss=loss_f,
+                    weight_delta_norm=float(dns[t]),
+                    mini_batch_size=int(cs[t]),
+                    wall_time_s=wall_dt,
+                ))
+            if cfg.convergence_tol > 0 and i > 1:
+                converged = float(dns[t]) < cfg.convergence_tol * max(
+                    float(wns[t]), 1.0)
+            if save_cb is not None and (
+                    (save_every and i % save_every == 0)
+                    or converged or i == cfg.num_iterations):
+                save_cb(i, ws[t], reg_val)
+        if converged:
+            break
+    return t_last, reg_val, converged
+
+
 class GradientDescent(Optimizer):
     """Drop-in mini-batch SGD optimizer (``TpuGradientDescent``).
 
@@ -296,6 +468,12 @@ class GradientDescent(Optimizer):
         #: TrainingSupervisor installs it)
         self.ingest_retry_policy = None
         self._stop_signal = None
+        #: fused-step count (set_superstep): K consecutive iterations
+        #: run as ONE compiled lax.scan program on the host-dispatched
+        #: paths (host-streamed + stepwise); 1 = the legacy
+        #: one-dispatch-per-iteration drivers.  The planner picks K for
+        #: host_streamed schedules (plan.choose_superstep)
+        self.superstep = 1
         #: gram-knob fields the USER set via set_gram_options /
         #: set_streamed_stats — the planner preserves these and resets
         #: only plan-owned fields (Plan.apply)
@@ -515,6 +693,41 @@ class GradientDescent(Optimizer):
                                   pipeline=pipeline, retry=retry)
         return self
 
+    def set_superstep(self, k: int):
+        """Fuse ``k`` consecutive SGD iterations into ONE compiled
+        program (``lax.scan`` of the per-iteration step) on the paths
+        that pay a host round-trip per iteration — the host-streamed
+        feed (``set_host_streaming``; the prefetcher assembles a
+        ``k``-batch *superchunk* so ``device_put`` fires once per
+        superstep too) and the observed stepwise driver
+        (listener/checkpoint attached).  Per-step math and the sampled
+        sequence are unchanged: loss history and convergence detection
+        stay per-iteration exact (the scan returns per-step ys),
+        checkpoints land on the same iterations, and every
+        same-program contract is bitwise — fused runs replay, resume,
+        and prefetch-A/B to identical weights.  Versus the ``k=1``
+        legacy loop, trajectories agree to reassociation noise (~1
+        ulp/step: XLA lowers the batch dot differently inside a
+        scanned program — see ``make_superstep``'s trajectory
+        contract).  What changes: dispatch + transfer count drops
+        ~``k``×
+        (BENCH_SUPERSTEP.json), listener events arrive in bursts of
+        ``k`` with averaged per-iteration wall times, and cooperative
+        preemption (``set_stop_signal``) is polled at superstep
+        boundaries — worst-case preemption latency grows to ``k``
+        iterations (see ADVICE.md; keep ``k`` at or below the
+        checkpoint cadence).  ``k=1`` restores the legacy drivers.
+        Single-device only: meshed and partial-residency feeds keep the
+        per-iteration driver (a warning says so).  The fused
+        single-program paths (no listener/checkpoint/streaming) already
+        run zero host dispatches and ignore it."""
+        if int(k) < 1:
+            raise ValueError(f"superstep must be >= 1, got {k}")
+        self.superstep = int(k)
+        self._user_gram_opts = self._user_gram_opts | {"superstep"}
+        self._plan_key = None
+        return self
+
     def set_stop_signal(self, stop_signal):
         """Install a zero-arg callable polled once per iteration on the
         observed (listener/checkpoint) and host-streamed paths: when it
@@ -729,6 +942,7 @@ class GradientDescent(Optimizer):
                                 if self.ingest_pipeline else 0),
                 retry_policy=self.ingest_retry_policy,
                 stop_signal=self._stop_signal,
+                superstep_k=self.superstep,
             )
             self._loss_history = hist
             if self.check_numerics:
@@ -1199,11 +1413,78 @@ class GradientDescent(Optimizer):
         if self.listener is not None:
             self.listener.on_run_start(cfg)
 
+        fused_k = int(self.superstep or 1)
+        if fused_k > 1 and self.mesh is not None:
+            import warnings
+
+            warnings.warn(
+                "set_superstep applies to the single-device stepwise "
+                "driver; the meshed observed path keeps the "
+                "per-iteration stepper",
+                RuntimeWarning, stacklevel=4,
+            )
+            fused_k = 1
+
         w = w0
         t_run = _time.perf_counter()
         converged_early = False
+        if fused_k > 1:
+            # Fused stepwise: K iterations per compiled lax.scan
+            # dispatch, per-step loss/norm/weights returned as scan ys
+            # and replayed host-side with the EXACT legacy bookkeeping
+            # (_replay_fused_steps) — listener events, convergence at
+            # the true iteration, checkpoints on the same cadence with
+            # identical state.  X/y stay resident, so the only
+            # per-superstep host work is the one dispatch.
+            fused = self._superstepper(fused_k)
+
+            def _save(ii, w_np, rv):
+                mgr.save(ii, np.asarray(w_np), rv, np.asarray(losses),
+                         config_key)
+
+            i0 = start_iter
+            while i0 <= cfg.num_iterations and not converged_early:
+                steps = min(fused_k, cfg.num_iterations - i0 + 1)
+                t0 = _time.perf_counter()
+                w_dev, ys = fused(
+                    w, jnp.asarray(reg_val, jnp.float32),
+                    jnp.asarray(i0, jnp.int32), X, y,
+                )
+                ys_host = tuple(np.asarray(a) for a in ys)  # blocks
+                dt = _time.perf_counter() - t0
+                t_last, reg_val, converged_early = _replay_fused_steps(
+                    ys_host, i0, steps, losses, reg_val, cfg,
+                    listener=self.listener, wall_dt=dt / steps,
+                    check_numerics=self.check_numerics,
+                    save_cb=(_save if mgr is not None else None),
+                    save_every=self.checkpoint_every,
+                )
+                if converged_early or steps < fused_k:
+                    # the run ends mid-superstep: truncate the
+                    # program's overshoot — the true last iteration's
+                    # state rides the ys
+                    w = jnp.asarray(ys_host[0][t_last])
+                else:
+                    w = w_dev
+                if (not converged_early and self._stop_signal is not None
+                        and self._stop_signal()):
+                    # cooperative preemption at the superstep BOUNDARY
+                    # (the fused program cannot poll mid-scan):
+                    # checkpoint the exact boundary iteration, then
+                    # unwind — a resume replays from precisely here, so
+                    # interrupted+resumed runs stay bitwise
+                    from tpu_sgd.reliability.supervisor import (
+                        TrainingPreempted,
+                    )
+
+                    boundary = i0 + steps - 1
+                    if mgr is not None:
+                        mgr.save(boundary, np.asarray(w), reg_val,
+                                 np.asarray(losses), config_key)
+                    raise TrainingPreempted(boundary)
+                i0 += steps
         i = start_iter
-        while i <= cfg.num_iterations:
+        while fused_k == 1 and i <= cfg.num_iterations:
             t0 = _time.perf_counter()
             if valid is not None:
                 new_w, loss_i, new_reg, c = step(
@@ -1273,6 +1554,20 @@ class GradientDescent(Optimizer):
 
         self._loss_history = _np.asarray(losses, _np.float32)
         return w, self._loss_history
+
+    def _superstepper(self, k: int):
+        """Memoized jitted fused K-step function for the single-device
+        stepwise driver (``set_superstep``) — built ONCE per (plugin
+        pair, config, K) like ``_stepper``, so every superstep of a run
+        (including the tail) reuses the one compiled scan program."""
+        key = ("superstep", self.gradient, self.updater, self.config,
+               int(k))
+        fn = self._run_cache.get(key)
+        if fn is None:
+            fn = jax.jit(make_shared_batch_superstep(
+                self.gradient, self.updater, self.config, int(k)))
+            self._run_cache[key] = fn
+        return fn
 
     def _stepper(self, with_valid: bool, sparse_shape=None):
         """Memoized jitted single-step function (mesh-aware; pass

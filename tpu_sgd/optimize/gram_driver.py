@@ -29,6 +29,16 @@ it amortizes measured only ~0.0036 ms/iter (14%).  The driver stays
 OPT-IN via ``GradientDescent.set_gram_options(chunk_iters=K)`` — it
 still wins ~1.4–2.6× on CPU hosts — and the planner default remains
 the per-iteration contract (see BASELINE.md, round-5 decision).
+
+FOLLOW-UP CLOSED (PR 5): the weights_agree-gated product_chunked vs
+full_contract comparison the JSON asked for is now computed by
+``scripts/gram_scan_experiment.py`` itself (``product_chunked_wins`` +
+``verdict`` fields) and the recorded verdict keeps the per-iteration
+default.  The dispatch-tax half of the original motivation — the
+~44–65 ms fixed cost plus per-iteration host slop — is attacked from
+the other side by the superstep executor
+(``GradientDescent.set_superstep``; README "Fused stepping"), which
+fuses the HOST-dispatched paths where that tax actually dominates.
 """
 
 from __future__ import annotations

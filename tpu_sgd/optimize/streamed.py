@@ -74,6 +74,7 @@ def optimize_host_streamed(
     prefetch_depth: int = 2,
     retry_policy=None,
     stop_signal=None,
+    superstep_k: int = 1,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -120,6 +121,31 @@ def optimize_host_streamed(
     bitwise-identical final weights (f32 wire).  The iteration body and
     the transfer pass the ``optimize.streamed.step`` /
     ``io.device_put`` failpoints.
+
+    Superstep fusion (``superstep_k=K > 1``; README "Fused stepping"):
+    K consecutive iterations run as ONE compiled ``lax.scan`` program,
+    and the prefetch worker assembles a K-batch *superchunk*
+    (``tpu_sgd.io.stack_superchunk``, the ``io.superstep`` failpoint)
+    so ``device_put`` and program dispatch each fire once per K
+    iterations instead of once per iteration — the per-iteration host
+    dispatch tax drops ~K× (BENCH_SUPERSTEP.json).  Per-step math is
+    the SAME ``make_step`` over the SAME deterministic sample sequence;
+    per-step loss/norm/weights return as scan ys and replay host-side
+    with the legacy bookkeeping, so the loss-history length, the
+    detected convergence iteration, and the checkpoint cadence are
+    exactly the K=1 loop's, and every same-program contract stays
+    bitwise (fused runs replay, RESUME, and prefetch-A/B to identical
+    weights, all three sampling modes).  Versus the K=1 loop the
+    trajectories agree to reassociation noise — XLA lowers the batch
+    dot differently inside the scanned program (~1 ulp/step; the same
+    cross-program caveat as ``resident_step`` above — see
+    ``make_superstep``).  ``stop_signal`` is polled at superstep
+    BOUNDARIES
+    (worst-case preemption latency: K iterations; the boundary
+    iteration is checkpointed exactly).  Full-batch feeds
+    (``mini_batch_fraction >= 1``) transfer the batch ONCE and scan
+    over it.  Single device only — a mesh or ``resident_rows`` keeps
+    the per-iteration driver (warned).
     """
     import time as _time
 
@@ -162,6 +188,17 @@ def optimize_host_streamed(
                 f"window ({m_fixed} rows); no window can ever hit the "
                 "resident prefix — raise it or use plain streaming"
             )
+    K = max(1, int(superstep_k))
+    if K > 1 and (mesh is not None or R):
+        import warnings
+
+        warnings.warn(
+            "superstep fusion applies to the single-device streamed "
+            "feed without partial residency; keeping the per-iteration "
+            "driver",
+            RuntimeWarning, stacklevel=3,
+        )
+        K = 1
     if mesh is None:
         if device is None:
             device = jax.devices()[0]
@@ -261,18 +298,20 @@ def optimize_host_streamed(
             jax.device_put(valid, mask_sharding),
         ))
 
-    def sample(i: int):
-        """Per-iteration host-side sample honoring ``config.sampling`` —
+    def sample_host(i: int):
+        """Per-iteration HOST-side sample honoring ``config.sampling`` —
         bernoulli (RDD.sample parity), indexed (fixed-size gather with
         replacement), or sliced (contiguous window) — deterministic in
-        ``default_rng(seed + i)`` and padded to the fixed cap.  Runs on
-        the prefetch worker: everything here (gather, pad, wire cast,
-        ``device_put`` dispatch) overlaps the previous iteration's device
-        step.
+        ``default_rng(seed + i)`` and padded to the fixed cap.  Pure
+        host assembly (gather, pad, wire cast); the transfer belongs to
+        the caller, so the SAME assembly feeds both the per-iteration
+        feed (one ``_put_batch`` per batch) and the superstep feed (K
+        batches stacked into one superchunk, one put).
 
         Returns a tagged pair: ``("resident", start)`` for an on-device
-        window of the resident prefix, or ``("batch", (Xb, yb, valid))``
-        for a transferred batch — explicit dispatch, no type-sniffing."""
+        window of the resident prefix, or ``("host", (Xb, yb, valid))``
+        with cap-row host arrays — explicit dispatch, no
+        type-sniffing."""
         rng = np.random.default_rng(cfg.seed + i)
         if frac < 1.0 and cfg.sampling == "sliced":
             # Contiguous window: a plain slice (zero-copy view on an f32
@@ -295,7 +334,7 @@ def optimize_host_streamed(
                 yp = np.zeros((cap,), y.dtype)
                 yp[:m_fixed] = yb
                 Xb, yb = Xp, yp
-            return _put_batch(Xb, yb, valid)
+            return ("host", (Xb, yb, valid))
         if frac >= 1.0:
             if _full_batch[0] is None:
                 Xw = wire_cast(X, wd)
@@ -312,8 +351,7 @@ def optimize_host_streamed(
                     valid = np.zeros((cap,), bool)
                     valid[:n] = True
                     _full_batch[0] = (Xp, yp, valid)
-            Xb, yb, valid = _full_batch[0]
-            return _put_batch(Xb, yb, valid)
+            return ("host", _full_batch[0])
         if cfg.sampling == "indexed":
             idx = rng.integers(0, n, size=m_fixed)
         else:  # bernoulli
@@ -327,7 +365,35 @@ def optimize_host_streamed(
         pad[: idx.shape[0]] = idx
         # the gather itself rides the prefetch worker (the i+1 lookahead),
         # so this host pass overlaps iteration i's device step
-        return _put_batch(wire_cast(_gather(X, pad), wd), y[pad], valid)
+        return ("host", (wire_cast(_gather(X, pad), wd), y[pad], valid))
+
+    def sample(i: int):
+        """``sample_host`` plus the transfer — the per-iteration
+        producer the legacy (K=1) prefetch loop consumes."""
+        kind, payload = sample_host(i)
+        if kind == "resident":
+            return (kind, payload)
+        return _put_batch(*payload)
+
+    def sample_super(base: int):
+        """Superstep producer: assemble the K per-iteration batches for
+        iterations ``[base, base+K)`` into ONE ``(K, cap, ...)``
+        superchunk (host numpy; ``tpu_sgd.io.stack_superchunk`` — the
+        ``io.superstep`` failpoint) and transfer it with a single
+        ``device_put`` per leaf.  A tail superstep (fewer than K real
+        iterations left) pads with zero rows and all-False valid masks,
+        which the fused step turns into no-op updates — the fixed (K,
+        cap) shape keeps the scan program compiled exactly once.  Runs
+        on the prefetch worker, inside the retry scope, like every
+        other producer."""
+        from tpu_sgd.io import stack_superchunk
+
+        steps = min(K, cfg.num_iterations - base + 1)
+        parts = [sample_host(base + t)[1] for t in range(steps)]
+        Xs, Ys, Vs = stack_superchunk(
+            [p[0] for p in parts], [p[1] for p in parts],
+            [p[2] for p in parts], k=K)
+        return _put_batch(Xs, Ys, Vs)[1]
 
     if listener is not None:
         listener.on_run_start(cfg)
@@ -352,6 +418,121 @@ def optimize_host_streamed(
             start_iter = state["iteration"] + 1
     t_run = _time.perf_counter()
     converged = False
+    if K > 1:
+        # Superstep executor: ONE compiled lax.scan program advances K
+        # iterations per dispatch; the prefetcher stages whole
+        # superchunks, so device_put ALSO fires once per K iterations.
+        # Per-step (weights, loss, reg, count, norms) return as scan ys
+        # and replay host-side with the legacy loop's exact bookkeeping
+        # (_replay_fused_steps) — same loss history, same convergence
+        # iteration, same checkpoint bytes.
+        from tpu_sgd.optimize.gradient_descent import (
+            _replay_fused_steps,
+            make_shared_batch_superstep,
+            make_superstep,
+        )
+        from tpu_sgd.reliability.supervisor import TrainingPreempted
+
+        shared_full_batch = frac >= 1.0
+        if shared_full_batch:
+            # the full-batch "sample" is identical every iteration:
+            # transfer it ONCE and let the scan reuse it — zero
+            # per-iteration AND zero per-superstep transfer
+            fused = jax.jit(make_shared_batch_superstep(
+                gradient, updater, step_cfg, K))
+        else:
+            fused = jax.jit(make_superstep(gradient, updater, step_cfg))
+
+        def _save(ii, w_np, rv):
+            checkpoint_manager.save(ii, np.asarray(w_np), rv,
+                                    np.asarray(losses), config_key)
+
+        prefetch = None
+        try:
+            if shared_full_batch:
+                if start_iter <= cfg.num_iterations:
+                    # the one-time transfer runs OUTSIDE a prefetcher,
+                    # so the ingest retry must wrap it here — a
+                    # transient device_put fault heals exactly as it
+                    # does on the per-iteration feed
+                    def _transfer():
+                        return sample(start_iter)
+
+                    if retry_policy is not None:
+                        _, (Xd, yd, vd) = retry_policy.call(_transfer)
+                    else:
+                        _, (Xd, yd, vd) = _transfer()
+            else:
+                prefetch = Prefetcher(
+                    sample_super,
+                    range(start_iter, cfg.num_iterations + 1, K),
+                    depth=prefetch_depth, retry_policy=retry_policy)
+                nxt = (next(prefetch)
+                       if start_iter <= cfg.num_iterations else None)
+            i0 = start_iter
+            while i0 <= cfg.num_iterations and not converged:
+                steps = min(K, cfg.num_iterations - i0 + 1)
+                t0 = _time.perf_counter()
+                failpoint("optimize.streamed.step")
+                # Dispatch the fused program FIRST (async), pull the
+                # next superchunk while the device runs the K steps,
+                # and only then block on the ys fetch.
+                if shared_full_batch:
+                    w_dev, ys = fused(
+                        w, jnp.asarray(reg_val, jnp.float32),
+                        jnp.asarray(i0, jnp.int32), Xd, yd, vd)
+                else:
+                    Xs, Ys, Vs = nxt
+                    w_dev, ys = fused(
+                        w, jnp.asarray(reg_val, jnp.float32),
+                        jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
+                    if i0 + K <= cfg.num_iterations:
+                        nxt = next(prefetch)
+                ys_host = tuple(np.asarray(a) for a in ys)
+                dt = _time.perf_counter() - t0
+                t_last, reg_val, converged = _replay_fused_steps(
+                    ys_host, i0, steps, losses, reg_val, cfg,
+                    listener=listener, wall_dt=dt / steps,
+                    save_cb=(_save if checkpoint_manager is not None
+                             else None),
+                    save_every=checkpoint_every,
+                )
+                if converged or steps < K:
+                    # run ends mid-superstep: the true last iteration's
+                    # weights ride the ys (per-batch tails are no-op
+                    # padded, shared-batch tails overshoot — either
+                    # way the carry is not the answer)
+                    w = jax.device_put(jnp.asarray(ys_host[0][t_last]),
+                                       w_sharding)
+                else:
+                    w = w_dev
+                if (not converged and stop_signal is not None
+                        and stop_signal()):
+                    # cooperative preemption at the superstep BOUNDARY
+                    # (the scan cannot poll mid-program): checkpoint
+                    # the exact boundary iteration so a resumed run
+                    # replays from precisely here, bitwise
+                    boundary = i0 + steps - 1
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.save(
+                            boundary, np.asarray(w), reg_val,
+                            np.asarray(losses), config_key)
+                    raise TrainingPreempted(boundary)
+                i0 += steps
+        finally:
+            if prefetch is not None:
+                prefetch.close()
+        if listener is not None:
+            listener.on_run_end(
+                RunEvent(
+                    event="run_completed",
+                    num_iterations=len(losses),
+                    final_loss=losses[-1] if losses else None,
+                    converged_early=converged,
+                    wall_time_s=_time.perf_counter() - t_run,
+                )
+            )
+        return w, np.asarray(losses, np.float32)
     # Lookahead prefetcher: the sample sequence is deterministic in
     # (seed, i), so sample(i+1) — gather/pad/cast/put, the whole host
     # side — runs on the worker thread while iteration i computes.

@@ -28,7 +28,11 @@ Schedules (measured figures: BASELINE.md "Measured results", TPU v5 lite):
                        cost no transfer (~2.4× the plain streamed rate
                        here)
 ``host_streamed``      anything host-resident: double-buffered per-
-                       iteration batch transfer (feed-bandwidth-bound)
+                       iteration batch transfer (feed-bandwidth-bound);
+                       on a single device the planner also picks the
+                       fused-step count K (``choose_superstep``) so one
+                       compiled K-step scan amortizes the per-iteration
+                       dispatch tax (README "Fused stepping")
 ``streamed_virtual_gram``  least squares beyond HBM, sliced/full-batch:
                        ONE streaming pass builds on-device statistics,
                        then iterations touch no rows (0.026 ms/iter
@@ -107,6 +111,19 @@ class CostModel:
     #: minimum fraction of iterations that must avoid transfer for partial
     #: residency to be chosen over plain streaming
     min_resident_gain: float = 0.05
+    #: fixed host cost of ONE streamed-SGD iteration dispatch (batch
+    #: ``device_put``s + program launch + readback bookkeeping) — the
+    #: per-iteration tax the superstep executor amortizes K-fold.
+    #: Fitted from BENCH_SUPERSTEP.json's slope difference between the
+    #: K=1 and K=8 drivers on this harness (slope_K1 - slope_K8 scaled
+    #: by 8/7 = implied_dispatch_overhead_s, measured 1.4 ms); like
+    #: ``host_feed_gb_s`` it is environment-bound — pod-local hosts
+    #: dispatch ~10× faster
+    dispatch_overhead_s: float = 1.4e-3
+    #: target ceiling for the residual dispatch tax under fusion:
+    #: choose_superstep picks the smallest K with
+    #: ``dispatch_overhead_s / K <= frac * per-iteration wall``
+    superstep_dispatch_frac: float = 0.05
     #: set by :meth:`calibrate` — raw probe readings plus which probes
     #: were rejected and fell back to the persisted defaults; excluded
     #: from equality/repr (two models with the same rates ARE the same
@@ -277,6 +294,12 @@ class Plan:
     #: choose_streamed_build budgets for
     wire_dtype: Optional[str] = None
     prefetch_depth: int = 2
+    #: fused-step count for the host_streamed schedule (README "Fused
+    #: stepping"): K iterations per compiled dispatch, the K-batch
+    #: superchunk staged double-buffered like every other chunk
+    #: (choose_superstep budgets 2× its footprint); 1 = the
+    #: per-iteration driver
+    superstep: int = 1
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -356,6 +379,8 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
     if ("prefetch_depth" not in user
             and hasattr(optimizer, "ingest_prefetch_depth")):
         optimizer.ingest_prefetch_depth = int(p.prefetch_depth)
+    if "superstep" not in user and hasattr(optimizer, "superstep"):
+        optimizer.superstep = int(getattr(p, "superstep", 1) or 1)
 
 
 #: THE user-facing gram knob table: name -> (optimizer attribute,
@@ -481,6 +506,8 @@ def reset_plan_owned_gram_knobs(optimizer) -> None:
         from tpu_sgd.io import DEFAULT_PREFETCH_DEPTH
 
         optimizer.ingest_prefetch_depth = DEFAULT_PREFETCH_DEPTH
+    if "superstep" not in user and hasattr(optimizer, "superstep"):
+        optimizer.superstep = 1
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
@@ -525,6 +552,33 @@ def choose_streamed_build(n_local: int, d: int, itemsize: int,
     if rows < B:  # cannot hold even one block alongside the stack
         return None, None
     return B, int(min(rows, 64 * B))
+
+
+def choose_superstep(window_rows: int, d: int, itemsize: int,
+                     iter_s: float, staging_budget: float,
+                     cost_model: CostModel = DEFAULT_COST_MODEL,
+                     cap: int = 64) -> int:
+    """Fused-step count K for the host_streamed schedule, from the
+    fixed-cost/slope fit (the GRAM_SCAN_EXPERIMENT / BENCH_SUPERSTEP
+    methodology): every streamed iteration pays a fixed host dispatch
+    tax ``dispatch_overhead_s`` on top of its ``iter_s`` transfer/
+    compute slope, and fusing K steps into one program divides the tax
+    by K.  Picks the smallest K that pushes the residual tax below
+    ``superstep_dispatch_frac`` of the per-iteration wall — smallest,
+    not largest, because K also multiplies the preemption latency and
+    the staging footprint — then clamps to what the double-buffered
+    K-batch superchunk (2× one superchunk live at the peak, the same
+    2× rule ``choose_streamed_build`` applies) fits in
+    ``staging_budget``, and to ``cap``.  Returns 1 when fusion cannot
+    pay (tiny dispatch tax or no staging room)."""
+    cm = cost_model
+    batch_bytes = window_rows * (d * itemsize + 5.0)  # X + y(f32) + valid
+    k_budget = int(staging_budget // max(1.0, 2.0 * batch_bytes))
+    if k_budget < 2:
+        return 1
+    target = cm.superstep_dispatch_frac * max(iter_s, 1e-9)
+    k_amortize = math.ceil(cm.dispatch_overhead_s / target)
+    return int(max(1, min(cap, k_amortize, k_budget)))
 
 
 def _fmt_gb(b: float) -> str:
@@ -735,14 +789,28 @@ def plan(
                     resident_rows=R, estimates=est,
                 )
         if chosen is None:
+            # superstep fusion: single-device only (the meshed feed
+            # keeps the per-iteration driver), budgeted against the
+            # free HBM a streamed schedule leaves idle — a quarter of
+            # it caps the double-buffered superchunk staging
+            K = 1
+            if n_devices == 1:
+                K = choose_superstep(window_rows, d, itemsize,
+                                     streamed_iter_s, free_hbm * 0.25,
+                                     cost_model=cm)
+            est["superstep"] = K
+            fused_note = (
+                f"; K={K} fused steps per dispatch amortize the "
+                f"~{cm.dispatch_overhead_s * 1e3:.1f} ms/iter host "
+                "dispatch tax" if K > 1 else "")
             chosen = Plan(
                 "host_streamed",
                 f"data ({_fmt_gb(data_bytes_local)}) exceeds HBM "
                 f"({_fmt_gb(free_hbm)} free); host-resident with "
                 "double-buffered per-iteration batches "
                 f"(~{streamed_iter_s:.2f}s/iter at {cm.host_feed_gb_s} "
-                "GB/s feed)",
-                estimates=est,
+                f"GB/s feed){fused_note}",
+                superstep=K, estimates=est,
             )
 
     if not host_resident_ok and chosen.schedule in (

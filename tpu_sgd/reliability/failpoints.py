@@ -141,6 +141,7 @@ def inject_latency(ms: float, *, nth: int = 0, prob: float = 0.0,
 #: both fail lint); the chaos soak iterates it to inject at every site.
 HOOK_SITES = {
     "io.prefetch.produce": "tpu_sgd/io/prefetch.py",
+    "io.superstep": "tpu_sgd/io/chunking.py",
     "io.device_put": "tpu_sgd/optimize/streamed.py",
     "optimize.streamed.step": "tpu_sgd/optimize/streamed.py",
     "checkpoint.save": "tpu_sgd/utils/checkpoint.py",

@@ -11,8 +11,12 @@ optimizers without changing their math:
   (``GradientDescent.set_checkpoint``), so durable state always trails
   the run by at most ``checkpoint_every`` iterations;
 * **preemption** — a SIGTERM/SIGINT handler flips a cooperative stop
-  flag; the streamed/stepwise loops check it once per iteration,
-  checkpoint the CURRENT state, and unwind with
+  flag; the streamed/stepwise loops check it once per iteration — or,
+  under superstep fusion (``set_superstep(K)``), once per superstep
+  BOUNDARY, since a compiled K-step scan cannot stop mid-program: the
+  worst-case latency grows to K iterations and the boundary iteration
+  is checkpointed exactly (ADVICE.md: keep K at or below the
+  checkpoint cadence) — checkpoint the CURRENT state, and unwind with
   :class:`TrainingPreempted` — a clean exit inside the grace window,
   never a torn write (the checkpoint rename is atomic);
 * **crash-resume** — any retryable crash (an injected fault, a
@@ -26,7 +30,9 @@ iteration ``default_rng(seed + i)`` sample and the pure jitted step), a
 resumed run replays the exact trajectory: final weights are **bitwise
 identical** to an uninterrupted run on the f32 wire — asserted across
 all three sampling modes in ``tests/test_reliability.py`` and under
-random fault schedules in ``scripts/chaos_soak.py``.
+random fault schedules in ``scripts/chaos_soak.py``, and preserved
+under superstep fusion (boundary-checkpointed fused runs resume
+bitwise — ``tests/test_superstep.py``).
 """
 
 from __future__ import annotations
