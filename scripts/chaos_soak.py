@@ -69,6 +69,23 @@ def _make_opt(iters: int, sampling: str, retry=None):
     return opt
 
 
+def _make_resident_opt(iters: int, retry=None):
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    # full-batch feed + residency(2): the WHOLE run is one compiled
+    # while_loop dispatch; the host touches the run only through the
+    # cadence io_callback (optimize/resident_driver.py) — which is
+    # exactly the surface this phase injects faults into
+    opt = (GradientDescent()
+           .set_num_iterations(iters).set_step_size(0.1)
+           .set_mini_batch_fraction(1.0).set_convergence_tol(0.0)
+           .set_seed(7).set_host_streaming(True)
+           .set_superstep(4).set_residency(2))
+    if retry is not None:
+        opt.set_ingest_options(retry=retry)
+    return opt
+
+
 def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
     """Run the soak; returns a summary dict.  Raises AssertionError on
     any invariant violation, TimeoutError/DeadlineExceeded on a hang."""
@@ -199,6 +216,97 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
         np.testing.assert_array_equal(np.asarray(w_res), w_ref)
         np.testing.assert_array_equal(h_res, h_ref)
         say(f"kill at dispatch {crash_at} + bare resume: bitwise equal")
+
+        # ---- phase 1b: DEVICE-RESIDENT driver under fire -----------------
+        # the resident path's only steady-state host surface is the
+        # cadence window callback: arm its failpoint (heals through the
+        # ingest RetryPolicy inside the callback, before any bookkeeping
+        # mutates), plus save/load and the dispatch-body site, and
+        # require the three invariants again — mid-run preempt lands at
+        # a cadence-window boundary, resumes, and stays bitwise
+        deadline = Deadline(300.0)
+        w_res_ref, h_res_ref = _make_resident_opt(iters) \
+            .optimize_with_history((X, y), w0)
+        w_res_ref = np.asarray(w_res_ref)
+        res_dir = os.path.join(work, "ckpt_resident")
+        res_mgr = CheckpointManager(res_dir)
+        res_opt = _make_resident_opt(
+            iters, retry=RetryPolicy(max_attempts=4, base_backoff_s=0.002,
+                                     seed=seed + 20))
+        res_sup = TrainingSupervisor(
+            res_opt, checkpoint_manager=res_mgr, checkpoint_every=5,
+            retry=RetryPolicy(max_attempts=200, base_backoff_s=0.002,
+                              seed=seed + 21),
+            listener=event_log, install_signal_handlers=False)
+        resident_faults = {
+            # the window callback itself: healed by the ingest retry
+            # inside the callback; an exhausted retry stashes the error,
+            # stops the loop, and the supervisor resumes from checkpoint
+            "io.resident_callback": fail_prob(0.2, seed=seed + 22),
+            # cadence saves run INSIDE the window replay — a fault here
+            # must unwind through the io_callback boundary cleanly
+            "checkpoint.save": fail_prob(0.05, seed=seed + 23),
+            "checkpoint.load": fail_prob(0.10, seed=seed + 24),
+            # the per-dispatch body site (one hit per resident run)
+            "optimize.streamed.step": fail_prob(0.10, seed=seed + 25),
+        }
+        with inject_faults(resident_faults):
+            res_result = res_sup.run((X, y), w0)
+            summary["resident_hits"] = {
+                k: fp.hits(k) for k in resident_faults}
+            summary["resident_triggers"] = {
+                k: fp.triggers(k) for k in resident_faults}
+        deadline.check("resident chaos phase")
+        assert res_result.completed, (
+            f"resident soak did not complete: {res_result.status}")
+        assert summary["resident_hits"]["io.resident_callback"] > 0, (
+            "the resident window callback was never reached")
+        np.testing.assert_array_equal(
+            np.asarray(res_result.weights), w_res_ref,
+            err_msg="resident chaos weights diverged from fault-free")
+        np.testing.assert_array_equal(
+            res_result.loss_history, h_res_ref,
+            err_msg="resident chaos loss history diverged")
+        summary["resident_attempts"] = res_result.attempts
+        say(f"resident driver survived: {res_result.attempts} "
+            f"attempt(s), triggers={summary['resident_triggers']}, "
+            "BITWISE equal to fault-free")
+
+        # mid-run preempt -> boundary checkpoint -> resume, fault-free
+        # wiring but the REAL preemption path: request_preempt from a
+        # listener event firing inside the window replay; the stop
+        # probe honors it at the NEXT cadence window boundary
+        pre_dir = os.path.join(work, "ckpt_resident_pre")
+        pre_opt = _make_resident_opt(iters)
+        pre_sup = TrainingSupervisor(
+            pre_opt, checkpoint_manager=CheckpointManager(pre_dir),
+            checkpoint_every=100, install_signal_handlers=False)
+
+        class _PreemptAt:
+            def on_run_start(self, c): ...
+
+            def on_iteration(self, ev):
+                if ev.iteration == 5:
+                    pre_sup.request_preempt()
+
+            def on_run_end(self, ev): ...
+
+        pre_opt.set_listener(_PreemptAt())
+        pre_res = pre_sup.run((X, y), w0)
+        window = 2 * 4  # cadence C=2 of K=4 supersteps
+        assert pre_res.status == "preempted", pre_res.status
+        assert pre_res.preempted_at % window == 0, (
+            f"preempt landed off the cadence-window grid: "
+            f"{pre_res.preempted_at}")
+        pre_opt.set_listener(None)
+        pre_res2 = pre_sup.run((X, y), w0)
+        assert pre_res2.completed
+        np.testing.assert_array_equal(
+            np.asarray(pre_res2.weights), w_res_ref)
+        np.testing.assert_array_equal(pre_res2.loss_history, h_res_ref)
+        summary["resident_preempted_at"] = pre_res.preempted_at
+        say(f"resident preempt at window boundary "
+            f"{pre_res.preempted_at} + resume: bitwise equal")
 
         # torn-write corruption (deterministic, not seed-dependent):
         # truncate the newest TWO checkpoints mid-file and require the

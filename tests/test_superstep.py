@@ -254,16 +254,33 @@ def test_stepwise_fused_checkpoint_cadence_matches_legacy(rng, tmp_path):
     assert run(1, "legacy") == run(4, "fused") == [3, 6, 9, 10]
 
 
-def test_stepwise_fused_mesh_falls_back_with_warning(rng):
+def test_stepwise_fused_mesh_runs_fused(rng):
+    """ISSUE 6 lift: the meshed observed path joins the fused driver
+    (dp_shared_superstep_fn) — no fall-back warning, trajectory at the
+    usual fused-vs-legacy tolerance, history exact length."""
+    import warnings as _warnings
+
     from tpu_sgd import data_mesh
     from tpu_sgd.utils.events import SGDListener
 
     X, y = _data(rng, n=256, d=6)
-    o = (GradientDescent().set_num_iterations(4).set_step_size(0.1)
-         .set_mesh(data_mesh()).set_listener(SGDListener())
-         .set_superstep(4))
-    with pytest.warns(RuntimeWarning, match="single-device stepwise"):
-        o.optimize_with_history((X, y), np.zeros(6, np.float32))
+
+    def run(k):
+        o = (GradientDescent().set_num_iterations(10).set_step_size(0.1)
+             .set_mini_batch_fraction(0.5).set_sampling("bernoulli")
+             .set_convergence_tol(0.0).set_seed(3)
+             .set_mesh(data_mesh()).set_listener(SGDListener()))
+        if k > 1:
+            o.set_superstep(k)
+        return o.optimize_with_history((X, y), np.zeros(6, np.float32))
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        w1, h1 = run(1)
+        w4, h4 = run(4)
+    assert len(h4) == len(h1) == 10
+    np.testing.assert_allclose(np.asarray(w4), np.asarray(w1), **TOL)
+    np.testing.assert_allclose(h4, h1, **TOL)
 
 
 # ---- preemption / resume at superstep boundaries ---------------------------
@@ -427,7 +444,7 @@ def test_stepwise_fused_run_compiles_one_program(rng):
          .set_convergence_tol(0.0).set_seed(3)
          .set_listener(SGDListener()).set_superstep(4))
     o.optimize_with_history((X, y), np.zeros(6, np.float32))
-    key = ("superstep", o.gradient, o.updater, o.config, 4)
+    key = ("superstep", o.gradient, o.updater, o.config, 4, None, False)
     fn = o._run_cache[key]
     assert fn._cache_size() == 1
 
@@ -504,12 +521,36 @@ def test_set_superstep_validates():
     assert GradientDescent().set_superstep(8).superstep == 8
 
 
-def test_streamed_fused_mesh_and_residency_fall_back(rng):
+def test_streamed_fused_mesh_and_residency_run_fused(rng):
+    """ISSUE 6 lift: a mesh and partial residency both JOIN the fused
+    driver — no fall-back warning, trajectories at the usual tolerance
+    vs their per-iteration drivers, same-program replays bitwise."""
+    import warnings as _warnings
+
+    from tpu_sgd import data_mesh
+
     X, y = _data(rng, n=512, d=8)
     cfg = _cfg("sliced")
-    with pytest.warns(RuntimeWarning, match="per-iteration driver"):
-        w, h = _stream(cfg, X, y, superstep_k=4, resident_rows=300)
-    assert len(h) == 10
+
+    # partial residency: mixed resident/transferred windows, one fused
+    # program (make_resident_window_superstep)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        wl, hl = _stream(cfg, X, y, resident_rows=300)
+        wf, hf = _stream(cfg, X, y, superstep_k=4, resident_rows=300)
+    assert len(hf) == len(hl) == 10
+    np.testing.assert_allclose(np.asarray(wf), np.asarray(wl), **TOL)
+    wf2, _ = _stream(cfg, X, y, superstep_k=4, resident_rows=300)
+    np.testing.assert_array_equal(np.asarray(wf), np.asarray(wf2))
+
+    # mesh: the sharded superchunk feed (dp_superstep_fn)
+    mesh = data_mesh()
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        wm1, hm1 = _stream(cfg, X, y, mesh=mesh)
+        wm4, hm4 = _stream(cfg, X, y, mesh=mesh, superstep_k=4)
+    assert len(hm4) == len(hm1) == 10
+    np.testing.assert_allclose(np.asarray(wm4), np.asarray(wm1), **TOL)
 
 
 def test_choose_superstep_amortizes_and_respects_budget():

@@ -13,13 +13,16 @@ CLI: ``python -m tpu_sgd.analysis.lint``.  Suppress one line with
 
 from tpu_sgd.analysis.core import (Finding, KNOWN_RULES, LintResult,
                                    ModuleFile, Rule, load_config, run_lint)
-from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
-                                      LocksetRecorder, assert_compile_count,
-                                      instrument_object)
+from tpu_sgd.analysis.runtime import (CompileCountError, DispatchCountError,
+                                      InstrumentedLock, LocksetRecorder,
+                                      assert_compile_count,
+                                      assert_dispatch_count,
+                                      count_dispatches, instrument_object)
 
 __all__ = [
     "Finding", "KNOWN_RULES", "LintResult", "ModuleFile", "Rule",
     "load_config", "run_lint",
-    "CompileCountError", "InstrumentedLock", "LocksetRecorder",
-    "assert_compile_count", "instrument_object",
+    "CompileCountError", "DispatchCountError", "InstrumentedLock",
+    "LocksetRecorder", "assert_compile_count", "assert_dispatch_count",
+    "count_dispatches", "instrument_object",
 ]

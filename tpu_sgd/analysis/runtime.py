@@ -33,7 +33,8 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Union
 
 __all__ = [
-    "CompileCountError", "assert_compile_count",
+    "CompileCountError", "DispatchCountError",
+    "assert_compile_count", "assert_dispatch_count", "count_dispatches",
     "InstrumentedLock", "LocksetRecorder", "LockViolation",
     "instrument_object",
 ]
@@ -100,6 +101,110 @@ def assert_compile_count(expected: int, *, of: CacheSource,
             "hot path usually means an eager jnp op or dynamic slice on "
             "a batch-shaped value — pad/slice in host numpy instead "
             "(see the shape-trap rule, tpu_sgd/analysis)")
+
+
+# -- dispatch counting ------------------------------------------------------
+
+class DispatchCountError(AssertionError):
+    """The wrapped region launched a different number of compiled
+    programs than the contract allows."""
+
+
+@contextlib.contextmanager
+def count_dispatches():
+    """Count XLA program LAUNCHES in a region — the execution twin of
+    :func:`assert_compile_count`'s compile counting.
+
+    Yields a one-key dict whose ``"n"`` entry is the number of compiled
+    programs dispatched so far inside the region.  Counting hooks the
+    runtime's one Python-level launch site
+    (``pxla.ExecuteReplicated.__call__`` — every pjit execution passes
+    through it on the Python dispatch path) and, for the duration of the
+    region, disables jit's C++ fastpath (which executes warm effect-free
+    programs entirely in C++, invisibly to any Python hook) by patching
+    ``_get_fastpath_data`` to decline and clearing the C++ pjit caches on
+    entry/exit.  Inside the region every call therefore takes the Python
+    path and is counted exactly once per launch; ``device_put`` transfers
+    and host callbacks are NOT launches and are not counted.  Slower than
+    production dispatch — instrumentation for tests and benches, never
+    for hot paths.
+
+    Semantics to be aware of: EAGER jnp ops are dispatches too (each is
+    its own one-op program — the same cost model behind the shape-trap
+    rule), so a region that eagerly pads or slices will honestly count
+    higher.  A ``lax.while_loop``/``scan`` program counts ONCE however
+    many trips it runs — which is exactly the property the resident
+    training driver's one-dispatch contract pins.
+
+    Not reentrant; thread-compatible only for the counting thread (other
+    threads' launches are counted too — keep the region single-actor).
+    """
+    from jax._src import pjit as _pjit
+    from jax._src.interpreters import pxla as _pxla
+    from jax._src.lib import xla_client as _xc
+
+    counter = {"n": 0}
+    orig_fastpath = _pjit._get_fastpath_data
+    orig_call = _pxla.ExecuteReplicated.__call__
+
+    def _no_fastpath(*a, **kw):
+        return None
+
+    def _counting_call(self, *args):
+        counter["n"] += 1
+        return orig_call(self, *args)
+
+    def _clear_cpp_caches():
+        _pjit._cpp_pjit_cache_fun_only.clear()
+        _pjit._cpp_pjit_cache_explicit_attributes.clear()
+        _xc._xla.PjitFunctionCache.clear_all()
+
+    try:
+        _pjit._get_fastpath_data = _no_fastpath
+        _pxla.ExecuteReplicated.__call__ = _counting_call
+        # functions warmed BEFORE the region hold installed fastpaths
+        # that would bypass the hook — drop them so their next call
+        # re-enters the (now fastpath-less) Python path.  Inside the
+        # try: _clear_cpp_caches touches deep-private jax internals, and
+        # a renamed attribute on a future jax must unwind the patches
+        # above rather than leave the process permanently hook-routed
+        _clear_cpp_caches()
+        yield counter
+    finally:
+        _pjit._get_fastpath_data = orig_fastpath
+        _pxla.ExecuteReplicated.__call__ = orig_call
+        # entries cached during the region carry no fastpath data and
+        # would stay on the slow path forever — drop them too
+        _clear_cpp_caches()
+
+
+@contextlib.contextmanager
+def assert_dispatch_count(expected: int, *, at_most: bool = False):
+    """Assert the region launches exactly ``expected`` compiled programs
+    — the sibling of :func:`assert_compile_count`, pinning program
+    LAUNCHES instead of program compiles (see :func:`count_dispatches`
+    for how launches are observed and what counts as one).
+
+    The resident training driver's contract is the motivating use: a
+    whole converged-or-budget-exhausted run is ONE dispatch (its
+    ``lax.while_loop`` trips and ``io_callback`` cadence hops are not
+    launches), where the K-superstep driver pays one launch per
+    superstep — ``assert_dispatch_count(1)`` around the run pins that
+    structurally, not by timing.  ``at_most=True`` relaxes to an upper
+    bound.
+    """
+    if expected < 0:
+        raise ValueError(f"expected must be >= 0, got {expected}")
+    with count_dispatches() as counter:
+        yield counter
+    if (counter["n"] > expected) if at_most else (counter["n"] != expected):
+        bound = "at most" if at_most else "exactly"
+        raise DispatchCountError(
+            f"region launched {counter['n']} compiled program(s); the "
+            f"contract allows {bound} {expected}.  Extra launches on a "
+            "fused path usually mean an eager jnp op between dispatches "
+            "or a loop that failed to stay device-resident (see "
+            "optimize/resident_driver.py)")
 
 
 # -- lock instrumentation ---------------------------------------------------

@@ -251,6 +251,25 @@ def make_run(
     return run
 
 
+def pack_step_ys(prev_w, new_w, loss_i, new_rv, count, f32: bool = False):
+    """THE per-step scan-ys tuple every fused driver emits — ``(new_w,
+    loss, reg_val, count, ||w_t - w_{t-1}||, ||w_t||)``, exactly what
+    :func:`_replay_fused_steps` consumes.  One definition shared by the
+    four scan bodies (:func:`make_superstep`,
+    :func:`make_shared_batch_superstep`,
+    :func:`make_resident_window_superstep`, and the resident driver's
+    while-loop body) so the norms-ride-the-ys convergence contract
+    cannot drift between drivers.  ``f32`` casts the scalar leaves for
+    the resident ring buffer's fixed-dtype carry."""
+    dn = jnp.linalg.norm(new_w - prev_w)
+    wn = jnp.linalg.norm(new_w)
+    if f32:
+        f = jnp.float32
+        return (new_w, loss_i.astype(f), new_rv.astype(f),
+                count.astype(f), dn.astype(f), wn.astype(f))
+    return (new_w, loss_i, new_rv, count, dn, wn)
+
+
 def make_superstep(
     gradient: Gradient,
     updater: Updater,
@@ -307,9 +326,8 @@ def make_superstep(
             new_w, loss_i, new_rv, c = step(w, Xb, yb, i, rv, vb)
             # per-step norms ride the ys so the host-side convergence
             # check stays EXACTLY the legacy per-iteration rule
-            dn = jnp.linalg.norm(new_w - w)
-            wn = jnp.linalg.norm(new_w)
-            return (new_w, new_rv), (new_w, loss_i, new_rv, c, dn, wn)
+            return (new_w, new_rv), pack_step_ys(w, new_w, loss_i,
+                                                 new_rv, c)
 
         (w, _), out = jax.lax.scan(body, (weights, reg_val),
                                    (idx, Xs, ys, valids))
@@ -348,11 +366,62 @@ def make_shared_batch_superstep(
         def body(carry, i):
             w, rv = carry
             new_w, loss_i, new_rv, c = step(w, X, y, i, rv, valid)
-            dn = jnp.linalg.norm(new_w - w)
-            wn = jnp.linalg.norm(new_w)
-            return (new_w, new_rv), (new_w, loss_i, new_rv, c, dn, wn)
+            return (new_w, new_rv), pack_step_ys(w, new_w, loss_i,
+                                                 new_rv, c)
 
         (w, _), out = jax.lax.scan(body, (weights, reg_val), idx)
+        return w, out
+
+    return superstep
+
+
+def make_resident_window_superstep(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    window_rows: int,
+):
+    """The partial-residency variant of :func:`make_superstep`: each
+    fused step's window comes EITHER from the device-resident slab
+    (sliced on device at a host-drawn start — zero transfer) OR from
+    the transferred superchunk batch, selected per step by a flag the
+    host packs alongside the superchunk.
+
+    ``superstep(weights, reg_val, i0, Xres, yres, starts, flags, Xs,
+    ys, valids) -> (carry_weights, ys_out)`` with the same ys contract
+    as :func:`make_superstep`.  ``starts``/``flags`` are ``(K,)``
+    per-step window starts and residency flags; resident steps ride
+    zero rows in ``Xs`` (the fixed superchunk shape is the price of
+    one compiled program — fusing trades those windows' transfer-byte
+    savings for the K-fold dispatch cut, which the tunnel-attached
+    target's 10-100x dispatch tax usually wins; the fully-resident
+    slab feed avoids even that via the resident driver).  Both window
+    sources feed bit-identical rows through the SAME scan body, so
+    same-program contracts stay bitwise across mixed
+    resident/transferred windows — this is what lifts the old
+    "superstep fusion applies ... without partial residency" warning.
+    """
+    step = make_step(gradient, updater, config)
+    m = int(window_rows)
+
+    def superstep(weights, reg_val, i0, Xres, yres, starts, flags,
+                  Xs, ys, valids):
+        idx = i0 + jnp.arange(Xs.shape[0], dtype=jnp.int32)
+
+        def body(carry, xs):
+            w, rv = carry
+            i, s0, res, Xb, yb, vb = xs
+            Xw, yw = jax.lax.cond(
+                res,
+                lambda: (jax.lax.dynamic_slice_in_dim(Xres, s0, m, 0),
+                         jax.lax.dynamic_slice_in_dim(yres, s0, m, 0)),
+                lambda: (Xb, yb))
+            new_w, loss_i, new_rv, c = step(w, Xw, yw, i, rv, vb)
+            return (new_w, new_rv), pack_step_ys(w, new_w, loss_i,
+                                                 new_rv, c)
+
+        (w, _), out = jax.lax.scan(body, (weights, reg_val),
+                                   (idx, starts, flags, Xs, ys, valids))
         return w, out
 
     return superstep
@@ -474,6 +543,13 @@ class GradientDescent(Optimizer):
         #: one-dispatch-per-iteration drivers.  The planner picks K for
         #: host_streamed schedules (plan.choose_superstep)
         self.superstep = 1
+        #: device-residency cadence (set_residency): C >= 2 moves the
+        #: WHOLE run loop into one compiled lax.while_loop over fused
+        #: supersteps on the device-resident-data paths, with host
+        #: callbacks every C supersteps (optimize/resident_driver.py);
+        #: 0 = the per-superstep host driver.  The planner picks C for
+        #: host_streamed schedules (plan.choose_residency)
+        self.resident_cadence = 0
         #: gram-knob fields the USER set via set_gram_options /
         #: set_streamed_stats — the planner preserves these and resets
         #: only plan-owned fields (Plan.apply)
@@ -728,6 +804,42 @@ class GradientDescent(Optimizer):
         self._plan_key = None
         return self
 
+    def set_residency(self, cadence: int = 8):
+        """Move the WHOLE run loop on device: a single compiled
+        ``lax.while_loop`` over fused superstep scans drives the run
+        from start to converged-or-budget-exhausted in ONE program
+        dispatch, with the host involved only every ``cadence``
+        supersteps — an ordered ``io_callback`` surfaces a bounded
+        ring buffer of per-step history that replays through the exact
+        superstep bookkeeping (loss history, listener events,
+        convergence at the true iteration, checkpoint cadence; see
+        ``optimize/resident_driver.py`` and README "Device-resident
+        training").  Applies where the per-iteration data already
+        lives on device: the observed stepwise driver and the
+        host-streamed full-batch / fully-resident-slab feeds; the
+        host-sampled streamed feeds keep the superstep driver (the
+        host hop there IS the data feed).  Requires ``set_superstep(K
+        >= 2)`` (or a planner-chosen K) — residency fuses the
+        superstep executor, it does not replace it.  Stop signals are
+        polled once per cadence window, so worst-case preemption
+        latency grows to ``cadence * K`` iterations (ADVICE.md); keep
+        the window at or below the checkpoint cadence.  ``cadence=0``
+        restores the per-superstep host driver; a window of ONE
+        superstep is the superstep driver already, so ``cadence=1``
+        is rejected.  ``plan.choose_residency`` picks the cadence
+        automatically for planned host-streamed schedules."""
+        c = int(cadence)
+        if c == 1:
+            raise ValueError(
+                "residency cadence 1 is the per-superstep driver "
+                "(set_superstep); use cadence >= 2 or 0 to disable")
+        if c < 0:
+            raise ValueError(f"cadence must be >= 0, got {cadence}")
+        self.resident_cadence = c
+        self._user_gram_opts = self._user_gram_opts | {"residency"}
+        self._plan_key = None
+        return self
+
     def set_stop_signal(self, stop_signal):
         """Install a zero-arg callable polled once per iteration on the
         observed (listener/checkpoint) and host-streamed paths: when it
@@ -943,6 +1055,7 @@ class GradientDescent(Optimizer):
                 retry_policy=self.ingest_retry_policy,
                 stop_signal=self._stop_signal,
                 superstep_k=self.superstep,
+                resident_cadence=self.resident_cadence,
             )
             self._loss_history = hist
             if self.check_numerics:
@@ -1414,29 +1527,86 @@ class GradientDescent(Optimizer):
             self.listener.on_run_start(cfg)
 
         fused_k = int(self.superstep or 1)
-        if fused_k > 1 and self.mesh is not None:
+        if fused_k > 1 and sparse_shape is not None:
             import warnings
 
             warnings.warn(
-                "set_superstep applies to the single-device stepwise "
-                "driver; the meshed observed path keeps the "
-                "per-iteration stepper",
+                "set_superstep applies to dense data on the meshed "
+                "observed path; the sparse meshed stepper stays "
+                "per-iteration",
                 RuntimeWarning, stacklevel=4,
             )
             fused_k = 1
+        resident_c = int(self.resident_cadence or 0)
+        if resident_c >= 2 and fused_k > 1 and self.mesh is not None:
+            import warnings
+
+            warnings.warn(
+                "set_residency is single-device (io_callback cadence "
+                "hooks do not ride shard_map); the meshed observed "
+                "path runs the fused superstep driver",
+                RuntimeWarning, stacklevel=4,
+            )
+            resident_c = 0
+        if resident_c >= 2 and fused_k <= 1:
+            import warnings
+
+            warnings.warn(
+                "set_residency rides the fused superstep executor; "
+                "call set_superstep(K >= 2) (or let the planner pick "
+                "K) to engage the device-resident driver",
+                RuntimeWarning, stacklevel=4,
+            )
+            resident_c = 0
 
         w = w0
         t_run = _time.perf_counter()
         converged_early = False
-        if fused_k > 1:
+        if fused_k > 1 and resident_c >= 2:
+            # Device-resident route: the WHOLE run is one lax.while_loop
+            # program over fused superstep scans — one dispatch for a
+            # converged-or-budget-exhausted run, host hops only at the
+            # cadence io_callback (optimize/resident_driver.py).  The
+            # ring ys replay through the same _replay_fused_steps, so
+            # history, events, convergence, and checkpoint bytes are
+            # exactly the superstep driver's (bitwise-pinned in
+            # tests/test_resident.py).
+            from tpu_sgd.optimize.resident_driver import (
+                ResidentBookkeeper,
+            )
+
+            loop = self._resident_loop(fused_k, resident_c)
+
+            def _save_res(ii, w_np, rv_):
+                mgr.save(ii, np.asarray(w_np), rv_, np.asarray(losses),
+                         config_key)
+
+            hooks = ResidentBookkeeper(
+                cfg, fused_k, resident_c, losses=losses,
+                reg_val=reg_val, start_iter=start_iter,
+                listener=self.listener,
+                save_cb=(_save_res if mgr is not None else None),
+                save_every=self.checkpoint_every,
+                stop_signal=self._stop_signal,
+                retry_policy=self.ingest_retry_policy,
+                check_numerics=self.check_numerics)
+            if start_iter <= cfg.num_iterations:
+                w_np, converged_early = loop.run(
+                    jnp.asarray(w0), reg_val, start_iter, (X, y), hooks)
+                w = jnp.asarray(w_np)
+                reg_val = hooks.reg_val
+        elif fused_k > 1:
             # Fused stepwise: K iterations per compiled lax.scan
             # dispatch, per-step loss/norm/weights returned as scan ys
             # and replayed host-side with the EXACT legacy bookkeeping
             # (_replay_fused_steps) — listener events, convergence at
             # the true iteration, checkpoints on the same cadence with
             # identical state.  X/y stay resident, so the only
-            # per-superstep host work is the one dispatch.
-            fused = self._superstepper(fused_k)
+            # per-superstep host work is the one dispatch.  On a 1-D
+            # data mesh the same fused scan runs under shard_map with
+            # the ICI gradient all-reduce (dp_shared_superstep_fn).
+            fused = self._superstepper(fused_k,
+                                       with_valid=valid is not None)
 
             def _save(ii, w_np, rv):
                 mgr.save(ii, np.asarray(w_np), rv, np.asarray(losses),
@@ -1446,10 +1616,16 @@ class GradientDescent(Optimizer):
             while i0 <= cfg.num_iterations and not converged_early:
                 steps = min(fused_k, cfg.num_iterations - i0 + 1)
                 t0 = _time.perf_counter()
-                w_dev, ys = fused(
-                    w, jnp.asarray(reg_val, jnp.float32),
-                    jnp.asarray(i0, jnp.int32), X, y,
-                )
+                if valid is not None:
+                    w_dev, ys = fused(
+                        w, jnp.asarray(reg_val, jnp.float32),
+                        jnp.asarray(i0, jnp.int32), X, y, valid,
+                    )
+                else:
+                    w_dev, ys = fused(
+                        w, jnp.asarray(reg_val, jnp.float32),
+                        jnp.asarray(i0, jnp.int32), X, y,
+                    )
                 ys_host = tuple(np.asarray(a) for a in ys)  # blocks
                 dt = _time.perf_counter() - t0
                 t_last, reg_val, converged_early = _replay_fused_steps(
@@ -1555,19 +1731,48 @@ class GradientDescent(Optimizer):
         self._loss_history = _np.asarray(losses, _np.float32)
         return w, self._loss_history
 
-    def _superstepper(self, k: int):
-        """Memoized jitted fused K-step function for the single-device
-        stepwise driver (``set_superstep``) — built ONCE per (plugin
-        pair, config, K) like ``_stepper``, so every superstep of a run
-        (including the tail) reuses the one compiled scan program."""
+    def _superstepper(self, k: int, with_valid: bool = False):
+        """Memoized jitted fused K-step function for the stepwise
+        driver (``set_superstep``) — built ONCE per (plugin pair,
+        config, K, mesh) like ``_stepper``, so every superstep of a run
+        (including the tail) reuses the one compiled scan program.
+        Single device runs the plain scan; a 1-D data mesh runs the
+        same scan under shard_map (``dp_shared_superstep_fn``)."""
         key = ("superstep", self.gradient, self.updater, self.config,
-               int(k))
+               int(k), self.mesh, with_valid)
         fn = self._run_cache.get(key)
         if fn is None:
-            fn = jax.jit(make_shared_batch_superstep(
-                self.gradient, self.updater, self.config, int(k)))
+            if self.mesh is None:
+                fn = jax.jit(make_shared_batch_superstep(
+                    self.gradient, self.updater, self.config, int(k)))
+            else:
+                from tpu_sgd.parallel.data_parallel import (
+                    dp_shared_superstep_fn,
+                )
+
+                fn = dp_shared_superstep_fn(
+                    self.gradient, self.updater, self.config, int(k),
+                    self.mesh, with_valid)
             self._run_cache[key] = fn
         return fn
+
+    def _resident_loop(self, k: int, cadence: int):
+        """Memoized device-resident whole-run program
+        (``set_residency``; ``optimize/resident_driver.py``) — one
+        compiled while_loop per (plugin pair, config, K, C); repeated
+        runs and resumes re-dispatch the same program."""
+        key = ("resident", self.gradient, self.updater, self.config,
+               int(k), int(cadence))
+        loop = self._run_cache.get(key)
+        if loop is None:
+            from tpu_sgd.optimize.resident_driver import ResidentLoop
+
+            step = make_step(self.gradient, self.updater, self.config)
+            loop = ResidentLoop(
+                lambda w, i, rv, X, y: step(w, X, y, i, rv, None),
+                self.config, int(k), int(cadence))
+            self._run_cache[key] = loop
+        return loop
 
     def _stepper(self, with_valid: bool, sparse_shape=None):
         """Memoized jitted single-step function (mesh-aware; pass

@@ -1,0 +1,391 @@
+"""Device-resident training driver: the whole run as ONE dispatch.
+
+PR 5's superstep executor cut the per-iteration host dispatch tax K-fold
+but kept one full host round-trip per superstep: the convergence test,
+the stop-signal poll, and the bookkeeping replay all lived host-side, so
+even at K=8 the driver paid a measured ~1.7 ms/iter residual slope
+(``BENCH_SUPERSTEP.json``).  The MLlib lineage we reproduce defines
+convergence as a weight-delta test that is pure device arithmetic
+(arXiv:1505.06807) — there is no reason the steady state ever touches
+the host.  This module moves the *run loop itself* onto the device:
+
+* a ``lax.while_loop`` whose body is the existing fused superstep scan
+  (the same per-step math as :func:`make_superstep` /
+  :func:`make_shared_batch_superstep` — measured bitwise-identical to
+  the dispatched superstep programs on this harness, all three sampling
+  modes, ``tests/test_resident.py``),
+* the convergence predicate (weight-delta tolerance), iteration
+  counter, and per-step loss/norm history carried in the loop state, so
+  a converged-or-budget-exhausted run is ONE program dispatch
+  (``assert_dispatch_count(1)``-pinned), and
+* host involvement ONLY at checkpoint/listener cadence and stop-signal
+  polls: an ordered ``io_callback`` fires every ``cadence`` supersteps
+  with a bounded ring buffer of per-step ys, which replays through the
+  existing :func:`_replay_fused_steps` — the loss history, the detected
+  convergence iteration, listener events, and the checkpoint cadence
+  are byte-for-byte the superstep driver's.
+
+Why a bounded RING, not whole-run ys: a while_loop cannot return
+per-trip stacked outputs (its carry is fixed-shape), and even if it
+could, an unbounded ``(num_iterations, d)`` history pinned in the carry
+is exactly the host/device-memory trap the cadence exists to avoid — a
+10M-iteration run must not stage a 10M-row weight history anywhere.
+The ring holds one cadence window (``cadence * k`` steps); each window
+is surfaced to the host once and overwritten.
+
+Convergence authority: the device predicate replicates the host rule
+(``delta < tol * max(||w||, 1)`` from the second recorded update on) in
+f32 and decides only when the LOOP exits; the host replay remains the
+single bookkeeping authority.  In the astronomically-unlikely event the
+f32 predicate fires where the host f64 comparison disagrees, the driver
+simply re-dispatches the program from the exact replayed state — the
+per-step math is bitwise-stable across dispatches, so the trajectory is
+unchanged and the disagreement costs one extra launch, never a drift.
+
+Failure containment: the window callback NEVER lets an exception cross
+the FFI boundary (an exception escaping an ``io_callback`` would
+surface as an opaque ``XlaRuntimeError`` and defeat the retry/resume
+machinery).  The stop-probe phase passes the ``io.resident_callback``
+failpoint inside the ingest ``RetryPolicy`` scope (transient faults
+heal in place, before any bookkeeping mutates); anything that still
+raises — an injected checkpoint-save fault, a listener error — is
+stashed, the loop is stopped via the returned flag, and the ORIGINAL
+exception re-raises host-side after the dispatch returns, where
+``TrainingSupervisor`` can see its true class and resume from the last
+checkpoint (bitwise, like every other healed path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.reliability.failpoints import failpoint
+
+_BOOL = jax.ShapeDtypeStruct((), jnp.bool_)
+
+
+class ResidentBookkeeper:
+    """Host-side bookkeeping state for ONE resident run.
+
+    Owns the mutable pieces the legacy loops kept inline — the loss
+    list, the running reg value, the listener, the checkpoint save
+    callback — and replays ring-buffer windows through the one shared
+    :func:`_replay_fused_steps`, so resident bookkeeping cannot drift
+    from the superstep driver's.  ``on_window`` is the ``io_callback``
+    target body; ``replay`` is also called by the driver for the tail
+    window after the dispatch returns.
+    """
+
+    def __init__(self, config: SGDConfig, k: int, cadence: int, *,
+                 losses: list, reg_val: float, start_iter: int,
+                 listener=None, save_cb: Optional[Callable] = None,
+                 save_every: int = 0, stop_signal=None,
+                 retry_policy=None, check_numerics: bool = False):
+        self.cfg = config
+        self.k = int(k)
+        self.cadence = int(cadence)
+        self.losses = losses
+        self.reg_val = float(reg_val)
+        self.listener = listener
+        self.save_cb = save_cb
+        self.save_every = int(save_every)
+        self.stop_signal = stop_signal
+        self.retry_policy = retry_policy
+        self.check_numerics = bool(check_numerics)
+        #: last iteration whose bookkeeping has been replayed (the
+        #: preemption boundary and the resume point after a false
+        #: device-convergence)
+        self.replayed_through = int(start_iter) - 1
+        #: host copy of the weights AT ``replayed_through`` (from the
+        #: ring ys — the truncation-safe final state when a run ends
+        #: mid-superstep, exactly like the superstep drivers')
+        self.last_w: Optional[np.ndarray] = None
+        self.host_converged = False
+        self.stop_requested = False
+        self.error: Optional[BaseException] = None
+        self.windows_fired = 0
+        self._t_mark = time.perf_counter()
+
+    # -- io_callback target --------------------------------------------------
+    def on_window(self, i0w, *rings) -> np.bool_:
+        """Replay one FULL cadence window and poll the stop signal.
+
+        Returns the device-side stop flag.  Never raises: see the module
+        docstring's failure-containment contract."""
+        try:
+            self.windows_fired += 1
+
+            def _probe():
+                # THE host-side fault-injection site of the resident
+                # path (registered in HOOK_SITES); placed BEFORE any
+                # bookkeeping mutation so a healed retry replays nothing
+                # twice
+                failpoint("io.resident_callback")
+                return bool(self.stop_signal()) \
+                    if self.stop_signal is not None else False
+            if self.retry_policy is not None:
+                want_stop = self.retry_policy.call(_probe)
+            else:
+                want_stop = _probe()
+            # materialize to HOST numpy at the FFI boundary: io_callback
+            # hands the rings over as device arrays, and replaying with
+            # python slicing/indexing on those would dispatch an eager
+            # one-op program per touched element (the shape-trap cost
+            # model) — one bulk fetch per leaf instead
+            self.replay(int(i0w), tuple(np.asarray(r) for r in rings),
+                        self.cadence)
+            if want_stop and not self.host_converged:
+                self.stop_requested = True
+            return np.bool_(self.host_converged or self.stop_requested)
+        except BaseException as e:  # noqa: BLE001 — FFI boundary, see doc
+            self.error = e
+            return np.bool_(True)
+
+    # -- shared replay -------------------------------------------------------
+    def replay(self, i0w: int, rings, n_supersteps: int) -> None:
+        """Replay ``n_supersteps`` supersteps of ring ys starting at
+        iteration ``i0w`` with EXACTLY the fused drivers' bookkeeping
+        (:func:`_replay_fused_steps` per superstep: per-iteration loss
+        history, listener events, convergence at the true iteration,
+        checkpoint cadence).  Overshoot steps past ``num_iterations``
+        (the while body's scan never branches on the budget) are bounded
+        out here, exactly as the superstep drivers truncate their tails.
+        """
+        from tpu_sgd.optimize.gradient_descent import _replay_fused_steps
+
+        K, cfg = self.k, self.cfg
+        ws, ls, rs, cs, dns, wns = rings
+        now = time.perf_counter()
+        span = max(1, n_supersteps * K)
+        wall_dt = (now - self._t_mark) / span
+        self._t_mark = now
+        for s in range(n_supersteps):
+            base = i0w + s * K
+            if base > cfg.num_iterations:
+                break  # whole superstep is overshoot (tail window only)
+            steps = min(K, cfg.num_iterations - base + 1)
+            lo = s * K
+            t_last, self.reg_val, conv = _replay_fused_steps(
+                (ws[lo:lo + K], ls[lo:lo + K], rs[lo:lo + K],
+                 cs[lo:lo + K], dns[lo:lo + K], wns[lo:lo + K]),
+                base, steps, self.losses, self.reg_val, cfg,
+                listener=self.listener, wall_dt=wall_dt,
+                check_numerics=self.check_numerics,
+                save_cb=self.save_cb, save_every=self.save_every,
+            )
+            self.replayed_through = base + t_last
+            self.last_w = np.asarray(ws[lo + t_last])
+            if conv:
+                self.host_converged = True
+                break
+
+
+class ResidentLoop:
+    """One compiled whole-run program: ``lax.while_loop`` over fused
+    superstep scans, with an ordered ``io_callback`` window hook.
+
+    ``step_fn(w, i, reg_val, *data) -> (new_w, loss_i, new_reg, count)``
+    is the per-iteration unit — an adapter around the SAME
+    :func:`make_step` the superstep drivers scan over, closed over
+    nothing (the data rides as program arguments ``*data`` so it enters
+    as buffers, not baked constants).  ``k`` steps fuse per superstep
+    (the scan), ``cadence`` supersteps per host window (the ring).
+
+    One instance = one jitted program; ``run()`` may be called
+    repeatedly (the stepwise driver memoizes instances per
+    ``(gradient, updater, config, K, C)``) — a whole run, including
+    resumes and tail windows, leaves exactly ONE compiled program
+    behind (``assert_compile_count(1)``-guarded in tests).
+    """
+
+    def __init__(self, step_fn: Callable, config: SGDConfig, k: int,
+                 cadence: int):
+        if int(cadence) < 1:
+            raise ValueError(f"cadence must be >= 1, got {cadence}")
+        if int(k) < 1:
+            raise ValueError(f"superstep k must be >= 1, got {k}")
+        self.config = config
+        self.k = int(k)
+        self.cadence = int(cadence)
+        self._step_fn = step_fn
+        # Installed by run() immediately before each dispatch and read
+        # by the io_callback (which may execute on the runtime's
+        # host-callback thread).  Safe vs the callback thread without a
+        # lock — the write happens-before the dispatch that triggers
+        # the reads, and no callback outlives its dispatch (the driver
+        # blocks on the carry before clearing it) — but instances are
+        # SHARED via the drivers' memo caches, so concurrent run()s
+        # from different threads would clobber the handoff: _run_lock
+        # serializes them (each run is independent; the per-step math
+        # is bitwise-stable across dispatches, so ordering is free).
+        self._hooks: Optional[ResidentBookkeeper] = None
+        self._run_lock = threading.Lock()
+        self._fn = jax.jit(self._build())
+
+    # -- trace-time ----------------------------------------------------------
+    def _fire(self, i0w, *rings):
+        """io_callback trampoline: bound once into the trace, routed to
+        the bookkeeper installed for the current dispatch."""
+        return self._hooks.on_window(i0w, *rings)
+
+    def _build(self):
+        cfg = self.config
+        K, C = self.k, self.cadence
+        CK = C * K
+        N = cfg.num_iterations
+        tol = float(cfg.convergence_tol)
+        step_fn = self._step_fn
+        fire_cb = self._fire
+
+        def loop(w0, rv0, i0, *data):
+            from jax.experimental import io_callback
+
+            from tpu_sgd.optimize.gradient_descent import pack_step_ys
+
+            rings0 = (
+                jnp.zeros((CK,) + w0.shape, w0.dtype),
+                jnp.zeros((CK,), jnp.float32),  # loss
+                jnp.zeros((CK,), jnp.float32),  # reg value
+                jnp.zeros((CK,), jnp.float32),  # realized batch count
+                jnp.zeros((CK,), jnp.float32),  # ||w_t - w_{t-1}||
+                jnp.zeros((CK,), jnp.float32),  # ||w_t||
+            )
+
+            def superstep(carry):
+                (i, w, rv, rws, rls, rrs, rcs, rdns, rwns, slot, conv,
+                 stop) = carry
+                idx = i + jnp.arange(K, dtype=jnp.int32)
+
+                def body(c, ii):
+                    cw, crv = c
+                    new_w, loss_i, new_rv, cnt = step_fn(cw, ii, crv,
+                                                         *data)
+                    # per-step norms ride the ring (f32, the carry's
+                    # fixed dtype) so the host replay keeps EXACTLY the
+                    # legacy convergence comparison
+                    return (new_w, new_rv), pack_step_ys(
+                        cw, new_w, loss_i, new_rv, cnt, f32=True)
+
+                (w, rv), ys = jax.lax.scan(body, (w, rv), idx)
+                base = slot * K
+                rws = jax.lax.dynamic_update_slice_in_dim(
+                    rws, ys[0], base, 0)
+                rls = jax.lax.dynamic_update_slice_in_dim(
+                    rls, ys[1], base, 0)
+                rrs = jax.lax.dynamic_update_slice_in_dim(
+                    rrs, ys[2], base, 0)
+                rcs = jax.lax.dynamic_update_slice_in_dim(
+                    rcs, ys[3], base, 0)
+                rdns = jax.lax.dynamic_update_slice_in_dim(
+                    rdns, ys[4], base, 0)
+                rwns = jax.lax.dynamic_update_slice_in_dim(
+                    rwns, ys[5], base, 0)
+                if tol > 0.0:
+                    # the device twin of _replay_fused_steps' rule —
+                    # recorded step (count > 0), second update on
+                    conv_t = ((ys[3] > 0) & (idx > 1)
+                              & (ys[4] < tol * jnp.maximum(ys[5], 1.0)))
+                    conv = jnp.any(conv_t)
+                slot = slot + 1
+                # fire the window hook only on a FULL, un-converged
+                # window: a converged (or budget-ending) partial window
+                # replays host-side from the returned carry instead
+                fire = (slot == C) & jnp.logical_not(conv)
+                win_start = i - (C - 1) * K
+                stop = jax.lax.cond(
+                    fire,
+                    lambda a: io_callback(fire_cb, _BOOL, *a,
+                                          ordered=True),
+                    lambda a: stop,
+                    (win_start, rws, rls, rrs, rcs, rdns, rwns))
+                slot = jnp.where(fire, 0, slot)
+                return (i + K, w, rv, rws, rls, rrs, rcs, rdns, rwns,
+                        slot, conv, stop)
+
+            def cond(carry):
+                i, conv, stop = carry[0], carry[10], carry[11]
+                return ((i <= N) & jnp.logical_not(conv)
+                        & jnp.logical_not(stop))
+
+            init = (jnp.asarray(i0, jnp.int32), w0,
+                    jnp.asarray(rv0, jnp.float32), *rings0,
+                    jnp.asarray(0, jnp.int32), jnp.asarray(False),
+                    jnp.asarray(False))
+            return jax.lax.while_loop(cond, superstep, init)
+
+        return loop
+
+    def compile_cache_size(self) -> int:
+        """Compiled-program count of the underlying jitted loop (for
+        ``assert_compile_count``)."""
+        return self._fn._cache_size()
+
+    # -- run-time ------------------------------------------------------------
+    def run(self, w0, reg_val: float, start_iter: int, data: tuple,
+            hooks: ResidentBookkeeper):
+        """Dispatch the whole-run program and finalize through ``hooks``.
+
+        Returns ``(weights_np, converged)`` with every side effect (loss
+        history, listener events, checkpoint saves) already applied via
+        the window replays.  Raises the stashed callback exception, or
+        ``TrainingPreempted`` at the exact replayed boundary when the
+        stop signal fired.  Normally ONE dispatch; a false f32
+        device-convergence (see module docstring) re-dispatches from the
+        exact replayed state — bitwise-stable, never a drift.
+        """
+        from tpu_sgd.reliability.supervisor import TrainingPreempted
+
+        cfg = self.config
+        K = self.k
+        w_dev = w0
+        rv = float(reg_val)
+        i0 = int(start_iter)
+        while True:
+            with self._run_lock:
+                self._hooks = hooks
+                try:
+                    carry = self._fn(w_dev, rv, i0, *data)
+                    # dispatch is async: block on the carry BEFORE
+                    # clearing the hook — no callback outlives its
+                    # dispatch only once the program has completed
+                    jax.block_until_ready(carry)
+                finally:
+                    self._hooks = None
+            i_f = int(carry[0])
+            slot_f = int(carry[9])
+            conv_f = bool(carry[10])
+            if hooks.error is None and slot_f:
+                # tail window: the un-replayed supersteps since the last
+                # fired window sit in ring rows [0, slot_f * K) — the
+                # rings are fetched to host ONLY here (a completed or
+                # stopped run with slot_f == 0 never pays the (C*K, d)
+                # device->host copy)
+                rings = tuple(np.asarray(r) for r in carry[3:9])
+                hooks.replay(i_f - slot_f * K, rings, slot_f)
+            if hooks.error is not None:
+                raise hooks.error
+            if hooks.stop_requested and not hooks.host_converged:
+                boundary = hooks.replayed_through
+                if hooks.save_cb is not None:
+                    hooks.save_cb(boundary, hooks.last_w, hooks.reg_val)
+                raise TrainingPreempted(boundary)
+            if hooks.host_converged \
+                    or hooks.replayed_through >= cfg.num_iterations:
+                return hooks.last_w, hooks.host_converged
+            if not conv_f:  # pragma: no cover — cond exhausts the cases
+                raise AssertionError(
+                    "resident loop exited without budget, convergence, "
+                    f"or stop (i={i_f}, replayed="
+                    f"{hooks.replayed_through})")
+            # device predicate fired where the host comparison did not:
+            # continue from the exact replayed state (one extra launch)
+            i0 = hooks.replayed_through + 1
+            w_dev = jnp.asarray(hooks.last_w).astype(w0.dtype)
+            rv = hooks.reg_val

@@ -29,6 +29,7 @@ windows.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Tuple
 
 import jax
@@ -57,6 +58,18 @@ def resident_window_probability(n: int, frac: float, resident: int) -> float:
     return min(1.0, max(0.0, (resident - m + 1) / max(n - m + 1, 1)))
 
 
+#: whole-run resident-loop memo for the streamed path — the stepwise
+#: driver memoizes its loops per-optimizer (``_run_cache``), but this is
+#: a free function, so the memo lives here: ``TrainingSupervisor``
+#: resume attempts and repeated runs with an unchanged ``(gradient,
+#: updater, config, K, C, feed)`` reuse the ONE compiled while-loop
+#: program instead of re-tracing the largest program in the codebase
+#: per call.  Bounded FIFO so a long-lived process cycling configs
+#: doesn't pin dead programs (and their gradient objects) forever.
+_RESIDENT_LOOPS: OrderedDict = OrderedDict()
+_RESIDENT_LOOPS_MAX = 8
+
+
 def optimize_host_streamed(
     gradient: Gradient,
     updater: Updater,
@@ -75,6 +88,7 @@ def optimize_host_streamed(
     retry_policy=None,
     stop_signal=None,
     superstep_k: int = 1,
+    resident_cadence: int = 0,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -144,8 +158,14 @@ def optimize_host_streamed(
     (worst-case preemption latency: K iterations; the boundary
     iteration is checkpointed exactly).  Full-batch feeds
     (``mini_batch_fraction >= 1``) transfer the batch ONCE and scan
-    over it.  Single device only — a mesh or ``resident_rows`` keeps
-    the per-iteration driver (warned).
+    over it.  A mesh shards the superchunk row-wise under the shared
+    ``superchunk_specs`` layout (``dp_superstep_fn``), and
+    ``resident_rows`` rides the same scan body with a per-step
+    resident/transferred flag — both fuse since PR 6.
+    ``resident_cadence >= 2`` additionally moves the WHOLE run loop on
+    device for the full-batch and fully-resident-slab feeds (README
+    "Device-resident training"); host-sampled feeds keep the superstep
+    driver (warned — the host hop is the data feed).
     """
     import time as _time
 
@@ -189,16 +209,31 @@ def optimize_host_streamed(
                 "resident prefix — raise it or use plain streaming"
             )
     K = max(1, int(superstep_k))
-    if K > 1 and (mesh is not None or R):
+    C = max(0, int(resident_cadence))
+    # fully-resident slab: R == n means EVERY sliced window lands in the
+    # resident prefix — the feed is device-resident-sample and the
+    # whole-run resident driver can take it (zero steady-state transfer)
+    fully_resident = bool(R) and R >= n
+    if C >= 2 and K <= 1:
         import warnings
 
         warnings.warn(
-            "superstep fusion applies to the single-device streamed "
-            "feed without partial residency; keeping the per-iteration "
-            "driver",
+            "device residency rides the fused superstep executor; pass "
+            "superstep_k >= 2 (or let the planner pick K) to engage it",
             RuntimeWarning, stacklevel=3,
         )
-        K = 1
+        C = 0
+    if C >= 2 and (mesh is not None
+                   or not (frac >= 1.0 or fully_resident)):
+        import warnings
+
+        warnings.warn(
+            "device residency applies to the single-device full-batch "
+            "and fully-resident-slab feeds (a host-sampled feed's host "
+            "hop IS the data feed); running the fused superstep driver",
+            RuntimeWarning, stacklevel=3,
+        )
+        C = 0
     if mesh is None:
         if device is None:
             device = jax.devices()[0]
@@ -206,16 +241,20 @@ def optimize_host_streamed(
         base_step = make_step(gradient, updater, step_cfg)
         step = jax.jit(base_step)
         row_sharding = mask_sharding = device
+        super_row_sharding = super_mask_sharding = device
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from tpu_sgd.parallel.data_parallel import dp_step_fn
-        from tpu_sgd.parallel.mesh import DATA_AXIS
+        from tpu_sgd.parallel.mesh import DATA_AXIS, superchunk_specs
 
         step = dp_step_fn(gradient, updater, step_cfg, mesh, with_valid=True)
         w_sharding = NamedSharding(mesh, P())
         row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
         mask_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        spec_xs, spec_ys, _ = superchunk_specs()
+        super_row_sharding = NamedSharding(mesh, spec_xs)
+        super_mask_sharding = NamedSharding(mesh, spec_ys)
     w = jax.device_put(w, w_sharding)
 
     _, reg_val = updater.compute(
@@ -259,17 +298,20 @@ def optimize_host_streamed(
         # transfer): the window sequence decides per iteration which
         # program runs, so without this the OTHER program's first compile
         # would land mid-run at an RNG-dependent iteration — a multi-second
-        # wall spike that corrupts steady-state timing.
-        i0 = jnp.asarray(1, jnp.int32)
-        r0 = jnp.zeros((), jnp.float32)
-        jax.block_until_ready(resident_step(
-            w, Xres, yres, jnp.asarray(0, jnp.int32), i0, r0
-        ))
-        Xb0 = jnp.zeros((m_fixed,) + X.shape[1:], Xres.dtype)
-        yb0 = jnp.zeros((m_fixed,), yres.dtype)
-        v0 = jnp.ones((m_fixed,), bool)
-        jax.block_until_ready(step(w, Xb0, yb0, i0, r0, v0))
-        del Xb0, yb0, v0
+        # wall spike that corrupts steady-state timing.  The fused K > 1
+        # drivers run ONE program for both window kinds and compile it on
+        # their own first dispatch — no prewarm to do.
+        if K == 1:
+            i0 = jnp.asarray(1, jnp.int32)
+            r0 = jnp.zeros((), jnp.float32)
+            jax.block_until_ready(resident_step(
+                w, Xres, yres, jnp.asarray(0, jnp.int32), i0, r0
+            ))
+            Xb0 = jnp.zeros((m_fixed,) + X.shape[1:], Xres.dtype)
+            yb0 = jnp.zeros((m_fixed,), yres.dtype)
+            v0 = jnp.ones((m_fixed,), bool)
+            jax.block_until_ready(step(w, Xb0, yb0, i0, r0, v0))
+            del Xb0, yb0, v0
 
     _gather = lambda A, idx: A[idx]
     if X.flags.c_contiguous:  # native gather requires contiguous rows
@@ -375,12 +417,24 @@ def optimize_host_streamed(
             return (kind, payload)
         return _put_batch(*payload)
 
+    def _put_super(Xs, Ys, Vs):
+        """The host→device hop of one assembled K-step superchunk —
+        the same ``io.device_put`` failpoint/retry scope as
+        ``_put_batch``, with the ``(K, rows, ...)`` shardings from
+        ``superchunk_specs`` (row axis sharded on a mesh, step axis
+        replicated)."""
+        failpoint("io.device_put")
+        return (jax.device_put(Xs, super_row_sharding),
+                jax.device_put(Ys, super_mask_sharding),
+                jax.device_put(Vs, super_mask_sharding))
+
     def sample_super(base: int):
         """Superstep producer: assemble the K per-iteration batches for
         iterations ``[base, base+K)`` into ONE ``(K, cap, ...)``
         superchunk (host numpy; ``tpu_sgd.io.stack_superchunk`` — the
         ``io.superstep`` failpoint) and transfer it with a single
-        ``device_put`` per leaf.  A tail superstep (fewer than K real
+        ``device_put`` per leaf (row-sharded over a mesh when one is
+        set).  A tail superstep (fewer than K real
         iterations left) pads with zero rows and all-False valid masks,
         which the fused step turns into no-op updates — the fixed (K,
         cap) shape keeps the scan program compiled exactly once.  Runs
@@ -393,7 +447,43 @@ def optimize_host_streamed(
         Xs, Ys, Vs = stack_superchunk(
             [p[0] for p in parts], [p[1] for p in parts],
             [p[2] for p in parts], k=K)
-        return _put_batch(Xs, Ys, Vs)[1]
+        return _put_super(Xs, Ys, Vs)
+
+    def sample_super_resident(base: int):
+        """Partial-residency superstep producer: a per-step window that
+        lands in the resident prefix rides as a ``(start, True)`` flag
+        pair with zero rows in the superchunk (the fixed shape still
+        transfers — fusing trades those windows' transfer-byte savings
+        for the K-fold dispatch cut, see
+        ``make_resident_window_superstep``), while non-resident windows
+        assemble and transfer exactly like ``sample_super``'s.  One put
+        per superstep, same failpoint/retry scope as every producer."""
+        from tpu_sgd.io import stack_superchunk
+
+        steps = min(K, cfg.num_iterations - base + 1)
+        starts = np.zeros((K,), np.int32)
+        flags = np.zeros((K,), bool)
+        xdt = np.dtype(wd) if wd is not None else X.dtype
+        zeros = None
+        parts = []
+        for t in range(steps):
+            kind, payload = sample_host(base + t)
+            if kind == "resident":
+                starts[t] = payload
+                flags[t] = True
+                if zeros is None:
+                    zeros = (np.zeros((cap, X.shape[1]), xdt),
+                             np.zeros((cap,), y.dtype),
+                             np.ones((cap,), bool))
+                parts.append(zeros)
+            else:
+                parts.append(payload)
+        Xs, Ys, Vs = stack_superchunk(
+            [p[0] for p in parts], [p[1] for p in parts],
+            [p[2] for p in parts], k=K)
+        Xd, Yd, Vd = _put_super(Xs, Ys, Vs)
+        return (jax.device_put(starts, device),
+                jax.device_put(flags, device), Xd, Yd, Vd)
 
     if listener is not None:
         listener.on_run_start(cfg)
@@ -425,46 +515,156 @@ def optimize_host_streamed(
         # Per-step (weights, loss, reg, count, norms) return as scan ys
         # and replay host-side with the legacy loop's exact bookkeeping
         # (_replay_fused_steps) — same loss history, same convergence
-        # iteration, same checkpoint bytes.
+        # iteration, same checkpoint bytes.  A mesh runs the same scan
+        # under shard_map; partial residency runs the mixed
+        # resident/transferred-window scan; and resident_cadence >= 2
+        # on a device-resident-data feed escalates to the whole-run
+        # resident driver below.
         from tpu_sgd.optimize.gradient_descent import (
             _replay_fused_steps,
+            make_resident_window_superstep,
             make_shared_batch_superstep,
             make_superstep,
         )
         from tpu_sgd.reliability.supervisor import TrainingPreempted
 
         shared_full_batch = frac >= 1.0
-        if shared_full_batch:
-            # the full-batch "sample" is identical every iteration:
-            # transfer it ONCE and let the scan reuse it — zero
-            # per-iteration AND zero per-superstep transfer
-            fused = jax.jit(make_shared_batch_superstep(
-                gradient, updater, step_cfg, K))
-        else:
-            fused = jax.jit(make_superstep(gradient, updater, step_cfg))
+        window_resident = bool(R) and not shared_full_batch
 
         def _save(ii, w_np, rv):
             checkpoint_manager.save(ii, np.asarray(w_np), rv,
                                     np.asarray(losses), config_key)
 
+        def _full_batch_transfer():
+            # THE one-time full-batch device_put, inside the ingest
+            # retry scope (it runs outside a prefetcher, so a transient
+            # fault must heal here exactly as on the per-iteration
+            # feed) — shared by the resident and superstep drivers
+            def _t():
+                return sample(start_iter)
+
+            _, put = (retry_policy.call(_t)
+                      if retry_policy is not None else _t())
+            return put
+
+        if C >= 2:
+            # Whole-run device-resident driver
+            # (optimize/resident_driver.py): the per-iteration data is
+            # already on device — the one-time full-batch transfer, or
+            # the fully-resident slab plus a precomputed window-start
+            # sequence — so the entire converged-or-budget-exhausted
+            # run is ONE program dispatch; the host hops only at the
+            # cadence io_callback, whose ring ys replay through the
+            # same _replay_fused_steps as the superstep loop below
+            # (bitwise-pinned in tests/test_resident.py).
+            from tpu_sgd.optimize.resident_driver import (
+                ResidentBookkeeper,
+                ResidentLoop,
+            )
+
+            if start_iter <= cfg.num_iterations:
+                if shared_full_batch:
+                    res_data = _full_batch_transfer()
+
+                    def _res_step(w_, i_, rv_, Xr, yr, vr):
+                        return base_step(w_, Xr, yr, i_, rv_, vr)
+                else:
+                    # fully-resident sliced slab: the window sequence
+                    # is deterministic in (seed, i) — replay THE host
+                    # sampler's draws up front (every window of a
+                    # fully-resident slab returns ("resident", start),
+                    # zero assembly) so the on-device run consumes the
+                    # IDENTICAL windows from the one authoritative RNG
+                    # rule (one tiny (N,) int32 transfer, once per run)
+                    starts_np = np.empty((cfg.num_iterations,),
+                                         np.int32)
+                    for it in range(1, cfg.num_iterations + 1):
+                        tag, start = sample_host(it)
+                        assert tag == "resident", tag
+                        starts_np[it - 1] = start
+                    starts_d = jax.device_put(starts_np, device)
+                    res_data = (Xres, yres, starts_d)
+
+                    def _res_step(w_, i_, rv_, Xr, yr, st):
+                        s0 = st[i_ - 1]
+                        Xb = jax.lax.dynamic_slice_in_dim(
+                            Xr, s0, m_fixed, 0)
+                        yb = jax.lax.dynamic_slice_in_dim(
+                            yr, s0, m_fixed, 0)
+                        return base_step(w_, Xb, yb, i_, rv_,
+                                         ones_mask)
+
+                # the loop's program depends only on (step math, cfg,
+                # K, C) and the feed shape family — memo hit = zero
+                # re-trace on resume/replay with the same optimizer
+                loop_key = (gradient, updater, cfg, K, C,
+                            ("full",) if shared_full_batch
+                            else ("slab", m_fixed))
+                loop = _RESIDENT_LOOPS.get(loop_key)
+                if loop is None:
+                    loop = ResidentLoop(_res_step, cfg, K, C)
+                    _RESIDENT_LOOPS[loop_key] = loop
+                    while len(_RESIDENT_LOOPS) > _RESIDENT_LOOPS_MAX:
+                        _RESIDENT_LOOPS.popitem(last=False)
+                hooks = ResidentBookkeeper(
+                    cfg, K, C, losses=losses, reg_val=reg_val,
+                    start_iter=start_iter, listener=listener,
+                    save_cb=(_save if checkpoint_manager is not None
+                             else None),
+                    save_every=checkpoint_every,
+                    stop_signal=stop_signal,
+                    retry_policy=retry_policy)
+                # the iteration-body failpoint fires once per DISPATCH,
+                # as on every other driver — one hit per resident run
+                failpoint("optimize.streamed.step")
+                w_np, converged = loop.run(w, reg_val, start_iter,
+                                           res_data, hooks)
+                w = jax.device_put(jnp.asarray(w_np), w_sharding)
+                reg_val = hooks.reg_val
+            if listener is not None:
+                listener.on_run_end(RunEvent(
+                    event="run_completed",
+                    num_iterations=len(losses),
+                    final_loss=losses[-1] if losses else None,
+                    converged_early=converged,
+                    wall_time_s=_time.perf_counter() - t_run,
+                ))
+            return w, np.asarray(losses, np.float32)
+
+        if mesh is not None:
+            from tpu_sgd.parallel.data_parallel import (
+                dp_shared_superstep_fn,
+                dp_superstep_fn,
+            )
+
+            if shared_full_batch:
+                fused = dp_shared_superstep_fn(
+                    gradient, updater, step_cfg, K, mesh, True)
+            else:
+                fused = dp_superstep_fn(gradient, updater, step_cfg,
+                                        mesh)
+        elif shared_full_batch:
+            # the full-batch "sample" is identical every iteration:
+            # transfer it ONCE and let the scan reuse it — zero
+            # per-iteration AND zero per-superstep transfer
+            fused = jax.jit(make_shared_batch_superstep(
+                gradient, updater, step_cfg, K))
+        elif window_resident:
+            fused = jax.jit(make_resident_window_superstep(
+                gradient, updater, step_cfg, m_fixed))
+        else:
+            fused = jax.jit(make_superstep(gradient, updater, step_cfg))
+
         prefetch = None
         try:
             if shared_full_batch:
                 if start_iter <= cfg.num_iterations:
-                    # the one-time transfer runs OUTSIDE a prefetcher,
-                    # so the ingest retry must wrap it here — a
-                    # transient device_put fault heals exactly as it
-                    # does on the per-iteration feed
-                    def _transfer():
-                        return sample(start_iter)
-
-                    if retry_policy is not None:
-                        _, (Xd, yd, vd) = retry_policy.call(_transfer)
-                    else:
-                        _, (Xd, yd, vd) = _transfer()
+                    Xd, yd, vd = _full_batch_transfer()
             else:
+                producer = (sample_super_resident if window_resident
+                            else sample_super)
                 prefetch = Prefetcher(
-                    sample_super,
+                    producer,
                     range(start_iter, cfg.num_iterations + 1, K),
                     depth=prefetch_depth, retry_policy=retry_policy)
                 nxt = (next(prefetch)
@@ -481,6 +681,12 @@ def optimize_host_streamed(
                     w_dev, ys = fused(
                         w, jnp.asarray(reg_val, jnp.float32),
                         jnp.asarray(i0, jnp.int32), Xd, yd, vd)
+                elif window_resident:
+                    w_dev, ys = fused(
+                        w, jnp.asarray(reg_val, jnp.float32),
+                        jnp.asarray(i0, jnp.int32), Xres, yres, *nxt)
+                    if i0 + K <= cfg.num_iterations:
+                        nxt = next(prefetch)
                 else:
                     Xs, Ys, Vs = nxt
                     w_dev, ys = fused(

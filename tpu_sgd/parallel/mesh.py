@@ -15,10 +15,24 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def superchunk_specs():
+    """PartitionSpecs of one fused K-step *superchunk* ``(Xs, ys,
+    valids)`` with shapes ``(K, rows, d)`` / ``(K, rows)`` / ``(K,
+    rows)``: the STEP axis is replicated (every shard runs all K fused
+    steps), the ROW axis shards over 'data'.  THE one definition shared
+    by the meshed superstep builder (``parallel/data_parallel.py``) and
+    the streamed feed's superchunk transfer (``optimize/streamed.py``),
+    so the program's in_specs and the host-side ``device_put`` sharding
+    cannot drift."""
+    P = PartitionSpec
+    return (P(None, DATA_AXIS, None), P(None, DATA_AXIS),
+            P(None, DATA_AXIS))
 
 
 def make_mesh(

@@ -116,10 +116,13 @@ class CostModel:
     #: per-iteration tax the superstep executor amortizes K-fold.
     #: Fitted from BENCH_SUPERSTEP.json's slope difference between the
     #: K=1 and K=8 drivers on this harness (slope_K1 - slope_K8 scaled
-    #: by 8/7 = implied_dispatch_overhead_s, measured 1.4 ms); like
+    #: by 8/7 = implied_dispatch_overhead_s; recalibrated for the
+    #: resident-driver round at 2.3 ms — the earlier 1.4 ms capture was
+    #: a quieter ambient state, the same run-to-run band
+    #: BENCH_SUPERSTEP.json's basis string warns about); like
     #: ``host_feed_gb_s`` it is environment-bound — pod-local hosts
     #: dispatch ~10× faster
-    dispatch_overhead_s: float = 1.4e-3
+    dispatch_overhead_s: float = 2.3e-3
     #: target ceiling for the residual dispatch tax under fusion:
     #: choose_superstep picks the smallest K with
     #: ``dispatch_overhead_s / K <= frac * per-iteration wall``
@@ -300,6 +303,13 @@ class Plan:
     #: (choose_superstep budgets 2× its footprint); 1 = the
     #: per-iteration driver
     superstep: int = 1
+    #: device-residency cadence for the host_streamed full-batch feed
+    #: (README "Device-resident training"): C >= 2 moves the whole run
+    #: into one compiled while_loop with host callbacks every C
+    #: supersteps (choose_residency — resident only when the cadence
+    #: window holds at least 2 supersteps); 0 = the per-superstep
+    #: host-dispatched driver
+    residency: int = 0
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -381,6 +391,9 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
         optimizer.ingest_prefetch_depth = int(p.prefetch_depth)
     if "superstep" not in user and hasattr(optimizer, "superstep"):
         optimizer.superstep = int(getattr(p, "superstep", 1) or 1)
+    if ("residency" not in user
+            and hasattr(optimizer, "resident_cadence")):
+        optimizer.resident_cadence = int(getattr(p, "residency", 0) or 0)
 
 
 #: THE user-facing gram knob table: name -> (optimizer attribute,
@@ -508,6 +521,9 @@ def reset_plan_owned_gram_knobs(optimizer) -> None:
         optimizer.ingest_prefetch_depth = DEFAULT_PREFETCH_DEPTH
     if "superstep" not in user and hasattr(optimizer, "superstep"):
         optimizer.superstep = 1
+    if ("residency" not in user
+            and hasattr(optimizer, "resident_cadence")):
+        optimizer.resident_cadence = 0
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
@@ -573,12 +589,63 @@ def choose_superstep(window_rows: int, d: int, itemsize: int,
     pay (tiny dispatch tax or no staging room)."""
     cm = cost_model
     batch_bytes = window_rows * (d * itemsize + 5.0)  # X + y(f32) + valid
-    k_budget = int(staging_budget // max(1.0, 2.0 * batch_bytes))
+    if math.isinf(staging_budget):
+        # shared-batch feeds stage no superchunk at all (one transfer,
+        # the scan reuses it): only the amortization target binds
+        k_budget = int(cap)
+    else:
+        k_budget = int(staging_budget // max(1.0, 2.0 * batch_bytes))
     if k_budget < 2:
         return 1
     target = cm.superstep_dispatch_frac * max(iter_s, 1e-9)
     k_amortize = math.ceil(cm.dispatch_overhead_s / target)
     return int(max(1, min(cap, k_amortize, k_budget)))
+
+
+def choose_residency(k: int, checkpoint_every: int = 10,
+                     preempt_latency_iters: Optional[int] = None,
+                     cap: int = 64) -> int:
+    """Cadence C (in supersteps) for the device-resident whole-run
+    driver — :func:`choose_superstep` extended past the dispatch axis:
+    K fixed how many iterations one PROGRAM advances; C fixes how many
+    supersteps run between HOST callbacks once the loop itself lives on
+    device (``optimize/resident_driver.py``).
+
+    The choice rule, and the resident-vs-superstep crossover it
+    records: residency only pays when a cadence window holds at least
+    **2 supersteps** — at C=1 the resident loop would call back to the
+    host exactly as often as the superstep driver dispatches, paying
+    the io_callback round trip where the superstep driver pays the
+    (comparable, ``dispatch_overhead_s``-calibrated) dispatch tax, for
+    no structural win; BENCH_RESIDENT.json measures the counts.  So C
+    is the LARGEST window that respects the two host-side bounds, and 0
+    (keep the superstep driver) when that window is smaller than 2:
+
+    * **checkpoint cadence** — the window may not exceed
+      ``checkpoint_every`` iterations, or cadence saves (replayed
+      inside the window callback) would trail their legacy iterations
+      by a whole window;
+    * **preemption latency** — stop signals are polled once per window,
+      so the window may not exceed the preemption-latency budget
+      (defaults to ``checkpoint_every``, the same grace-window
+      reasoning as ADVICE.md's K <= checkpoint_every rule).
+
+    ``cap`` bounds C itself (supersteps per window) as a backstop; the
+    ring buffer stages ``C*K`` steps of history, and its ROW bound
+    comes from the budget above — ``C*K`` never exceeds
+    ``min(checkpoint_every, preempt_latency_iters)`` iterations, the
+    same staging-vs-cadence reasoning as ``choose_superstep``'s cap."""
+    K = max(1, int(k))
+    if K < 2:
+        return 0  # residency rides the fused executor; no K, no ring
+    budget_iters = min(
+        max(1, int(checkpoint_every)),
+        max(1, int(preempt_latency_iters))
+        if preempt_latency_iters is not None else max(
+            1, int(checkpoint_every)),
+    )
+    c = min(int(cap), budget_iters // K)
+    return int(c) if c >= 2 else 0
 
 
 def _fmt_gb(b: float) -> str:
@@ -599,6 +666,7 @@ def plan(
     host_resident_ok: bool = True,
     cost_model: CostModel = DEFAULT_COST_MODEL,
     force: Optional[str] = None,
+    checkpoint_every: int = 10,
 ) -> Plan:
     """Pick an execution schedule for an ``(n, d)`` dense dataset.
 
@@ -625,6 +693,10 @@ def plan(
     * ``force`` — schedule name to apply regardless; the planner still
       runs its estimates and WARNS when the forced choice is estimated to
       lose (e.g. gram with ``build_amortize_iters > num_iterations``).
+    * ``checkpoint_every`` — the optimizer's checkpoint cadence in
+      iterations; bounds the device-residency window
+      (:func:`choose_residency`) so cadence saves and preemption
+      latency stay within one checkpoint interval.
 
     Returns a :class:`Plan`; ``plan.estimates`` records every number the
     decision used.
@@ -789,20 +861,55 @@ def plan(
                     resident_rows=R, estimates=est,
                 )
         if chosen is None:
-            # superstep fusion: single-device only (the meshed feed
-            # keeps the per-iteration driver), budgeted against the
-            # free HBM a streamed schedule leaves idle — a quarter of
-            # it caps the double-buffered superchunk staging
+            # superstep fusion: single-device only (the meshed feed now
+            # fuses too, but through per-superstep host staging the
+            # planner does not yet model), budgeted against the free
+            # HBM a streamed schedule leaves idle — a quarter of it
+            # caps the double-buffered superchunk staging; the shared
+            # full-batch feed stages nothing (one transfer, the scan
+            # reuses it), so only the amortization target binds there
             K = 1
             if n_devices == 1:
-                K = choose_superstep(window_rows, d, itemsize,
-                                     streamed_iter_s, free_hbm * 0.25,
-                                     cost_model=cm)
+                # the shared full-batch feed transfers ONCE and then
+                # iterates at the device rate, so its dispatch-tax
+                # amortization is judged against stock_iter_s, not the
+                # per-iteration feed slope (which it never pays after
+                # the first transfer); it also stages no superchunk
+                K = choose_superstep(
+                    window_rows, d, itemsize,
+                    stock_iter_s if full_batch else streamed_iter_s,
+                    math.inf if full_batch else free_hbm * 0.25,
+                    cost_model=cm)
             est["superstep"] = K
+            # device residency: the run loop itself moves on device
+            # when the feed is device-resident-data (full batch) and a
+            # cadence window holds >= 2 supersteps (choose_residency's
+            # crossover rule) — host hops drop from one per superstep
+            # to one per window, and dispatches to one per run
+            Cres = 0
+            if n_devices == 1 and full_batch and K > 1:
+                # under residency K no longer buys dispatch savings
+                # (the whole run is one dispatch regardless) — shrink
+                # it into the ADVICE K <= checkpoint_every rule, halved
+                # so the cadence window holds >= 2 supersteps; the
+                # shrink only sticks if residency actually engages —
+                # when choose_residency still says 0 (a tight
+                # checkpoint cadence), the dispatch tax IS the cost
+                # model again and the unshrunk amortizing K wins
+                K_res = max(2, min(K, max(1, int(checkpoint_every) // 2)))
+                Cres = choose_residency(K_res, checkpoint_every)
+                if Cres:
+                    K = K_res
+                    est["superstep"] = K
+            est["residency"] = Cres
             fused_note = (
                 f"; K={K} fused steps per dispatch amortize the "
                 f"~{cm.dispatch_overhead_s * 1e3:.1f} ms/iter host "
                 "dispatch tax" if K > 1 else "")
+            if Cres:
+                fused_note += (
+                    f"; device-resident run loop (cadence {Cres} "
+                    "supersteps/host hop — one dispatch per run)")
             chosen = Plan(
                 "host_streamed",
                 f"data ({_fmt_gb(data_bytes_local)}) exceeds HBM "
@@ -810,7 +917,7 @@ def plan(
                 "double-buffered per-iteration batches "
                 f"(~{streamed_iter_s:.2f}s/iter at {cm.host_feed_gb_s} "
                 f"GB/s feed){fused_note}",
-                superstep=K, estimates=est,
+                superstep=K, residency=Cres, estimates=est,
             )
 
     if not host_resident_ok and chosen.schedule in (
@@ -1171,4 +1278,5 @@ def plan_for(optimizer, X, y, cost_model: Optional[CostModel] = None,
         host_resident_ok=host_resident_ok,
         cost_model=cost_model or DEFAULT_COST_MODEL,
         force=force,
+        checkpoint_every=int(getattr(optimizer, "checkpoint_every", 10)),
     )
