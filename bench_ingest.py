@@ -111,6 +111,7 @@ def wire_stage_wall(Xs, y, idx):
     for s in range(0, ROWS, CHUNK):
         a = jax.device_put(Xs[idx[s:s + CHUNK]])
         b = jax.device_put(y[idx[s:s + CHUNK]])
+        # graftlint: disable=host-sync -- stage-isolation bench: blocking per chunk IS the wire-wall measurement
         jax.block_until_ready((a, b))
     return time.perf_counter() - t0
 

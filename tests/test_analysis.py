@@ -17,10 +17,14 @@ import pytest
 
 from tpu_sgd.analysis.core import (Config, Finding, KNOWN_RULES, ModuleFile,
                                    run_lint)
+from tpu_sgd.analysis.rules_callback import CallbackDisciplineRule
+from tpu_sgd.analysis.rules_carry import CarryStabilityRule
 from tpu_sgd.analysis.rules_donation import DonationSafetyRule
 from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
 from tpu_sgd.analysis.rules_lock import LockDisciplineRule
+from tpu_sgd.analysis.rules_memo import MemoKeyRule
 from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
+from tpu_sgd.analysis.rules_sync import HostSyncRule
 from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
                                       LocksetRecorder, assert_compile_count,
                                       instrument_object)
@@ -473,15 +477,21 @@ def test_mutation_deleted_lock_block_fails_lint():
 
 def test_every_rule_fires_on_its_seeded_violation():
     """One seeded violation per rule, one combined sweep: each of the
-    five rules must report exactly its own planted bug."""
+    nine rules must report exactly its own planted bug."""
     registry = {"io.feed": "seeded.py"}
     seeded = mod("""
         import threading
         import jax
         import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+        from jax.experimental import io_callback
         from functools import partial
 
         GRAFTLINT_LOCKS = {"S": {"_q": "_lock"}}
+
+        HIST = []
+        _PROGRAMS = {}
 
         class S:
             def __init__(self):
@@ -495,6 +505,8 @@ def test_every_rule_fires_on_its_seeded_violation():
         def acc(G, Gi):
             return G + Gi
 
+        step = jax.jit(lambda w: w * 2)
+
         def host(X, G, Gi):
             Xp = jnp.pad(X, ((0, 1), (0, 0)))
             out = acc(G, Gi)
@@ -502,10 +514,37 @@ def test_every_rule_fires_on_its_seeded_violation():
             for _ in range(2):
                 f = jax.jit(lambda a: a)
             return Xp, out, use_after, f
+
+        def drive(w, n):
+            hist = []
+            for _ in range(n):
+                w = step(w)
+                hist.append(float(w))
+            return hist
+
+        def leaky_cb(x):
+            HIST.append(x)
+            return x
+
+        def resident(w):
+            def body(carry):
+                i, w = carry
+                r = io_callback(leaky_cb, w, w)
+                return (i + 1, r)
+            return lax.while_loop(lambda c: c[0] < 3, body, (0, w))
+
+        def program_for(k, lr):
+            fn = _PROGRAMS.get(k)
+            if fn is None:
+                fn = jax.jit(lambda w: w * lr)
+                _PROGRAMS[k] = fn
+            return fn
     """, relpath="seeded.py")
-    res = lint([seeded], [
-        ShapeTrapRule(), LockDisciplineRule(), DonationSafetyRule(),
-        FailpointCoverageRule(registry=registry), EagerInLoopRule()])
+    from tpu_sgd.analysis.core import default_rules
+    rules = [FailpointCoverageRule(registry=registry)
+             if r.name == "failpoint-coverage" else r
+             for r in default_rules()]
+    res = lint([seeded], rules)
     fired = {f.rule for f in res.findings}
     assert set(KNOWN_RULES) <= fired, (
         f"rules that failed to fire: {set(KNOWN_RULES) - fired}")
@@ -716,3 +755,821 @@ def test_instrumented_condition_wait_releases_lockset():
     t.join(timeout=5)
     assert not t.is_alive()
     assert observed == [("pre", True), ("post", True)]
+
+
+# -- host-sync (dataflow) ----------------------------------------------------
+
+def test_host_sync_fires_on_scalar_coercions_in_loop():
+    res = lint(mod("""
+        import jax
+
+        step = jax.jit(lambda w: w * 2)
+
+        def drive(w, n):
+            hist = []
+            for _ in range(n):
+                w = step(w)
+                hist.append(float(w))
+            return hist
+    """), [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert len(found) == 1 and "float()" in found[0].message
+
+
+def test_host_sync_fires_on_implicit_bool_and_while_test():
+    res = lint(mod("""
+        import jax
+
+        step = jax.jit(lambda w: w)
+
+        def poll(w):
+            flag = step(w)
+            while flag:
+                flag = step(flag)
+    """), [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert len(found) == 1 and "bool()" in found[0].message
+
+
+def test_host_sync_fires_on_comparison_bool_test():
+    """`if c > 0:` on a device value builds a device bool then coerces
+    it — same per-trip sync as a bare-name test; and a host rebind
+    (`c = int(c)`, itself flagged) releases the name for later tests."""
+    res = lint(mod("""
+        import jax
+
+        step = jax.jit(lambda w: w)
+
+        def poll(w, n):
+            for _ in range(n):
+                w = step(w)
+                if w > 0:
+                    break
+
+        def drain(c):
+            c = step(c)
+            while c > 0:
+                c = step(c)
+    """), [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert len(found) == 2
+    assert all("bool()" in f.message for f in found)
+
+    res = lint(mod("""
+        import jax
+
+        step = jax.jit(lambda w: w)
+
+        def drive(w, n):
+            for _ in range(n):
+                w = step(w)
+                c = int(w)  # graftlint: disable=host-sync -- one sanctioned fetch
+                if c > 0:
+                    break
+    """), [HostSyncRule()])
+    assert by_rule(res, "host-sync") == []
+
+
+def test_host_sync_interprocedural_flags_loop_borne_call_site():
+    """A helper that forces the sync internally is flagged at its
+    loop-borne call site — the line that pays."""
+    res = lint(mod("""
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda w: w * 2)
+
+        def fetch(v):
+            return np.asarray(v)
+
+        def drive(w, n):
+            for _ in range(n):
+                w = step(w)
+                fetch(w)
+    """), [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert len(found) == 1
+    assert "fetch" in found[0].message and found[0].line == 13
+
+
+def test_host_sync_silent_on_boundary_fetch_and_traced_loops():
+    """No finding for: a fetch AFTER the loop (the contract), the
+    sanctioned genexp bulk fetch, a loop inside a traced function, and
+    values the rule cannot prove device-resident."""
+    res = lint(mod("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        step = jax.jit(lambda w: w * 2)
+
+        def drive(w, n):
+            for _ in range(n):
+                w = step(w)
+            return float(w)
+
+        def bulk(w, n):
+            ys = step(w)
+            for _ in range(n):
+                w = step(w)
+            return tuple(np.asarray(a) for a in (w, ys))
+
+        @jax.jit
+        def traced(w):
+            for _ in range(3):
+                w = jnp.sin(w)
+            return w
+
+        def host_numpy(rows, n):
+            out = []
+            for r in rows:
+                out.append(np.asarray(r))
+            return out
+    """), [HostSyncRule()])
+    assert by_rule(res, "host-sync") == []
+
+
+def test_host_sync_silent_on_for_iterable_and_else_clause():
+    """A for's iterable and a loop's else clause evaluate ONCE — the
+    one-fetch-then-iterate spelling must not fire; the same fetch moved
+    into the body still does, and an iterable fetch nested inside an
+    OUTER loop's body is per-outer-trip and fires."""
+    res = lint(mod("""
+        import jax
+        import numpy as np
+
+        count = jax.jit(lambda w: w.sum())
+
+        def once(w, rows):
+            n = count(w)
+            for i in range(int(n)):
+                rows.append(i)
+            else:
+                tail = float(n)
+            return tail
+
+        def per_trip(w, rows):
+            n = count(w)
+            for _ in rows:
+                k = int(n)
+            return k
+
+        def per_outer_trip(w, grids):
+            n = count(w)
+            for g in grids:
+                for i in range(int(n)):
+                    g.append(i)
+    """), [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert len(found) == 2
+    assert {f.line for f in found} == {18, 24}
+
+
+# -- callback-discipline -----------------------------------------------------
+
+def test_callback_unordered_consumed_result_and_leaky_target():
+    res = lint(mod("""
+        import jax
+        from jax.experimental import io_callback
+
+        HIST = []
+
+        def bad_cb(x):
+            HIST.append(x)
+            return x
+
+        def body(x):
+            r = io_callback(bad_cb, x, x)
+            return r
+    """), [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "not ordered=True" in msgs
+    assert "exception cross the FFI boundary" in msgs
+    assert "appends to closure variable" in msgs
+
+
+def test_callback_clean_site_passes():
+    """ordered=True + stash-flag-reraise guard + bookkeeper-owned state:
+    the resident_driver contract, distilled."""
+    res = lint(mod("""
+        import numpy as np
+        from jax.experimental import io_callback
+
+        class Keeper:
+            def on_window(self, start, ws):
+                try:
+                    self.last = np.asarray(ws)
+                    return np.zeros((), np.bool_)
+                except BaseException as e:
+                    self.error = e
+                    return np.ones((), np.bool_)
+
+        def build(keeper, spec):
+            def fire(start, ws):
+                return io_callback(keeper.on_window, spec, start, ws,
+                                   ordered=True)
+            return fire
+    """), [CallbackDisciplineRule()])
+    assert by_rule(res, "callback-discipline") == []
+
+
+def test_callback_fire_and_forget_unordered_is_fine():
+    """An Expr-statement callback (result unused) may stay unordered —
+    no bookkeeping is driven by its result."""
+    res = lint(mod("""
+        from jax.experimental import io_callback
+
+        def tick(x):
+            try:
+                print(x)
+            except BaseException:
+                pass
+
+        def body(x):
+            io_callback(tick, None, x)
+            return x
+    """), [CallbackDisciplineRule()])
+    assert by_rule(res, "callback-discipline") == []
+
+
+def test_callback_reraising_handler_is_still_leaky():
+    res = lint(mod("""
+        from jax.experimental import io_callback
+
+        def cb(x):
+            try:
+                return x
+            except BaseException:
+                raise
+
+        def body(x):
+            r = io_callback(cb, x, x, ordered=True)
+            return r
+    """), [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    assert len(found) == 1
+    assert "exception cross the FFI boundary" in found[0].message
+
+
+def test_callback_target_resolution_survives_name_collision():
+    """An unrelated `def on_window` elsewhere in the lint set must not
+    silently void the contract checks: the call site's own module wins
+    the tie, and a collision with NO local def is itself a finding."""
+    caller = mod("""
+        from jax.experimental import io_callback
+
+        class Keeper:
+            def on_window(self, x):
+                return x
+
+        def build(keeper, spec):
+            def fire(x):
+                return io_callback(keeper.on_window, spec, x,
+                                   ordered=True)
+            return fire
+    """, "caller_mod.py")
+    other = mod("""
+        class Widget:
+            def on_window(self, event):
+                return event
+    """, "other_mod.py")
+    # alone: the unguarded local target is flagged
+    res = lint([caller], [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    assert len(found) == 1
+    assert "exception cross the FFI boundary" in found[0].message
+    # with the colliding module: SAME finding — local def still wins
+    res = lint([caller, other], [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    assert len(found) == 1
+    assert "exception cross the FFI boundary" in found[0].message
+
+    # no local def + several remote candidates: ambiguity is loud
+    remote_caller = mod("""
+        from jax.experimental import io_callback
+
+        def build(hooks, spec):
+            def fire(x):
+                return io_callback(hooks.on_window, spec, x,
+                                   ordered=True)
+            return fire
+    """, "remote_caller.py")
+    other2 = mod("""
+        class Panel:
+            def on_window(self, event):
+                return event
+    """, "other2_mod.py")
+    res = lint([remote_caller, other, other2],
+               [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    assert len(found) == 1
+    assert "matches several defs" in found[0].message
+
+
+# -- carry-stability ---------------------------------------------------------
+
+def test_carry_fires_on_python_scalar_init():
+    res = lint(mod("""
+        import jax
+        from jax import lax
+
+        def run(w):
+            def body(carry):
+                i, wc = carry
+                return (i + 1, wc * 2)
+            return lax.while_loop(lambda c: c[0] < 3, body, (0, w))
+    """), [CarryStabilityRule()])
+    found = by_rule(res, "carry-stability")
+    assert len(found) == 1 and "WEAK-typed" in found[0].message
+
+
+def test_carry_fires_on_scalar_reset_in_body():
+    res = lint(mod("""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs, w):
+            def body(c, x):
+                return (0, c[1] + x)
+            init = (jnp.asarray(0, jnp.int32), w)
+            return lax.scan(body, init, xs)
+    """), [CarryStabilityRule()])
+    found = by_rule(res, "carry-stability")
+    assert len(found) == 1 and "re-enters" in found[0].message
+
+
+def test_carry_silent_on_pinned_init_and_device_reset():
+    res = lint(mod("""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def run(xs, w):
+            def body(c, x):
+                slot = jnp.where(x > 0, jnp.zeros_like(c[0]), c[0])
+                return (slot, c[1] + x), x
+            init = (jnp.asarray(0, jnp.int32), w)
+            return lax.scan(body, init, xs)
+
+        def local_scan_helper_does_not_fire(scan, data):
+            return scan(lambda c, x: (0, c), 0, data)
+    """), [CarryStabilityRule()])
+    assert by_rule(res, "carry-stability") == []
+
+
+def test_carry_silent_on_non_jax_lax_lookalikes():
+    """Only `lax` / `*.lax` heads are loop entries: `flax.while_loop`
+    or a `parallax.scan` must not fire (the substring-match trap), while
+    the real `jax.lax` spellings still do."""
+    res = lint(mod("""
+        import flax
+        import parallax
+
+        def run(w):
+            flax.while_loop(lambda c: c, lambda c: c, (0, w))
+            return parallax.scan(lambda c, x: (0, c), 0, w)
+    """), [CarryStabilityRule()])
+    assert by_rule(res, "carry-stability") == []
+
+    res = lint(mod("""
+        import jax
+        from jax import lax
+
+        def run(w, xs):
+            jax.lax.while_loop(lambda c: c[0] < 3,
+                               lambda c: (c[0] + 1, c[1]), (0, w))
+            return lax.scan(lambda c, x: (c, x), 0.0, xs)
+    """), [CarryStabilityRule()])
+    assert len(by_rule(res, "carry-stability")) == 2
+
+
+def test_carry_fires_on_keyword_init_and_body():
+    """`lax.scan(body, init=(0, w), xs=xs)` and
+    `lax.while_loop(..., init_val=..., body_fun=...)` are standard
+    spellings — keyword-passed carries must not slip the net."""
+    res = lint(mod("""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def kw_init(w, xs):
+            return lax.scan(lambda c, x: (c, x), init=(0, w), xs=xs)
+
+        def kw_body_reset(w, xs):
+            init = (jnp.asarray(0, jnp.int32), w)
+            return lax.scan(xs=xs, init=init,
+                            f=lambda c, x: ((0, c[1] + x), x))
+
+        def kw_while(w):
+            return lax.while_loop(
+                cond_fun=lambda c: c[0] < 3,
+                body_fun=lambda c: (c[0] + 1, c[1]),
+                init_val=(0, w))
+    """), [CarryStabilityRule()])
+    found = by_rule(res, "carry-stability")
+    assert len(found) == 3
+    msgs = " | ".join(f.message for f in found)
+    assert "WEAK-typed" in msgs and "re-enters" in msgs
+
+
+# -- memo-key ----------------------------------------------------------------
+
+def test_memo_local_alias_store_attaches_to_declared_cache():
+    """`cache = self._cache; cache[key] = fn` — the idiomatic local
+    alias must attach to the declaration (no never-stores drift, no
+    undeclared-alias finding), and its factory check still works."""
+    res = lint(mod("""
+        import jax
+
+        GRAFTLINT_MEMO = {"Engine._cache": ("size",)}
+
+        class Engine:
+            def __init__(self, size):
+                self._cache = {}
+                self.size = size
+
+            def program_for(self):
+                cache = self._cache
+                key = (self.size,)
+                fn = cache.get(key)
+                if fn is None:
+                    fn = jax.jit(lambda x: x * self.size)
+                    cache[key] = fn
+                return fn
+    """), [MemoKeyRule()])
+    assert by_rule(res, "memo-key") == []
+
+
+def test_memo_undeclared_program_cache_is_a_finding():
+    res = lint(mod("""
+        import jax
+
+        _CACHE = {}
+
+        def program_for(key):
+            fn = _CACHE.get(key)
+            if fn is None:
+                fn = jax.jit(lambda x: x)
+                _CACHE[key] = fn
+            return fn
+    """), [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    assert len(found) == 1 and "no GRAFTLINT_MEMO entry" in found[0].message
+
+
+def test_memo_declared_cache_with_complete_key_passes():
+    res = lint(mod("""
+        import jax
+
+        _CACHE = {}
+        GRAFTLINT_MEMO = {"_CACHE": ("key", "lr")}
+
+        def program_for(key, lr):
+            fn = _CACHE.get((key, lr))
+            if fn is None:
+                fn = jax.jit(lambda w: w * lr)
+                _CACHE[(key, lr)] = fn
+            return fn
+    """), [MemoKeyRule()])
+    assert by_rule(res, "memo-key") == []
+
+
+def test_memo_declaration_drift_both_directions():
+    res = lint(mod("""
+        import jax
+
+        _CACHE = {}
+        GRAFTLINT_MEMO = {"_CACHE": ("key", "ghost")}
+
+        def program_for(key, flavor):
+            fn = jax.jit(lambda x: x + len(flavor))
+            _CACHE[(key, flavor)] = fn
+            return fn
+    """), [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    msgs = " | ".join(f.message for f in found)
+    assert "'ghost'" in msgs and "no store site's key derives" in msgs
+    assert "'flavor'" in msgs and "does not list it" in msgs
+
+
+def test_memo_factory_read_outside_key_is_a_finding():
+    """THE incomplete-memo-key bug: the stored program bakes in ``lr``
+    but the key does not carry it — two configs share one program."""
+    res = lint(mod("""
+        import jax
+
+        _CACHE = {}
+        GRAFTLINT_MEMO = {"_CACHE": ("k",)}
+
+        def program_for(k, lr):
+            fn = jax.jit(lambda w: w * lr)
+            _CACHE[k] = fn
+            return fn
+    """), [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    assert any("`lr`" in f.message and "key does not include it"
+               in f.message for f in found)
+
+
+def test_memo_missing_cache_and_malformed_declaration():
+    res = lint(mod("""
+        GRAFTLINT_MEMO = {"_GONE": ("key",)}
+    """), [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    assert len(found) == 1 and "no such name" in found[0].message
+
+    res = lint(mod("""
+        GRAFTLINT_MEMO = {"_C": "not-a-tuple"}
+        _C = {}
+    """), [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    assert len(found) == 1 and "literal" in found[0].message
+
+
+# -- call-graph upgrades (lock + donation) -----------------------------------
+
+def test_lock_private_helper_proven_by_locked_call_sites():
+    """The _swap pattern: every in-class call site of the private helper
+    holds the lock, so its unlocked accesses pass without suppression."""
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"R": {"_model": "_lock"}}
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._model = None
+
+            def _swap(self, m):
+                self._model = m
+
+            def reload(self, m):
+                with self._lock:
+                    self._swap(m)
+
+            def rollback(self, m):
+                with self._lock:
+                    self._swap(m)
+    """), [LockDisciplineRule()])
+    assert by_rule(res, "lock-discipline") == []
+
+
+def test_lock_one_unlocked_call_site_voids_the_proof():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"R": {"_model": "_lock"}}
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._model = None
+
+            def _swap(self, m):
+                self._model = m
+
+            def reload(self, m):
+                with self._lock:
+                    self._swap(m)
+
+            def sloppy(self, m):
+                self._swap(m)
+    """), [LockDisciplineRule()])
+    found = by_rule(res, "lock-discipline")
+    assert len(found) == 1 and "_model" in found[0].message
+
+
+def test_donation_forwarder_one_call_level():
+    """helper() forwards its param into a donated position, so calling
+    helper(G) donates G — a later read of G is a finding."""
+    res = lint(mod("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def acc(G, Gi):
+            return G + Gi
+
+        def helper(G, Gi):
+            return acc(G, Gi)
+
+        def use(G, Gi):
+            out = helper(G, Gi)
+            tail = G.sum()
+            return out, tail
+    """), [DonationSafetyRule()])
+    found = by_rule(res, "donation-safety")
+    assert len(found) == 1 and "helper" in found[0].message
+
+
+def test_donation_forwarder_voided_by_param_rebind():
+    res = lint(mod("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def acc(G, Gi):
+            return G + Gi
+
+        def safe_helper(G, Gi):
+            G = G + 0  # a fresh buffer is donated, not the caller's
+            return acc(G, Gi)
+
+        def use(G, Gi):
+            out = safe_helper(G, Gi)
+            tail = G.sum()
+            return out, tail
+    """), [DonationSafetyRule()])
+    assert by_rule(res, "donation-safety") == []
+
+
+# -- stale suppressions ------------------------------------------------------
+
+def test_stale_suppression_is_a_finding():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def clean(x):
+            return x + 1  # graftlint: disable=shape-trap -- historical
+    """), [ShapeTrapRule()])
+    found = by_rule(res, "stale-suppression")
+    assert len(found) == 1 and "no longer fires" in found[0].message
+
+
+def test_live_suppression_is_not_stale():
+    res = lint(mod("""
+        import jax.numpy as jnp
+
+        def host_assemble(X, tail):
+            return jnp.pad(X, ((0, tail), (0, 0)))  # graftlint: disable=shape-trap -- fixture: intentionally eager
+    """), [ShapeTrapRule()])
+    assert by_rule(res, "stale-suppression") == []
+    assert by_rule(res, "shape-trap") == []
+    assert res.suppressed == 1
+
+
+def test_stale_not_reported_for_rules_that_did_not_run():
+    """Staleness is only provable when the rule had its chance to fire:
+    a host-sync suppression is NOT stale under a shape-trap-only run."""
+    res = lint(mod("""
+        def clean(x):
+            return x + 1  # graftlint: disable=host-sync -- not checked this run
+    """), [ShapeTrapRule()])
+    assert by_rule(res, "stale-suppression") == []
+
+
+def test_stale_all_wildcard_needs_every_rule_to_have_run():
+    """A `disable=all` wildcard is only provably stale when EVERY known
+    rule had its chance to fire: under a shape-trap-only run the
+    host-sync finding it eats never existed, so the wildcard must not
+    be reported stale — but under the full default rule set a clean
+    line's wildcard is."""
+    from tpu_sgd.analysis.core import default_rules
+    src = """
+        import jax
+
+        step = jax.jit(lambda w: w)
+
+        def drive(w, n):
+            for _ in range(n):
+                w = step(w)
+                probe = w.item()  # graftlint: disable=all -- intentional probe
+            return probe
+    """
+    res = lint(mod(src), [ShapeTrapRule()])
+    assert by_rule(res, "stale-suppression") == []
+
+    clean = """
+        def clean(x):
+            return x + 1  # graftlint: disable=all -- nothing here
+    """
+    res = lint(mod(clean), default_rules())
+    found = by_rule(res, "stale-suppression")
+    assert len(found) == 1 and "'all'" in found[0].message
+    res = lint(mod(clean), [ShapeTrapRule()])
+    assert by_rule(res, "stale-suppression") == []
+
+
+# -- real-module mutation checks (graftlint v2) ------------------------------
+
+def test_mutation_deleted_memo_key_field_fails_lint():
+    """Delete the 'X' key field from streamed.py's _RESIDENT_LOOPS
+    declaration: the memo-key drift check must catch it."""
+    intact = _real_module("tpu_sgd/optimize/streamed.py")
+    res = lint([intact], [MemoKeyRule()])
+    assert by_rule(res, "memo-key") == []
+
+    mutated = _real_module(
+        "tpu_sgd/optimize/streamed.py",
+        lambda s: s.replace('"resident_cadence", "X"),',
+                            '"resident_cadence"),'))
+    res = lint([mutated], [MemoKeyRule()])
+    found = by_rule(res, "memo-key")
+    assert any("'X'" in f.message and "does not list it" in f.message
+               for f in found)
+
+
+def test_mutation_item_in_resident_loop_body_fails_lint():
+    """Insert a ``.item()`` on the carried weights inside the observed
+    streamed loop: the host-sync rule must catch the new per-iteration
+    sync."""
+    gd = _real_module("tpu_sgd/optimize/gradient_descent.py")
+    intact = _real_module("tpu_sgd/optimize/streamed.py")
+    res = lint([intact, gd], [HostSyncRule()])
+    assert by_rule(res, "host-sync") == []
+
+    mutated = _real_module(
+        "tpu_sgd/optimize/streamed.py",
+        lambda s: s.replace(
+            "                w = new_w\n",
+            "                w = new_w\n"
+            "                probe = w.item()\n", 1))
+    res = lint([mutated, gd], [HostSyncRule()])
+    found = by_rule(res, "host-sync")
+    assert any(".item()" in f.message for f in found)
+
+
+def test_mutation_unguarded_resident_callback_fails_lint():
+    """Make the real `on_window` handler re-raise (breaking the
+    stash-flag-reraise contract): callback-discipline must flag the
+    io_callback site — proof the attribute-hop target resolution
+    actually attaches the contract to the resident driver."""
+    intact = _real_module("tpu_sgd/optimize/resident_driver.py")
+    res = lint([intact], [CallbackDisciplineRule()])
+    assert by_rule(res, "callback-discipline") == []
+
+    mutated = _real_module(
+        "tpu_sgd/optimize/resident_driver.py",
+        lambda s: s.replace(
+            "self.error = e\n            return np.bool_(True)",
+            "self.error = e\n            raise"))
+    res = lint([mutated], [CallbackDisciplineRule()])
+    found = by_rule(res, "callback-discipline")
+    assert len(found) == 1
+    assert "on_window" in found[0].message
+    assert "exception cross the FFI boundary" in found[0].message
+
+
+# -- runtime twins: host-sync + callback buffers -----------------------------
+
+def test_count_host_syncs_counts_coercions_not_cache_hits():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis.runtime import count_host_syncs
+
+    f = jax.jit(lambda x: x * 2)
+    a = f(jnp.arange(8.0))
+    jax.block_until_ready(a)
+    with count_host_syncs() as c:
+        float(a[0])          # scalar coercion: one transfer
+        a.__array__()        # materializes (and caches) the array
+        a.__array__()        # cached: free
+        jax.block_until_ready(a)  # barrier, never a transfer
+    assert c["n"] == 2
+    assert all(isinstance(s, tuple) for s, _ in c["shapes"])
+
+
+def test_assert_no_host_sync_raises_and_allows():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis.runtime import (HostSyncError,
+                                          assert_no_host_sync)
+
+    f = jax.jit(lambda x: x + 1)
+    a = f(jnp.arange(4.0))
+    jax.block_until_ready(a)
+    with pytest.raises(HostSyncError) as ei:
+        with assert_no_host_sync():
+            a.item(0)
+    assert "device->host transfer" in str(ei.value)
+
+    b = f(jnp.arange(4.0))
+    with assert_no_host_sync(allow=1):
+        float(b[1])
+
+    # call-through form: dispatching is not syncing
+    out = assert_no_host_sync(lambda: f(jnp.arange(4.0)))
+    assert out.shape == (4,)
+
+
+def test_assert_bounded_callback_buffer():
+    import numpy as np
+
+    from tpu_sgd.analysis.runtime import (CallbackBufferError,
+                                          assert_bounded_callback_buffer)
+
+    grows = []
+    with pytest.raises(CallbackBufferError):
+        with assert_bounded_callback_buffer(grows):
+            grows.append(1)
+
+    ring = np.zeros(16)
+    with assert_bounded_callback_buffer(lambda: ring):
+        ring[3] = 1.0  # overwrite in place: bounded
+
+    capped = [1, 2]
+    with assert_bounded_callback_buffer(capped, max_len=4):
+        capped.append(3)

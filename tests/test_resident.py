@@ -283,6 +283,40 @@ def test_resident_loop_compiles_one_program(rng):
         o.optimize_with_history((X, y), w0)
 
 
+def test_resident_warmed_window_no_host_sync(rng):
+    """graftlint v2's runtime twin on the real driver: a warmed resident
+    run forces host syncs proportional to CADENCE WINDOWS, never to
+    iterations — one tiny int32 scalar per window (the ordered
+    callback's ``win_start``) plus the three documented end-of-run
+    boundary scalars, every one of them shape-() (no bulk fetch rides
+    along).  Doubling the iteration budget at fixed cadence doubles
+    windows, not per-iteration syncs."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis import assert_no_host_sync
+    from tpu_sgd.optimize.resident_driver import ResidentBookkeeper
+
+    X, y = _data(rng, n=400, d=6)
+    w0 = np.zeros(6, np.float32)
+
+    def run_counted(iters):
+        o = _opt("sliced", iters=iters, k=4, c=2)
+        o.optimize_with_history((X, y), w0)  # warm the compile
+        key = ("resident", o.gradient, o.updater, o.config, 4, 2)
+        loop = o._run_cache[key]
+        hooks = ResidentBookkeeper(o.config, 4, 2, losses=[],
+                                   reg_val=0.0, start_iter=1)
+        windows = iters // (4 * 2)
+        with assert_no_host_sync(allow=windows + 3) as counter:
+            loop.run(jnp.asarray(w0), 0.0, 1,
+                     (jnp.asarray(X), jnp.asarray(y)), hooks)
+        assert counter["n"] == windows + 3
+        assert all(shape == () for shape, _ in counter["shapes"])
+        return counter["n"]
+
+    assert run_counted(64) - run_counted(32) == (64 - 32) // (4 * 2)
+
+
 # ---- stop signal / preemption ----------------------------------------------
 
 def test_resident_stop_latency_bounded_by_cadence_window(rng, tmp_path):

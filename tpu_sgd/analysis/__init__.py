@@ -13,16 +13,23 @@ CLI: ``python -m tpu_sgd.analysis.lint``.  Suppress one line with
 
 from tpu_sgd.analysis.core import (Finding, KNOWN_RULES, LintResult,
                                    ModuleFile, Rule, load_config, run_lint)
-from tpu_sgd.analysis.runtime import (CompileCountError, DispatchCountError,
-                                      InstrumentedLock, LocksetRecorder,
+from tpu_sgd.analysis.runtime import (CallbackBufferError,
+                                      CompileCountError, DispatchCountError,
+                                      HostSyncError, InstrumentedLock,
+                                      LocksetRecorder,
+                                      assert_bounded_callback_buffer,
                                       assert_compile_count,
                                       assert_dispatch_count,
-                                      count_dispatches, instrument_object)
+                                      assert_no_host_sync,
+                                      count_dispatches, count_host_syncs,
+                                      instrument_object)
 
 __all__ = [
     "Finding", "KNOWN_RULES", "LintResult", "ModuleFile", "Rule",
     "load_config", "run_lint",
-    "CompileCountError", "DispatchCountError", "InstrumentedLock",
-    "LocksetRecorder", "assert_compile_count", "assert_dispatch_count",
-    "count_dispatches", "instrument_object",
+    "CallbackBufferError", "CompileCountError", "DispatchCountError",
+    "HostSyncError", "InstrumentedLock", "LocksetRecorder",
+    "assert_bounded_callback_buffer", "assert_compile_count",
+    "assert_dispatch_count", "assert_no_host_sync", "count_dispatches",
+    "count_host_syncs", "instrument_object",
 ]

@@ -44,10 +44,15 @@ KNOWN_RULES = (
     "donation-safety",
     "failpoint-coverage",
     "eager-in-loop",
+    "host-sync",
+    "callback-discipline",
+    "carry-stability",
+    "memo-key",
 )
 
 #: core policy checks (not AST rules; emitted by the runner itself)
-POLICY_CHECKS = ("bare-suppression", "unknown-rule", "parse-error")
+POLICY_CHECKS = ("bare-suppression", "unknown-rule", "parse-error",
+                 "stale-suppression")
 
 
 @dataclass(frozen=True)
@@ -282,13 +287,18 @@ def load_modules(cfg: Config,
 def default_rules() -> List[Rule]:
     # imported here, not at module top: core must stay import-cycle-free
     # for the rule modules that import it
+    from tpu_sgd.analysis.rules_callback import CallbackDisciplineRule
+    from tpu_sgd.analysis.rules_carry import CarryStabilityRule
     from tpu_sgd.analysis.rules_donation import DonationSafetyRule
     from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
     from tpu_sgd.analysis.rules_lock import LockDisciplineRule
+    from tpu_sgd.analysis.rules_memo import MemoKeyRule
     from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
+    from tpu_sgd.analysis.rules_sync import HostSyncRule
 
     return [ShapeTrapRule(), LockDisciplineRule(), DonationSafetyRule(),
-            FailpointCoverageRule(), EagerInLoopRule()]
+            FailpointCoverageRule(), EagerInLoopRule(), HostSyncRule(),
+            CallbackDisciplineRule(), CarryStabilityRule(), MemoKeyRule()]
 
 
 def _policy_findings(modules: Sequence[ModuleFile],
@@ -328,7 +338,12 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     mods = list(modules) if modules is not None else load_modules(cfg, paths)
     active = [r for r in (rules if rules is not None else default_rules())
               if r.name not in cfg.disable]
-    options = {"config": cfg, "failpoint_registry": cfg.failpoint_registry}
+    # the project-wide dataflow index (call graph, traced closure, sync
+    # summaries, ...) is built ONCE per run and shared by every rule
+    # that needs cross-module facts
+    from tpu_sgd.analysis.dataflow import ProjectIndex
+    options = {"config": cfg, "failpoint_registry": cfg.failpoint_registry,
+               "project": ProjectIndex(mods)}
     raw: List[Finding] = []
     for rule in active:
         raw.extend(rule.run(mods, options))
@@ -336,16 +351,58 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
 
     by_rel = {m.relpath: m for m in mods}
     kept, suppressed = [], 0
+    #: (relpath, target line) -> rule ids a suppression actually ate
+    hit_suppressions: Dict[tuple, Set[str]] = {}
     for f in raw:
         mod = by_rel.get(f.path)
         if (mod is not None and f.rule not in POLICY_CHECKS
                 and mod.is_suppressed(f.rule, f.line)):
             suppressed += 1
+            hit_suppressions.setdefault(
+                (f.path, f.line), set()).add(f.rule)
             continue
         kept.append(f)
+    kept.extend(_stale_suppressions(
+        mods, cfg, {r.name for r in active}, hit_suppressions))
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintResult(findings=kept, suppressed=suppressed,
                       files=len(mods), rules=[r.name for r in active])
+
+
+def _stale_suppressions(modules: Sequence[ModuleFile], cfg: Config,
+                        active_rules: Set[str],
+                        hit: Dict[tuple, Set[str]]) -> List[Finding]:
+    """A ``# graftlint: disable=<rule>`` whose rule no longer fires on
+    its line is itself a finding: dead suppressions read as live
+    hazards and rot into folklore.  A rule that did not RUN (disabled,
+    or a custom rule list) is skipped — staleness is only provable when
+    the rule had its chance to fire."""
+    out = []
+    for mod in modules:
+        for s in mod.suppressions:
+            target = mod._target_line(s)
+            ate = hit.get((mod.relpath, target), set())
+            for r in sorted(s.rules):
+                if r == "all":
+                    # an 'all' wildcard is only provably stale when
+                    # EVERY known rule had its chance to fire — under a
+                    # --disable run or a custom rule list, the rule it
+                    # was written for may simply not have run
+                    if not ate and set(KNOWN_RULES) <= active_rules:
+                        out.append(Finding(
+                            "stale-suppression", mod.relpath, s.line, 0,
+                            "suppression 'all' no longer matches any "
+                            "finding on this line; delete it"))
+                    continue
+                if r not in active_rules:
+                    continue  # unknown (already flagged) or not run
+                if r not in ate:
+                    out.append(Finding(
+                        "stale-suppression", mod.relpath, s.line, 0,
+                        f"suppressed rule {r!r} no longer fires on this "
+                        "line; delete the suppression (or narrow it to "
+                        "the rules that still fire)"))
+    return out
 
 
 @dataclass

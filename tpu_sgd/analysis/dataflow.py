@@ -1,0 +1,606 @@
+"""Project-wide dataflow: call graph, traced-ness, device values, sync
+summaries, and root decomposition.
+
+The PR 4 rules were lexical and module-local; every review finding on
+the device-resident driver (PR 6) fell in a class they cannot see — a
+helper that forces a device→host sync called from a hot loop in another
+function, a compiled-program cache whose key silently lost a field, an
+``io_callback`` target three attribute hops from its trace site.  This
+module is the shared machinery those checks need, built once per lint
+run over EVERY linted module:
+
+* **call graph** — :meth:`ProjectIndex.resolve_call` maps a call
+  expression to candidate defs: same-module by name, cross-module
+  through explicit ``from x import y`` / ``import x as m; m.f`` edges,
+  and ``self.f`` to same-module methods.  Unresolvable calls resolve to
+  nothing — rules err toward silence on edges they cannot prove.
+* **traced-ness, project-wide** — the module-local fixpoint
+  ``tracing.TracedIndex`` runs is generalized: a def passed to
+  ``jax.jit`` in *another* module (resolved through imports) is traced
+  too, so cross-module traced helpers no longer need suppressions.
+* **jitted values** — which names/attributes hold jit-compiled
+  callables (``step = jax.jit(...)``, ``self._fn = jax.jit(...)``,
+  factories that *return* jitted callables, closed transitively), and
+  per-function which local names hold **device values** (results of
+  calling those, plus ``jax.device_put``, closed over plain-name
+  aliasing).
+* **sync summaries** — per def, which parameter positions flow into a
+  device→host sync (``.item()``, ``float()``, ``np.asarray``, ...),
+  closed over the call graph, so ``host-sync`` can flag a sync-forcing
+  helper at its loop-borne call site.
+* **root decomposition** — :meth:`ProjectIndex.local_roots` rewrites a
+  function-local name into the parameter / ``self.<attr>`` /
+  free-variable reads it was derived from (intra-function reaching
+  definitions, one assignment granularity), which is how ``memo-key``
+  decides whether a build-path read is covered by a cache key.
+
+Everything here is AST-only; no checked module is ever imported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tpu_sgd.analysis.core import ModuleFile
+from tpu_sgd.analysis.tracing import (FuncNode, TracedIndex,
+                                      _is_partial_of_tracer,
+                                      _is_tracer_callable, dotted_name,
+                                      enclosing, last_seg)
+
+DefNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: attribute/name calls that materialize a device value on the host.
+#: ``block_until_ready`` is a barrier, not a transfer, but on a hot
+#: loop it stalls the dispatch pipeline the same way — the ISSUE class.
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+SYNC_BUILTINS = {"float", "int", "bool"}
+SYNC_NUMPY = {"asarray", "array", "ascontiguousarray", "copy"}
+SYNC_JAX = {"device_get", "block_until_ready"}
+
+
+def numpy_prefixes(tree: ast.Module) -> Set[str]:
+    """Names bound to the numpy module in this file (``np``, ``numpy``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+        # `from numpy import asarray` is deliberately not chased: the
+        # bare-name spelling is absent from this codebase and tracking
+        # it would mean per-name (not per-prefix) sync classification
+    return out
+
+
+def jax_prefixes(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    out.add(a.asname or "jax")
+    return out
+
+
+class ModuleInfo:
+    """Per-module slice of the project index."""
+
+    def __init__(self, mod: ModuleFile):
+        self.mod = mod
+        self.tree = mod.tree
+        self.traced = TracedIndex(mod.tree, close=False) \
+            if mod.tree is not None else None
+        self.parents = self.traced.parents if self.traced else {}
+        self.defs_by_name: Dict[str, List[ast.AST]] = \
+            dict(self.traced._defs_by_name) if self.traced else {}
+        #: ``from x.y import f [as g]`` -> g: ("x.y", "f")
+        self.imports_from: Dict[str, Tuple[str, str]] = {}
+        #: ``import x.y as m`` -> m: "x.y"
+        self.import_mods: Dict[str, str] = {}
+        #: module-scope (and class-scope) names bound to jitted callables
+        self.jitted_names: Set[str] = set()
+        #: ``self.<attr> = jax.jit(...)`` attribute names
+        self.jitted_attrs: Set[str] = set()
+        #: names assigned at MODULE level (constants, helpers, imports)
+        self.module_names: Set[str] = set()
+        self.np_prefixes: Set[str] = set()
+        self.jax_prefixes: Set[str] = set()
+        if mod.tree is None:
+            return
+        self.np_prefixes = numpy_prefixes(mod.tree)
+        self.jax_prefixes = jax_prefixes(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_mods[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                for a in node.names:
+                    if node.module:
+                        self.imports_from[a.asname or a.name] = \
+                            (node.module, a.name)
+        for node in mod.tree.body:
+            for t in _stmt_targets(node):
+                self.module_names.add(t)
+            if isinstance(node, DefNode + (ast.ClassDef,)):
+                self.module_names.add(node.name)
+        self.module_names.update(self.import_mods)
+        self.module_names.update(self.imports_from)
+        self._collect_jitted()
+
+    def _collect_jitted(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, DefNode):
+                if any(_is_tracer_callable(d) for d in node.decorator_list):
+                    self.jitted_names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                if not _is_jit_construction(node.value):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jitted_names.add(t.id)
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self"):
+                        self.jitted_attrs.add(t.attr)
+
+
+def _stmt_targets(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        yield e.id
+    elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                       ast.Name):
+        yield node.target.id
+
+
+def _is_jit_construction(expr: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``partial(jax.jit, ...)(f)`` / ``pjit(...)``."""
+    if not isinstance(expr, ast.Call):
+        return False
+    if _is_tracer_callable(expr.func):
+        return True
+    return (isinstance(expr.func, ast.Call)
+            and _is_partial_of_tracer(expr.func))
+
+
+def scope_nodes(fn: ast.AST, *, include_nested: bool = False
+                ) -> List[ast.AST]:
+    """Nodes in ``fn``'s own scope; nested defs/lambdas excluded unless
+    asked for (comprehensions are transparent — they run inline)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if not include_nested and isinstance(n, FuncNode):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def expr_reads(expr: ast.AST) -> Set[str]:
+    """Plain-name and ``self.<attr>`` loads in ``expr``, normalized
+    (``self.gradient`` -> ``gradient``).  A nested lambda contributes
+    only its FREE names (its params are not reads of the enclosing
+    scope), and comprehension loop variables are bound, not read —
+    without those two carve-outs ``jax.jit(lambda X, w: X @ w)`` would
+    "read" X and w and a memo-key check would flag phantom roots."""
+    out: Set[str] = set()
+    bound: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Lambda):
+            out.update(free_names(n))
+            return
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+            else:  # comprehension targets parse as Store
+                bound.add(n.id)
+        elif (isinstance(n, ast.Attribute)
+              and isinstance(n.value, ast.Name) and n.value.id == "self"
+              and isinstance(n.ctx, ast.Load)):
+            out.add(n.attr)
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(expr)
+    out -= bound
+    out.discard("self")
+    return out
+
+
+def func_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if isinstance(fn, DefNode) and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def free_names(fn: ast.AST) -> Set[str]:
+    """Names a nested def reads from its enclosing scope: loads minus
+    its own params, locals, and nested-def names."""
+    bound: Set[str] = set(p.arg for p in
+                          fn.args.posonlyargs + fn.args.args
+                          + fn.args.kwonlyargs)
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    loads: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load):
+                loads.add(n.id)
+            else:
+                bound.add(n.id)
+        elif isinstance(n, DefNode) and n is not fn:
+            bound.add(n.name)
+    return loads - bound
+
+
+class ProjectIndex:
+    """All linted modules, cross-linked.  Build once per lint run."""
+
+    def __init__(self, modules: Sequence[ModuleFile]):
+        self.infos: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        for m in modules:
+            mi = ModuleInfo(m)
+            self.infos[m.relpath] = mi
+            self.by_dotted[m.dotted] = mi
+        self._close_traced()
+        self._returns_jitted: Set[ast.AST] = set()
+        self._close_returns_jitted()
+        self._syncing: Dict[ast.AST, Set[int]] = {}
+        self._close_syncing()
+
+    def info(self, mod: ModuleFile) -> ModuleInfo:
+        return self.infos[mod.relpath]
+
+    # -- call resolution -----------------------------------------------------
+    def resolve_call(self, mi: ModuleInfo,
+                     call: ast.Call) -> List[Tuple[ModuleInfo, ast.AST]]:
+        """Candidate (module, def) targets of ``call``; empty when the
+        callee cannot be proven."""
+        return self.resolve_name(mi, call.func)
+
+    def resolve_name(self, mi: ModuleInfo,
+                     func: ast.AST) -> List[Tuple[ModuleInfo, ast.AST]]:
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mi.defs_by_name:
+                return [(mi, d) for d in mi.defs_by_name[name]]
+            if name in mi.imports_from:
+                dotted, orig = mi.imports_from[name]
+                tgt = self.by_dotted.get(dotted)
+                if tgt is not None and orig in tgt.defs_by_name:
+                    return [(tgt, d) for d in tgt.defs_by_name[orig]]
+            return []
+        name = dotted_name(func)
+        if name is None:
+            return []
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return [(mi, d) for d in mi.defs_by_name.get(parts[1], ())]
+        # m.f / m.sub.f through an ``import m [as alias]``
+        if parts[0] in mi.import_mods and len(parts) >= 2:
+            dotted = ".".join([mi.import_mods[parts[0]]] + parts[1:-1])
+            tgt = self.by_dotted.get(dotted)
+            if tgt is not None:
+                return [(tgt, d)
+                        for d in tgt.defs_by_name.get(parts[-1], ())]
+        # mod.f through ``from pkg import mod``
+        if parts[0] in mi.imports_from and len(parts) == 2:
+            pkg, sub = mi.imports_from[parts[0]]
+            tgt = self.by_dotted.get(f"{pkg}.{sub}")
+            if tgt is not None:
+                return [(tgt, d)
+                        for d in tgt.defs_by_name.get(parts[1], ())]
+        return []
+
+    # -- traced-ness ---------------------------------------------------------
+    def _close_traced(self) -> None:
+        """Project-wide closure over the per-module seeds: nested defs,
+        same-module called-by-name defs (the PR 4 behavior), PLUS defs
+        reached through import-resolved cross-module calls."""
+        changed = True
+        while changed:
+            changed = False
+            for mi in self.infos.values():
+                if mi.traced is None:
+                    continue
+                for root in list(mi.traced._traced):
+                    for node in ast.walk(root):
+                        if node is root:
+                            continue
+                        if isinstance(node, FuncNode):
+                            if node not in mi.traced._traced:
+                                mi.traced._traced.add(node)
+                                changed = True
+                        elif isinstance(node, ast.Call):
+                            callee = last_seg(dotted_name(node.func))
+                            for d in mi.defs_by_name.get(callee, ()):
+                                if d not in mi.traced._traced:
+                                    mi.traced._traced.add(d)
+                                    changed = True
+                            for tmi, d in self.resolve_call(mi, node):
+                                if tmi is not mi \
+                                        and d not in tmi.traced._traced:
+                                    tmi.traced._traced.add(d)
+                                    changed = True
+
+    def is_traced(self, mod: ModuleFile, node: ast.AST) -> bool:
+        mi = self.infos[mod.relpath]
+        return mi.traced is not None and mi.traced.is_traced(node)
+
+    def enclosing_function(self, mod: ModuleFile,
+                           node: ast.AST) -> Optional[ast.AST]:
+        mi = self.infos[mod.relpath]
+        return enclosing(node, mi.parents, FuncNode)
+
+    # -- jitted callables / device values ------------------------------------
+    def _close_returns_jitted(self) -> None:
+        """Defs whose RESULT is a jit-compiled callable (``_stepper``,
+        ``dp_step_fn``, ...): a direct ``return jax.jit(...)``, a
+        returned name locally assigned one, or a returned call to
+        another such def — iterated to fixpoint."""
+        changed = True
+        while changed:
+            changed = False
+            for mi in self.infos.values():
+                if mi.tree is None:
+                    continue
+                for name, defs in mi.defs_by_name.items():
+                    for d in defs:
+                        if d in self._returns_jitted:
+                            continue
+                        if self._def_returns_jitted(mi, d):
+                            self._returns_jitted.add(d)
+                            changed = True
+
+    def _def_returns_jitted(self, mi: ModuleInfo, fn: ast.AST) -> bool:
+        assigns: Dict[str, List[ast.AST]] = {}
+        for n in scope_nodes(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append(n.value)
+        def _jitted_expr(expr: ast.AST, depth: int = 0) -> bool:
+            if depth > 4 or expr is None:
+                return False
+            if _is_jit_construction(expr):
+                return True
+            if isinstance(expr, ast.Call):
+                return any(d in self._returns_jitted
+                           for _, d in self.resolve_call(mi, expr))
+            if isinstance(expr, ast.Name):
+                return any(_jitted_expr(v, depth + 1)
+                           for v in assigns.get(expr.id, ()))
+            return False
+        for n in scope_nodes(fn):
+            if isinstance(n, ast.Return) and _jitted_expr(n.value):
+                return True
+        return False
+
+    def jitted_value_names(self, mi: ModuleInfo,
+                           fn: ast.AST) -> Set[str]:
+        """Local names in ``fn`` bound to jit-compiled callables."""
+        out = set(mi.jitted_names)
+        changed = True
+        while changed:
+            changed = False
+            for n in scope_nodes(fn):
+                if isinstance(n, DefNode) and any(
+                        _is_tracer_callable(d) for d in n.decorator_list):
+                    if n.name not in out:
+                        out.add(n.name)
+                        changed = True
+                if not isinstance(n, ast.Assign):
+                    continue
+                val = n.value
+                is_jitted = _is_jit_construction(val)
+                if not is_jitted and isinstance(val, ast.Call):
+                    is_jitted = any(
+                        d in self._returns_jitted
+                        for _, d in self.resolve_call(mi, val))
+                if not is_jitted and isinstance(val, ast.Name):
+                    is_jitted = val.id in out
+                if not is_jitted and isinstance(val, ast.Attribute) \
+                        and isinstance(val.value, ast.Name) \
+                        and val.value.id == "self":
+                    is_jitted = val.attr in mi.jitted_attrs
+                if not is_jitted:
+                    continue
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id not in out:
+                        out.add(t.id)
+                        changed = True
+        return out
+
+    def is_device_call(self, mi: ModuleInfo, fn: ast.AST,
+                       call: ast.Call,
+                       jitted_locals: Optional[Set[str]] = None) -> bool:
+        """Does ``call`` dispatch a compiled program (its result is a
+        device value)?"""
+        if jitted_locals is None:
+            jitted_locals = self.jitted_value_names(mi, fn)
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in jitted_locals:
+            return True
+        name = dotted_name(func)
+        if name is not None:
+            parts = name.split(".")
+            if parts[0] == "self" and len(parts) == 2 \
+                    and parts[1] in mi.jitted_attrs:
+                return True
+            if len(parts) == 2 and parts[0] in mi.jax_prefixes \
+                    and parts[1] == "device_put":
+                return True
+        # ``jax.jit(f)(x)``: dispatching a freshly-built jitted callable
+        return isinstance(func, ast.Call) and _is_jit_construction(func)
+
+    def device_value_names(self, mi: ModuleInfo, fn: ast.AST,
+                           jitted: Optional[Set[str]] = None) -> Set[str]:
+        """Local names in ``fn`` holding device arrays: results of
+        calling a jitted callable or ``jax.device_put``, closed over
+        plain-name aliasing and tuple unpacking.  ``jitted`` lets a
+        caller reuse an already-computed ``jitted_value_names`` fixpoint
+        (it is O(scope²) and callers often need both)."""
+        if jitted is None:
+            jitted = self.jitted_value_names(mi, fn)
+        out: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for n in scope_nodes(fn):
+                if not isinstance(n, ast.Assign):
+                    continue
+                val = n.value
+                is_dev = False
+                if isinstance(val, ast.Call):
+                    is_dev = self.is_device_call(mi, fn, val, jitted)
+                elif isinstance(val, ast.Name):
+                    is_dev = val.id in out
+                if not is_dev:
+                    continue
+                for t in n.targets:
+                    names = [t] if isinstance(t, ast.Name) else (
+                        [e for e in t.elts if isinstance(e, ast.Name)]
+                        if isinstance(t, (ast.Tuple, ast.List)) else [])
+                    for e in names:
+                        if e.id not in out:
+                            out.add(e.id)
+                            changed = True
+        return out
+
+    # -- sync summaries ------------------------------------------------------
+    def _is_method_form(self, mi: ModuleInfo,
+                        func: ast.AST) -> bool:
+        """``x.item()`` / ``arr.block_until_ready()`` — an attribute
+        sync whose RECEIVER is the synced value.  False for the
+        module-function spellings (``np.copy(x)``,
+        ``jax.block_until_ready(x)``), whose synced value is args[0]."""
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in SYNC_METHODS):
+            return False
+        base = dotted_name(func.value)
+        head = base.split(".")[0] if base else None
+        return head not in mi.np_prefixes and head not in mi.jax_prefixes
+
+    def sync_op_kind(self, mi: ModuleInfo,
+                     call: ast.Call) -> Optional[str]:
+        """Is ``call`` a device→host sync operation?  Returns a short
+        label, or None."""
+        func = call.func
+        if self._is_method_form(mi, func):
+            return f".{func.attr}()"
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1 and parts[0] in SYNC_BUILTINS and call.args:
+            return f"{parts[0]}()"
+        if len(parts) == 2 and parts[0] in mi.np_prefixes \
+                and parts[1] in SYNC_NUMPY:
+            return f"{name}()"
+        if len(parts) == 2 and parts[0] in mi.jax_prefixes \
+                and parts[1] in SYNC_JAX:
+            return f"{name}()"
+        return None
+
+    def _sync_arg_expr(self, mi: ModuleInfo,
+                       call: ast.Call) -> Optional[ast.AST]:
+        """The expression a sync op materializes: receiver of ``.item()``
+        style calls, first argument otherwise (including the
+        ``jax.block_until_ready(x)`` module-function spelling)."""
+        if self._is_method_form(mi, call.func):
+            return call.func.value
+        return call.args[0] if call.args else None
+
+    def _close_syncing(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for mi in self.infos.values():
+                if mi.tree is None:
+                    continue
+                for defs in mi.defs_by_name.values():
+                    for d in defs:
+                        new = self._def_syncing_params(mi, d)
+                        if new != self._syncing.get(d, set()):
+                            self._syncing[d] = new
+                            changed = True
+
+    def _def_syncing_params(self, mi: ModuleInfo,
+                            fn: ast.AST) -> Set[int]:
+        params = func_params(fn)
+        if not params:
+            return set()
+        idx = {p: i for i, p in enumerate(params)}
+        out: Set[int] = set(self._syncing.get(fn, set()))
+        for n in scope_nodes(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            if self.sync_op_kind(mi, n) is not None:
+                arg = self._sync_arg_expr(mi, n)
+                if arg is not None:
+                    for name in expr_reads(arg):
+                        if name in idx:
+                            out.add(idx[name])
+                continue
+            for _, d in self.resolve_call(mi, n):
+                for j in self._syncing.get(d, set()):
+                    if j < len(n.args):
+                        for name in expr_reads(n.args[j]):
+                            if name in idx:
+                                out.add(idx[name])
+        return out
+
+    def syncing_params(self, d: ast.AST) -> Set[int]:
+        return self._syncing.get(d, set())
+
+    # -- root decomposition --------------------------------------------------
+    def local_roots(self, mi: ModuleInfo, fn: ast.AST, name: str,
+                    stop: Set[str], _seen: Optional[Set[str]] = None
+                    ) -> Set[str]:
+        """Decompose local ``name`` into the reads it derives from,
+        stopping at ``stop`` names (the key fields), parameters,
+        ``self.<attr>``s, module-level names, and free variables.  A
+        local function decomposes into its free variables."""
+        if _seen is None:
+            _seen = set()
+        if name in stop or name in _seen:
+            return {name} if name in stop else set()
+        _seen.add(name)
+        sources: List[Set[str]] = []
+        for n in scope_nodes(fn):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in n.targets):
+                sources.append(expr_reads(n.value))
+            elif isinstance(n, ast.Assign) and any(
+                    isinstance(t, (ast.Tuple, ast.List)) and any(
+                        isinstance(e, ast.Name) and e.id == name
+                        for e in t.elts)
+                    for t in n.targets):
+                sources.append(expr_reads(n.value))
+        for n in scope_nodes(fn, include_nested=True):
+            if isinstance(n, DefNode) and n.name == name:
+                sources.append(free_names(n))
+        if not sources:
+            return {name}  # a parameter / free var: irreducible
+        roots: Set[str] = set()
+        for reads in sources:
+            for r in reads:
+                roots |= self.local_roots(mi, fn, r, stop, _seen)
+        return roots

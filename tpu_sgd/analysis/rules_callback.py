@@ -1,0 +1,281 @@
+"""callback-discipline: the io_callback / pure_callback contracts.
+
+``io_callback`` is the resident driver's only window back to the host,
+and it crosses an FFI boundary with three sharp edges this rule pins
+(each one was learned on the PR 6 review):
+
+1. **Ordering.**  A callback whose RESULT feeds stateful bookkeeping
+   (it is assigned, returned, or otherwise consumed — not a fire-and-
+   forget ``Expr`` statement) must pass ``ordered=True``: unordered
+   callbacks may be reordered or elided by the compiler, so bookkeeping
+   driven by their results replays out of order or not at all.
+
+2. **Exception boundary.**  An exception escaping the callback body
+   surfaces as an opaque ``XlaRuntimeError`` host-side and defeats the
+   retry/resume machinery.  The checked contract is the stash-flag-
+   reraise pattern ``optimize/resident_driver.py`` documents: the
+   target's body is one ``try`` whose handler catches ``Exception`` /
+   ``BaseException`` and does NOT re-raise (it stashes and returns a
+   flag; the ORIGINAL exception re-raises host-side after the dispatch
+   returns).  A bare trampoline — a def whose whole body is a single
+   ``return <call>`` — passes when every resolvable callee is guarded.
+
+3. **Bounded buffers.**  The callback fires once per cadence window for
+   the whole run: an ``append`` (or ``+=``) to a CLOSURE variable from
+   the callback body accumulates host memory proportional to run length
+   inside the compiled program's lifetime.  State owned by a bookkeeper
+   object (``self.<attr>``) is exempt — the object's lifecycle is the
+   run's, and bounding it is the bookkeeper's documented contract.
+
+Unresolvable targets (lambdas from other modules, partials over runtime
+values) are skipped: rules err toward silence on edges they cannot
+prove.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tpu_sgd.analysis.core import Finding, ModuleFile, Rule
+from tpu_sgd.analysis.dataflow import (DefNode, ModuleInfo, ProjectIndex,
+                                       free_names, scope_nodes)
+from tpu_sgd.analysis.tracing import dotted_name, last_seg
+
+CALLBACK_NAMES = {"io_callback", "pure_callback"}
+
+
+def _is_callback_call(call: ast.Call) -> Optional[str]:
+    name = last_seg(dotted_name(call.func))
+    return name if name in CALLBACK_NAMES else None
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+    return False
+
+
+def _is_guarded(fn: ast.AST) -> bool:
+    """Whole body (docstring aside) is one try whose handlers catch
+    Exception/BaseException (or bare) without re-raising."""
+    body = list(fn.body)
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant) \
+            and isinstance(body[0].value.value, str):
+        body = body[1:]
+    # nested defs before the try (local helpers) don't run on entry
+    while body and isinstance(body[0], DefNode):
+        body = body[1:]
+    if len(body) != 1 or not isinstance(body[0], ast.Try):
+        return False
+    tr = body[0]
+    for h in tr.handlers:
+        t = h.type
+        names = []
+        if t is None:
+            names = ["BaseException"]
+        elif isinstance(t, (ast.Name, ast.Attribute)):
+            names = [last_seg(dotted_name(t))]
+        elif isinstance(t, ast.Tuple):
+            names = [last_seg(dotted_name(e)) for e in t.elts]
+        if any(n in ("Exception", "BaseException") for n in names):
+            return not _handler_reraises(h)
+    return False
+
+
+def _is_trampoline(fn: ast.AST) -> List[ast.Call]:
+    """If ``fn``'s body is a single ``return <call>`` (docstring aside),
+    the forwarded call; else []."""
+    body = [s for s in fn.body
+            if not (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))]
+    if len(body) == 1 and isinstance(body[0], ast.Return) \
+            and isinstance(body[0].value, ast.Call):
+        return [body[0].value]
+    return []
+
+
+class CallbackDisciplineRule(Rule):
+    name = "callback-discipline"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        project: ProjectIndex = options["project"]
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            mi = project.info(mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and _is_callback_call(node):
+                    yield from self._check_site(mod, mi, project, node)
+
+    # -- per-site checks -----------------------------------------------------
+    def _check_site(self, mod: ModuleFile, mi: ModuleInfo,
+                    project: ProjectIndex,
+                    call: ast.Call) -> Iterable[Finding]:
+        kind = _is_callback_call(call)
+        yield from self._check_ordered(mod, mi, kind, call)
+        targets, ambiguous = self._resolve_target(mi, project, call)
+        for name in ambiguous:
+            yield Finding(
+                self.name, mod.relpath, call.lineno, call.col_offset,
+                f"callback target `{name}` matches several defs across "
+                "the lint set and none in this module, so the guarded/"
+                "bounded contract checks cannot attach to this site; "
+                "rename the target or bind it to a resolvable def — an "
+                "ambiguity silently voiding a checked contract is "
+                "itself the hazard")
+        for tmi, d in targets:
+            yield from self._check_guarded(mod, mi, project, call, tmi, d)
+            yield from self._check_bounded(mod, tmi, project, call, d)
+
+    def _check_ordered(self, mod: ModuleFile, mi: ModuleInfo, kind: str,
+                       call: ast.Call) -> Iterable[Finding]:
+        if kind != "io_callback":
+            return  # pure_callback is functionally pure by contract
+        parent = mi.parents.get(call)
+        consumed = not isinstance(parent, ast.Expr)
+        if not consumed:
+            return
+        for kw in call.keywords:
+            if kw.arg == "ordered":
+                if isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    return
+                if not isinstance(kw.value, ast.Constant):
+                    return  # runtime-computed: out of static reach
+                break
+        yield Finding(
+            self.name, mod.relpath, call.lineno, call.col_offset,
+            "io_callback result feeds back into the program but the "
+            "call is not ordered=True; unordered callbacks may be "
+            "reordered or elided, so stateful bookkeeping driven by "
+            "this result can replay out of order")
+
+    # -- target resolution ---------------------------------------------------
+    @staticmethod
+    def _chase_alias(mi: ModuleInfo, project: ProjectIndex,
+                     call: ast.Call, target: ast.AST) -> ast.AST:
+        """Walk OUT through the enclosing defs chasing a plain-name
+        alias: the resident driver binds ``fire_cb = self._fire`` in
+        ``_build`` and fires it from a lambda two scopes down."""
+        if not isinstance(target, ast.Name):
+            return target
+        from tpu_sgd.analysis.tracing import FuncNode, enclosing
+        fn = project.enclosing_function(mi.mod, call)
+        while fn is not None:
+            for n in scope_nodes(fn):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == target.id
+                        for t in n.targets):
+                    return n.value
+            fn = enclosing(fn, mi.parents, FuncNode)
+        return target
+
+    @staticmethod
+    def _unique_def(project: ProjectIndex, name: str,
+                    near: Optional[ModuleInfo] = None
+                    ) -> Tuple[List[Tuple[ModuleInfo, ast.AST]], bool]:
+        """Bare-name resolution for the attribute hops the call graph
+        cannot type (``self._hooks.on_window``).  Tiered: a unique def
+        in the call site's own module wins (the bookkeeper lives beside
+        its trace site), else a unique def project-wide.  Returns
+        ``(hits, ambiguous)`` — ``ambiguous`` is True when several
+        modules define ``name`` and neither tier singles one out, so the
+        site can surface the LOST contract coverage as a finding; an
+        unrelated ``def on_window`` landing anywhere in the lint set
+        must not silently void a checked contract."""
+        if near is not None:
+            local = [(near, d)
+                     for d in near.defs_by_name.get(name, ())]
+            if len(local) == 1:
+                return local, False
+        hits: List[Tuple[ModuleInfo, ast.AST]] = []
+        for info in project.infos.values():
+            for d in info.defs_by_name.get(name, ()):
+                hits.append((info, d))
+        if len(hits) == 1:
+            return hits, False
+        return [], len(hits) > 1
+
+    def _resolve_target(self, mi: ModuleInfo, project: ProjectIndex,
+                        call: ast.Call
+                        ) -> Tuple[List[Tuple[ModuleInfo, ast.AST]],
+                                   List[str]]:
+        ambiguous: List[str] = []
+        if not call.args:
+            return [], ambiguous
+        target = self._chase_alias(mi, project, call, call.args[0])
+        resolved = project.resolve_name(mi, target)
+        if not resolved and isinstance(target, ast.Attribute):
+            # `hooks.on_window` / `self._hooks.on_window`: object-hop
+            # the import machinery cannot follow — tiered-name fallback
+            resolved, amb = self._unique_def(project, target.attr,
+                                             near=mi)
+            if amb:
+                ambiguous.append(target.attr)
+        out = []
+        for tmi, d in resolved:
+            # a trampoline forwards the contract one hop: check its
+            # resolvable callee(s) instead of the trampoline itself
+            fwd = _is_trampoline(d)
+            if not fwd:
+                out.append((tmi, d))
+                continue
+            for fcall in fwd:
+                t2s = project.resolve_name(tmi, fcall.func)
+                if not t2s and isinstance(fcall.func, ast.Attribute):
+                    t2s, amb = self._unique_def(
+                        project, fcall.func.attr, near=tmi)
+                    if amb:
+                        ambiguous.append(fcall.func.attr)
+                out.extend(t2s)
+            # an unresolvable trampoline is an unresolvable target: the
+            # trampoline body itself cannot raise, and the callee is
+            # beyond static reach — err toward silence
+        return out, ambiguous
+
+    def _check_guarded(self, mod: ModuleFile, mi: ModuleInfo,
+                       project: ProjectIndex, call: ast.Call,
+                       tmi: ModuleInfo, d: ast.AST) -> Iterable[Finding]:
+        if _is_guarded(d):
+            return
+        yield Finding(
+            self.name, mod.relpath, call.lineno, call.col_offset,
+            f"callback target `{getattr(d, 'name', '?')}` can let an "
+            "exception cross the FFI boundary (it would surface as an "
+            "opaque XlaRuntimeError and defeat retry/resume); wrap the "
+            "whole body in try/except BaseException that stashes the "
+            "error and returns a stop flag — the stash-flag-reraise "
+            "contract (see optimize/resident_driver.py)")
+
+    def _check_bounded(self, mod: ModuleFile, tmi: ModuleInfo,
+                       project: ProjectIndex, call: ast.Call,
+                       d: ast.AST) -> Iterable[Finding]:
+        free = free_names(d)
+        for n in ast.walk(d):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("append", "extend", "appendleft") \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id in free:
+                yield Finding(
+                    self.name, tmi.mod.relpath, n.lineno, n.col_offset,
+                    f"callback target `{getattr(d, 'name', '?')}` "
+                    f"appends to closure variable "
+                    f"`{n.func.value.id}` on every firing: an "
+                    "unbounded host buffer pinned for the whole "
+                    "dispatch; hand windows to a bookkeeper object "
+                    "with a documented bound instead")
+            elif isinstance(n, ast.AugAssign) \
+                    and isinstance(n.target, ast.Name) \
+                    and n.target.id in free \
+                    and isinstance(n.value, (ast.List, ast.ListComp)):
+                yield Finding(
+                    self.name, tmi.mod.relpath, n.lineno, n.col_offset,
+                    f"callback target `{getattr(d, 'name', '?')}` "
+                    f"grows closure list `{n.target.id}` every firing; "
+                    "unbounded host buffer — see the bounded-ring "
+                    "contract in optimize/resident_driver.py")

@@ -1,0 +1,205 @@
+"""carry-stability: scan/while carries must be dtype-pinned arrays.
+
+The silent-recompile class ADVICE.md documents: a ``lax.scan`` /
+``lax.while_loop`` / ``lax.fori_loop`` carry element that enters as a
+Python scalar (``0``, ``0.0``, ``False``) is traced as a WEAK-typed
+value.  Weak types promote differently from committed dtypes — one
+``+ 1.0`` in the body and the carry-out dtype no longer matches the
+carry-in, which either fails the while_loop carry-structure check
+outright (the lucky case) or, across dispatches with different input
+dtypes, silently re-traces and recompiles the largest program in the
+codebase (the unlucky case PR 6 hit).  The same applies to a body that
+RETURNS a raw Python scalar in the carry tuple (a "reset" like
+``return (i, 0)``): the reset element re-enters weak.
+
+The fix is mechanical and local, which is what makes this a good lint:
+``jnp.asarray(x, jnp.int32)`` every scalar carry element at init, and
+reset through ``jnp.where`` / ``jnp.zeros_like`` in the body — exactly
+what ``optimize/resident_driver.py`` does.
+
+The rule checks, for each trace-entry loop call it can see:
+
+* **init elements** that are Python constants (``0``, ``-1.0``,
+  ``True``) or ``float()`` / ``int()`` host-scalar coercions;
+* **body carry-out elements** that are Python constants, for bodies
+  resolvable through the call graph (a local def or lambda).
+
+Single non-tuple carries are checked as one-element tuples.  Elements
+the rule cannot prove scalar (names, calls) are silent: a name bound to
+a Python scalar two hops away is real but rare, and wolf-crying on
+every name would bury the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tpu_sgd.analysis.core import Finding, ModuleFile, Rule
+from tpu_sgd.analysis.dataflow import (ModuleInfo, ProjectIndex,
+                                       scope_nodes)
+from tpu_sgd.analysis.tracing import dotted_name, last_seg
+
+#: loop entry -> (body positional index, init positional index)
+LOOP_SIGS = {
+    "scan": (0, 1),
+    "while_loop": (1, 2),
+    "fori_loop": (2, 3),
+}
+
+#: loop entry -> (body keyword, init keyword) — `lax.scan(body,
+#: init=..., xs=...)` is a standard spelling and must not slip the net
+LOOP_KWARGS = {
+    "scan": ("f", "init"),
+    "while_loop": ("body_fun", "init_val"),
+    "fori_loop": ("body_fun", "init_val"),
+}
+
+
+def _loop_arg(call: ast.Call, kind: str, pos: int,
+              which: int) -> Optional[ast.AST]:
+    """The body (``which=0``) or init (``which=1``) argument of a loop
+    call, positional or keyword."""
+    if pos < len(call.args):
+        return call.args[pos]
+    kw_name = LOOP_KWARGS[kind][which]
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    return None
+
+SCALAR_COERCIONS = {"float", "int", "bool"}
+
+
+def _is_py_scalar(node: ast.AST) -> Optional[str]:
+    """A Python-scalar expression: constant, negated constant, or a
+    float()/int()/bool() coercion.  Returns a display string or None."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float, bool, complex)):
+        return repr(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant):
+        return f"-{node.operand.value!r}"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in SCALAR_COERCIONS:
+        return f"{node.func.id}(...)"
+    return None
+
+
+def _carry_elements(init: ast.AST) -> List[ast.AST]:
+    if isinstance(init, (ast.Tuple, ast.List)):
+        return list(init.elts)
+    return [init]
+
+
+class CarryStabilityRule(Rule):
+    name = "carry-stability"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        project: ProjectIndex = options["project"]
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            mi = project.info(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                sig = self._loop_sig(mi, node)
+                if sig is None:
+                    continue
+                kind, (body_i, init_i) = sig
+                yield from self._check_init(mod, kind, node, init_i)
+                yield from self._check_body(mod, mi, project, kind,
+                                            node, body_i)
+
+    @staticmethod
+    def _loop_sig(mi: ModuleInfo, call: ast.Call
+                  ) -> Optional[Tuple[str, Tuple[int, int]]]:
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        seg = last_seg(name)
+        if seg not in LOOP_SIGS:
+            return None
+        parts = name.split(".")
+        # accept `jax.lax.scan` / `lax.while_loop` spellings and names
+        # imported straight from jax.lax; a bare local `scan` helper
+        # must not fire
+        if len(parts) >= 2:
+            head = ".".join(parts[:-1])
+            if head in mi.jax_prefixes \
+                    or any(head == f"{p}.lax" for p in mi.jax_prefixes) \
+                    or head == "lax" or head.endswith(".lax"):
+                return seg, LOOP_SIGS[seg]
+            return None
+        src = mi.imports_from.get(seg)
+        if src is not None and src[0].endswith("lax") \
+                and src[1] == seg:
+            return seg, LOOP_SIGS[seg]
+        return None
+
+    def _check_init(self, mod: ModuleFile, kind: str, call: ast.Call,
+                    init_i: int) -> Iterable[Finding]:
+        init = _loop_arg(call, kind, init_i, 1)
+        if init is None:
+            return
+        for j, el in enumerate(_carry_elements(init)):
+            shown = _is_py_scalar(el)
+            if shown is None:
+                continue
+            yield Finding(
+                self.name, mod.relpath, el.lineno, el.col_offset,
+                f"carry element {j} of this `{kind}` is the Python "
+                f"scalar {shown}: it traces WEAK-typed, and one "
+                "promotion in the body makes carry-out dtype disagree "
+                "with carry-in (silent re-trace / recompile across "
+                "dispatches); pin it — jnp.asarray(x, jnp.int32) — "
+                "like optimize/resident_driver.py does")
+
+    def _check_body(self, mod: ModuleFile, mi: ModuleInfo,
+                    project: ProjectIndex, kind: str, call: ast.Call,
+                    body_i: int) -> Iterable[Finding]:
+        body = _loop_arg(call, kind, body_i, 0)
+        if body is None:
+            return
+        defs: List[ast.AST] = []
+        if isinstance(body, ast.Lambda):
+            defs = [body]
+        else:
+            defs = [d for _, d in project.resolve_name(mi, body)]
+        for d in defs:
+            for ret in self._carry_returns(d, kind):
+                for j, el in enumerate(_carry_elements(ret)):
+                    shown = _is_py_scalar(el)
+                    if shown is None:
+                        continue
+                    yield Finding(
+                        self.name, mod.relpath, el.lineno,
+                        el.col_offset,
+                        f"`{kind}` body returns Python scalar {shown} "
+                        f"as carry element {j}: the reset re-enters "
+                        "the loop WEAK-typed and drifts the carry "
+                        "dtype; reset on device instead "
+                        "(jnp.where / jnp.zeros_like)")
+
+    @staticmethod
+    def _carry_returns(fn: ast.AST, kind: str) -> List[ast.AST]:
+        """The carry expression(s) a body returns: for scan, the first
+        element of the `(carry, y)` pair; whole value otherwise."""
+        rets: List[ast.AST] = []
+        if isinstance(fn, ast.Lambda):
+            values: List[ast.AST] = [fn.body]
+        else:
+            # own-scope returns only: a nested def's return is ITS
+            # carry contract (checked at its own loop site), not this
+            # body's
+            values = [r.value for r in scope_nodes(fn)
+                      if isinstance(r, ast.Return) and r.value is not None]
+        for v in values:
+            if kind == "scan":
+                if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                    rets.append(v.elts[0])
+            else:
+                rets.append(v)
+        return rets

@@ -20,6 +20,14 @@ spellings::
     @partial(jax.jit, donate_argnums=(0, 1, 2))     # decorated def
     acc = jax.jit(body, donate_argnums=(0,))        # assigned wrapper
 
+Since graftlint v2 the rule is one call level deep: a def that forwards
+its own parameter into a donated position of a known donator —
+unconditionally, never rebinding the parameter first — DONATES that
+parameter itself, so ``helper(G)`` followed by a read of ``G`` is a
+finding even though the ``donate_argnums`` lives inside ``helper``.
+Forwarder summaries are computed per module over the module's resolved
+donator map (direct + imported), one level only.
+
 A wrapper whose ``donate_argnums`` is a runtime expression (e.g.
 ``(0, 1) if donate else ()``) is invisible to the rule — such factories
 must keep their own discipline (and do: they are the reason the rule
@@ -90,6 +98,45 @@ def collect_donators(mod: ModuleFile) -> Dict[str, Tuple[int, ...]]:
     return out
 
 
+def collect_forwarders(mod: ModuleFile,
+                       donators: Dict[str, Tuple[int, ...]]
+                       ) -> Dict[str, Tuple[int, ...]]:
+    """One-level donation summaries: defs in ``mod`` that forward a
+    parameter — never rebound in the def — into a donated position of a
+    known donator.  Calling such a def donates the argument too."""
+    from tpu_sgd.analysis.dataflow import func_params
+
+    out: Dict[str, Tuple[int, ...]] = {}
+    if mod.tree is None:
+        return out
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = func_params(fn)
+        idx = {p: i for i, p in enumerate(params)}
+        # ANY rebind of the param in the def voids the summary: the
+        # donated buffer is then the rebound local, not the caller's
+        stored = {n.id for n in ast.walk(fn)
+                  if isinstance(n, ast.Name)
+                  and not isinstance(n.ctx, ast.Load)}
+        fwd = set()
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            nums = donators.get(last_seg(dotted_name(call.func)))
+            if not nums:
+                continue
+            for i in nums:
+                if i < len(call.args) and isinstance(call.args[i],
+                                                     ast.Name):
+                    p = call.args[i].id
+                    if p in idx and p not in stored:
+                        fwd.add(idx[p])
+        if fwd:
+            out[fn.name] = tuple(sorted(fwd))
+    return out
+
+
 class DonationSafetyRule(Rule):
     name = "donation-safety"
 
@@ -110,6 +157,11 @@ class DonationSafetyRule(Rule):
                 for a in node.names:
                     if a.name in exported:
                         local[a.asname or a.name] = exported[a.name]
+            # pass 2 (call graph): defs forwarding a param into a
+            # donated position donate it themselves, one level deep
+            for name, nums in collect_forwarders(mod, local).items():
+                merged = tuple(sorted(set(local.get(name, ())) | set(nums)))
+                local[name] = merged
             if local:
                 yield from self._check_module(mod, local)
 

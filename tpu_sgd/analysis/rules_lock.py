@@ -19,15 +19,21 @@ mode suffix encodes the atomic-reference-swap idiom (registry hot
 reload): readers may race on the reference, but every mutation must
 serialize.
 
-Two honest limitations, by design:
+Since graftlint v2 the check is one call level deep: a PRIVATE helper
+(leading-underscore method) whose guarded accesses are unlocked passes
+when every ``self._helper(...)`` call site inside the class holds the
+declared lock — the call graph proves the caller-holds-lock contract
+that previously needed a suppression (``ModelRegistry._swap``).  A
+public method gets no such proof (external callers are invisible), and
+ONE unlocked call site voids the proof for every access in the helper.
 
-* the check is lexical, so a helper that runs with the lock held by its
-  *caller* (``ModelRegistry._swap``) needs an inline suppression whose
-  reason states the contract — exactly the documentation such a helper
-  should carry; the runtime side (``tpu_sgd.analysis.runtime
-  .instrument_object``) validates the same declarations dynamically in
-  ``tests/test_analysis.py``, covering what lexical analysis must take
-  on faith;
+Honest limitations, by design:
+
+* the caller-holds-lock proof is class-local and one level deep — a
+  helper's helper still needs a suppression; the runtime side
+  (``tpu_sgd.analysis.runtime.instrument_object``) validates the same
+  declarations dynamically in ``tests/test_analysis.py``, covering
+  what lexical analysis must take on faith;
 * a closure defined inside a ``with`` block but executed later passes
   — none exist in the declared modules, and the runtime validator
   would catch one.
@@ -125,6 +131,7 @@ class LockDisciplineRule(Rule):
                      guards: Dict[str, Tuple[str, str]]
                      ) -> Iterable[Finding]:
         parents = build_parents(cls)
+        locked_helpers = self._locked_helpers(cls, parents, guards)
         # declared locks must exist: self.<lock> must be assigned
         # somewhere in the class (almost always __init__)
         assigned = {
@@ -154,12 +161,50 @@ class LockDisciplineRule(Rule):
                 continue
             if self._under_lock(node, parents, lock):
                 continue
+            if method is not None \
+                    and (method.name, lock) in locked_helpers:
+                # call-graph proof: every in-class call site of this
+                # private helper holds the lock, so the access runs
+                # under it even though no `with` is lexically visible
+                continue
             verb = "write of" if write else "read of"
             yield Finding(
                 self.name, mod.relpath, node.lineno, node.col_offset,
                 f"{verb} guarded attribute self.{node.attr} outside "
                 f"`with self.{lock}:` (declared in {DECLARATION} for "
                 f"{cls.name})")
+
+    def _locked_helpers(self, cls: ast.ClassDef, parents,
+                        guards: Dict[str, Tuple[str, str]]
+                        ) -> set:
+        """``(method_name, lock)`` pairs proven caller-locked: the
+        method is private (external callers are out of static reach for
+        a public one), it has at least one ``self.<method>(...)`` call
+        site in this class, and EVERY such site sits under ``with
+        self.<lock>:``.  One unlocked site voids the proof — the helper
+        really can run without the lock then."""
+        sites: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr.startswith("_")):
+                sites.setdefault(node.func.attr, []).append(node)
+        locks = {lk for lk, _ in guards.values()}
+        out = set()
+        for name, calls in sites.items():
+            # a call site inside the helper itself (recursion) proves
+            # nothing — it is only reached through the outer sites
+            outer = [c for c in calls
+                     if getattr(self._enclosing_method(c, parents, cls),
+                                "name", None) != name]
+            if not outer:
+                continue
+            for lock in locks:
+                if all(self._under_lock(c, parents, lock) for c in outer):
+                    out.add((name, lock))
+        return out
 
     @staticmethod
     def _enclosing_method(node: ast.AST, parents, cls: ast.ClassDef

@@ -1,0 +1,215 @@
+"""host-sync: no device→host sync inside a host loop's hot body.
+
+The device-resident refactors (PRs 5-6) exist to keep the dispatch
+pipeline full: the driver queues compiled programs ahead of the device
+and touches results only at cadence boundaries.  One ``.item()`` /
+``float()`` / ``np.asarray`` / ``jax.device_get`` — or an implicit
+``bool()`` coercion in an ``if``/``while`` test — on a value flowing
+out of a jitted call stalls that pipeline: the host blocks until the
+device drains, every iteration, turning an async dispatch loop back
+into lockstep.  ``block_until_ready`` is a barrier rather than a
+transfer but stalls identically, so it counts.
+
+The rule fires when, inside a ``for``/``while`` statement body that is
+NOT itself traced (a host loop, not a scan), a sync operation is
+applied to a **device value** — a local name bound (possibly through
+aliasing or tuple unpacking) to the result of calling a jit-compiled
+callable or ``jax.device_put``.  It is interprocedural through
+:class:`~tpu_sgd.analysis.dataflow.ProjectIndex` sync summaries: a
+helper that forces the sync internally is flagged at its loop-borne
+call site, because that is the line that pays.
+
+What does NOT fire, by design:
+
+* syncs on values the rule cannot prove device-resident (host numpy
+  flowing through ``np.asarray`` is free) — silence over wolf-crying;
+* the sanctioned bulk-fetch spelling ``tuple(np.asarray(a) for a in
+  ys)``: the generator variable is not itself a tracked device name,
+  and the pattern is exactly the one-fetch-per-leaf boundary idiom the
+  drivers document;
+* syncs outside any loop (a run-end fetch is the contract, not a bug).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set
+
+from tpu_sgd.analysis.core import Finding, ModuleFile, Rule
+from tpu_sgd.analysis.dataflow import (DefNode, ModuleInfo, ProjectIndex,
+                                       expr_reads, func_params, scope_nodes)
+from tpu_sgd.analysis.tracing import FuncNode, enclosing
+
+#: host loop statements; comprehensions are deliberately excluded (the
+#: bulk-fetch-at-the-boundary idiom is a genexp and is sanctioned)
+LOOP_KINDS = (ast.For, ast.While)
+
+
+def _enclosing_host_loop(node: ast.AST, parents,
+                         fn: ast.AST) -> Optional[ast.AST]:
+    """The nearest for/while whose PER-TRIP region contains ``node``.
+
+    Per-trip means the loop's body (and, for ``while``, its test, which
+    re-evaluates every trip).  A ``for``'s iterable and either loop's
+    ``else`` clause evaluate exactly once, so a sync there belongs to
+    the next loop out (if any) — ``for i in range(int(n_dev)):`` is the
+    sanctioned one-fetch-then-iterate spelling, not a per-trip sync."""
+    child: ast.AST = node
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.For):
+            if child in cur.body:
+                return cur
+        elif isinstance(cur, ast.While):
+            if child in cur.body or child is cur.test:
+                return cur
+        elif isinstance(cur, FuncNode):
+            return None  # nested def: runs when called, not per trip
+        child, cur = cur, parents.get(cur)
+    return None
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        project: ProjectIndex = options["project"]
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            mi = project.info(mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, DefNode):
+                    yield from self._check_function(mod, mi, project, node)
+
+    def _check_function(self, mod: ModuleFile, mi: ModuleInfo,
+                        project: ProjectIndex,
+                        fn: ast.AST) -> Iterable[Finding]:
+        if project.is_traced(mod, fn):
+            return  # a traced body's "loop" unrolls or lowers — no host
+        jitted = project.jitted_value_names(mi, fn)
+        dev = project.device_value_names(mi, fn, jitted)
+        if not dev:
+            return
+        # bool tests use the PURE subset: the name tracking is flow-
+        # insensitive, and the idiomatic `c = int(c)` scalar rebind
+        # followed by `if c > 0:` must not re-flag the (already
+        # sync-checked) fetch as a second implicit-bool sync
+        pure = dev - self._host_rebound(mi, project, fn, dev, jitted)
+        parents = mi.parents
+        for n in scope_nodes(fn):
+            if isinstance(n, ast.While):
+                # the while's own test re-evaluates every trip: it IS
+                # the loop, whether or not another loop encloses it
+                yield from self._check_bool_test(mod, pure, n)
+                continue
+            loop = _enclosing_host_loop(n, parents, fn)
+            if loop is None:
+                continue
+            if isinstance(n, ast.Call):
+                yield from self._check_call(mod, mi, project, fn, dev, n)
+            elif isinstance(n, ast.If):
+                yield from self._check_bool_test(mod, pure, n)
+
+    def _check_call(self, mod: ModuleFile, mi: ModuleInfo,
+                    project: ProjectIndex, fn: ast.AST, dev: Set[str],
+                    call: ast.Call) -> Iterable[Finding]:
+        kind = project.sync_op_kind(mi, call)
+        if kind is not None:
+            arg = project._sync_arg_expr(mi, call)
+            if arg is None:
+                return
+            touched = expr_reads(arg) & dev
+            if touched:
+                name = sorted(touched)[0]
+                yield Finding(
+                    self.name, mod.relpath, call.lineno, call.col_offset,
+                    f"`{kind}` on device value `{name}` inside a host "
+                    "loop body forces a device->host sync every "
+                    "iteration, stalling the dispatch pipeline; fetch "
+                    "once after the loop, or move the loop on device "
+                    "(lax.scan / the resident driver)")
+            return
+        # interprocedural: a helper whose summary says parameter j flows
+        # into a sync, called with a device value at position j
+        for tmi, d in project.resolve_call(mi, call):
+            syncing = project.syncing_params(d)
+            if not syncing:
+                continue
+            params = func_params(d)
+            for j in syncing:
+                if j >= len(call.args):
+                    continue
+                touched = expr_reads(call.args[j]) & dev
+                if touched:
+                    pname = params[j] if j < len(params) else f"#{j}"
+                    yield Finding(
+                        self.name, mod.relpath, call.lineno,
+                        call.col_offset,
+                        f"call to `{getattr(d, 'name', '?')}` forces a "
+                        f"device->host sync on its parameter "
+                        f"`{pname}` (receiving device value "
+                        f"`{sorted(touched)[0]}`) inside a host loop "
+                        "body; hoist the sync out of the loop or keep "
+                        "the value on device")
+                    break  # one finding per call site is enough
+
+    @staticmethod
+    def _host_rebound(mi: ModuleInfo, project: ProjectIndex, fn: ast.AST,
+                      dev: Set[str], jitted: Set[str]) -> Set[str]:
+        """Device names that are ALSO assigned a non-device value
+        somewhere in ``fn`` (``c = int(c)``): ambiguous under the
+        flow-insensitive tracking, so implicit-bool checks skip them."""
+        out: Set[str] = set()
+        for n in scope_nodes(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            val = n.value
+            is_dev = (isinstance(val, ast.Call)
+                      and project.is_device_call(mi, fn, val, jitted)) \
+                or (isinstance(val, ast.Name) and val.id in dev)
+            if is_dev:
+                continue
+            for t in n.targets:
+                names = [t] if isinstance(t, ast.Name) else (
+                    [e for e in t.elts if isinstance(e, ast.Name)]
+                    if isinstance(t, (ast.Tuple, ast.List)) else [])
+                for e in names:
+                    if e.id in dev:
+                        out.add(e.id)
+        return out
+
+    @staticmethod
+    def _test_names(test: ast.AST) -> List[str]:
+        """Names whose truth/comparison drives a bool test: bare names,
+        ``not x``, ``and``/``or`` arms, and comparison operands (``if
+        c > 0:`` on a device array builds a device bool then coerces it
+        — the same per-trip sync with one more hop)."""
+        out: List[str] = []
+        if isinstance(test, ast.Name):
+            out.append(test.id)
+        elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            out.extend(HostSyncRule._test_names(test.operand))
+        elif isinstance(test, ast.BoolOp):
+            for v in test.values:
+                out.extend(HostSyncRule._test_names(v))
+        elif isinstance(test, ast.Compare):
+            for side in [test.left] + list(test.comparators):
+                if isinstance(side, ast.Name):
+                    out.append(side.id)
+        return out
+
+    def _check_bool_test(self, mod: ModuleFile, dev: Set[str],
+                         stmt: ast.AST) -> Iterable[Finding]:
+        """``if device_val:`` / ``while device_val > 0:`` — the implicit
+        ``bool()`` coercion is a sync with no visible call."""
+        test = stmt.test
+        for nm in dict.fromkeys(self._test_names(test)):
+            if nm in dev:
+                yield Finding(
+                    self.name, mod.relpath, test.lineno, test.col_offset,
+                    f"truth-testing device value `{nm}` inside a host "
+                    "loop body is an implicit bool() device->host sync "
+                    "every iteration; compare on device and fetch the "
+                    "flag at a cadence boundary instead")

@@ -33,8 +33,11 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Union
 
 __all__ = [
-    "CompileCountError", "DispatchCountError",
+    "CompileCountError", "DispatchCountError", "HostSyncError",
+    "CallbackBufferError",
     "assert_compile_count", "assert_dispatch_count", "count_dispatches",
+    "assert_no_host_sync", "count_host_syncs",
+    "assert_bounded_callback_buffer",
     "InstrumentedLock", "LocksetRecorder", "LockViolation",
     "instrument_object",
 ]
@@ -205,6 +208,161 @@ def assert_dispatch_count(expected: int, *, at_most: bool = False):
             "fused path usually mean an eager jnp op between dispatches "
             "or a loop that failed to stay device-resident (see "
             "optimize/resident_driver.py)")
+
+
+# -- host-sync counting -----------------------------------------------------
+
+class HostSyncError(AssertionError):
+    """The wrapped region forced more device→host transfers than the
+    contract allows."""
+
+
+@contextlib.contextmanager
+def count_host_syncs():
+    """Count device→host materializations in a region — the runtime twin
+    of the static ``host-sync`` rule (the rule catches the syncing
+    *pattern*, this counts the *effect* on a live run).
+
+    Yields a dict whose ``"n"`` entry is the number of jax arrays
+    materialized to host so far inside the region, and whose
+    ``"shapes"`` entry lists ``(shape, dtype)`` per materialization
+    (the debugging breadcrumb: WHICH fetch fired).  Counting hooks the
+    Python-level funnels on ``jax.Array`` — the ``_value`` property
+    (``float()``/``int()``/``bool()`` scalar coercions route here),
+    ``.item()``, and ``__array__`` — reentrancy-guarded so a funnel
+    calling another counts once.  Only entries that actually COPY
+    count: a re-read of an array whose host value is already cached
+    (``_npy_value``) is free.
+
+    Backend honesty: on the CPU backend ``np.asarray(arr)`` /
+    ``jax.device_get`` convert through the C++ buffer protocol —
+    zero-copy, invisible to these hooks, and genuinely free of DMA, so
+    a zero count there is the truth, not a blind spot; on an
+    accelerator backend the same spelling routes through ``__array__``
+    and is counted.  ``block_until_ready`` is a barrier, not a
+    transfer, and is never counted; use :func:`count_dispatches` for
+    launch accounting.
+
+    Not reentrant; the patch is process-global for the duration, so
+    keep the region single-actor (a concurrent thread's fetches would
+    be counted too — honestly, but confusingly).
+    """
+    from jax._src import array as _array
+
+    counter = {"n": 0, "shapes": []}
+    cls = _array.ArrayImpl
+    depth = threading.local()
+
+    def _tick(self):
+        if getattr(depth, "d", 0) > 0:
+            return  # inner funnel of an already-counted materialization
+        if self._npy_value is None:  # an actual copy, not a cache hit
+            counter["n"] += 1
+            counter["shapes"].append((tuple(self.shape), str(self.dtype)))
+
+    @contextlib.contextmanager
+    def _nested():
+        depth.d = getattr(depth, "d", 0) + 1
+        try:
+            yield
+        finally:
+            depth.d -= 1
+
+    orig_value, orig_item, orig_array = cls._value, cls.item, cls.__array__
+
+    @property
+    def _counting_value(self):
+        _tick(self)
+        with _nested():
+            return orig_value.fget(self)
+
+    def _counting_item(self, *args):
+        _tick(self)
+        with _nested():
+            return orig_item(self, *args)
+
+    def _counting_array(self, *args, **kwargs):
+        _tick(self)
+        with _nested():
+            return orig_array(self, *args, **kwargs)
+
+    try:
+        cls._value = _counting_value
+        cls.item = _counting_item
+        cls.__array__ = _counting_array
+        yield counter
+    finally:
+        cls._value = orig_value
+        cls.item = orig_item
+        cls.__array__ = orig_array
+
+
+def assert_no_host_sync(fn: Optional[Callable] = None, *, allow: int = 0):
+    """Assert a region (or ``fn()``) forces no device→host transfers.
+
+    The resident training driver's steady-state contract: between
+    dispatch and the cadence boundary the host touches NOTHING — one
+    stray ``.item()`` / ``float()`` / ``np.asarray`` turns the
+    device-resident loop back into per-trip lockstep, which is exactly
+    what the static ``host-sync`` rule flags in source.  ``allow``
+    admits the documented boundary fetches (e.g. the resident driver's
+    three end-of-run scalars).
+
+    Use as a context manager (``with assert_no_host_sync(): ...``) or
+    call-through (``result = assert_no_host_sync(lambda: step(w))``).
+    """
+    if fn is not None:
+        with _no_host_sync_region(allow):
+            return fn()
+    return _no_host_sync_region(allow)
+
+
+@contextlib.contextmanager
+def _no_host_sync_region(allow: int):
+    with count_host_syncs() as counter:
+        yield counter
+    if counter["n"] > allow:
+        shown = ", ".join(
+            f"{s}:{d}" for s, d in counter["shapes"][:8])
+        raise HostSyncError(
+            f"region forced {counter['n']} device->host transfer(s); "
+            f"the contract allows {allow}.  Transfers seen (first 8): "
+            f"[{shown}].  A sync on a hot path usually means an "
+            ".item()/float()/np.asarray on a device value — fetch at "
+            "the cadence boundary instead (see the host-sync rule, "
+            "tpu_sgd/analysis)")
+
+
+# -- callback buffer bounds -------------------------------------------------
+
+class CallbackBufferError(AssertionError):
+    """A callback-carried host buffer grew beyond its declared bound."""
+
+
+@contextlib.contextmanager
+def assert_bounded_callback_buffer(buf, *, max_len: Optional[int] = None):
+    """Assert a host buffer a callback feeds stays bounded across the
+    region — the runtime twin of ``callback-discipline``'s bounded-
+    buffer check (the static rule catches closure ``append``s in the
+    callback body; this pins the live object's size over real firings).
+
+    ``buf`` is the buffer itself or a zero-arg callable returning it
+    (anything sized: list, deque, ndarray ring).  ``max_len`` defaults
+    to the ENTRY length — the no-growth contract a preallocated ring
+    satisfies and an append-per-firing history violates.
+    """
+    get = buf if callable(buf) else (lambda: buf)
+    start = len(get())
+    bound = start if max_len is None else max_len
+    yield
+    end = len(get())
+    if end > bound:
+        raise CallbackBufferError(
+            f"callback buffer grew to {end} element(s); the bound is "
+            f"{bound} (entry length {start}).  An unbounded host buffer "
+            "pinned by a compiled program's callback accumulates for "
+            "the whole run — hand windows to a bookkeeper with a "
+            "documented bound instead (see optimize/resident_driver.py)")
 
 
 # -- lock instrumentation ---------------------------------------------------

@@ -108,10 +108,16 @@ def _is_tracer_callable(node: ast.AST) -> bool:
 
 
 class TracedIndex:
-    """Per-module index answering :meth:`is_traced` for any node."""
+    """Per-module index answering :meth:`is_traced` for any node.
+
+    ``close=False`` stops after seeding (decorators + wrap sites): the
+    project-wide index (``tpu_sgd.analysis.dataflow.ProjectIndex``)
+    runs its own cross-module closure over the seeds instead of the
+    module-local one."""
 
     def __init__(self, tree: ast.Module,
-                 parents: Optional[Dict[ast.AST, ast.AST]] = None):
+                 parents: Optional[Dict[ast.AST, ast.AST]] = None,
+                 close: bool = True):
         self.tree = tree
         self.parents = parents if parents is not None else \
             build_parents(tree)
@@ -121,7 +127,8 @@ class TracedIndex:
                 self._defs_by_name.setdefault(node.name, []).append(node)
         self._traced: Set[ast.AST] = set()
         self._seed()
-        self._close()
+        if close:
+            self._close()
 
     # -- seeding -----------------------------------------------------------
     def _seed(self) -> None:

@@ -35,6 +35,12 @@ DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
 #: (rows, d, x-dtype, w-ndim, w-cols, w-dtype, activation)
 _MATVEC_PROGRAMS: dict = {}
 
+#: memo-key contract (graftlint memo-key rule): the factory receives
+#: the fully-formed key tuple — callers build it from the shape/dtype/
+#: activation roots documented above, and the factory's only program-
+#: affecting read (the activation tag) comes out of the key itself
+GRAFTLINT_MEMO = {"_MATVEC_PROGRAMS": ("key",)}
+
 
 def program_cache_size() -> int:
     return len(_MATVEC_PROGRAMS)

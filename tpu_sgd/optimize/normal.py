@@ -85,6 +85,15 @@ def _solve(A, b, yty, n, reg_param: float):
     return w, loss
 
 
+#: memo-key contract (graftlint memo-key rule): the compiled-solver
+#: cache keys on exactly these roots; reg is baked into the program, so
+#: dropping reg_param from the key would serve one lambda's solver to
+#: every other
+GRAFTLINT_MEMO = {
+    "NormalEquations._cache": ("reg_param", "mesh", "with_valid"),
+}
+
+
 class NormalEquations(Optimizer):
     """Exact least-squares solver behind the Optimizer boundary.
 

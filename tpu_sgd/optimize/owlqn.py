@@ -330,7 +330,9 @@ class OWLQN(LBFGS):
             # every halving.
             if swept:
                 W_trials, preds = make_trials(w, direction, xi, pg)
+                # graftlint: disable=host-sync -- swept line search: ONE bulk fetch of all trial objectives per outer iteration (the host Armijo decision), not a per-trial sync
                 F_trials = np.asarray(sweep1(W_trials))
+                # graftlint: disable=host-sync -- swept line search: the matching one-per-outer-iteration fetch of the predicted decreases
                 preds_h = np.asarray(preds)
                 ok = (F_trials <= F + 1e-4 * preds_h) & (preds_h < 0)
                 j = int(np.argmax(ok)) if ok.any() else -1

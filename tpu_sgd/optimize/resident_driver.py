@@ -53,6 +53,17 @@ stashed, the loop is stopped via the returned flag, and the ORIGINAL
 exception re-raises host-side after the dispatch returns, where
 ``TrainingSupervisor`` can see its true class and resume from the last
 checkpoint (bitwise, like every other healed path).
+
+Since graftlint v2 these are CHECKED contracts, not conventions: the
+``callback-discipline`` rule pins the stash-flag-reraise shape, the
+``ordered=True`` requirement, and the bounded-ring no-growth rule at
+every ``io_callback`` site, and ``carry-stability`` pins the
+``jnp.asarray``-pinned loop carry below (see ADVICE.md "Weak-type
+carry drift" and "io_callback exception boundary", and README "Static
+analysis" for the rule table).  Runtime twins:
+``tpu_sgd.analysis.assert_no_host_sync`` (a warmed resident run syncs
+once per cadence window + three end-of-run scalars, pinned in
+``tests/test_resident.py``) and ``assert_bounded_callback_buffer``.
 """
 
 from __future__ import annotations
@@ -355,11 +366,15 @@ class ResidentLoop:
                     # dispatch is async: block on the carry BEFORE
                     # clearing the hook — no callback outlives its
                     # dispatch only once the program has completed
+                    # graftlint: disable=host-sync -- whole-run dispatch barrier: this 'loop' trips once per run (re-trips only on a false f32 device-convergence), and the callback hook must not be cleared before the program completes
                     jax.block_until_ready(carry)
                 finally:
                     self._hooks = None
+            # graftlint: disable=host-sync -- boundary fetch: three scalars once per RUN (the while re-trips only on false device-convergence), not per iteration
             i_f = int(carry[0])
+            # graftlint: disable=host-sync -- boundary fetch, see line above
             slot_f = int(carry[9])
+            # graftlint: disable=host-sync -- boundary fetch, see line above
             conv_f = bool(carry[10])
             if hooks.error is None and slot_f:
                 # tail window: the un-replayed supersteps since the last

@@ -94,6 +94,13 @@ def stack_rows(rows):
     return out
 
 
+#: memo-key contract (graftlint memo-key rule): the sparse-kernel cache
+#: receives the fully-formed key tuple — ``_sparse_key`` builds it from
+#: (kind, rows, d, dtype, nse, K, has_bias), and the factory's program-
+#: affecting reads (kind/K/has_bias) all unpack from the key itself
+GRAFTLINT_MEMO = {"PredictEngine._sparse_compiled": ("key",)}
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
 

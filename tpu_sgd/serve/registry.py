@@ -259,13 +259,14 @@ class ModelRegistry:
 
     def _swap(self, version: int, model):
         """Caller holds ``self._lock`` and is responsible for emitting the
-        'reloaded' event AFTER releasing it (re-entrant listeners)."""
+        'reloaded' event AFTER releasing it (re-entrant listeners).
+        graftlint v2 proves the contract through the call graph (every
+        in-class call site of this private helper is under the lock),
+        so the accesses below need no suppressions; the runtime twin in
+        tests/test_analysis.py validates it dynamically too."""
         if self._version is not None and version != self._version:
-            # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
             self._previous_version = self._version
-        # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
         self._model = model  # atomic reference swap: readers see old or new
-        # graftlint: disable=lock-discipline -- caller holds _lock (docstring contract); runtime-validated in tests/test_analysis.py
         self._version = version
         self.reload_count += 1
         logger.info("serving model hot-swapped to version %d", version)
