@@ -25,6 +25,19 @@ Deterministic by construction: all fault schedules draw from
 Usage::
 
     python scripts/chaos_soak.py --seed 0 [--iters 40] [--quiet]
+        [--trace chaos_trace.jsonl] [--slo slo.json] [--chrome t.json]
+
+Observability (ISSUE 8): every soak emits a trace — the soak's event
+log IS the trace file (``--trace``; listener events, ``trace_span`` /
+``trace_event`` / ``metric_counters`` records, and the serve_reload
+stream interleave on ONE lock-serialized JSONL, torn tail included),
+and after the invariants hold the CLI pipes it straight through
+``python -m tpu_sgd.obs.report``: a Chrome trace-event export
+(``--chrome``, Perfetto-loadable) plus an SLO verdict (``--slo``, or
+the built-in :data:`DEFAULT_SLOS` asserting the soak really exercised
+train windows, checkpoint saves, and serve batches).  Exit code 0 =
+invariants held AND every SLO passed; an SLO violation exits nonzero
+through the report CLI's own exit-code contract.
 
 Exit code 0 = all invariants held.  Also exposed as the ``slow``-marked
 ``tests/test_reliability.py::test_chaos_soak`` (excluded from tier-1).
@@ -42,6 +55,24 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+#: the built-in SLO document the CLI evaluates when ``--slo`` is not
+#: given: not latency theater (this 2-core harness drowns wall clocks
+#: in noise — counts are the truth), but structural assertions that the
+#: soak's trace really contains the full cycle it claims to have
+#: soaked.  A soak that silently skipped a phase fails its SLO gate.
+DEFAULT_SLOS = {"slos": [
+    {"name": "train-windows-fired", "metric": "span_count",
+     "span": "train.window", "min": 1},
+    {"name": "checkpoint-saves-traced", "metric": "span_count",
+     "span": "checkpoint.save", "min": 1},
+    {"name": "serve-batches-traced", "metric": "span_count",
+     "span": "serve.batch", "min": 1},
+    {"name": "no-serve-stall", "metric": "span_max_s",
+     "span": "serve.batch", "max": 30.0},
+    {"name": "callback-windows-counted", "metric": "counter",
+     "counter": "train.io_callback", "min": 1},
+]}
 
 
 def _make_data(seed: int, n: int = 768, d: int = 12):
@@ -86,9 +117,18 @@ def _make_resident_opt(iters: int, retry=None):
     return opt
 
 
-def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
+def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
+         trace_path: str | None = None) -> dict:
     """Run the soak; returns a summary dict.  Raises AssertionError on
-    any invariant violation, TimeoutError/DeadlineExceeded on a hang."""
+    any invariant violation, TimeoutError/DeadlineExceeded on a hang.
+
+    ``trace_path`` routes the soak's event log to a PERSISTENT file and
+    turns the observability layer on over it (``tpu_sgd.obs``: spans +
+    runtime counters share the log as a caller-owned sink), so the
+    returned file is a complete soak trace — including the deliberately
+    torn tail line phase 3 appends, which ``obs.report`` must (and
+    does) parse past via the shared ``read()`` semantics."""
+    from tpu_sgd import obs
     from tpu_sgd.models import LinearRegressionModel
     from tpu_sgd.reliability import (
         CircuitBreaker,
@@ -121,8 +161,21 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
 
     with tempfile.TemporaryDirectory() as work:
         ckpt_dir = os.path.join(work, "ckpt")
-        log_path = os.path.join(work, "events.jsonl")
+        log_path = trace_path or os.path.join(work, "events.jsonl")
+        if trace_path is not None and os.path.exists(trace_path):
+            # the log opens in append mode and every soak ENDS with a
+            # deliberately torn tail line — a rerun must start from an
+            # empty trace or its first record would concatenate onto the
+            # previous run's torn tail into one malformed interior line
+            # (which read() correctly refuses to tolerate)
+            os.truncate(trace_path, 0)
         event_log = JsonLinesEventLog(log_path, fsync=True)
+        if trace_path is not None:
+            # ONE stream: listener events, serve_reload records, and
+            # the obs layer's trace_span/trace_event/metric_counters
+            # all interleave on the caller-owned log — the spelling
+            # tests/test_obs.py pins and obs.report consumes whole
+            obs.enable(event_log)
         quarantined = []
         manager = CheckpointManager(
             ckpt_dir,
@@ -392,6 +445,12 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True) -> dict:
             f"by injected admission faults, breaker={summary['breaker']}")
 
         # ---- phase 3: event log survives a torn tail ---------------------
+        if trace_path is not None:
+            # flushes the cumulative counter snapshot as the trace's
+            # final metric_counters record, unwinds the runtime
+            # patches, and drops the sink ref (caller-owned log: the
+            # close below is ours)
+            obs.disable()
         event_log.close()
         with open(log_path, "a") as f:
             f.write('{"kind": "torn_mid_rec')  # simulated crash tail
@@ -412,12 +471,47 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--iters", type=int, default=40)
     ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--trace", metavar="OUT.jsonl",
+                    default="chaos_trace.jsonl",
+                    help="soak trace path (the soak's event log; "
+                         "default %(default)s); --trace '' disables")
+    ap.add_argument("--slo", metavar="SLO.json", default=None,
+                    help="SLO file for the post-soak report (default: "
+                         "the built-in structural assertions)")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="Chrome trace-event export path (default: "
+                         "<trace>.chrome.json)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.ERROR)  # chaos warnings are expected
-    summary = soak(seed=args.seed, iters=args.iters,
-                   verbose=not args.quiet)
+    trace = args.trace or None
+    try:
+        summary = soak(seed=args.seed, iters=args.iters,
+                       verbose=not args.quiet, trace_path=trace)
+    finally:
+        # a failed invariant must not leave the runtime patches or the
+        # closed log's sink ref behind (idempotent when trace is off)
+        from tpu_sgd import obs
+
+        obs.disable()
     print(json.dumps(summary, indent=2, default=str))
-    return 0
+    if trace is None:
+        return 0
+
+    # ---- the report pipeline over the soak's own trace -------------------
+    # (torn tail and all: phase 3 tore the final line on purpose, and
+    # obs.report parses past it via the shared read() semantics)
+    from tpu_sgd.obs import report as obs_report
+
+    slo_path = args.slo
+    if slo_path is None:
+        slo_path = trace + ".slo.json"
+        with open(slo_path, "w") as f:
+            json.dump(DEFAULT_SLOS, f, indent=2)
+    chrome = args.chrome or (trace + ".chrome.json")
+    # the report CLI's exit code IS this CLI's exit code from here on:
+    # 0 = SLOs hold, 1 = violation, 2 = unreadable trace/SLO file
+    return obs_report.main([trace, "--slo", slo_path,
+                            "--chrome", chrome])
 
 
 if __name__ == "__main__":

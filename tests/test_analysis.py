@@ -24,7 +24,7 @@ from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
 from tpu_sgd.analysis.rules_lock import LockDisciplineRule
 from tpu_sgd.analysis.rules_memo import MemoKeyRule
 from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
-from tpu_sgd.analysis.rules_sync import HostSyncRule
+from tpu_sgd.analysis.rules_sync import HostSyncRule, ObsDisciplineRule
 from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
                                       LocksetRecorder, assert_compile_count,
                                       instrument_object)
@@ -477,7 +477,7 @@ def test_mutation_deleted_lock_block_fails_lint():
 
 def test_every_rule_fires_on_its_seeded_violation():
     """One seeded violation per rule, one combined sweep: each of the
-    nine rules must report exactly its own planted bug."""
+    ten rules must report exactly its own planted bug."""
     registry = {"io.feed": "seeded.py"}
     seeded = mod("""
         import threading
@@ -487,6 +487,7 @@ def test_every_rule_fires_on_its_seeded_violation():
         from jax import lax
         from jax.experimental import io_callback
         from functools import partial
+        from tpu_sgd.obs.spans import event
 
         GRAFTLINT_LOCKS = {"S": {"_q": "_lock"}}
 
@@ -539,6 +540,11 @@ def test_every_rule_fires_on_its_seeded_violation():
                 fn = jax.jit(lambda w: w * lr)
                 _PROGRAMS[k] = fn
             return fn
+
+        def traced_tick(w):
+            out = step(w)
+            event("train.tick", loss=out)
+            return out
     """, relpath="seeded.py")
     from tpu_sgd.analysis.core import default_rules
     rules = [FailpointCoverageRule(registry=registry)

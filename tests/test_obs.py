@@ -1,0 +1,621 @@
+"""Unified observability layer tests (ISSUE 8): span tracing, runtime
+counters, and the trace/SLO report pipeline.
+
+The two load-bearing contracts pinned here:
+
+* DISABLED is a measured no-op — one module-global load and a falsy
+  branch per hook, the failpoints discipline (`span()` returns the one
+  shared singleton, `inc()` bumps nothing, zero runtime patches
+  installed).
+* ENABLED adds ZERO dispatches, compiles, or host syncs on the warmed
+  superstep and resident hot paths (the acceptance criterion), measured
+  both by the analysis twins (disabled baseline) and by the promoted
+  counters themselves (enabled run) — the numbers must agree exactly.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd import obs
+from tpu_sgd.obs import counters as obs_counters
+from tpu_sgd.obs import report as obs_report
+from tpu_sgd.obs import spans as obs_spans
+from tpu_sgd.obs.spans import disable_tracing, enable_tracing
+from tpu_sgd.utils.events import JsonLinesEventLog
+
+
+class ListSink:
+    """In-memory sink on the ``emit(kind, payload)`` contract."""
+
+    def __init__(self, raising: bool = False):
+        self.records = []
+        self.raising = raising
+
+    def emit(self, kind, payload):
+        if self.raising:
+            raise RuntimeError("sink intentionally broken")
+        self.records.append((kind, dict(payload)))
+
+    def spans(self, name=None):
+        return [p for k, p in self.records if k == "trace_span"
+                and (name is None or p["name"] == name)]
+
+    def events(self, name=None):
+        return [p for k, p in self.records if k == "trace_event"
+                and (name is None or p["name"] == name)]
+
+
+@pytest.fixture(autouse=True)
+def _obs_off():
+    """Every test starts and ends with the layer fully disabled."""
+    obs.disable()
+    obs_counters.reset()
+    yield
+    obs.disable()
+    obs_counters.reset()
+
+
+# -- disabled-mode cost contract --------------------------------------------
+
+def test_disabled_span_is_the_shared_noop_singleton():
+    """`span(...)` disabled returns ONE shared object — no allocation,
+    no formatting; `event`/`inc` return before touching anything."""
+    s1 = obs_spans.span("train.superstep", i0=1)
+    s2 = obs_spans.span("serve.batch")
+    assert s1 is s2  # the singleton, not a fresh object per call
+    with s1 as s:
+        assert s.set(anything=1) is s  # set() is a no-op that chains
+    obs_spans.event("reliability.retry", attempt=1)  # must not raise
+    obs_counters.inc("serve.reject")
+    assert obs_counters.snapshot() == {}
+
+
+def test_disabled_hooks_are_measured_noops():
+    """The failpoints discipline, measured: sub-microsecond per call on
+    this noisy 2-core host (bound ~20x the measured mean for CI
+    headroom).  `span()` pays one kwargs dict + global load + branch;
+    `inc()` pays the global load + branch."""
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs_spans.span("train.step")
+    per_span = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs_counters.inc("train.io_callback")
+    per_inc = (time.perf_counter() - t0) / n
+    assert per_span < 2e-6, f"disabled span costs {per_span*1e9:.0f}ns"
+    assert per_inc < 2e-6, f"disabled inc costs {per_inc*1e9:.0f}ns"
+
+
+def test_disabled_installs_zero_runtime_patches():
+    """A production process that never opts in runs the STOCK runtime:
+    enabling installs the patches, disabling restores the originals."""
+    import jax
+
+    orig_put = jax.device_put
+    obs_counters.enable()
+    try:
+        assert jax.device_put is not orig_put
+    finally:
+        obs_counters.disable()
+    assert jax.device_put is orig_put
+
+
+# -- span mechanics ----------------------------------------------------------
+
+def test_span_nesting_and_attrs():
+    sink = ListSink()
+    enable_tracing(sink)
+    try:
+        with obs_spans.span("train.superstep", i0=5) as outer:
+            with obs_spans.span("train.replay"):
+                pass
+            outer.set(steps=4)
+    finally:
+        disable_tracing()
+    inner, = sink.spans("train.replay")
+    outer, = sink.spans("train.superstep")
+    assert inner["parent_id"] == outer["span_id"]  # child closed first
+    assert outer["parent_id"] == 0
+    assert outer["i0"] == 5 and outer["steps"] == 4
+    assert outer["dur_s"] >= inner["dur_s"] >= 0.0
+    assert outer["error"] is None
+
+
+def test_span_records_error_class_and_propagates():
+    sink = ListSink()
+    enable_tracing(sink)
+    try:
+        with pytest.raises(ValueError):
+            with obs_spans.span("checkpoint.save"):
+                raise ValueError("boom")
+    finally:
+        disable_tracing()
+    rec, = sink.spans("checkpoint.save")
+    assert rec["error"] == "ValueError"
+
+
+def test_spans_are_thread_aware():
+    """Each thread keeps its own stack: a worker's span must not parent
+    onto whatever the main thread has open (the prefetch-worker /
+    flush-thread contract), and the subsystem tag is per-thread too."""
+    sink = ListSink()
+    enable_tracing(sink)
+    tags = {}
+    try:
+        def worker():
+            with obs_spans.span("ingest.produce"):
+                tags["worker"] = obs_spans.current_subsystem()
+                time.sleep(0.005)
+
+        with obs_spans.span("train.superstep"):
+            t = threading.Thread(target=worker, name="w0")
+            t.start()
+            tags["main"] = obs_spans.current_subsystem()
+            t.join()
+    finally:
+        disable_tracing()
+    produce, = sink.spans("ingest.produce")
+    assert produce["parent_id"] == 0  # NOT nested under train.superstep
+    assert produce["thread"] == "w0"
+    assert tags == {"worker": "ingest", "main": "train"}
+    assert obs_spans.current_subsystem() == "untagged"
+
+
+def test_raising_sink_never_kills_the_hot_path():
+    enable_tracing(ListSink(raising=True))
+    try:
+        with obs_spans.span("train.step", i=1):
+            pass  # span exit swallows the sink error
+        obs_spans.event("reliability.retry")  # ditto
+    finally:
+        disable_tracing()
+
+
+# -- counters ----------------------------------------------------------------
+
+def test_counters_inc_snapshot_deltas_reset():
+    obs_counters.enable()
+    try:
+        obs_counters.inc("serve.reject")
+        with obs_counters.deltas() as d:
+            obs_counters.inc("serve.reject", 2)
+            obs_counters.inc("ingest.wire", nbytes=128)
+        got = d.get()
+        assert got == {"serve.reject": {"n": 2, "bytes": 0},
+                       "ingest.wire": {"n": 1, "bytes": 128}}
+        snap = obs_counters.snapshot()
+        assert snap["serve.reject"]["n"] == 3
+    finally:
+        obs_counters.disable()
+    # values survive disable (scrape-after-stop); reset clears
+    assert obs_counters.snapshot()["serve.reject"]["n"] == 3
+    obs_counters.reset()
+    assert obs_counters.snapshot() == {}
+
+
+def test_counters_attribute_runtime_events_to_the_open_subsystem():
+    """Dispatches/compiles/syncs/h2d land under the span-derived tag of
+    the thread that caused them — the straggler-attribution surface.
+    Tagging rides the span stack, so tracing must be on too (the facade
+    enables both)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones((8, 8))
+    f(x).block_until_ready()  # warm BEFORE enabling
+    enable_tracing(ListSink())
+    obs_counters.enable()
+    try:
+        with obs_spans.span("train.superstep"):
+            y = f(x)
+            v = float(y[0, 0])  # eager slice + scalar fetch
+        with obs_spans.span("ingest.produce"):
+            jax.device_put(np.ones((4, 4), np.float32))
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+    assert v == 3.0
+    assert snap["train.dispatch"]["n"] >= 1     # the warmed f(x) launch
+    assert snap["train.host_sync"]["n"] >= 1    # the float() fetch
+    assert snap["train.host_sync"]["bytes"] >= 4
+    assert snap["ingest.h2d"]["n"] == 1
+    assert snap["ingest.h2d"]["bytes"] == 64
+    # no compile of the WARMED function; the eager slice may compile
+    assert snap.get("untagged.dispatch", {"n": 0})["n"] == 0
+
+
+def test_counters_enable_disable_roundtrip_under_twins():
+    """The analysis twins nest over the promoted patches (both
+    patch/restore LIFO) and agree with them on a warmed function."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis.runtime import count_dispatches
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((4,))
+    f(x).block_until_ready()
+    obs_counters.enable()
+    try:
+        obs_counters.reset()
+        with count_dispatches() as twin:
+            f(x).block_until_ready()
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+    assert twin["n"] == 1
+    assert snap["untagged.dispatch"]["n"] == 1  # no span open: untagged
+
+
+# -- facade ------------------------------------------------------------------
+
+def test_facade_owns_trace_log_and_flushes_counters(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    with obs.span("train.superstep", i0=1):
+        obs.inc("train.io_callback")
+    obs.flush_counters()
+    obs.disable()  # flushes once more + closes the owned log
+    records = JsonLinesEventLog.read(path)
+    kinds = [r["kind"] for r in records]
+    assert "trace_span" in kinds
+    assert kinds.count("metric_counters") == 2
+    last = [r for r in records if r["kind"] == "metric_counters"][-1]
+    assert last["counters"]["train.io_callback"]["n"] == 1
+
+
+def test_facade_shares_a_listener_event_log(tmp_path):
+    """Traces interleave with listener events on ONE JSONL stream — the
+    chaos-soak spelling (caller keeps ownership)."""
+    from tpu_sgd.utils.events import IterationEvent
+
+    path = str(tmp_path / "shared.jsonl")
+    log = JsonLinesEventLog(path)
+    obs.enable(log, with_counters=False)
+    log.on_iteration(IterationEvent(1, 0.5, 0.1, 32, 0.01))
+    with obs.span("train.step", i=1):
+        pass
+    obs.disable()  # caller-owned: must NOT close it
+    log.on_iteration(IterationEvent(2, 0.4, 0.1, 32, 0.01))
+    log.close()
+    kinds = [r["kind"] for r in JsonLinesEventLog.read(path)]
+    assert kinds == ["iteration", "trace_span", "iteration"]
+
+
+def test_reenable_with_new_path_closes_previous_owned_log(tmp_path):
+    """A second enable() must not leak the first's file handle: the
+    previously owned log is closed (tail flushed) when the sink swaps."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    obs.enable(a)
+    first = obs._OWNED_LOG
+    with obs.span("train.step", i=1):
+        pass
+    obs.enable(b)  # swap without an intervening disable()
+    assert first._f.closed  # the leak the review caught
+    with obs.span("train.step", i=2):
+        pass
+    obs.disable()
+    ka = [r for r in JsonLinesEventLog.read(a) if r["kind"] == "trace_span"]
+    kb = [r for r in JsonLinesEventLog.read(b) if r["kind"] == "trace_span"]
+    assert [r["i"] for r in ka] == [1]
+    assert [r["i"] for r in kb] == [2]
+
+
+# -- the acceptance pin: enabled obs adds ZERO runtime events ---------------
+
+def _data(rng, n=400, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, d).astype(np.float32)
+    y = (X @ w).astype(np.float32)
+    return X, y
+
+
+def _opt(iters=24, k=4, c=0):
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+    from tpu_sgd.utils.events import SGDListener
+
+    o = (GradientDescent().set_num_iterations(iters).set_step_size(0.1)
+         .set_mini_batch_fraction(0.5).set_sampling("sliced")
+         .set_convergence_tol(0.0).set_seed(7).set_superstep(k)
+         .set_listener(SGDListener()))
+    if c:
+        o.set_residency(c)
+    return o
+
+
+def test_enabled_obs_superstep_driver_zero_added_runtime_events(rng):
+    """ISSUE 8 acceptance: tracing+counters ENABLED, the warmed
+    superstep driver shows ZERO additional compiles, dispatches, or
+    host syncs versus disabled — the disabled baseline measured by the
+    analysis twins, the enabled run measured by the promoted counters
+    themselves, and the numbers must agree exactly."""
+    from tpu_sgd.analysis.runtime import count_dispatches, count_host_syncs
+
+    X, y = _data(rng)
+    w0 = np.zeros(6, np.float32)
+    o = _opt()
+    o.optimize_with_history((X, y), w0)  # warm every program
+    with count_host_syncs() as sc, count_dispatches() as dc:
+        o.optimize_with_history((X, y), w0)
+    base_dispatch, base_sync = dc["n"], sc["n"]
+
+    sink = ListSink()
+    obs.enable(sink)  # tracing + counters, the full production config
+    try:
+        obs_counters.reset()
+        o.optimize_with_history((X, y), w0)
+        snap = obs_counters.snapshot()
+    finally:
+        obs.disable()
+
+    def total(kind):
+        return sum(v["n"] for k, v in snap.items()
+                   if k.endswith("." + kind))
+
+    assert total("dispatch") == base_dispatch
+    assert total("host_sync") == base_sync
+    assert total("compile") == 0  # warmed: nothing recompiles
+    # and the trace really observed the run: one span per superstep
+    assert len(sink.spans("train.superstep")) == 24 // 4
+    assert all(s["i0"] % 4 == 1 for s in sink.spans("train.superstep"))
+
+
+def test_enabled_obs_resident_driver_pins_one_dispatch_windows_syncs(rng):
+    """The resident acceptance pin via the promoted counters: a warmed
+    whole-run dispatch is exactly ONE train.dispatch, host syncs are
+    exactly windows+3 scalars (the same pin the analysis twin holds
+    with tracing OFF — tests/test_resident.py), compiles are zero, and
+    every one lands under the `train` tag."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.optimize.resident_driver import ResidentBookkeeper
+
+    X, y = _data(rng)
+    w0 = np.zeros(6, np.float32)
+    iters, k, c = 64, 4, 2
+    o = _opt(iters=iters, k=k, c=c)
+    o.optimize_with_history((X, y), w0)  # warm the one compiled program
+    key = ("resident", o.gradient, o.updater, o.config, k, c)
+    loop = o._run_cache[key]
+    windows = iters // (k * c)
+
+    sink = ListSink()
+    obs.enable(sink)
+    try:
+        obs_counters.reset()
+        hooks = ResidentBookkeeper(o.config, k, c, losses=[], reg_val=0.0,
+                                   start_iter=1)
+        loop.run(jnp.asarray(w0), 0.0, 1,
+                 (jnp.asarray(X), jnp.asarray(y)), hooks)
+        snap = obs_counters.snapshot()
+    finally:
+        obs.disable()
+    assert snap["train.dispatch"]["n"] == 1          # the whole-run program
+    assert snap["train.host_sync"]["n"] == windows + 3
+    assert sum(v["n"] for n, v in snap.items()
+               if n.endswith(".compile")) == 0
+    assert snap["train.io_callback"]["n"] == windows
+    # every window emitted its span on the callback thread, i0 attrs in
+    # cadence order
+    wins = sink.spans("train.window")
+    assert [w["i0"] for w in wins] == [1 + i * k * c for i in range(windows)]
+    assert len(sink.spans("train.resident_dispatch")) == 1
+
+
+# -- serving: the satellite fields -------------------------------------------
+
+def test_serve_batch_event_carries_enqueue_depth_and_deadline_slack(tmp_path):
+    """ISSUE 8 satellite: the batcher records queue depth at enqueue and
+    deadline slack at flush; both ride the serve_batch JSONL record and
+    old positional constructors keep working."""
+    from tpu_sgd.serve.batcher import MicroBatcher
+    from tpu_sgd.serve.metrics import ServingMetrics
+    from tpu_sgd.utils.events import ServeBatchEvent
+
+    # backward compat: the pre-ISSUE positional constructor still works
+    legacy = ServeBatchEvent(3, 2, 4, 0.01, 0, 7)
+    assert legacy.enqueue_depth == 0 and legacy.deadline_slack_s == 0.0
+
+    path = str(tmp_path / "serve.jsonl")
+    log = JsonLinesEventLog(path)
+    metrics = ServingMetrics(listener=log)
+    b = MicroBatcher(lambda X: np.asarray(X).sum(axis=1),
+                     max_batch=8, max_latency_s=0.01, metrics=metrics)
+    futs = [b.submit(np.ones((4,), np.float32)) for _ in range(3)]
+    b.stop(drain=True)  # synchronous drain: deterministic single flush
+    assert [f.result(1.0) for f in futs] == [4.0] * 3
+    log.close()
+    rec, = [r for r in JsonLinesEventLog.read(path)
+            if r["kind"] == "serve_batch"]
+    assert rec["batch_size"] == 3
+    # the OLDEST request saw an empty queue at its own enqueue
+    assert rec["enqueue_depth"] == 0
+    # stop() drained before the 10ms deadline ran out -> positive slack
+    # is possible but not guaranteed on a loaded CI box; the field just
+    # has to be present and finite
+    assert np.isfinite(rec["deadline_slack_s"])
+
+
+def test_enqueue_depth_reflects_queue_at_each_requests_enqueue():
+    from tpu_sgd.serve.batcher import MicroBatcher
+
+    seen = {}
+
+    class Capture:
+        def record_reject(self):
+            pass
+
+        def record_batch(self, **kw):
+            seen.update(kw)
+
+    b = MicroBatcher(lambda X: np.zeros((np.asarray(X).shape[0],)),
+                     max_batch=8, max_latency_s=0.01, metrics=Capture())
+    for _ in range(4):
+        b.submit(np.ones((2,), np.float32))
+    b.stop(drain=True)
+    # oldest request enqueued into an empty queue; the record carries
+    # ITS depth (0), not the last request's (3)
+    assert seen["enqueue_depth"] == 0
+    assert seen["batch_size"] == 4
+    assert "deadline_slack_s" in seen
+
+
+# -- report pipeline ---------------------------------------------------------
+
+def _mk_trace(tmp_path, name="t.jsonl"):
+    """A small synthetic trace with spans, counters, a checkpoint save,
+    and a reload — enough surface for every report feature."""
+    path = str(tmp_path / name)
+    log = JsonLinesEventLog(path)
+    log.emit("metric_counters", {"ts": 1.0, "counters": {
+        "train.dispatch": {"n": 10, "bytes": 0},
+        "serve.reject": {"n": 1, "bytes": 0}}})
+    for i, dur in enumerate([0.010, 0.012, 0.011, 0.200]):
+        log.emit("trace_span", {
+            "name": "serve.batch", "ts": 10.0 + i, "t0_s": 1.0 + i,
+            "dur_s": dur, "span_id": i + 1, "parent_id": 0,
+            "thread": "flush", "error": None, "batch": 4})
+    log.emit("trace_span", {
+        "name": "checkpoint.save", "ts": 100.0, "t0_s": 50.0,
+        "dur_s": 0.05, "span_id": 90, "parent_id": 0,
+        "thread": "MainThread", "error": None, "iteration": 40})
+    log.emit("trace_event", {
+        "name": "reliability.retry", "ts": 101.0, "t0_s": 51.0,
+        "thread": "MainThread", "subsystem": "ingest", "attempt": 1})
+    log.emit("serve_reload", {"ts": 130.0, "event": "reloaded",
+                              "version": 40, "previous_version": None})
+    log.emit("metric_counters", {"ts": 200.0, "counters": {
+        "train.dispatch": {"n": 25, "bytes": 0},
+        "serve.reject": {"n": 1, "bytes": 0}}})
+    log.close()
+    return path
+
+
+def test_report_span_stats_counters_and_staleness(tmp_path):
+    records = obs_report.load_trace(_mk_trace(tmp_path))
+    stats = obs_report.span_stats(records)
+    sb = stats["serve.batch"]
+    assert sb["count"] == 4
+    assert sb["p50_s"] == 0.011   # nearest-rank over [.010,.011,.012,.200]
+    assert sb["p99_s"] == 0.200
+    assert sb["max_s"] == 0.200
+    deltas = obs_report.counter_deltas(records)
+    assert deltas == {"train.dispatch": {"n": 15, "bytes": 0}}  # 25-10; 0-delta dropped
+    stale, = obs_report.staleness_samples(records)
+    assert stale == {"version": 40, "staleness_s": 30.0}
+
+
+def test_report_chrome_trace_export(tmp_path):
+    records = obs_report.load_trace(_mk_trace(tmp_path))
+    doc = obs_report.to_chrome_trace(records)
+    evs = doc["traceEvents"]
+    complete = [e for e in evs if e["ph"] == "X"]
+    instants = [e for e in evs if e["ph"] == "i"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(complete) == 5 and len(instants) == 1
+    assert {m["args"]["name"] for m in metas} == {"flush", "MainThread"}
+    sb = [e for e in complete if e["name"] == "serve.batch"][0]
+    assert sb["ts"] == pytest.approx(1.0 * 1e6)
+    assert sb["dur"] == pytest.approx(0.010 * 1e6)
+    assert sb["args"]["batch"] == 4  # non-core fields ride args
+    assert json.dumps(doc)  # serializable as-is
+
+
+def test_slo_evaluation_pass_fail_and_malformed(tmp_path):
+    records = obs_report.load_trace(_mk_trace(tmp_path))
+    verdicts = obs_report.evaluate_slos(records, {"slos": [
+        {"name": "p50", "metric": "span_p50_s", "span": "serve.batch",
+         "max": 0.05},
+        {"name": "p99", "metric": "span_p99_s", "span": "serve.batch",
+         "max": 0.05},
+        {"name": "no-drops", "metric": "counter", "counter": "serve.reject",
+         "max": 0},
+        {"name": "fresh", "metric": "staleness_s", "max": 60.0},
+        {"name": "absent-count", "metric": "span_count",
+         "span": "never.fired", "max": 0},
+        {"name": "absent-latency", "metric": "span_p99_s",
+         "span": "never.fired", "max": 1.0},
+    ]})
+    by = {v["name"]: v for v in verdicts}
+    assert by["p50"]["ok"] and not by["p99"]["ok"]
+    assert by["no-drops"]["ok"]          # counter DELTA is 0 across the trace
+    assert by["fresh"]["ok"] and by["fresh"]["value"] == 30.0
+    assert by["absent-count"]["ok"]      # count bound of 0 passes on absence
+    assert not by["absent-latency"]["ok"]  # unevaluable latency ≠ free pass
+    with pytest.raises(ValueError):
+        obs_report.evaluate_slos(records, {"slos": [
+            {"name": "typo", "metric": "span_p42_s", "span": "x", "max": 1}]})
+    with pytest.raises(ValueError):
+        obs_report.evaluate_slos(records, {"slos": [
+            {"name": "no-bound", "metric": "staleness_s"}]})
+
+
+def test_report_cli_exit_codes_and_chrome_file(tmp_path, capsys):
+    trace = _mk_trace(tmp_path)
+    slo_ok = tmp_path / "ok.json"
+    slo_ok.write_text(json.dumps({"slos": [
+        {"name": "p50", "metric": "span_p50_s", "span": "serve.batch",
+         "max": 0.05}]}))
+    slo_bad = tmp_path / "bad.json"
+    slo_bad.write_text(json.dumps({"slos": [
+        {"name": "p99", "metric": "span_p99_s", "span": "serve.batch",
+         "max": 0.05}]}))
+    chrome = str(tmp_path / "chrome.json")
+    assert obs_report.main([trace, "--slo", str(slo_ok),
+                            "--chrome", chrome]) == 0
+    out = capsys.readouterr().out
+    assert "SLO PASS: p50" in out and "per-stage breakdown" in out
+    with open(chrome) as f:
+        assert len(json.load(f)["traceEvents"]) > 0
+    assert obs_report.main([trace, "--slo", str(slo_bad)]) == 1
+    assert "SLO FAIL: p99" in capsys.readouterr().out
+    # usage errors are 2, distinct from violations
+    assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
+    # ... including an unwritable --chrome export path
+    assert obs_report.main(
+        [trace, "--chrome", str(tmp_path / "no_dir" / "t.json")]) == 2
+    assert "cannot write Chrome trace" in capsys.readouterr().err
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    assert obs_report.main([trace, "--slo", str(garbage)]) == 2
+    # --json emits one machine-readable object
+    assert obs_report.main([trace, "--json", "--slo", str(slo_ok)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["spans"]["serve.batch"]["count"] == 4
+    assert doc["slos"][0]["ok"] is True
+
+
+def test_chaos_soak_default_slos_are_well_formed():
+    """The soak's built-in SLO doc must stay on the report schema: every
+    entry evaluates (no ValueError) — on an empty trace the structural
+    min-bounds simply FAIL, they never error or vacuously pass."""
+    from scripts.chaos_soak import DEFAULT_SLOS
+
+    verdicts = obs_report.evaluate_slos([], DEFAULT_SLOS)
+    assert len(verdicts) == len(DEFAULT_SLOS["slos"])
+    by = {v["name"]: v for v in verdicts}
+    # a soak that emitted nothing fails its count gates loudly
+    assert not by["train-windows-fired"]["ok"]
+    assert not by["callback-windows-counted"]["ok"]
+
+
+def test_report_tolerates_crash_torn_tail(tmp_path):
+    """The soak/crash forensics contract, inherited from read(): a torn
+    trailing line is skipped, an interior malformed line still raises."""
+    trace = _mk_trace(tmp_path)
+    with open(trace, "a") as f:
+        f.write('{"kind": "trace_span", "name": "torn')  # no newline
+    records = obs_report.load_trace(trace)
+    assert len(obs_report.span_stats(records)["serve.batch"]) > 0
+    with open(trace, "a") as f:
+        f.write('ed"}\n{"interior": garbage}\n{"kind": "x"}\n')
+    with pytest.raises(json.JSONDecodeError):
+        obs_report.load_trace(trace)

@@ -317,6 +317,56 @@ def test_resident_warmed_window_no_host_sync(rng):
     assert run_counted(64) - run_counted(32) == (64 - 32) // (4 * 2)
 
 
+def test_resident_warmed_sync_pin_holds_with_tracing_on(rng):
+    """ISSUE 8: the windows+3 pin is not a tracing-off artifact — with
+    span tracing ENABLED (live sink, spans emitted from the callback
+    thread and the driver) the warmed resident run still forces exactly
+    windows+3 shape-() syncs: the span machinery reuses the window's
+    one win_start fetch (``i0_host``) instead of fetching twice, and
+    span timestamps never block_until_ready (ADVICE.md "Span
+    timestamps are attribution, not truth")."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis import assert_no_host_sync
+    from tpu_sgd.obs.spans import disable_tracing, enable_tracing
+    from tpu_sgd.optimize.resident_driver import ResidentBookkeeper
+
+    X, y = _data(rng, n=400, d=6)
+    w0 = np.zeros(6, np.float32)
+    iters, windows = 64, 64 // (4 * 2)
+    o = _opt("sliced", iters=iters, k=4, c=2)
+    o.optimize_with_history((X, y), w0)  # warm the compile
+    key = ("resident", o.gradient, o.updater, o.config, 4, 2)
+    loop = o._run_cache[key]
+
+    class Sink:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, kind, payload):
+            self.records.append((kind, payload))
+
+    sink = Sink()
+    hooks = ResidentBookkeeper(o.config, 4, 2, losses=[],
+                               reg_val=0.0, start_iter=1)
+    enable_tracing(sink)
+    try:
+        with assert_no_host_sync(allow=windows + 3) as counter:
+            loop.run(jnp.asarray(w0), 0.0, 1,
+                     (jnp.asarray(X), jnp.asarray(y)), hooks)
+    finally:
+        disable_tracing()
+    assert counter["n"] == windows + 3
+    assert all(shape == () for shape, _ in counter["shapes"])
+    # tracing really ran: one window span per cadence window, one
+    # dispatch span, every win_start attr from the SHARED fetch
+    wins = [p for k, p in sink.records
+            if k == "trace_span" and p["name"] == "train.window"]
+    assert [w["i0"] for w in wins] == [1 + 8 * i for i in range(windows)]
+    assert sum(1 for k, p in sink.records if k == "trace_span"
+               and p["name"] == "train.resident_dispatch") == 1
+
+
 # ---- stop signal / preemption ----------------------------------------------
 
 def test_resident_stop_latency_bounded_by_cadence_window(rng, tmp_path):

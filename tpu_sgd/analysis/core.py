@@ -48,6 +48,7 @@ KNOWN_RULES = (
     "callback-discipline",
     "carry-stability",
     "memo-key",
+    "obs-discipline",
 )
 
 #: core policy checks (not AST rules; emitted by the runner itself)
@@ -294,11 +295,12 @@ def default_rules() -> List[Rule]:
     from tpu_sgd.analysis.rules_lock import LockDisciplineRule
     from tpu_sgd.analysis.rules_memo import MemoKeyRule
     from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
-    from tpu_sgd.analysis.rules_sync import HostSyncRule
+    from tpu_sgd.analysis.rules_sync import HostSyncRule, ObsDisciplineRule
 
     return [ShapeTrapRule(), LockDisciplineRule(), DonationSafetyRule(),
             FailpointCoverageRule(), EagerInLoopRule(), HostSyncRule(),
-            CallbackDisciplineRule(), CarryStabilityRule(), MemoKeyRule()]
+            CallbackDisciplineRule(), CarryStabilityRule(), MemoKeyRule(),
+            ObsDisciplineRule()]
 
 
 def _policy_findings(modules: Sequence[ModuleFile],

@@ -213,3 +213,124 @@ class HostSyncRule(Rule):
                     "loop body is an implicit bool() device->host sync "
                     "every iteration; compare on device and fetch the "
                     "flag at a cadence boundary instead")
+
+
+#: the observability layer's record producers: a ``span()`` attr, an
+#: ``event()`` attr, or an ``inc()`` count that receives a DEVICE value
+#: serializes it (json.dumps / arithmetic on the payload), forcing a
+#: device->host sync at the record site — on a traced hot path that is
+#: the exact stall the span exists to observe, now CAUSED by observing.
+_OBS_MODULES = {"tpu_sgd.obs", "tpu_sgd.obs.spans", "tpu_sgd.obs.counters"}
+_OBS_FUNCS = {"span", "event", "inc"}
+
+
+class ObsDisciplineRule(Rule):
+    """obs-discipline: span/event/inc arguments must be host values.
+
+    Rides the host-sync rule's dataflow machinery (the same
+    ``ProjectIndex`` device-value tracking), but fires ANYWHERE in a
+    function, loop or not: the record is serialized when it is emitted,
+    so a device-valued attribute is a sync wherever the call sits.  The
+    sanctioned spelling is to fetch once at the documented boundary
+    (``i0_host = int(i0w)``) and pass the host scalar — exactly what
+    ``ResidentBookkeeper.on_window`` does, keeping the windows+3 sync
+    pin intact with tracing ON.
+    """
+
+    name = "obs-discipline"
+
+    def run(self, modules: Sequence[ModuleFile],
+            options: dict) -> Iterable[Finding]:
+        project: ProjectIndex = options["project"]
+        for mod in modules:
+            if mod.tree is None:
+                continue
+            mi = project.info(mod)
+            direct, mod_aliases = self._obs_names(mi)
+            if not direct and not mod_aliases:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, DefNode):
+                    yield from self._check_function(
+                        mod, mi, project, node, direct, mod_aliases)
+
+    @staticmethod
+    def _obs_names(mi: ModuleInfo):
+        """Resolve this module's spellings of the obs producers:
+        ``direct`` maps a bare callable name (aliasing honored —
+        ``from tpu_sgd.obs.counters import inc as obs_inc``) to its
+        canonical obs function; ``mod_aliases`` maps a module alias
+        (``from tpu_sgd.obs import spans`` / ``import tpu_sgd.obs.spans
+        as sp``) for attribute-form calls."""
+        direct = {}
+        mod_aliases = set()
+        for alias, (dotted, orig) in mi.imports_from.items():
+            if dotted in _OBS_MODULES and orig in _OBS_FUNCS:
+                direct[alias] = orig
+            elif f"{dotted}.{orig}" in _OBS_MODULES:
+                mod_aliases.add(alias)
+        for alias, dotted in mi.import_mods.items():
+            if dotted in _OBS_MODULES:
+                mod_aliases.add(alias)
+        return direct, mod_aliases
+
+    def _record_call(self, call: ast.Call, direct, mod_aliases):
+        """The canonical obs function this call invokes, or None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return direct.get(f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id in mod_aliases and f.attr in _OBS_FUNCS:
+                return f.attr
+        return None
+
+    def _check_function(self, mod: ModuleFile, mi: ModuleInfo,
+                        project: ProjectIndex, fn: ast.AST,
+                        direct, mod_aliases) -> Iterable[Finding]:
+        jitted = project.jitted_value_names(mi, fn)
+        dev = project.device_value_names(mi, fn, jitted)
+        if not dev:
+            return
+        # names bound to an open span (`with span(...) as sp:` /
+        # `sp = span(...)`): their `.set(...)` attrs are record
+        # arguments too
+        span_names: Set[str] = set()
+        for n in scope_nodes(fn):
+            if isinstance(n, ast.withitem) \
+                    and isinstance(n.context_expr, ast.Call) \
+                    and self._record_call(n.context_expr, direct,
+                                          mod_aliases) == "span" \
+                    and isinstance(n.optional_vars, ast.Name):
+                span_names.add(n.optional_vars.id)
+            elif isinstance(n, ast.Assign) \
+                    and isinstance(n.value, ast.Call) \
+                    and self._record_call(n.value, direct,
+                                          mod_aliases) == "span":
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        span_names.add(t.id)
+        for n in scope_nodes(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            kind = self._record_call(n, direct, mod_aliases)
+            if kind is None and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "set" \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id in span_names:
+                kind = "span.set"
+            if kind is None:
+                continue
+            for expr in list(n.args) + [kw.value for kw in n.keywords]:
+                touched = expr_reads(expr) & dev
+                if touched:
+                    name = sorted(touched)[0]
+                    yield Finding(
+                        self.name, mod.relpath, n.lineno, n.col_offset,
+                        f"`{kind}(...)` records device value `{name}`: "
+                        "serializing the payload forces a device->host "
+                        "sync at the record site — observability "
+                        "causing the stall it exists to observe.  "
+                        "Fetch once at the documented boundary "
+                        "(`x_host = int(x)` / the bulk np.asarray "
+                        "fetch) and record the host scalar")
+                    break  # one finding per record call is enough

@@ -44,6 +44,7 @@ import collections
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, TypeVar
 
+from tpu_sgd.obs.spans import span
 from tpu_sgd.reliability.failpoints import failpoint
 
 #: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
@@ -91,10 +92,15 @@ class Prefetcher:
             failpoint("io.prefetch.produce")
             return self._producer(item)
 
-        if self._retry_policy is not None:
-            out = self._retry_policy.call(attempt)
-        else:
-            out = attempt()
+        # spans are per-thread, so this one nests under whatever the
+        # WORKER thread has open (nothing, usually) rather than under
+        # the consumer's training span — which also tags the producer's
+        # device_put bytes as `ingest`, not `train` (obs.counters)
+        with span("ingest.produce"):
+            if self._retry_policy is not None:
+                out = self._retry_policy.call(attempt)
+            else:
+                out = attempt()
         if self._heartbeat is not None:
             self._heartbeat.beat()
         return out

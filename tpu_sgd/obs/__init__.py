@@ -1,0 +1,134 @@
+"""tpu_sgd.obs: the unified observability layer.
+
+Three pieces, one opt-in switch (ROADMAP items 1 and 3 both presuppose
+this surface: straggler detection for async replicas needs per-stage
+timings that run in production, and the closed production loop needs
+SLO assertions evaluated over a trace):
+
+* **span tracing** (:mod:`tpu_sgd.obs.spans`) — hierarchical,
+  thread-aware ``span("train.superstep")`` regions and instant
+  ``event(...)`` records wired through every hot path (ingest prefetch,
+  superstep/resident cadence windows, serve batcher flushes, registry
+  reloads, checkpoint save/restore, retry/breaker/failpoint incidents),
+  emitted as ``trace_*`` JSONL records on the shared
+  ``JsonLinesEventLog`` contract;
+* **runtime counters** (:mod:`tpu_sgd.obs.counters`) — the
+  test-twin monkeypatch machinery (``tpu_sgd.analysis.runtime``)
+  promoted to an always-on accounting layer: program dispatches,
+  compiles, host syncs, h2d/d2h transfer counts and bytes, io_callback
+  firings, tagged by the subsystem whose span caused them;
+* **the report pipeline** (:mod:`tpu_sgd.obs.report`) —
+  ``python -m tpu_sgd.obs.report trace.jsonl`` renders per-stage
+  breakdowns, counter deltas, p50/p99 tables, exports Chrome
+  trace-event JSON (Perfetto), and evaluates declarative SLO files
+  with CI-able exit codes.
+
+Quickstart::
+
+    from tpu_sgd import obs
+
+    obs.enable("run_trace.jsonl")        # tracing + counters on
+    ...                                   # train / serve as usual
+    obs.disable()                         # flushes counters, closes log
+    # then: python -m tpu_sgd.obs.report run_trace.jsonl --slo slo.json
+
+Disabled (the default, forever, unless an operator opts in) every hook
+is one module-global load and a falsy branch — the failpoints
+discipline, measured in ``tests/test_obs.py``.  Enabled, the layer adds
+wall-clock overhead but ZERO dispatches, compiles, or host syncs on the
+warmed hot paths (the acceptance pin, measured with the
+``tpu_sgd.analysis`` runtime twins; ``BENCH_OBS.json`` records both).
+Span timestamps never force a device sync — see ADVICE.md "Span
+timestamps are attribution, not truth".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_sgd.obs import spans
+from tpu_sgd.obs import counters
+from tpu_sgd.obs.spans import (current_subsystem, disable_tracing,
+                               enable_tracing, event, span)
+from tpu_sgd.obs.counters import RuntimeCounters, deltas, inc, snapshot
+
+__all__ = [
+    "span", "event", "inc", "snapshot", "deltas", "RuntimeCounters",
+    "enable", "disable", "flush_counters", "is_enabled",
+    "enable_tracing", "disable_tracing", "current_subsystem",
+    "spans", "counters",
+]
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose — the facade owns one GIL-atomic module reference
+#: (``_OWNED_LOG``); all guarded state lives in the submodules.
+GRAFTLINT_LOCKS: dict = {}
+
+_OWNED_LOG = None  # a JsonLinesEventLog this facade opened (and closes)
+
+
+def enable(trace=None, *, with_counters: bool = True,
+           fsync: bool = False) -> None:
+    """Turn the observability layer on.
+
+    ``trace`` is a JSONL path (a ``JsonLinesEventLog`` is opened and
+    owned — ``disable()`` closes it) or any sink with ``emit(kind,
+    payload)`` (e.g. an event log shared with training/serving records,
+    the chaos soak's spelling — caller keeps ownership).  ``None``
+    enables counters only.  ``with_counters=False`` skips the runtime
+    patches (tracing only)."""
+    global _OWNED_LOG
+    sink = owned = None
+    if trace is not None:
+        if hasattr(trace, "emit"):
+            sink = trace
+        else:
+            from tpu_sgd.utils.events import JsonLinesEventLog
+
+            sink = owned = JsonLinesEventLog(str(trace), fsync=fsync)
+    if sink is not None:
+        enable_tracing(sink)
+        # re-enable with a NEW sink: close the log a previous enable()
+        # opened (records already route to the new sink above) — a
+        # second enable must not leak the first's file handle
+        prev, _OWNED_LOG = _OWNED_LOG, owned
+        if prev is not None and prev is not sink:
+            prev.close()
+    if with_counters:
+        counters.enable()
+
+
+def flush_counters() -> None:
+    """Write the cumulative counter snapshot as one ``metric_counters``
+    record on the trace sink (no-op without both sides enabled).  The
+    report pipeline diffs consecutive flushes into window deltas."""
+    sink = spans._SINK
+    if sink is None or not counters.is_enabled():
+        return
+    import time
+
+    try:
+        sink.emit("metric_counters", {"ts": time.time(),
+                                      "counters": counters.snapshot()})
+    except Exception:
+        import logging
+
+        logging.getLogger("tpu_sgd.obs").warning(
+            "trace sink raised; counter flush dropped", exc_info=True)
+
+
+def disable() -> None:
+    """Turn everything off: flush counters into the trace (if both were
+    on), unwind the runtime patches, close an owned trace log.
+    Idempotent."""
+    global _OWNED_LOG
+    flush_counters()
+    counters.disable()
+    disable_tracing()
+    owned, _OWNED_LOG = _OWNED_LOG, None
+    if owned is not None:
+        owned.close()
+
+
+def is_enabled() -> bool:
+    return spans.is_enabled() or counters.is_enabled()

@@ -1,0 +1,376 @@
+"""Trace/SLO report pipeline: JSONL trace -> breakdowns, Chrome trace,
+SLO verdict.
+
+The consuming half of the observability layer (spans + counters write,
+this module reads)::
+
+    python -m tpu_sgd.obs.report events.jsonl            # stage tables
+    python -m tpu_sgd.obs.report events.jsonl --chrome t.json   # Perfetto
+    python -m tpu_sgd.obs.report events.jsonl --slo slo.json    # verdict
+
+* **Per-stage breakdowns** — ``trace_span`` records grouped by name:
+  count, total/mean wall, p50/p99/max (nearest-rank, the same
+  percentile rule ``serve.metrics.ServingMetrics`` scrapes with).
+* **Counter deltas** — ``metric_counters`` records (cumulative
+  snapshots flushed by ``tpu_sgd.obs``): last minus first, so a trace
+  covering one soak reports what THAT soak spent.
+* **Chrome trace-event export** — spans become ``ph:"X"`` complete
+  events and instant events become ``ph:"i"`` on a per-thread-named
+  timeline; the file loads in Perfetto / ``chrome://tracing``.
+* **SLO evaluation** — a declarative JSON file of assertions over the
+  trace; exit code 0 = all hold, 1 = violation, 2 = usage/parse error.
+  This is the harness ROADMAP open item 3's continuous-deployment
+  scenario asserts through (p99 bound, served-weight staleness, zero
+  dropped requests across reloads).
+
+SLO file format (README "Observability")::
+
+    {"slos": [
+      {"name": "serve-p99",  "metric": "span_p99_s",
+       "span": "serve.batch", "max": 0.050},
+      {"name": "no-drops",   "metric": "counter",
+       "counter": "serve.reject", "max": 0},
+      {"name": "fresh-weights", "metric": "staleness_s", "max": 30.0}
+    ]}
+
+``metric`` kinds: ``span_p50_s`` / ``span_p99_s`` / ``span_max_s`` /
+``span_mean_s`` / ``span_count`` (over ``span`` name), ``counter``
+(delta ``n`` of ``counter``; ``field: "bytes"`` selects bytes), and
+``staleness_s`` — for every ``serve_reload``-kind ``reloaded`` record,
+the age of the served weights at swap time: reload ts minus the ts of
+the ``checkpoint.save`` span that wrote that version (reloads of
+checkpoints older than the trace window are skipped — their save is
+simply not in the trace).  Every SLO takes ``max`` and/or ``min``.
+
+Parsing reuses ``JsonLinesEventLog.read`` — a crash-torn trailing line
+is tolerated (the soak/crash forensics contract), a malformed interior
+line still raises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from tpu_sgd.utils.events import JsonLinesEventLog
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose — this module is a single-threaded offline reader; it owns
+#: no shared mutable state and no locks.
+GRAFTLINT_LOCKS: dict = {}
+
+
+def load_trace(path: str) -> List[dict]:
+    """All records of a trace JSONL, via the shared torn-tail-tolerant
+    ``read()`` semantics."""
+    return JsonLinesEventLog.read(path)
+
+
+def _percentile(xs: List[float], p: float) -> float:
+    """Nearest-rank percentile — ONE shared definition with the live
+    scrape (``serve.metrics.nearest_rank``), so an SLO written against
+    a live p99 means the same thing evaluated offline."""
+    from tpu_sgd.serve.metrics import nearest_rank
+
+    return nearest_rank(sorted(xs), p)
+
+
+def span_stats(records: List[dict]) -> Dict[str, dict]:
+    """Per-span-name aggregate: ``{name: {count, total_s, mean_s,
+    p50_s, p99_s, max_s, errors}}``."""
+    by_name: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") != "trace_span":
+            continue
+        by_name.setdefault(r["name"], []).append(float(r["dur_s"]))
+        if r.get("error"):
+            errors[r["name"]] = errors.get(r["name"], 0) + 1
+    out = {}
+    for name, durs in sorted(by_name.items()):
+        out[name] = {
+            "count": len(durs),
+            "total_s": sum(durs),
+            "mean_s": sum(durs) / len(durs),
+            "p50_s": _percentile(durs, 50),
+            "p99_s": _percentile(durs, 99),
+            "max_s": max(durs),
+            "errors": errors.get(name, 0),
+        }
+    return out
+
+
+def counter_deltas(records: List[dict]) -> Dict[str, Dict[str, int]]:
+    """What the traced window spent: last ``metric_counters`` snapshot
+    minus the first (one snapshot = that snapshot verbatim — cumulative
+    from its enable())."""
+    snaps = [r["counters"] for r in records
+             if r.get("kind") == "metric_counters"]
+    if not snaps:
+        return {}
+    first, last = snaps[0], snaps[-1]
+    if len(snaps) == 1:
+        first = {}
+    out = {}
+    for name, c in last.items():
+        s = first.get(name, {"n": 0, "bytes": 0})
+        dn = int(c["n"]) - int(s["n"])
+        db = int(c["bytes"]) - int(s["bytes"])
+        if dn or db:
+            out[name] = {"n": dn, "bytes": db}
+    return out
+
+
+def staleness_samples(records: List[dict]) -> List[dict]:
+    """Served-weight staleness per hot reload: for each ``serve_reload``
+    record with ``event == "reloaded"``, the wall-clock age of the
+    swapped-in version — reload ts minus the ts of the
+    ``checkpoint.save`` span that wrote that version.  Reloads whose
+    save predates the trace are skipped, not guessed."""
+    save_ts: Dict[int, float] = {}
+    for r in records:
+        if r.get("kind") == "trace_span" \
+                and r.get("name") == "checkpoint.save" \
+                and "iteration" in r:
+            # last save of a version wins (re-saves replace the file)
+            save_ts[int(r["iteration"])] = float(r["ts"])
+    out = []
+    for r in records:
+        if r.get("kind") == "serve_reload" and r.get("event") == "reloaded":
+            v = int(r["version"])
+            if v in save_ts:
+                out.append({"version": v,
+                            "staleness_s": float(r["ts"]) - save_ts[v]})
+    return out
+
+
+# -- Chrome trace-event export ----------------------------------------------
+
+def to_chrome_trace(records: List[dict]) -> dict:
+    """Chrome trace-event JSON (object form), loadable in Perfetto /
+    chrome://tracing.  Spans -> ``ph:"X"`` complete events on their
+    thread's track (monotonic ``t0_s`` timebase, µs); instant events ->
+    ``ph:"i"``; thread-name metadata rides ``ph:"M"`` records."""
+    events = []
+    tids: Dict[str, int] = {}
+
+    def tid_of(thread: str) -> int:
+        if thread not in tids:
+            tids[thread] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                           "tid": tids[thread],
+                           "args": {"name": thread}})
+        return tids[thread]
+
+    core = {"kind", "name", "ts", "t0_s", "dur_s", "span_id",
+            "parent_id", "thread", "subsystem"}
+    for r in records:
+        kind = r.get("kind")
+        if kind == "trace_span":
+            events.append({
+                "ph": "X",
+                "name": r["name"],
+                "cat": r["name"].split(".", 1)[0],
+                "pid": 1,
+                "tid": tid_of(r.get("thread", "?")),
+                "ts": float(r["t0_s"]) * 1e6,
+                "dur": float(r["dur_s"]) * 1e6,
+                "args": {k: v for k, v in r.items() if k not in core},
+            })
+        elif kind == "trace_event":
+            events.append({
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "name": r["name"],
+                "cat": r.get("subsystem", "event"),
+                "pid": 1,
+                "tid": tid_of(r.get("thread", "?")),
+                "ts": float(r["t0_s"]) * 1e6,
+                "args": {k: v for k, v in r.items() if k not in core},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- SLO evaluation ----------------------------------------------------------
+
+_SPAN_METRICS = {"span_p50_s": "p50_s", "span_p99_s": "p99_s",
+                 "span_max_s": "max_s", "span_mean_s": "mean_s",
+                 "span_count": "count"}
+
+
+def evaluate_slos(records: List[dict], slo_doc: dict) -> List[dict]:
+    """Evaluate a declarative SLO document against a trace; returns one
+    verdict dict per SLO: ``{name, metric, value, max?, min?, ok,
+    detail?}``.  Unknown metric kinds and malformed entries raise
+    ``ValueError`` (a typo'd SLO must fail the gate loudly, never pass
+    green unevaluated)."""
+    slos = slo_doc.get("slos")
+    if not isinstance(slos, list):
+        raise ValueError('SLO document must have a "slos" list')
+    stats = span_stats(records)
+    counters = counter_deltas(records)
+    verdicts = []
+    for i, slo in enumerate(slos):
+        metric = slo.get("metric")
+        name = slo.get("name", f"slo-{i}")
+        detail = None
+        if metric in _SPAN_METRICS:
+            span_name = slo.get("span")
+            if not span_name:
+                raise ValueError(f"SLO {name!r}: span metrics need a "
+                                 '"span" field')
+            st = stats.get(span_name)
+            if st is None:
+                # an SLO over a span that never fired: a count bound of
+                # 0 legitimately passes; a latency bound cannot be
+                # evaluated and must not silently pass
+                if metric == "span_count":
+                    value: Optional[float] = 0
+                else:
+                    value = None
+                    detail = f"span {span_name!r} absent from trace"
+            else:
+                value = st[_SPAN_METRICS[metric]]
+        elif metric == "counter":
+            cname = slo.get("counter")
+            if not cname:
+                raise ValueError(f"SLO {name!r}: counter metric needs a "
+                                 '"counter" field')
+            field = slo.get("field", "n")
+            if field not in ("n", "bytes"):
+                raise ValueError(f"SLO {name!r}: field must be n|bytes")
+            value = counters.get(cname, {"n": 0, "bytes": 0})[field]
+        elif metric == "staleness_s":
+            samples = staleness_samples(records)
+            if not samples:
+                value = None
+                detail = "no reload-with-traced-save pairs in trace"
+            else:
+                value = max(s["staleness_s"] for s in samples)
+        else:
+            raise ValueError(f"SLO {name!r}: unknown metric {metric!r}")
+        lo, hi = slo.get("min"), slo.get("max")
+        if lo is None and hi is None:
+            raise ValueError(f"SLO {name!r}: needs max and/or min")
+        if value is None:
+            ok = False  # unevaluable is a violation, not a free pass
+        else:
+            ok = ((hi is None or value <= hi)
+                  and (lo is None or value >= lo))
+        v = {"name": name, "metric": metric, "value": value, "ok": ok}
+        if hi is not None:
+            v["max"] = hi
+        if lo is not None:
+            v["min"] = lo
+        if detail:
+            v["detail"] = detail
+        verdicts.append(v)
+    return verdicts
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _fmt_s(x: float) -> str:
+    return f"{x * 1e3:9.3f}ms" if x < 1.0 else f"{x:8.3f}s "
+
+
+def render_report(records: List[dict]) -> str:
+    lines = []
+    stats = span_stats(records)
+    if stats:
+        lines.append("per-stage breakdown (trace_span records):")
+        lines.append(f"  {'span':<28}{'count':>7}{'total':>12}"
+                     f"{'p50':>12}{'p99':>12}{'max':>12}{'err':>5}")
+        for name, st in stats.items():
+            lines.append(
+                f"  {name:<28}{st['count']:>7}"
+                f"{_fmt_s(st['total_s']):>12}{_fmt_s(st['p50_s']):>12}"
+                f"{_fmt_s(st['p99_s']):>12}{_fmt_s(st['max_s']):>12}"
+                f"{st['errors']:>5}")
+    else:
+        lines.append("no trace_span records in trace")
+    deltas = counter_deltas(records)
+    if deltas:
+        lines.append("counter deltas (metric_counters records):")
+        for name, c in sorted(deltas.items()):
+            extra = f"  bytes={c['bytes']}" if c["bytes"] else ""
+            lines.append(f"  {name:<40}{c['n']:>10}{extra}")
+    stale = staleness_samples(records)
+    if stale:
+        worst = max(s["staleness_s"] for s in stale)
+        lines.append(f"served-weight staleness: {len(stale)} reload(s), "
+                     f"worst {worst:.3f}s")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_sgd.obs.report",
+        description=__doc__.split("\n")[0])
+    ap.add_argument("trace", help="trace JSONL path (JsonLinesEventLog)")
+    ap.add_argument("--chrome", metavar="OUT.json",
+                    help="write Chrome trace-event JSON (Perfetto)")
+    ap.add_argument("--slo", metavar="SLO.json",
+                    help="evaluate a declarative SLO file; exit 1 on "
+                         "violation")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        records = load_trace(args.trace)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read trace {args.trace!r}: {e}",
+              file=sys.stderr)
+        return 2
+
+    verdicts = None
+    if args.slo:
+        try:
+            with open(args.slo) as f:
+                slo_doc = json.load(f)
+            verdicts = evaluate_slos(records, slo_doc)
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"error: bad SLO file {args.slo!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.chrome:
+        try:
+            with open(args.chrome, "w") as f:
+                json.dump(to_chrome_trace(records), f)
+        except OSError as e:
+            # an unwritable export path is the usage-error class (2),
+            # NOT the SLO-violation class (1) chaos_soak gates on
+            print(f"error: cannot write Chrome trace {args.chrome!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+
+    if args.json:
+        out = {"spans": span_stats(records),
+               "counters": counter_deltas(records),
+               "staleness": staleness_samples(records)}
+        if verdicts is not None:
+            out["slos"] = verdicts
+        print(json.dumps(out, indent=2))
+    else:
+        print(render_report(records))
+        if verdicts is not None:
+            for v in verdicts:
+                bound = " ".join(
+                    f"{k}={v[k]}" for k in ("min", "max") if k in v)
+                state = "PASS" if v["ok"] else "FAIL"
+                val = ("<unevaluable>" if v["value"] is None
+                       else f"{v['value']:.6g}")
+                extra = f"  ({v['detail']})" if v.get("detail") else ""
+                print(f"SLO {state}: {v['name']}: {v['metric']}="
+                      f"{val} vs {bound}{extra}")
+
+    if verdicts is not None and not all(v["ok"] for v in verdicts):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

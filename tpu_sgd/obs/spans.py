@@ -1,0 +1,254 @@
+"""Hierarchical span tracing: the low-overhead production half of the
+observability layer.
+
+The repo's timing signals were fragments — ``StepTimer`` wall clocks in
+benches, ``wall_time_s`` on iteration events, ad-hoc ``perf_counter``
+pairs in drivers.  A **span** unifies them: a named region with a
+monotonic start/duration, a thread-local parent (so nested regions form
+a tree), and arbitrary host-scalar attributes, emitted as one
+``trace_span`` JSONL record through the shared event-log contract
+(``tpu_sgd.utils.events.JsonLinesEventLog``; ``obs.report`` turns the
+records into per-stage breakdowns, Chrome trace-event JSON, and SLO
+verdicts)::
+
+    from tpu_sgd.obs.spans import span, event
+
+    with span("train.superstep", i0=i0, steps=steps):
+        ...                       # device dispatch + host replay
+    event("reliability.retry", attempt=2, error="FaultInjected")
+
+Cost contract (the failpoints discipline, measured in
+``tests/test_obs.py``): DISABLED — the only state a production process
+runs in unless an operator opts in — is ONE module-global load and a
+falsy branch; ``span(...)`` returns a shared no-op singleton, allocates
+nothing, and formats nothing.  Enabling (``tpu_sgd.obs.enable``) routes
+records to a sink; a raising sink drops the record and never kills the
+observed hot path.
+
+Thread-awareness: each thread keeps its own span stack, so the ingest
+prefetch worker, the serving flush thread, and the io_callback thread
+each nest their own spans correctly instead of parenting onto whatever
+the main thread happens to be doing.  The current span's first dotted
+segment (``train.superstep`` -> ``train``) is published as the thread's
+*subsystem tag*, which ``obs.counters`` uses to attribute patch-counted
+dispatches/syncs/transfers to the subsystem that caused them.
+
+Timestamp truth contract (ADVICE.md "Span timestamps are attribution,
+not truth"): spans time the HOST region only and must NEVER call
+``block_until_ready`` (or any other sync) to "include device time" —
+under async dispatch that would turn every traced hot loop back into
+lockstep, which is precisely what the resident/superstep drivers exist
+to avoid (and what graftlint's host-sync rule + the windows+3 sync pin
+in ``tests/test_resident.py`` enforce).  Counts and bytes
+(``obs.counters``) are the truth on this harness; span durations
+attribute where host wall clock went.
+
+A ``jax.profiler`` capture rides the span API: ``span("train.run",
+profile_dir="/tmp/jaxtrace")`` brackets the region with
+``jax.profiler.start_trace``/``stop_trace`` (TensorBoard/Perfetto),
+so a deep-dive capture attaches to exactly one traced region.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+
+__all__ = ["span", "event", "enable_tracing", "disable_tracing",
+           "is_enabled", "current_subsystem"]
+
+logger = logging.getLogger("tpu_sgd.obs")
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose, and load-bearing as documentation.  All mutable tracing
+#: state is either thread-local (the per-thread span stack and
+#: subsystem tag in ``_TL``) or a GIL-atomic single reference
+#: (``_SINK``, swapped whole by enable/disable; ``_IDS`` is an atomic
+#: ``itertools.count``).  Record serialization is the SINK's problem —
+#: ``JsonLinesEventLog`` already lock-serializes its writes.  Adding
+#: shared mutable state to this module means adding a lock AND
+#: declaring it here.
+GRAFTLINT_LOCKS: dict = {}
+
+#: fast-path gate: ``span()``/``event()`` read this ONE module global
+#: and return when falsy — the entire disabled-mode cost (the
+#: failpoints discipline; measured no-op in tests/test_obs.py)
+_ENABLED = False
+
+_SINK = None                  # object with .emit(kind, payload)
+_IDS = itertools.count(1)     # process-wide span ids (atomic under GIL)
+_TL = threading.local()       # .stack: list of _Span; .tag: str
+
+
+def _stack():
+    st = getattr(_TL, "stack", None)
+    if st is None:
+        st = _TL.stack = []
+    return st
+
+
+def current_subsystem() -> str:
+    """The accounting tag of the innermost open span on THIS thread
+    (its first dotted name segment), or ``"untagged"`` — how
+    ``obs.counters`` attributes patch-counted dispatches/syncs to the
+    subsystem whose region caused them."""
+    return getattr(_TL, "tag", "untagged")
+
+
+class _NoopSpan:
+    """The disabled-mode singleton: every ``span(...)`` call returns
+    THIS object, so the disabled hot path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "ts", "t0",
+                 "_profile_dir")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self._profile_dir = attrs.pop("profile_dir", None)
+        self.attrs = attrs
+        self.span_id = next(_IDS)
+        self.parent_id = 0
+        self.ts = 0.0
+        self.t0 = 0.0
+
+    def set(self, **attrs):
+        """Attach host-scalar attributes after entry (e.g. a batch size
+        known only mid-region).  NEVER pass device values: formatting
+        one forces a device->host sync (graftlint's obs-discipline
+        check flags that statically)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        st = _stack()
+        self.parent_id = st[-1].span_id if st else 0
+        st.append(self)
+        _TL.tag = self.name.split(".", 1)[0]
+        # epoch ts for cross-record joins (staleness SLOs), monotonic
+        # t0 for durations and the Chrome trace timeline
+        self.ts = time.time()
+        if self._profile_dir is not None:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self._profile_dir)
+            except Exception:
+                logger.warning("jax.profiler.start_trace failed; span "
+                               "continues untraced", exc_info=True)
+                self._profile_dir = None
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # duration FIRST: the profiler stop below is not part of the
+        # traced region's cost
+        dur = time.perf_counter() - self.t0
+        if self._profile_dir is not None:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.warning("jax.profiler.stop_trace failed",
+                               exc_info=True)
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        _TL.tag = st[-1].name.split(".", 1)[0] if st else "untagged"
+        sink = _SINK
+        if sink is not None:
+            payload = {
+                "name": self.name,
+                "ts": self.ts,
+                "t0_s": self.t0,
+                "dur_s": dur,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "thread": threading.current_thread().name,
+                "error": (exc_type.__name__
+                          if exc_type is not None else None),
+            }
+            payload.update(self.attrs)
+            try:
+                sink.emit("trace_span", payload)
+            except Exception:  # observability must never kill hot paths
+                logger.warning("trace sink raised; span record dropped",
+                               exc_info=True)
+        return False
+
+
+def span(name: str, **attrs):
+    """Open a trace span.  No-op singleton when tracing is disabled
+    (one global load + branch); otherwise a context manager that emits
+    one ``trace_span`` record on exit.
+
+    ``attrs`` must be HOST scalars/strings — a device value here forces
+    a sync when the record serializes (statically flagged by graftlint).
+    ``profile_dir=<dir>`` additionally brackets the region with
+    ``jax.profiler`` start/stop for a TensorBoard/Perfetto deep dive."""
+    if not _ENABLED:
+        return _NOOP
+    return _Span(name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Emit one instant ``trace_event`` record (a point, not a region):
+    retry attempts, breaker transitions, failpoint triggers, reload
+    decisions.  Same cost/discipline contract as :func:`span`."""
+    if not _ENABLED:
+        return
+    sink = _SINK
+    if sink is None:
+        return
+    payload = {
+        "name": name,
+        "ts": time.time(),
+        "t0_s": time.perf_counter(),
+        "thread": threading.current_thread().name,
+        "subsystem": current_subsystem(),
+    }
+    payload.update(attrs)
+    try:
+        sink.emit("trace_event", payload)
+    except Exception:
+        logger.warning("trace sink raised; event record dropped",
+                       exc_info=True)
+
+
+def enable_tracing(sink) -> None:
+    """Route spans/events to ``sink`` (anything with ``emit(kind,
+    payload)`` — a ``JsonLinesEventLog``) and open the gate.  Use the
+    ``tpu_sgd.obs.enable`` facade unless you are wiring a custom sink."""
+    global _SINK, _ENABLED
+    _SINK = sink
+    _ENABLED = True
+
+
+def disable_tracing() -> None:
+    """Close the gate and drop the sink reference (the caller owns the
+    sink's lifecycle — a ``JsonLinesEventLog`` still needs ``close()``)."""
+    global _SINK, _ENABLED
+    _ENABLED = False
+    _SINK = None
+
+
+def is_enabled() -> bool:
+    return _ENABLED

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_sgd.config import SGDConfig
+from tpu_sgd.obs.spans import span
 from tpu_sgd.ops.gradients import Gradient, LeastSquaresGradient
 from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
 from tpu_sgd.ops.sparse import is_sparse
@@ -1645,17 +1646,22 @@ class GradientDescent(Optimizer):
             while i0 <= cfg.num_iterations and not converged_early:
                 steps = min(fused_k, cfg.num_iterations - i0 + 1)
                 t0 = _time.perf_counter()
-                if valid is not None:
-                    w_dev, ys = fused(
-                        w, jnp.asarray(reg_val, jnp.float32),
-                        jnp.asarray(i0, jnp.int32), X, y, valid,
-                    )
-                else:
-                    w_dev, ys = fused(
-                        w, jnp.asarray(reg_val, jnp.float32),
-                        jnp.asarray(i0, jnp.int32), X, y,
-                    )
-                ys_host = tuple(np.asarray(a) for a in ys)  # blocks
+                # span times dispatch -> ys-on-host; the fetch below is
+                # this driver's own boundary, so tracing adds zero
+                # syncs/dispatches on the warmed path (the acceptance
+                # pin in tests/test_obs.py)
+                with span("train.superstep", i0=i0, steps=steps):
+                    if valid is not None:
+                        w_dev, ys = fused(
+                            w, jnp.asarray(reg_val, jnp.float32),
+                            jnp.asarray(i0, jnp.int32), X, y, valid,
+                        )
+                    else:
+                        w_dev, ys = fused(
+                            w, jnp.asarray(reg_val, jnp.float32),
+                            jnp.asarray(i0, jnp.int32), X, y,
+                        )
+                    ys_host = tuple(np.asarray(a) for a in ys)  # blocks
                 dt = _time.perf_counter() - t0
                 t_last, reg_val, converged_early = _replay_fused_steps(
                     ys_host, i0, steps, losses, reg_val, cfg,
@@ -1692,20 +1698,27 @@ class GradientDescent(Optimizer):
         i = start_iter
         while fused_k == 1 and i <= cfg.num_iterations:
             t0 = _time.perf_counter()
-            if valid is not None:
-                new_w, loss_i, new_reg, c = step(
-                    w, X, y, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val), valid
-                )
-            else:
-                new_w, loss_i, new_reg, c = step(
-                    w, X, y, jnp.asarray(i, jnp.int32), jnp.asarray(reg_val)
-                )
-            # the observed stepwise driver's host hop IS the contract:
-            # per-iteration listener scalars and convergence need the
-            # step's results on host every trip — barrier once, then
-            # fetch each scalar exactly once
-            # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
-            new_w = jax.block_until_ready(new_w)
+            # span around an ALREADY-contractual per-iteration barrier
+            # (the observed driver's host hop IS its bookkeeping
+            # contract); the span itself adds no sync
+            with span("train.step", i=i):
+                if valid is not None:
+                    new_w, loss_i, new_reg, c = step(
+                        w, X, y, jnp.asarray(i, jnp.int32),
+                        jnp.asarray(reg_val), valid
+                    )
+                else:
+                    new_w, loss_i, new_reg, c = step(
+                        w, X, y, jnp.asarray(i, jnp.int32),
+                        jnp.asarray(reg_val)
+                    )
+                # the observed stepwise driver's host hop IS the
+                # contract: per-iteration listener scalars and
+                # convergence need the step's results on host every
+                # trip — barrier once, then fetch each scalar exactly
+                # once
+                # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
+                new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
             c = int(c)  # graftlint: disable=host-sync -- observed driver: count gates the whole bookkeeping branch
             if c > 0:

@@ -64,6 +64,14 @@ analysis" for the rule table).  Runtime twins:
 ``tpu_sgd.analysis.assert_no_host_sync`` (a warmed resident run syncs
 once per cadence window + three end-of-run scalars, pinned in
 ``tests/test_resident.py``) and ``assert_bounded_callback_buffer``.
+
+Observability (``tpu_sgd.obs``, PR 8): the driver emits
+``train.resident_dispatch`` / ``train.window`` spans and a
+``train.io_callback`` counter, and the windows+3 sync pin holds with
+tracing ON — span timestamps must never ``block_until_ready`` mid-loop;
+under async dispatch a span duration is *attribution*, not device
+truth, and counts/bytes are the truth on this harness (ADVICE.md "Span
+timestamps are attribution, not truth").
 """
 
 from __future__ import annotations
@@ -77,6 +85,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_sgd.config import SGDConfig
+from tpu_sgd.obs.counters import inc as obs_inc
+from tpu_sgd.obs.spans import span
 from tpu_sgd.reliability.failpoints import failpoint
 
 _BOOL = jax.ShapeDtypeStruct((), jnp.bool_)
@@ -132,26 +142,45 @@ class ResidentBookkeeper:
         docstring's failure-containment contract."""
         try:
             self.windows_fired += 1
+            # explicit counter for the one event the runtime patches
+            # cannot classify on their own (a callback firing is neither
+            # a dispatch nor a transfer); disabled cost: one global
+            # load + branch
+            obs_inc("train.io_callback")
+            # the span opens BEFORE the win_start fetch so the window's
+            # one counted scalar sync (and, off-CPU, the ring fetch
+            # bytes) attribute to `train`, and it runs on the runtime's
+            # callback thread — thread-local stacks keep it from
+            # parenting onto whatever the dispatching thread has open
+            with span("train.window", supersteps=self.cadence) as sp:
 
-            def _probe():
-                # THE host-side fault-injection site of the resident
-                # path (registered in HOOK_SITES); placed BEFORE any
-                # bookkeeping mutation so a healed retry replays nothing
-                # twice
-                failpoint("io.resident_callback")
-                return bool(self.stop_signal()) \
-                    if self.stop_signal is not None else False
-            if self.retry_policy is not None:
-                want_stop = self.retry_policy.call(_probe)
-            else:
-                want_stop = _probe()
-            # materialize to HOST numpy at the FFI boundary: io_callback
-            # hands the rings over as device arrays, and replaying with
-            # python slicing/indexing on those would dispatch an eager
-            # one-op program per touched element (the shape-trap cost
-            # model) — one bulk fetch per leaf instead
-            self.replay(int(i0w), tuple(np.asarray(r) for r in rings),
-                        self.cadence)
+                def _probe():
+                    # THE host-side fault-injection site of the resident
+                    # path (registered in HOOK_SITES); placed BEFORE any
+                    # bookkeeping mutation so a healed retry replays
+                    # nothing twice
+                    failpoint("io.resident_callback")
+                    return bool(self.stop_signal()) \
+                        if self.stop_signal is not None else False
+                if self.retry_policy is not None:
+                    want_stop = self.retry_policy.call(_probe)
+                else:
+                    want_stop = _probe()
+                # the window's ONE scalar fetch (win_start), made once
+                # and shared by the span attr and the replay — the
+                # windows+3 sync pin in tests/test_resident.py holds
+                # with tracing ON because nothing here fetches twice
+                i0_host = int(i0w)
+                sp.set(i0=i0_host)
+                # materialize to HOST numpy at the FFI boundary:
+                # io_callback hands the rings over as device arrays, and
+                # replaying with python slicing/indexing on those would
+                # dispatch an eager one-op program per touched element
+                # (the shape-trap cost model) — one bulk fetch per leaf
+                # instead
+                self.replay(i0_host,
+                            tuple(np.asarray(r) for r in rings),
+                            self.cadence)
             if want_stop and not self.host_converged:
                 self.stop_requested = True
             return np.bool_(self.host_converged or self.stop_requested)
@@ -174,8 +203,8 @@ class ResidentBookkeeper:
         K, cfg = self.k, self.cfg
         ws, ls, rs, cs, dns, wns = rings
         now = time.perf_counter()
-        span = max(1, n_supersteps * K)
-        wall_dt = (now - self._t_mark) / span
+        n_steps = max(1, n_supersteps * K)
+        wall_dt = (now - self._t_mark) / n_steps
         self._t_mark = now
         for s in range(n_supersteps):
             base = i0w + s * K
@@ -359,31 +388,39 @@ class ResidentLoop:
         rv = float(reg_val)
         i0 = int(start_iter)
         while True:
-            with self._run_lock:
-                self._hooks = hooks
-                try:
-                    carry = self._fn(w_dev, rv, i0, *data)
-                    # dispatch is async: block on the carry BEFORE
-                    # clearing the hook — no callback outlives its
-                    # dispatch only once the program has completed
-                    # graftlint: disable=host-sync -- whole-run dispatch barrier: this 'loop' trips once per run (re-trips only on a false f32 device-convergence), and the callback hook must not be cleared before the program completes
-                    jax.block_until_ready(carry)
-                finally:
-                    self._hooks = None
-            # graftlint: disable=host-sync -- boundary fetch: three scalars once per RUN (the while re-trips only on false device-convergence), not per iteration
-            i_f = int(carry[0])
-            # graftlint: disable=host-sync -- boundary fetch, see line above
-            slot_f = int(carry[9])
-            # graftlint: disable=host-sync -- boundary fetch, see line above
-            conv_f = bool(carry[10])
-            if hooks.error is None and slot_f:
-                # tail window: the un-replayed supersteps since the last
-                # fired window sit in ring rows [0, slot_f * K) — the
-                # rings are fetched to host ONLY here (a completed or
-                # stopped run with slot_f == 0 never pays the (C*K, d)
-                # device->host copy)
-                rings = tuple(np.asarray(r) for r in carry[3:9])
-                hooks.replay(i_f - slot_f * K, rings, slot_f)
+            # the span times the whole-run host region (dispatch, the
+            # documented barrier, and the boundary fetches) so the
+            # counted syncs attribute to `train`; the barrier is the
+            # driver's own contract, not the span's — span timestamps
+            # never force a sync (ADVICE.md "Span timestamps are
+            # attribution, not truth")
+            with span("train.resident_dispatch", i0=i0):
+                with self._run_lock:
+                    self._hooks = hooks
+                    try:
+                        carry = self._fn(w_dev, rv, i0, *data)
+                        # dispatch is async: block on the carry BEFORE
+                        # clearing the hook — no callback outlives its
+                        # dispatch only once the program has completed
+                        # graftlint: disable=host-sync -- whole-run dispatch barrier: this 'loop' trips once per run (re-trips only on a false f32 device-convergence), and the callback hook must not be cleared before the program completes
+                        jax.block_until_ready(carry)
+                    finally:
+                        self._hooks = None
+                # graftlint: disable=host-sync -- boundary fetch: three scalars once per RUN (the while re-trips only on false device-convergence), not per iteration
+                i_f = int(carry[0])
+                # graftlint: disable=host-sync -- boundary fetch, see line above
+                slot_f = int(carry[9])
+                # graftlint: disable=host-sync -- boundary fetch, see line above
+                conv_f = bool(carry[10])
+                if hooks.error is None and slot_f:
+                    # tail window: the un-replayed supersteps since the
+                    # last fired window sit in ring rows
+                    # [0, slot_f * K) — the rings are fetched to host
+                    # ONLY here (a completed or stopped run with
+                    # slot_f == 0 never pays the (C*K, d) device->host
+                    # copy)
+                    rings = tuple(np.asarray(r) for r in carry[3:9])
+                    hooks.replay(i_f - slot_f * K, rings, slot_f)
             if hooks.error is not None:
                 raise hooks.error
             if hooks.stop_requested and not hooks.host_converged:

@@ -181,6 +181,7 @@ def optimize_host_streamed(
     import time as _time
 
     from tpu_sgd.io import Prefetcher, resolve_wire_dtype, wire_cast
+    from tpu_sgd.obs.spans import span
     from tpu_sgd.optimize.gradient_descent import make_step, step_norms
     from tpu_sgd.reliability.failpoints import failpoint
     from tpu_sgd.utils.events import IterationEvent, RunEvent
@@ -687,25 +688,31 @@ def optimize_host_streamed(
                 failpoint("optimize.streamed.step")
                 # Dispatch the fused program FIRST (async), pull the
                 # next superchunk while the device runs the K steps,
-                # and only then block on the ys fetch.
-                if shared_full_batch:
-                    w_dev, ys = fused(
-                        w, jnp.asarray(reg_val, jnp.float32),
-                        jnp.asarray(i0, jnp.int32), Xd, yd, vd)
-                elif window_resident:
-                    w_dev, ys = fused(
-                        w, jnp.asarray(reg_val, jnp.float32),
-                        jnp.asarray(i0, jnp.int32), Xres, yres, *nxt)
-                    if i0 + K <= cfg.num_iterations:
-                        nxt = next(prefetch)
-                else:
-                    Xs, Ys, Vs = nxt
-                    w_dev, ys = fused(
-                        w, jnp.asarray(reg_val, jnp.float32),
-                        jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
-                    if i0 + K <= cfg.num_iterations:
-                        nxt = next(prefetch)
-                ys_host = tuple(np.asarray(a) for a in ys)
+                # and only then block on the ys fetch.  The span times
+                # dispatch -> ys-on-host; attrs are HOST ints, and the
+                # ys fetch below is the driver's own documented
+                # boundary, so tracing adds zero syncs (the acceptance
+                # pin in tests/test_obs.py)
+                with span("train.superstep", i0=i0, steps=steps):
+                    if shared_full_batch:
+                        w_dev, ys = fused(
+                            w, jnp.asarray(reg_val, jnp.float32),
+                            jnp.asarray(i0, jnp.int32), Xd, yd, vd)
+                    elif window_resident:
+                        w_dev, ys = fused(
+                            w, jnp.asarray(reg_val, jnp.float32),
+                            jnp.asarray(i0, jnp.int32), Xres, yres,
+                            *nxt)
+                        if i0 + K <= cfg.num_iterations:
+                            nxt = next(prefetch)
+                    else:
+                        Xs, Ys, Vs = nxt
+                        w_dev, ys = fused(
+                            w, jnp.asarray(reg_val, jnp.float32),
+                            jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
+                        if i0 + K <= cfg.num_iterations:
+                            nxt = next(prefetch)
+                    ys_host = tuple(np.asarray(a) for a in ys)
                 dt = _time.perf_counter() - t0
                 t_last, reg_val, converged = _replay_fused_steps(
                     ys_host, i0, steps, losses, reg_val, cfg,
@@ -773,28 +780,32 @@ def optimize_host_streamed(
             failpoint("optimize.streamed.step")
             # Dispatch the device step FIRST (async), then pull the next
             # prefetched batch while the device computes — only the final
-            # block_until_ready waits on the device.
-            kind, payload = nxt
-            if kind == "resident":
-                new_w, loss_i, new_reg, c = resident_step(
-                    w, Xres, yres, jnp.asarray(payload, jnp.int32),
-                    jnp.asarray(i, jnp.int32),
-                    jnp.asarray(reg_val, jnp.float32),
-                )
-            else:
-                Xb, yb, valid = payload
-                new_w, loss_i, new_reg, c = step(
-                    w, Xb, yb, jnp.asarray(i, jnp.int32),
-                    jnp.asarray(reg_val, jnp.float32),
-                    valid,
-                )
-            if i < cfg.num_iterations:
-                nxt = next(prefetch)
-            # observed streamed driver: the per-iteration host hop IS
-            # the data feed and the bookkeeping contract — barrier once
-            # per step, then fetch each scalar exactly once
-            # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
-            new_w = jax.block_until_ready(new_w)
+            # block_until_ready waits on the device.  The span times the
+            # host region around an ALREADY-contractual barrier (this
+            # driver's per-iteration hop IS the data feed); it adds no
+            # sync of its own.
+            with span("train.step", i=i):
+                kind, payload = nxt
+                if kind == "resident":
+                    new_w, loss_i, new_reg, c = resident_step(
+                        w, Xres, yres, jnp.asarray(payload, jnp.int32),
+                        jnp.asarray(i, jnp.int32),
+                        jnp.asarray(reg_val, jnp.float32),
+                    )
+                else:
+                    Xb, yb, valid = payload
+                    new_w, loss_i, new_reg, c = step(
+                        w, Xb, yb, jnp.asarray(i, jnp.int32),
+                        jnp.asarray(reg_val, jnp.float32),
+                        valid,
+                    )
+                if i < cfg.num_iterations:
+                    nxt = next(prefetch)
+                # observed streamed driver: the per-iteration host hop IS
+                # the data feed and the bookkeeping contract — barrier
+                # once per step, then fetch each scalar exactly once
+                # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
+                new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
             c_host = int(c)  # graftlint: disable=host-sync -- observed driver: count gates the whole bookkeeping branch (fetched ONCE; it used to sync twice per step)
             if c_host > 0:

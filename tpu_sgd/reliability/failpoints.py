@@ -101,6 +101,15 @@ class FailpointSpec:
         if not fire:
             return
         self.triggers += 1
+        # an injected fault that the retry layer then heals leaves TWO
+        # trace records — this one and the reliability.retry that healed
+        # it — which is how a soak report pairs cause with recovery
+        # (local import: obs is optional machinery, failpoints is not)
+        from tpu_sgd.obs.spans import event as obs_event
+
+        obs_event("reliability.failpoint", site=name, hit=self.hits,
+                  latency_s=self.latency_s,
+                  raises=self.exc.__name__ if self.exc else None)
         if self.latency_s:
             time.sleep(self.latency_s)
         if self.exc is not None:

@@ -127,6 +127,13 @@ class RetryPolicy:
                 last = e
                 if on_retry is not None:
                     on_retry(attempt, e)
+                # the heal-in-progress breadcrumb: a soak trace shows
+                # WHICH attempt of WHAT error class healed where
+                # (disabled-mode cost: one global load + branch)
+                from tpu_sgd.obs.spans import event as obs_event
+
+                obs_event("reliability.retry", attempt=attempt,
+                          error=type(e).__name__)
                 logger.debug("attempt %d failed (%s: %s); retrying",
                              attempt, type(e).__name__, e)
                 pause = self.backoff_s(attempt)
@@ -203,19 +210,35 @@ class CircuitBreaker:
         return self.state != self.OPEN
 
     def record_success(self) -> None:
+        if self._opened_at is not None:
+            # a successful HALF_OPEN probe closed the breaker — the
+            # recovery edge an incident replay wants timestamped
+            from tpu_sgd.obs.spans import event as obs_event
+
+            obs_event("reliability.breaker_close",
+                      total_opens=self.total_opens)
         self.consecutive_failures = 0
         self._opened_at = None
 
     def record_failure(self) -> None:
         self.consecutive_failures += 1
+        opened = False
         if self.state == self.HALF_OPEN:
             # failed probe: re-open with a fresh cooldown
             self.total_opens += 1
             self._opened_at = time.monotonic()
+            opened = True
         elif (self._opened_at is None
               and self.consecutive_failures >= self.failure_threshold):
             self.total_opens += 1
             self._opened_at = time.monotonic()
+            opened = True
+        if opened:
+            from tpu_sgd.obs.spans import event as obs_event
+
+            obs_event("reliability.breaker_open",
+                      consecutive_failures=self.consecutive_failures,
+                      total_opens=self.total_opens)
 
     def snapshot(self) -> dict:
         return {

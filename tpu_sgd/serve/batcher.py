@@ -24,6 +24,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
+from tpu_sgd.obs.counters import inc as obs_inc
+from tpu_sgd.obs.spans import span
 from tpu_sgd.reliability.failpoints import failpoint
 from tpu_sgd.reliability.health import Heartbeat
 from tpu_sgd.serve.engine import stack_rows
@@ -48,12 +50,17 @@ class BackpressureError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "future", "t_enqueue")
+    __slots__ = ("x", "future", "t_enqueue", "enqueue_depth")
 
-    def __init__(self, x):
+    def __init__(self, x, enqueue_depth: int = 0):
         self.x = x
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        #: queue depth THIS request saw at its own enqueue — the batch's
+        #: oldest request's value rides the serve_batch event as the
+        #: admission-control signal (ISSUE 8: sustained high depth at
+        #: enqueue says shed load earlier)
+        self.enqueue_depth = enqueue_depth
 
 
 class MicroBatcher:
@@ -110,11 +117,12 @@ class MicroBatcher:
                 self.reject_count += 1
                 if self.metrics is not None:
                     self.metrics.record_reject()
+                obs_inc("serve.reject")
                 raise BackpressureError(
                     f"serving queue full ({self.max_queue} pending); "
                     "request rejected"
                 )
-            req = _Request(x)
+            req = _Request(x, enqueue_depth=len(self._pending))
             self._pending.append(req)
             self._cond.notify_all()
         return req.future
@@ -174,11 +182,12 @@ class MicroBatcher:
             # promise, so drain synchronously here — a waiter blocked on
             # fut.result() must not hang forever
             while True:
-                batch = self._collect()
-                if batch is None:
+                collected = self._collect()
+                if collected is None:
                     break
+                batch, slack = collected
                 if batch:
-                    self._flush(batch)
+                    self._flush(batch, slack)
 
     def __enter__(self):
         return self.start()
@@ -189,15 +198,20 @@ class MicroBatcher:
     # -- flush thread ------------------------------------------------------
     def _run(self):
         while True:
-            batch = self._collect()
-            if batch is None:
+            collected = self._collect()
+            if collected is None:
                 return
+            batch, slack = collected
             if batch:
-                self._flush(batch)
+                self._flush(batch, slack)
 
-    def _collect(self) -> Optional[List[_Request]]:
+    def _collect(self):
         """Block until a flushable batch exists: full, past the oldest
-        request's deadline, or stopping (drain).  None means exit."""
+        request's deadline, or stopping (drain).  None means exit;
+        otherwise ``(batch, deadline_slack_s)`` — the slack is how much
+        of the oldest request's deadline remained when the batch was
+        actually taken (negative = the deadline was missed by that
+        much: a saturated predict call or a scheduling stall)."""
         with self._cond:
             while not self._pending and not self._stopped:
                 # untimed: submit() and stop() both notify, so a timeout
@@ -215,6 +229,11 @@ class MicroBatcher:
                     break
                 self._cond.wait(remaining)
             depth = len(self._pending)
+            # slack measured when the batch is TAKEN (the flush decision
+            # point): a full batch flushes early with positive slack, a
+            # deadline flush reads ~0, and a stalled flush thread goes
+            # negative by exactly the miss
+            slack = deadline - time.perf_counter()
             batch = [
                 self._pending.popleft()
                 for _ in range(min(depth, self.max_batch))
@@ -226,13 +245,15 @@ class MicroBatcher:
             return [
                 r for r in batch
                 if r.future.set_running_or_notify_cancel()
-            ]
+            ], slack
 
-    def _flush(self, batch: List[_Request]):
+    def _flush(self, batch: List[_Request], deadline_slack_s: float = 0.0):
         t_done = None
+        sp = span("serve.batch", batch=len(batch))
         try:
-            X = stack_rows([r.x for r in batch])
-            out = self.predict_batch(X)
+            with sp:
+                X = stack_rows([r.x for r in batch])
+                out = self.predict_batch(X)
             t_done = time.perf_counter()
         except Exception as e:  # one bad row fails its batch, not the server
             for r in batch:
@@ -251,6 +272,8 @@ class MicroBatcher:
                     padded_size=self.padded_size_fn(len(batch)),
                     latencies=[t_done - r.t_enqueue for r in batch],
                     reject_count=self.reject_count,
+                    enqueue_depth=batch[0].enqueue_depth,
+                    deadline_slack_s=deadline_slack_s,
                 )
             except Exception:  # observability must never kill serving
                 logging.getLogger("tpu_sgd.serve.batcher").warning(

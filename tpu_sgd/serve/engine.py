@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_sgd.obs.spans import span
 from tpu_sgd.ops.bucketed import (DEFAULT_BUCKETS, bucket_for,
                                   bucketed_matvec, program_cache_size)
 from tpu_sgd.ops.sparse import is_sparse
@@ -144,18 +145,23 @@ class PredictEngine:
         to different compiled shapes, which XLA may tile at 1-ulp
         variance)."""
         self.call_count += 1
-        if not is_sparse(X):
-            X = np.asarray(X)
-            if X.ndim == 1:
-                X = X[None, :]
-            if X.shape[0] == 0:
-                return np.zeros((0,), np.float32)
-            return self._score_dense(model, X)
-        if X.ndim == 1:  # single sparse vector -> (1, d) row matrix
-            from tpu_sgd.ops.sparse import row_matrix_bcoo
+        # nests under the batcher's serve.batch span on the flush
+        # thread (batch size rides that parent); the engine's result
+        # fetch (np.asarray on the scored bucket) is the
+        # request/response boundary — the documented, deliberate sync
+        with span("serve.predict"):
+            if not is_sparse(X):
+                X = np.asarray(X)
+                if X.ndim == 1:
+                    X = X[None, :]
+                if X.shape[0] == 0:
+                    return np.zeros((0,), np.float32)
+                return self._score_dense(model, X)
+            if X.ndim == 1:  # single sparse vector -> (1, d) row matrix
+                from tpu_sgd.ops.sparse import row_matrix_bcoo
 
-            X = row_matrix_bcoo(X)
-        return self._predict_sparse(model, X)
+                X = row_matrix_bcoo(X)
+            return self._predict_sparse(model, X)
 
     def _score_dense(self, model, X: np.ndarray) -> np.ndarray:
         """Family dispatch over the shared bucketed matvec, honoring THIS

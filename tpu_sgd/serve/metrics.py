@@ -23,6 +23,18 @@ from typing import Callable, List, Optional
 from tpu_sgd.utils.events import ServeBatchEvent, ServeReloadEvent
 
 
+def nearest_rank(xs: List[float], p: float) -> float:
+    """THE nearest-rank percentile rule, defined once: the live scrape
+    (:meth:`ServingMetrics.latency_percentile`) and the offline report
+    (``tpu_sgd.obs.report``) both call this, so an SLO written against a
+    live p99 means the same thing evaluated over a trace.  ``xs`` must
+    already be sorted; empty means 0.0."""
+    if not xs:
+        return 0.0
+    k = min(len(xs) - 1, max(0, int(round(p / 100.0 * len(xs))) - 1))
+    return xs[k]
+
+
 class ServingMetrics:
     """Thread-safe rolling serving stats; forwards events to a listener."""
 
@@ -58,7 +70,14 @@ class ServingMetrics:
         padded_size: int,
         latencies: List[float],
         reject_count: int,
+        enqueue_depth: int = 0,
+        deadline_slack_s: float = 0.0,
     ):
+        """``enqueue_depth``/``deadline_slack_s`` (ISSUE 8) are the
+        admission-control inputs: the queue depth the batch's oldest
+        request saw at its own enqueue, and the deadline slack left when
+        the batch flushed (negative = missed).  Both default so older
+        callers keep working; they ride the event as two more keys."""
         with self._lock:
             self.total_batches += 1
             self.total_requests += batch_size
@@ -71,6 +90,8 @@ class ServingMetrics:
             latency_s=float(max(latencies)) if latencies else 0.0,
             reject_count=int(reject_count),
             model_version=self._version(),
+            enqueue_depth=int(enqueue_depth),
+            deadline_slack_s=float(deadline_slack_s),
         )
         if self.listener is not None:
             self.listener.on_serve_batch(event)
@@ -86,10 +107,7 @@ class ServingMetrics:
             raise ValueError(f"percentile must be in [0, 100], got {p}")
         with self._lock:
             xs = sorted(self._latencies)
-        if not xs:
-            return 0.0
-        k = min(len(xs) - 1, max(0, int(round(p / 100.0 * len(xs))) - 1))
-        return xs[k]
+        return nearest_rank(xs, p)
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -147,6 +147,8 @@ class ModelRegistry:
         # released: a listener that calls back into the registry (pin,
         # clear_bad_versions, another reload) must not deadlock on the
         # non-reentrant lock the emitting thread still holds
+        from tpu_sgd.obs.spans import span
+
         if self.breaker is not None and not self.breaker.allow():
             # OPEN breaker: the directory has been failing repeatedly —
             # skip the scan entirely and keep serving the current model
@@ -154,7 +156,8 @@ class ModelRegistry:
             return False
         emits = []
         swapped = False
-        with self._lock:
+        sp = span("serve.reload")
+        with sp, self._lock:
             if self._pinned:
                 # checked INSIDE the lock: a pin() that completed while
                 # this reload waited must win, not be silently undone
@@ -202,6 +205,8 @@ class ModelRegistry:
                 if self.breaker is not None:
                     self.breaker.record_success()
                 break
+            sp.set(swapped=swapped,
+                   version=self._version if swapped else None)
         for kind, v, err in emits:
             self._emit_reload(kind, v, err)
         return swapped

@@ -112,33 +112,41 @@ class CheckpointManager:
         the versioned schema) — the streaming driver persists its
         ``intercept`` through this (its stream position rides the core
         ``iteration`` field)."""
-        failpoint("checkpoint.save")  # injected BEFORE any byte is
-        # written: a save fault never leaves a partial file behind
-        path = self._path(iteration)
-        # Temp prefix must NOT match the ckpt_*.npz glob, or a truncated
-        # file left by a crash mid-write would be picked up by latest_path.
-        tmp = os.path.join(self.directory, f".tmp_ckpt_{iteration:08d}.npz")
-        with open(tmp, "wb") as f:
-            np.savez(
-                f,
-                version=FORMAT_VERSION,
-                iteration=np.asarray(iteration, np.int64),
-                weights=np.asarray(weights),
-                reg_val=np.asarray(reg_val, np.float64),
-                loss_history=np.asarray(loss_history, np.float64),
-                config_key=np.asarray(config_key),
-                **{f"x_{k}": np.asarray(v)
-                   for k, v in (extras or {}).items()},
-            )
-            # fsync BEFORE the rename: os.replace is atomic for the
-            # directory entry, but on a writeback mount a power loss can
-            # journal the rename while the data blocks are still dirty —
-            # a durable name pointing at truncated bytes
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        self._prune()
-        return path
+        from tpu_sgd.obs.spans import span
+
+        # the span's ``iteration`` attr is the join key obs.report's
+        # served-weight staleness metric uses: reload ts minus the ts of
+        # the checkpoint.save span that wrote that version
+        with span("checkpoint.save", iteration=int(iteration)):
+            failpoint("checkpoint.save")  # injected BEFORE any byte is
+            # written: a save fault never leaves a partial file behind
+            path = self._path(iteration)
+            # Temp prefix must NOT match the ckpt_*.npz glob, or a
+            # truncated file left by a crash mid-write would be picked
+            # up by latest_path.
+            tmp = os.path.join(self.directory,
+                               f".tmp_ckpt_{iteration:08d}.npz")
+            with open(tmp, "wb") as f:
+                np.savez(
+                    f,
+                    version=FORMAT_VERSION,
+                    iteration=np.asarray(iteration, np.int64),
+                    weights=np.asarray(weights),
+                    reg_val=np.asarray(reg_val, np.float64),
+                    loss_history=np.asarray(loss_history, np.float64),
+                    config_key=np.asarray(config_key),
+                    **{f"x_{k}": np.asarray(v)
+                       for k, v in (extras or {}).items()},
+                )
+                # fsync BEFORE the rename: os.replace is atomic for the
+                # directory entry, but on a writeback mount a power loss
+                # can journal the rename while the data blocks are still
+                # dirty — a durable name pointing at truncated bytes
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._prune()
+            return path
 
     def _prune(self):
         for p in self._paths_by_iteration()[: -self.keep]:
@@ -222,7 +230,14 @@ class CheckpointManager:
 
     @staticmethod
     def _load(path: str) -> dict:
-        failpoint("checkpoint.load")
+        from tpu_sgd.obs.spans import span
+
+        with span("checkpoint.restore"):
+            failpoint("checkpoint.load")
+            return CheckpointManager._parse(path)
+
+    @staticmethod
+    def _parse(path: str) -> dict:
         with np.load(path, allow_pickle=False) as z:
             if str(z["version"]) != FORMAT_VERSION:
                 raise CheckpointVersionError(

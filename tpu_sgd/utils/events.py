@@ -66,7 +66,17 @@ class ServeBatchEvent:
     record for the micro-batching path: how deep the queue ran, how many
     requests coalesced, the padded bucket actually compiled against, the
     oldest request's end-to-end latency, cumulative rejects, and which
-    model version answered."""
+    model version answered.
+
+    ``enqueue_depth`` is the queue depth the batch's OLDEST request saw
+    at its own enqueue, and ``deadline_slack_s`` is how much of the
+    flush deadline was left when the batch actually flushed (negative =
+    the deadline was missed by that much) — the two admission-control
+    inputs: sustained high enqueue depth says shed earlier, sustained
+    negative slack says the deadline is unkeepable at this load.  Both
+    default (old readers of the JSONL stream and positional
+    constructors keep working; new records simply carry two more keys).
+    """
 
     queue_depth: int
     batch_size: int
@@ -74,6 +84,8 @@ class ServeBatchEvent:
     latency_s: float
     reject_count: int
     model_version: int
+    enqueue_depth: int = 0
+    deadline_slack_s: float = 0.0
 
 
 @dataclass
@@ -180,6 +192,17 @@ class JsonLinesEventLog(SGDListener):
                 import os
 
                 os.fsync(self._f.fileno())
+
+    def emit(self, kind: str, payload: dict) -> None:
+        """Public record-writer for EXTERNAL producers on this log's
+        contract — the observability layer (``tpu_sgd.obs``) emits its
+        ``trace_span``/``trace_event``/``metric_counters`` records
+        through here, so traces interleave with the listener events on
+        one lock-serialized, torn-tail-tolerant JSONL stream that
+        ``read()`` (and ``obs.report``) replays whole.  ``payload``'s
+        own ``ts`` (the producer's timestamp) wins over the write-time
+        default."""
+        self._write(kind, payload)
 
     def on_run_start(self, config):
         self._write("run_started", {"config": asdict(config)})
