@@ -1,0 +1,273 @@
+"""Compressed sparse wire benchmark: BCOO feed + top-k/EF segments.
+
+Stage-isolated, counts-first measurement of the compressed wire
+(``tpu_sgd/io/sparse_wire.py``; README "Compressed wire") the way
+``bench_ingest.py`` measures the dense wire.  HEADLINE numbers are the
+structural ones this 2-core harness cannot blur: **wire-bytes ratios**
+(physical vs dense-f32-logical, from the ``obs`` wire counters) and
+**dispatch/transfer counts** (the analysis twins) on the warmed
+host-streamed sparse path.  Wall medians are SECONDARY, with basis
+strings saying why (ambient-noise-bound end-to-end walls; host
+staging/compress stages are the isolated timings that transfer).
+
+Three sections:
+
+* ``sparse_feed`` — the RCV1-shaped host-streamed BCOO feed: physical
+  vs dense-f32 bytes per staged superchunk, host staging wall medians,
+  and warmed-run dispatch/h2d counts (one dispatch + 4 component puts
+  per K-superstep).
+* ``topk_compress`` — the host top-k + error-feedback stage in
+  isolation: median compress wall per (d,)-update at several fracs,
+  plus the segment bytes ratio.
+* ``merge_wire`` — the per-shard streamed-totals merge, dense vs
+  compressed (4 shards): physical bytes each way, build walls
+  secondary.
+
+Writes ``BENCH_SPARSE_WIRE.json``; env knobs: ``SPW_ROWS``, ``SPW_DIM``,
+``SPW_NNZ``, ``SPW_ITERS``, ``SPW_REPS``.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    + " --xla_cpu_multi_thread_eigen=false"
+).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("SPW_ROWS", "20000"))
+DIM = int(os.environ.get("SPW_DIM", "47236"))  # the RCV1 feature count
+NNZ = int(os.environ.get("SPW_NNZ", "48"))     # ~0.1% density
+ITERS = int(os.environ.get("SPW_ITERS", "24"))
+K = 4
+REPS = int(os.environ.get("SPW_REPS", "5"))
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_SPARSE_WIRE.json")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def median(xs):
+    return float(np.median(np.asarray(xs)))
+
+
+def bench_sparse_feed():
+    """The host-streamed BCOO feed: bytes, counts, staging walls."""
+    from tpu_sgd.analysis.runtime import count_dispatches
+    from tpu_sgd.obs import counters as obs_counters
+    from tpu_sgd.obs.counters import wire_ratios
+    from tpu_sgd.ops.gradients import HingeGradient
+    from tpu_sgd.ops.sparse import sparse_data
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    log(f"sparse_feed: {ROWS}x{DIM}, {NNZ} nnz/row, {ITERS} iters K={K}")
+    X, y, _ = sparse_data(ROWS, DIM, nnz_per_row=NNZ, kind="svm", seed=0)
+    w0 = np.zeros(DIM, np.float32)
+
+    def mk():
+        return (GradientDescent(gradient=HingeGradient())
+                .set_num_iterations(ITERS).set_step_size(0.2)
+                .set_mini_batch_fraction(0.1).set_convergence_tol(0.0)
+                .set_seed(7).set_host_streaming(True).set_superstep(K))
+
+    mk().optimize_with_history((X, y), w0)  # warm the fused program
+
+    obs_counters.enable()
+    try:
+        obs_counters.reset()
+        t0 = time.perf_counter()
+        mk().optimize_with_history((X, y), w0)
+        wall = time.perf_counter() - t0
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+        obs_counters.reset()
+    ratios = wire_ratios(snap)
+    bcoo = next(r for n, r in ratios.items() if n.endswith(".bcoo"))
+
+    with count_dispatches() as dc:
+        mk().optimize_with_history((X, y), w0)
+
+    # isolated host staging wall: one superchunk's CSR gather + pad
+    from tpu_sgd.io.sparse_wire import (bcoo_to_csr_host,
+                                        plan_sparse_batches,
+                                        stage_sparse_batch)
+
+    indptr, cols, vals, _ = bcoo_to_csr_host(X)
+    frac = 0.1
+    sigma = np.sqrt(ROWS * frac * (1 - frac))
+    cap = int(min(ROWS, np.ceil(ROWS * frac + 6 * sigma + 8)))
+
+    def sample_rows(i):
+        rng = np.random.default_rng(7 + i)
+        m = rng.random(ROWS) < frac
+        idx = np.nonzero(m)[0]
+        return idx[:cap]
+
+    nse_cap = plan_sparse_batches(indptr, sample_rows, ITERS, cap)
+    stage_walls = []
+    for rep in range(REPS + 1):
+        t0 = time.perf_counter()
+        for t in range(K):
+            stage_sparse_batch(indptr, cols, vals, sample_rows(1 + t),
+                               cap, nse_cap)
+        if rep:  # first is warmup
+            stage_walls.append(time.perf_counter() - t0)
+
+    # every leaf that crosses, both sides: X rows (+12B/entry sparse,
+    # 4B/elem dense) plus the SAME f32 labels and bool valid mask
+    dense_super_bytes = K * (cap * (DIM * 4 + 5))
+    sparse_super_bytes = K * (nse_cap * 12 + cap * 5)
+    return {
+        "shape": {"rows": ROWS, "dim": DIM, "nnz_per_row": NNZ,
+                  "iters": ITERS, "superstep_k": K,
+                  "mini_batch_fraction": frac, "row_cap": cap,
+                  "nse_cap": nse_cap},
+        "wire_bytes": {
+            "physical": bcoo["physical_bytes"],
+            "dense_f32_logical": bcoo["logical_bytes"],
+            "ratio": bcoo["ratio"],
+            "per_superchunk_physical": sparse_super_bytes,
+            "per_superchunk_dense_f32": dense_super_bytes,
+            "basis": ("obs wire counters over one full run; physical = "
+                      "EVERY transferred leaf (BCOO data f32 + int32x2 "
+                      "indices + f32 labels + bool valid), logical = "
+                      "the dense-f32 chunk with the same labels/mask; "
+                      "structural, noise-free"),
+        },
+        "counts": {
+            "dispatches_per_run": dc["n"],
+            "supersteps": -(-ITERS // K),
+            "basis": ("analysis twins on the warmed run; the fused "
+                      "sparse scan is ONE program per superstep (+ the "
+                      "per-run re-jit trace, a known streamed-driver "
+                      "cost) — counts, not walls, are the headline on "
+                      "this 2-core harness"),
+        },
+        "staging_wall_s": {
+            "median_per_superchunk": median(stage_walls),
+            "basis": ("host-isolated CSR gather + fixed-shape pad for "
+                      f"K={K} batches, {REPS} reps median, warmup "
+                      "discarded; runs on the prefetch worker in "
+                      "production (overlapped)"),
+        },
+        "end_to_end_wall_s": {
+            "value": wall,
+            "basis": ("SECONDARY: counters-enabled run on a noisy "
+                      "2-core VM; see two-core overlap-bench policy"),
+        },
+    }
+
+
+def bench_topk_compress():
+    """Host top-k + EF compress stage in isolation."""
+    from tpu_sgd.io.sparse_wire import ErrorFeedback
+
+    out = {}
+    rng = np.random.default_rng(1)
+    for dim in (DIM, 1_000_000):
+        upd = rng.normal(size=dim).astype(np.float32)
+        for frac in (0.01, 0.05):
+            ef = ErrorFeedback(dim, frac)
+            walls = []
+            for rep in range(REPS + 1):
+                t0 = time.perf_counter()
+                idx, vals = ef.compress(upd)
+                if rep:
+                    walls.append(time.perf_counter() - t0)
+            out[f"d{dim}_topk{frac}"] = {
+                "median_s": median(walls),
+                "segment_bytes": int(idx.nbytes + vals.nbytes),
+                "dense_f32_bytes": int(upd.nbytes),
+                "ratio": upd.nbytes / (idx.nbytes + vals.nbytes),
+            }
+    out["basis"] = ("host numpy argpartition select + extract, median "
+                    f"of {REPS}, warmup discarded; the stage "
+                    "choose_wire_compress weighs against the wire "
+                    "saving")
+    return out
+
+
+def bench_merge_wire():
+    """Per-shard streamed-totals merge: dense vs compressed bytes."""
+    from tpu_sgd.obs import counters as obs_counters
+    from tpu_sgd.obs.counters import wire_ratios
+    from tpu_sgd.parallel.gram_parallel import build_streamed_total_stats
+    from tpu_sgd.parallel.mesh import data_mesh
+
+    mesh = data_mesh(jax.devices()[:4])
+    rng = np.random.default_rng(2)
+    d = 256
+    Xh = rng.normal(size=(4096, d)).astype(np.float32)
+    yh = rng.normal(size=4096).astype(np.float32)
+
+    def run(wire_compress):
+        obs_counters.enable()
+        try:
+            obs_counters.reset()
+            t0 = time.perf_counter()
+            build_streamed_total_stats(mesh, Xh, yh, block_rows=256,
+                                       wire_compress=wire_compress)
+            wall = time.perf_counter() - t0
+            snap = obs_counters.snapshot()
+        finally:
+            obs_counters.disable()
+            obs_counters.reset()
+        merge = {n.rsplit(".", 1)[-1]: r
+                 for n, r in wire_ratios(snap).items()}
+        return wall, merge
+
+    wall_dense, merge_dense = run(None)
+    wall_comp, merge_comp = run("topk:0.01")
+    dense_phys = merge_dense["dense-f32"]["physical_bytes"]
+    comp_phys = (merge_comp["topk"]["physical_bytes"]
+                 + merge_comp["dense-f32"]["physical_bytes"])
+    return {
+        "shards": 4, "d": d,
+        "dense_merge_bytes": dense_phys,
+        "compressed_merge_bytes": comp_phys,
+        "compressed_segments_bytes": merge_comp["topk"]["physical_bytes"],
+        "residual_flush_bytes": merge_comp["dense-f32"]["physical_bytes"],
+        "ratio": dense_phys / comp_phys,
+        "walls_s_secondary": {"dense": wall_dense,
+                              "compressed": wall_comp},
+        "basis": ("obs wire counters over the k-1 shard merges at "
+                  "topk:0.01 + ONE dense residual flush (totals exact "
+                  "up to reassociation); with k shards the ratio "
+                  "approaches (k-1)/(1 + (k-1)*2*frac) — the win grows "
+                  "with the shard count; walls secondary (2-core "
+                  "policy)"),
+    }
+
+
+def main():
+    doc = {
+        "bench": "sparse_wire",
+        "jax": jax.__version__,
+        "devices": len(jax.devices()),
+        "headline": ("wire-bytes ratios (physical vs dense-f32) and "
+                     "dispatch counts; walls secondary on this "
+                     "2-core harness"),
+        "sparse_feed": bench_sparse_feed(),
+        "topk_compress": bench_topk_compress(),
+        "merge_wire": bench_merge_wire(),
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+    log(f"wrote {OUT}")
+    log(f"sparse feed wire ratio: "
+        f"{doc['sparse_feed']['wire_bytes']['ratio']:.1f}x; merge ratio: "
+        f"{doc['merge_wire']['ratio']:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -378,6 +378,59 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         say(f"double-corrupt restore fell back to iteration "
             f"{state['iteration']}, quarantined {len(torn)} files")
 
+        # ---- phase 1c: SPARSE compressed wire under fire -----------------
+        # the host-streamed BCOO feed's compress/stage site
+        # (io.sparse_wire, fired per staged batch inside the prefetch
+        # retry scope) heals through the ingest RetryPolicy: the staged
+        # batch is deterministic in (seed, i), so a healed re-stage is
+        # identical and the faulted run must stay BITWISE equal to the
+        # fault-free sparse run
+        from tpu_sgd.ops.gradients import HingeGradient
+        from tpu_sgd.ops.sparse import sparse_data
+
+        deadline = Deadline(180.0)
+        Xs, ys_lab, _ = sparse_data(384, 256, nnz_per_row=8, kind="svm",
+                                    seed=seed)
+        ws0 = np.zeros(Xs.shape[1], np.float32)
+
+        def _make_sparse_opt(retry=None):
+            from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+            o = (GradientDescent(gradient=HingeGradient())
+                 .set_num_iterations(16).set_step_size(0.2)
+                 .set_mini_batch_fraction(0.4).set_convergence_tol(0.0)
+                 .set_seed(7).set_host_streaming(True).set_superstep(4))
+            if retry is not None:
+                o.set_ingest_options(retry=retry)
+            return o
+
+        w_sp_ref, h_sp_ref = _make_sparse_opt().optimize_with_history(
+            (Xs, ys_lab), ws0)
+        sparse_faults = {
+            "io.sparse_wire": fail_prob(0.15, seed=seed + 30),
+            "io.prefetch.produce": inject_latency(2.0, prob=0.2,
+                                                  seed=seed + 31),
+        }
+        sp_opt = _make_sparse_opt(
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.002,
+                              seed=seed + 32))
+        with inject_faults(sparse_faults):
+            w_sp, h_sp = sp_opt.optimize_with_history((Xs, ys_lab), ws0)
+            summary["sparse_hits"] = {
+                k: fp.hits(k) for k in sparse_faults}
+            summary["sparse_triggers"] = {
+                k: fp.triggers(k) for k in sparse_faults}
+        deadline.check("sparse wire chaos phase")
+        assert summary["sparse_hits"]["io.sparse_wire"] > 0, (
+            "the sparse-wire stage site was never reached")
+        np.testing.assert_array_equal(
+            np.asarray(w_sp), np.asarray(w_sp_ref),
+            err_msg="sparse chaos weights diverged from fault-free")
+        np.testing.assert_array_equal(
+            h_sp, h_sp_ref, err_msg="sparse chaos loss history diverged")
+        say(f"sparse wire survived: triggers="
+            f"{summary['sparse_triggers']}, BITWISE equal to fault-free")
+
         # ---- phase 2: serving under reload faults ------------------------
         deadline = Deadline(120.0)
         breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.05)

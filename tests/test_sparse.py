@@ -205,9 +205,19 @@ def test_sparse_guards(small_sparse):
     opt = GradientDescent().set_sampling("sliced").set_mini_batch_fraction(0.5)
     with pytest.raises(NotImplementedError, match="bernoulli"):
         opt.optimize((X, y), w0)
-    opt2 = GradientDescent().set_host_streaming(True)
-    with pytest.raises(NotImplementedError, match="dense rows"):
+    # host streaming on sparse features TRAINS since the compressed-wire
+    # round (optimize/streamed_sparse.py; tests/test_sparse_wire.py) —
+    # the remaining guard is the meshed variant (single-device only)
+    from tpu_sgd.parallel import data_mesh as _dm
+
+    opt2 = GradientDescent().set_host_streaming(True).set_mesh(_dm())
+    with pytest.raises(NotImplementedError, match="single-device"):
         opt2.optimize((X, y), w0)
+    # ...and the sliced-sampling guard holds on the streamed path too
+    opt3 = (GradientDescent().set_host_streaming(True)
+            .set_sampling("sliced").set_mini_batch_fraction(0.5))
+    with pytest.raises(NotImplementedError, match="bernoulli"):
+        opt3.optimize((X, y), w0)
     from tpu_sgd.optimize.normal import NormalEquations
 
     with pytest.raises(NotImplementedError, match="dense features"):

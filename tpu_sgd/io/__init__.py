@@ -29,6 +29,11 @@ builders, ``parallel/gram_parallel.py`` meshed builders,
   transfer half the bytes, upcast/accumulate in f32 on device (the
   SparCML shrink-bytes-on-the-wire move, arXiv:1802.08021, applied to
   the host→HBM hop).
+* :mod:`tpu_sgd.io.sparse_wire` — the compressed sparse wire: top-k +
+  error-feedback ``(indices, values)`` segments for update-shaped data
+  (``wire_compress="topk:<frac>"``; the dropped mass is carried, never
+  lost) and fixed-nse BCOO chunk staging for the host-streamed sparse
+  feed — see README "Compressed wire".
 
 The superstep executor (``GradientDescent.set_superstep``; README
 "Fused stepping") composes with all three: ``stack_superchunk``
@@ -44,6 +49,9 @@ See README "Ingestion pipeline" for when the bf16 wire is safe and how
 from tpu_sgd.io.chunking import (Chunk, ChunkPlan, pad_rows, plan_chunks,
                                  stack_superchunk)
 from tpu_sgd.io.prefetch import Prefetcher
+from tpu_sgd.io.sparse_wire import (ErrorFeedback, parse_wire_compress,
+                                    plan_sparse_batches, stage_sparse_batch,
+                                    topk_nnz, topk_select)
 from tpu_sgd.io.wire import resolve_wire_dtype, wire_cast
 
 #: default lookahead of every pipelined streaming path (double buffer)
@@ -53,10 +61,16 @@ __all__ = [
     "Chunk",
     "ChunkPlan",
     "DEFAULT_PREFETCH_DEPTH",
+    "ErrorFeedback",
     "Prefetcher",
     "pad_rows",
+    "parse_wire_compress",
     "plan_chunks",
+    "plan_sparse_batches",
     "resolve_wire_dtype",
     "stack_superchunk",
+    "stage_sparse_batch",
+    "topk_nnz",
+    "topk_select",
     "wire_cast",
 ]

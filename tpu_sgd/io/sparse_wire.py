@@ -1,0 +1,268 @@
+"""Compressed sparse wire: top-k + error feedback, and fixed-nse BCOO chunks.
+
+The bf16 wire (``tpu_sgd/io/wire.py``) halved the bytes on the
+host→device hop; this module moves the SparCML lever (arXiv:1802.08021)
+the rest of the way for the two wires that carry *update-shaped* data —
+only the bytes that matter cross the link:
+
+* **top-k + error feedback** — a gradient/update vector is reduced to
+  its ``k`` largest-magnitude entries as ``(indices, values)`` segments;
+  the dropped mass is NOT lost but carried in a persistent
+  *error-feedback accumulator* that is added back before the next
+  selection, so every coordinate's contribution eventually ships
+  (EF-SGD: convergent at matched final loss where plain top-k is not).
+  The host-side selection (:class:`ErrorFeedback`, the per-shard
+  Gram/totals merge wire in ``parallel/gram_parallel.py``) runs in HOST
+  numpy — an eager ``jnp.argsort``/gather here would compile one
+  program per novel shape, the eager-op shape-compile trap.  The
+  device-side selection (``make_compressed_step`` in
+  ``optimize/gradient_descent.py``, the data-parallel all-reduce wire)
+  uses ``jax.lax.top_k`` with a STATIC ``k`` inside the traced step, so
+  it is shape-stable by construction and the EF state rides the
+  superstep scan carry.
+
+* **fixed-nse BCOO chunk staging** — the host-streamed sparse feed
+  (``optimize/streamed_sparse.py``) moves batches as ``(data, indices)``
+  component arrays padded to ONE fixed ``(rows, nse)`` shape per build
+  (:func:`plan_sparse_batches` + :func:`stage_sparse_batch`), so the
+  device consumer compiles exactly one body program and a ~0.1%-nnz
+  RCV1-shaped batch ships ~100-1000x fewer bytes than its dense-f32
+  chunk.  Padding entries are *null entries* — value 0.0 at local
+  (0, 0), the same construction as ``parallel/sparse_parallel.py`` —
+  which contribute exactly zero to both matvecs; no chunk is ever
+  densified anywhere on the path.
+
+Error feedback is OPTIMIZER STATE, not a transport detail: the
+accumulator changes which update reaches the weights, so it must live
+in the checkpoint (the drivers persist it through
+``CheckpointManager.save(extras={"ef": ...})``) and in the scan carry —
+see ADVICE.md "Error feedback is optimizer state, not a transport
+detail" and README "Compressed wire".
+
+``wire_compress`` spec format: ``"topk:<frac>"`` — keep the top
+``frac`` fraction of coordinates (e.g. ``"topk:0.01"`` ships ~1% of
+the entries; physical bytes are ``2 * frac`` of the dense wire since
+each entry carries an int32 index alongside its f32 value).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_sgd.obs.counters import record_wire
+from tpu_sgd.reliability.failpoints import failpoint
+
+
+def parse_wire_compress(spec) -> Optional[float]:
+    """Validate a ``wire_compress`` spec; returns the top-k fraction or
+    None (no compression).  Accepted: ``None``, ``"topk:<frac>"`` with
+    ``0 < frac <= 1``.  Raises on anything else — a typo must fail at
+    ``set_ingest_options`` time, not mid-build."""
+    if spec is None:
+        return None
+    if not isinstance(spec, str) or not spec.startswith("topk:"):
+        raise ValueError(
+            f"wire_compress must be 'topk:<frac>' or None, got {spec!r}"
+        )
+    try:
+        frac = float(spec[len("topk:"):])
+    except ValueError:
+        raise ValueError(
+            f"wire_compress fraction is not a number: {spec!r}"
+        ) from None
+    if not 0.0 < frac <= 1.0:
+        raise ValueError(
+            f"wire_compress fraction must be in (0, 1], got {frac}"
+        )
+    return frac
+
+
+def topk_nnz(dim: int, frac: float) -> int:
+    """Entries kept per compressed update: ``ceil(frac * dim)``, at
+    least 1, at most ``dim`` — ONE definition shared by the host wire,
+    the traced step, and the byte accounting."""
+    return int(max(1, min(int(dim), int(np.ceil(int(dim) * float(frac))))))
+
+
+def topk_select(v: np.ndarray, k: int) -> np.ndarray:
+    """Host-numpy indices of the ``k`` largest-|v| entries (int32,
+    unordered — scatter-add is order-free).  ``argpartition`` keeps the
+    selection O(dim), not O(dim log dim)."""
+    v = np.asarray(v)
+    k = int(min(k, v.shape[0]))
+    if k >= v.shape[0]:
+        return np.arange(v.shape[0], dtype=np.int32)
+    return np.argpartition(np.abs(v), -k)[-k:].astype(np.int32)
+
+
+class ErrorFeedback:
+    """Persistent host-side error-feedback accumulator for one wire.
+
+    ``compress(update)`` folds the update into the accumulator, extracts
+    the top-k ``(indices, values)`` segment, and KEEPS the rest — the
+    dropped mass is carried into the next selection, never lost.
+    ``residual()`` surfaces what is still unsent (the merge wires flush
+    it as one dense add at the end, making the merged total exact up to
+    f.p. reassociation).  ``state()``/``load_state()`` round-trip the
+    accumulator through a checkpoint: error feedback is optimizer
+    state, and a resumed compressed run must select from the same
+    accumulator to stay bitwise.
+    """
+
+    def __init__(self, dim: int, frac: float, dtype=np.float32):
+        self.dim = int(dim)
+        self.frac = float(frac)
+        self.k = topk_nnz(self.dim, self.frac)
+        self.acc = np.zeros((self.dim,), dtype)
+
+    def compress(self, update: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """``(indices int32, values)`` of the top-k of accumulator +
+        update; the selected coordinates are zeroed in the accumulator
+        (their mass ships), the rest stays.  All host numpy.  Passes the
+        ``io.sparse_wire`` failpoint — THE compress/stage fault-injection
+        site, healed by the caller's ingest ``RetryPolicy`` where one is
+        wired (the accumulator mutates only after the failpoint, so a
+        healed retry replays nothing twice)."""
+        failpoint("io.sparse_wire")
+        update = np.asarray(update).reshape(-1)
+        if update.shape[0] != self.dim:
+            raise ValueError(
+                f"update has {update.shape[0]} entries, accumulator has "
+                f"{self.dim}"
+            )
+        self.acc += update
+        idx = topk_select(self.acc, self.k)
+        vals = self.acc[idx].copy()
+        self.acc[idx] = 0.0
+        record_wire("topk", logical_nbytes=int(update.nbytes),
+                    physical_nbytes=int(vals.nbytes + idx.nbytes))
+        return idx, vals
+
+    def residual(self) -> np.ndarray:
+        """Copy of the still-unsent mass (the merge wires' final dense
+        flush; does NOT clear — call :meth:`clear` after flushing)."""
+        return self.acc.copy()
+
+    def clear(self) -> None:
+        self.acc[:] = 0.0
+
+    def state(self) -> np.ndarray:
+        """Checkpointable accumulator state (see class docstring)."""
+        return self.acc.copy()
+
+    def load_state(self, acc: np.ndarray) -> None:
+        acc = np.asarray(acc).reshape(-1)
+        if acc.shape[0] != self.dim:
+            raise ValueError(
+                f"checkpointed accumulator has {acc.shape[0]} entries, "
+                f"this wire needs {self.dim}"
+            )
+        self.acc = acc.astype(self.acc.dtype, copy=True)
+
+
+# -- fixed-nse sparse chunk planning / staging -------------------------------
+
+
+def plan_sparse_batches(indptr: np.ndarray, sample_rows, num_iterations: int,
+                        row_cap: int) -> int:
+    """Fixed nse cap covering EVERY batch of a deterministic sampled
+    run — the sparse chunk planner's shape-discipline moment.
+
+    The dense chunk planner (``io/chunking.py``) fixes the ROW shape;
+    a sparse batch additionally varies in nse, and a per-batch nse
+    would compile one device program per novel sparsity (the shape
+    trap).  The sample sequence is deterministic in ``(seed, i)``, so
+    one cheap host pre-pass over ``sample_rows(i)`` computes the max
+    batch nse of the whole run; every staged batch then pads to that
+    ONE ``(row_cap, nse_cap)`` shape and the fused body compiles
+    exactly once per build (``assert_compile_count``-pinned in
+    tests/test_sparse_wire.py).  A resumed run re-plans over the SAME
+    full iteration range, so its cap — and its compiled program —
+    match the uninterrupted run's.
+
+    ``indptr``: CSR row pointers of the host matrix; ``sample_rows(i)``
+    returns iteration ``i``'s row ids (truncated to ``row_cap``
+    exactly as the producer truncates).  Returns ``nse_cap >= 1``.
+    """
+    row_nnz = np.diff(np.asarray(indptr)).astype(np.int64)
+    cap = 1
+    for i in range(1, int(num_iterations) + 1):
+        rows = np.asarray(sample_rows(i))[:row_cap]
+        nse = int(row_nnz[rows].sum())
+        if nse > cap:
+            cap = nse
+    return cap
+
+
+def gather_csr_rows(indptr: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                    rows: np.ndarray):
+    """Host-numpy CSR row gather: entries of ``rows`` (in order) with
+    LOCAL row ids ``0..len(rows)-1``.  Returns ``(lrows, lcols, lvals)``
+    flat entry arrays.  Vectorized — one ``np.repeat`` + ranged index,
+    no per-row Python loop."""
+    rows = np.asarray(rows)
+    starts = indptr[rows]
+    counts = (indptr[rows + 1] - starts).astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return (np.zeros((0,), np.int32), np.zeros((0,), np.int32),
+                np.zeros((0,), vals.dtype))
+    # flat positions: for each selected row r, the range
+    # [indptr[r], indptr[r+1]) — built as offsets into a repeat
+    base = np.repeat(starts, counts)
+    within = np.arange(total, dtype=np.int64) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    pos = base + within
+    lrows = np.repeat(np.arange(rows.shape[0], dtype=np.int32), counts)
+    return lrows, cols[pos].astype(np.int32), vals[pos]
+
+
+def stage_sparse_batch(indptr: np.ndarray, cols: np.ndarray,
+                       vals: np.ndarray, rows: np.ndarray, row_cap: int,
+                       nse_cap: int):
+    """Assemble one fixed-shape sparse batch in HOST numpy.
+
+    Returns ``(data (nse_cap,), idx (nse_cap, 2) int32, valid
+    (row_cap,) bool)``: the entries of ``rows`` at local row ids, padded
+    with *null entries* (0.0 at (0, 0) — exact zero contribution to
+    both matvecs, the ``sparse_parallel`` construction) to the planned
+    ``nse_cap`` and ``row_cap``.  Passes the ``io.sparse_wire``
+    failpoint (the stage site; runs on the prefetch worker inside the
+    retry scope like every producer).  The wire-byte accounting lives
+    at the TRANSFER site (the streamed driver's producer), which sees
+    every leaf that actually crosses — components, labels, and mask —
+    so the recorded ratio compares like payloads."""
+    failpoint("io.sparse_wire")
+    lrows, lcols, lvals = gather_csr_rows(indptr, cols, vals, rows)
+    nse = lvals.shape[0]
+    if nse > nse_cap:
+        raise ValueError(
+            f"batch carries {nse} entries but the plan capped nse at "
+            f"{nse_cap} (the pre-pass and the producer must share one "
+            "sample rule)"
+        )
+    data = np.zeros((nse_cap,), vals.dtype)
+    idx = np.zeros((nse_cap, 2), np.int32)
+    data[:nse] = lvals
+    idx[:nse, 0] = lrows
+    idx[:nse, 1] = lcols
+    valid = np.zeros((row_cap,), bool)
+    valid[: rows.shape[0]] = True
+    return data, idx, valid
+
+
+def bcoo_to_csr_host(X):
+    """Host CSR view ``(indptr, cols, vals, (n, d))`` of a BCOO matrix —
+    the one-time relayout the streamed sparse feed samples from
+    (``host_entries`` drops jax's out-of-bounds padding sentinels and
+    establishes row-major order, so ``searchsorted`` yields exact row
+    pointers)."""
+    from tpu_sgd.ops.sparse import host_entries
+
+    n, d = X.shape
+    rows, cols, vals = host_entries(X)
+    indptr = np.searchsorted(rows, np.arange(int(n) + 1)).astype(np.int64)
+    return indptr, np.asarray(cols, np.int32), np.asarray(vals), \
+        (int(n), int(d))

@@ -15,6 +15,13 @@ input rounding far below its own sampling noise.  When it is not: runs
 that must be bit-reproducible against an f32 resident build, or data
 whose information lives below bf16's 8 mantissa bits.  The default is
 always OFF (``wire_dtype=None`` = transfer at the data dtype).
+
+bf16 halves the bytes of EVERY element; the compressed sparse wire
+(``tpu_sgd/io/sparse_wire.py``, ``wire_compress="topk:<frac>"``) goes
+further for *update-shaped* data — ship only the top-k coordinates and
+carry the rest in an error-feedback accumulator (README "Compressed
+wire"; ADVICE.md "Error feedback is optimizer state, not a transport
+detail").
 """
 
 from __future__ import annotations

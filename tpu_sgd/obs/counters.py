@@ -53,7 +53,7 @@ from typing import Dict, Optional
 from tpu_sgd.obs import spans as _spans
 
 __all__ = ["RuntimeCounters", "inc", "enable", "disable", "is_enabled",
-           "snapshot", "reset", "deltas"]
+           "record_wire", "snapshot", "reset", "deltas", "wire_ratios"]
 
 logger = logging.getLogger("tpu_sgd.obs")
 
@@ -109,6 +109,47 @@ def inc(name: str, n: int = 1, nbytes: int = 0) -> None:
     if not _ENABLED:
         return
     _GLOBAL.inc(name, n, nbytes)
+
+
+def record_wire(fmt: str, logical_nbytes: int, physical_nbytes: int) -> None:
+    """Tag one wire transfer by FORMAT (``dense-f32`` / ``bf16`` /
+    ``bcoo`` / ``topk``): ``physical`` is what actually crosses the
+    link, ``logical`` the dense-f32-equivalent payload it represents —
+    the pair is what makes the per-stage compression ratio a measured
+    number (``obs.report`` prints ``logical / physical``;
+    :func:`wire_ratios` computes it).  Counter names:
+    ``<subsystem>.wire.<fmt>`` carries the physical bytes,
+    ``<subsystem>.wire.<fmt>.logical`` the logical bytes, both with one
+    ``n`` per transfer.  Same disabled-mode cost contract as
+    :func:`inc` — one global load + falsy branch."""
+    if not _ENABLED:
+        return
+    base = f"{_tagged('wire')}.{fmt}"
+    _GLOBAL.inc(base, nbytes=int(physical_nbytes))
+    _GLOBAL.inc(base + ".logical", nbytes=int(logical_nbytes))
+
+
+def wire_ratios(counts: Optional[Dict[str, Dict[str, int]]] = None
+                ) -> Dict[str, Dict[str, float]]:
+    """Per-stage wire compression table from a counter snapshot:
+    ``{"<subsystem>.wire.<fmt>": {n, physical_bytes, logical_bytes,
+    ratio}}`` where ``ratio = logical / physical`` (>= 1 means the wire
+    shipped fewer bytes than the dense-f32 payload it represents).  THE
+    one definition shared by ``obs.report`` and the benches."""
+    counts = snapshot() if counts is None else counts
+    out: Dict[str, Dict[str, float]] = {}
+    for name, c in counts.items():
+        if ".wire." not in name or name.endswith(".logical"):
+            continue
+        logical = counts.get(name + ".logical", {"bytes": 0})["bytes"]
+        phys = c["bytes"]
+        out[name] = {
+            "n": c["n"],
+            "physical_bytes": phys,
+            "logical_bytes": logical,
+            "ratio": (logical / phys) if phys else float("inf"),
+        }
+    return out
 
 
 def snapshot() -> Dict[str, Dict[str, int]]:

@@ -297,6 +297,18 @@ def render_report(records: List[dict]) -> str:
         for name, c in sorted(deltas.items()):
             extra = f"  bytes={c['bytes']}" if c["bytes"] else ""
             lines.append(f"  {name:<40}{c['n']:>10}{extra}")
+        from tpu_sgd.obs.counters import wire_ratios
+
+        ratios = wire_ratios(deltas)
+        if ratios:
+            lines.append("wire formats (physical vs dense-f32-logical "
+                         "bytes; ratio = compression):")
+            for name, r in sorted(ratios.items()):
+                lines.append(
+                    f"  {name:<34}{r['n']:>8}"
+                    f"  physical={r['physical_bytes']:>12}"
+                    f"  logical={r['logical_bytes']:>12}"
+                    f"  ratio={r['ratio']:.1f}x")
     stale = staleness_samples(records)
     if stale:
         worst = max(s["staleness_s"] for s in stale)
@@ -348,8 +360,11 @@ def main(argv=None) -> int:
             return 2
 
     if args.json:
+        from tpu_sgd.obs.counters import wire_ratios
+
         out = {"spans": span_stats(records),
                "counters": counter_deltas(records),
+               "wire": wire_ratios(counter_deltas(records)),
                "staleness": staleness_samples(records)}
         if verdicts is not None:
             out["slos"] = verdicts

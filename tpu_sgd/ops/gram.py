@@ -310,6 +310,24 @@ def _acc_totals(G, b, yy, dG, db, dyy):
     return G + dG, b + db, yy + dyy
 
 
+@partial(jax.jit, donate_argnums=0)
+def _scatter_acc_flat(flat, idx, vals):
+    """In-place (donated) scatter-add of one compressed ``(indices,
+    values)`` wire segment into the flat totals accumulator — the
+    sparse sibling of :func:`_acc_totals` for the top-k merge wire
+    (``parallel/gram_parallel.py``; README "Compressed wire")."""
+    return flat.at[idx].add(vals.astype(flat.dtype))
+
+
+@partial(jax.jit, donate_argnums=0)
+def _dense_acc_flat(flat, delta):
+    """In-place (donated) dense add into the flat totals accumulator —
+    the compressed merge's FINAL residual flush (the error-feedback
+    mass that never made a top-k cut ships exactly once here, so the
+    merged totals stay exact up to f.p. reassociation)."""
+    return flat + delta.astype(flat.dtype)
+
+
 def _dataset_fingerprint(Xh, yh, n_rows: int) -> str:
     """Cheap dataset identity for resume checkpoints (first/last used
     row + a label head) — shared by the prefix and totals builders so a

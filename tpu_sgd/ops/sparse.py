@@ -25,9 +25,11 @@ gradients, GradientDescent / LBFGS / OWLQN — single-device AND data-
 parallel over a 1-D mesh (equal-nse per-shard blocks,
 tpu_sgd/parallel/sparse_parallel.py — the distributed-sparse
 treeAggregate analogue), including multi-host assembly from per-process
-local rows.  Sliced/indexed sampling, host streaming, feature-axis
-('model') sharding, and NormalEquations need dense row layouts and raise
-clear errors.
+local rows; host-resident datasets additionally stream through the
+fixed-nse BCOO feed (``GradientDescent.set_host_streaming`` ->
+``optimize/streamed_sparse.py``, README "Compressed wire" — never
+densified).  Sliced/indexed sampling, feature-axis ('model') sharding,
+and NormalEquations need dense row layouts and raise clear errors.
 """
 
 from __future__ import annotations

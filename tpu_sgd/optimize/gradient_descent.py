@@ -101,6 +101,47 @@ def _make_mask(cfg: SGDConfig, key, i, n_local, valid, axis_name):
     return valid
 
 
+def _make_local_sums(gradient, cfg, key, axis_name, model_axis_name):
+    """THE per-iteration LOCAL ``(grad_sum, loss_sum, count)`` recipe —
+    sampling (bernoulli / indexed / sliced) + the fused batch sums,
+    pre-psum.  One definition shared by :func:`make_step` (dense
+    all-reduce) and :func:`make_compressed_step` (top-k + error-feedback
+    all-reduce) so the sampled sequence can never drift between the two
+    wires."""
+    indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
+    sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
+
+    def local_sums(weights, X, y, i, valid):
+        if sliced or indexed:
+            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
+            k = _sample_key(key, i, axis_name)
+        if sliced:
+            # HBM-optimal path: a contiguous row window at a random offset —
+            # one sequential DMA (zero-copy under PallasGradient) instead of
+            # a random gather.  Assumes exchangeable row order (see
+            # SGDConfig.sampling docs).
+            start = jax.random.randint(k, (), 0, max(1, X.shape[0] - m + 1))
+            return gradient.window_sums(
+                X, y, weights, start, m, valid=valid,
+                margin_axis_name=model_axis_name,
+            )
+        if indexed:
+            # TPU fast path: gather a fixed-size batch (with replacement)
+            # instead of masking the whole dataset — touches only ``frac``
+            # of HBM per iteration.
+            idx = jax.random.randint(k, (m,), 0, X.shape[0])
+            Xb, yb = X[idx], y[idx]
+            mask = None if valid is None else valid[idx]
+        else:
+            Xb, yb = X, y
+            mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
+        return gradient.batch_sums(
+            Xb, yb, weights, mask, margin_axis_name=model_axis_name
+        )
+
+    return local_sums
+
+
 def make_step(
     gradient: Gradient,
     updater: Updater,
@@ -124,38 +165,11 @@ def make_step(
     """
     cfg = config
     key = jax.random.PRNGKey(cfg.seed)
-
-    indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
-    sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
+    local_sums = _make_local_sums(gradient, cfg, key, axis_name,
+                                  model_axis_name)
 
     def step(weights, X, y, i, reg_val, valid=None):
-        if sliced or indexed:
-            m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
-            k = _sample_key(key, i, axis_name)
-        if sliced:
-            # HBM-optimal path: a contiguous row window at a random offset —
-            # one sequential DMA (zero-copy under PallasGradient) instead of
-            # a random gather.  Assumes exchangeable row order (see
-            # SGDConfig.sampling docs).
-            start = jax.random.randint(k, (), 0, max(1, X.shape[0] - m + 1))
-            g, l, c = gradient.window_sums(
-                X, y, weights, start, m, valid=valid,
-                margin_axis_name=model_axis_name,
-            )
-        elif indexed:
-            # TPU fast path: gather a fixed-size batch (with replacement)
-            # instead of masking the whole dataset — touches only ``frac``
-            # of HBM per iteration.
-            idx = jax.random.randint(k, (m,), 0, X.shape[0])
-            Xb, yb = X[idx], y[idx]
-            mask = None if valid is None else valid[idx]
-        else:
-            Xb, yb = X, y
-            mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
-        if not sliced:
-            g, l, c = gradient.batch_sums(
-                Xb, yb, weights, mask, margin_axis_name=model_axis_name
-            )
+        g, l, c = local_sums(weights, X, y, i, valid)
         if axis_name is not None:
             g, l, c = jax.lax.psum((g, l, c), axis_name)
         has_batch = c > 0
@@ -439,6 +453,161 @@ def make_resident_window_superstep(
     return superstep
 
 
+def make_compressed_step(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    axis_name: Optional[str] = None,
+):
+    """One SGD iteration over the COMPRESSED gradient wire: top-k +
+    error feedback (``wire_compress="topk:<frac>"``; README "Compressed
+    wire", SparCML arXiv:1802.08021).
+
+    ``step(weights, ef, X, y, i, reg_val, valid) -> (new_w, new_ef,
+    loss_i, new_reg_val, count)``.  Sampling and the local batch sums
+    are EXACTLY :func:`make_step`'s (one shared ``_make_local_sums``);
+    what changes is the combine: each shard folds its normalized
+    gradient contribution into a persistent per-shard error-feedback
+    accumulator, extracts the top-k ``(values, indices)`` segment with
+    ``jax.lax.top_k`` (``k`` is STATIC — shape-stable inside the traced
+    program; the host-numpy-top-k rule is for HOST wires), and only
+    those segments cross the link (``lax.all_gather`` of ``2·k``
+    entries per shard instead of a dense ``(d,)`` psum) before a
+    scatter-add rebuilds the applied update on every shard.  The
+    dropped mass stays in ``ef`` and ships on later iterations — the
+    EF-SGD update rule, convergent at matched final loss where plain
+    top-k is not.
+
+    ``ef`` is OPTIMIZER STATE (ADVICE.md "Error feedback is optimizer
+    state, not a transport detail"): the caller carries it across
+    iterations (the superstep scan carries it in
+    :func:`make_compressed_superstep`), checkpoints it
+    (``CheckpointManager.save(extras={"ef": ...})``), and restores it
+    on resume — a compressed run resumed mid-stream is bitwise equal
+    to its uninterrupted twin only if the accumulator travels too.
+    Loss and count still combine densely (two scalars); an empty
+    sampled batch leaves weights AND accumulator untouched (the
+    reference's skip-the-update rule — extracted mass must not vanish
+    on a skipped step).  Single-device (``axis_name=None``) the same
+    rule applies without the gather: the update is the top-k of the
+    accumulated gradient — the sparsified-update twin used for
+    matched-loss A/B runs.
+    """
+    from tpu_sgd.io.sparse_wire import topk_nnz
+
+    cfg = config
+    key = jax.random.PRNGKey(cfg.seed)
+    frac = float(topk_frac)
+    local_sums = _make_local_sums(gradient, cfg, key, axis_name, None)
+
+    def step(weights, ef, X, y, i, reg_val, valid=None):
+        g, l, c = local_sums(weights, X, y, i, valid)
+        if axis_name is not None:
+            l, c = jax.lax.psum((l, c), axis_name)
+        has_batch = c > 0
+        safe_c = jnp.maximum(c, 1.0)
+        loss_i = l / safe_c + reg_val
+        dim = g.shape[-1]
+        k = topk_nnz(dim, frac)  # static at trace time: one program
+        acc = ef + (g / safe_c).astype(ef.dtype)
+        _, idx = jax.lax.top_k(jnp.abs(acc), k)
+        vals = jnp.take(acc, idx)
+        new_ef = acc.at[idx].set(0.0)
+        if axis_name is not None:
+            # the compressed all-reduce: (values, indices) segments ride
+            # the link, each shard scatter-adds every shard's segment
+            vals_all = jax.lax.all_gather(vals, axis_name)
+            idx_all = jax.lax.all_gather(idx, axis_name)
+            ghat = jnp.zeros((dim,), acc.dtype).at[
+                idx_all.reshape(-1)].add(vals_all.reshape(-1))
+        else:
+            ghat = jnp.zeros((dim,), acc.dtype).at[idx].add(vals)
+        new_w, new_reg = updater.compute(
+            weights, ghat.astype(weights.dtype), cfg.step_size, i,
+            cfg.reg_param
+        )
+        # empty sampled batch: skip the update AND keep the accumulator
+        # (the extracted mass must not vanish on a skipped step)
+        new_w = jnp.where(has_batch, new_w, weights)
+        new_reg = jnp.where(has_batch, new_reg, reg_val)
+        new_ef = jnp.where(has_batch, new_ef, ef)
+        return new_w, new_ef, loss_i, new_reg, c
+
+    return step
+
+
+def make_compressed_superstep(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    axis_name: Optional[str] = None,
+):
+    """:func:`make_superstep` over the compressed wire: the
+    error-feedback accumulator rides the scan CARRY (state, like the
+    weights) and the per-step post-update accumulators ride the ys as a
+    seventh leaf — checkpoints taken mid-superstep need iteration-exact
+    EF state just as they need iteration-exact weights.
+
+    ``superstep(weights, ef, reg_val, i0, Xs, ys, valids) ->
+    (carry_weights, carry_ef, ys_out)`` with ``ys_out = (*pack_step_ys,
+    efs)``.  Same one-program / tail-padding contract as
+    :func:`make_superstep` (a padded no-op step passes ``ef`` through
+    unchanged)."""
+    step = make_compressed_step(gradient, updater, config, topk_frac,
+                                axis_name)
+
+    def superstep(weights, ef, reg_val, i0, Xs, ys, valids):
+        idx = i0 + jnp.arange(Xs.shape[0], dtype=jnp.int32)
+
+        def body(carry, xs):
+            w, e, rv = carry
+            i, Xb, yb, vb = xs
+            new_w, new_e, loss_i, new_rv, c = step(w, e, Xb, yb, i, rv,
+                                                   vb)
+            return (new_w, new_e, new_rv), pack_step_ys(
+                w, new_w, loss_i, new_rv, c) + (new_e,)
+
+        (w, e, _), out = jax.lax.scan(body, (weights, ef, reg_val),
+                                      (idx, Xs, ys, valids))
+        return w, e, out
+
+    return superstep
+
+
+def make_compressed_shared_superstep(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    k: int,
+    axis_name: Optional[str] = None,
+):
+    """The shared-batch variant of :func:`make_compressed_superstep`
+    (one transferred ``(X, y)``, K fused compressed steps; same
+    overshoot-truncation contract as
+    :func:`make_shared_batch_superstep`)."""
+    step = make_compressed_step(gradient, updater, config, topk_frac,
+                                axis_name)
+    K = int(k)
+
+    def superstep(weights, ef, reg_val, i0, X, y, valid=None):
+        idx = i0 + jnp.arange(K, dtype=jnp.int32)
+
+        def body(carry, i):
+            w, e, rv = carry
+            new_w, new_e, loss_i, new_rv, c = step(w, e, X, y, i, rv,
+                                                   valid)
+            return (new_w, new_e, new_rv), pack_step_ys(
+                w, new_w, loss_i, new_rv, c) + (new_e,)
+
+        (w, e, _), out = jax.lax.scan(body, (weights, ef, reg_val), idx)
+        return w, e, out
+
+    return superstep
+
+
 def _replay_fused_steps(
     ys_host, i0, steps, losses, reg_val, cfg, *,
     listener=None, wall_dt=0.0, check_numerics=False,
@@ -561,6 +730,12 @@ class GradientDescent(Optimizer):
         self.ingest_wire_dtype = None
         self.ingest_prefetch_depth = 2
         self.ingest_pipeline = True
+        #: compressed gradient/update wire (tpu_sgd/io/sparse_wire;
+        #: README "Compressed wire"): "topk:<frac>" ships top-k
+        #: (values, indices) segments with error-feedback state on the
+        #: update-shaped wires; None = dense wire.  The planner may
+        #: choose it (plan.choose_wire_compress); user wins
+        self.ingest_wire_compress = None
         #: reliability knobs (tpu_sgd/reliability): a RetryPolicy for
         #: transient host-feed faults (set_ingest_options(retry=...))
         #: and the cooperative preemption probe (set_stop_signal — the
@@ -764,7 +939,7 @@ class GradientDescent(Optimizer):
         return self
 
     def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
-                           pipeline=None, retry=None):
+                           pipeline=None, retry=None, wire_compress=None):
         """Tuning knobs for the host→device ingest pipeline
         (``tpu_sgd/io``; README "Ingestion pipeline") — they apply to
         every streaming schedule: ``set_host_streaming``,
@@ -791,12 +966,25 @@ class GradientDescent(Optimizer):
         not change WHAT is sampled (the sample is deterministic in
         ``(seed, i)``), so a healed run stays bitwise identical.  For
         whole-run crash-resume and preemption safety wrap the run in a
-        ``tpu_sgd.reliability.TrainingSupervisor``."""
+        ``tpu_sgd.reliability.TrainingSupervisor``.
+
+        ``wire_compress="topk:<frac>"`` (README "Compressed wire"): the
+        COMPRESSED gradient/update wire — top-k ``(values, indices)``
+        segments with error-feedback accumulation on the wires that
+        move update-shaped data: the per-step gradient all-reduce of
+        the ``set_host_streaming`` feed (meshed: segments replace the
+        dense psum; single-device: the same EF top-k update rule, the
+        matched-loss A/B twin) and the per-shard totals merge of the
+        streamed statistics builds.  The EF accumulator is optimizer
+        state — checkpointed and scan-carried, see ADVICE.md "Error
+        feedback is optimizer state, not a transport detail".  Pass
+        ``False`` to clear a previously set spec."""
         from tpu_sgd.plan import apply_user_ingest_options
 
         apply_user_ingest_options(self, wire_dtype=wire_dtype,
                                   prefetch_depth=prefetch_depth,
-                                  pipeline=pipeline, retry=retry)
+                                  pipeline=pipeline, retry=retry,
+                                  wire_compress=wire_compress)
         return self
 
     def set_superstep(self, k: int):
@@ -1009,10 +1197,59 @@ class GradientDescent(Optimizer):
             # training, SURVEY.md §2 #10): same fused step, gather/segment
             # lowering.  Everything that needs a dense row layout raises.
             if self.host_streaming:
-                raise NotImplementedError(
-                    "host streaming needs dense rows; BCOO features are "
-                    "~1000x smaller and stay device-resident instead"
+                # host-streamed SPARSE feed (optimize/streamed_sparse.py;
+                # README "Compressed wire"): the dataset stays host-
+                # resident as CSR entry arrays and each sampled batch
+                # ships as fixed-nse BCOO components — never densified
+                # anywhere on the path
+                from tpu_sgd.optimize.streamed_sparse import (
+                    optimize_host_streamed_sparse,
                 )
+
+                if self.mesh is not None:
+                    raise NotImplementedError(
+                        "host-streamed sparse training is single-device "
+                        "(shard the resident BCOO path with set_mesh "
+                        "instead)"
+                    )
+                if self.resident_cadence >= 2:
+                    import warnings
+
+                    warnings.warn(
+                        "set_residency applies to the dense "
+                        "device-resident-data feeds; the host-streamed "
+                        "sparse driver runs per-superstep dispatch",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                if self.ingest_wire_dtype is not None:
+                    import warnings
+
+                    warnings.warn(
+                        "wire_dtype applies to dense row chunks; the "
+                        "sparse feed ships BCOO components at the data "
+                        "dtype (its compression is the sparsity itself)",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                w0 = _coerce_w0(self.gradient, initial_weights,
+                                X.shape[1])
+                w, hist = optimize_host_streamed_sparse(
+                    self.gradient, self.updater, self.config, X,
+                    np.asarray(y), w0,
+                    listener=self.listener,
+                    checkpoint_manager=self.checkpoint_manager,
+                    checkpoint_every=self.checkpoint_every,
+                    prefetch_depth=(self.ingest_prefetch_depth
+                                    if self.ingest_pipeline else 0),
+                    retry_policy=self.ingest_retry_policy,
+                    stop_signal=self._stop_signal,
+                    superstep_k=self.superstep,
+                    wire_compress=(self.ingest_wire_compress
+                                   if self.ingest_pipeline else None),
+                )
+                self._loss_history = hist
+                if self.check_numerics:
+                    _raise_if_nonfinite(hist)
+                return w, hist
             if self.mesh is not None and self._mesh_kind() == "dp_mp":
                 raise NotImplementedError(
                     "feature-axis ('model') sharding needs dense column "
@@ -1086,6 +1323,8 @@ class GradientDescent(Optimizer):
                 stop_signal=self._stop_signal,
                 superstep_k=self.superstep,
                 resident_cadence=self.resident_cadence,
+                wire_compress=(self.ingest_wire_compress
+                               if self.ingest_pipeline else None),
             )
             self._loss_history = hist
             if self.check_numerics:

@@ -347,6 +347,10 @@ class LBFGS(Optimizer):
         self.ingest_prefetch_depth = 2
         self.ingest_pipeline = True
         self.ingest_retry_policy = None
+        #: compressed update wire (tpu_sgd/io/sparse_wire): the meshed
+        #: streamed totals MERGE ships top-k + error-feedback segments
+        #: with one dense residual flush (README "Compressed wire")
+        self.ingest_wire_compress = None
         #: gram-knob fields the USER set (planner preserves these; see
         #: GradientDescent._user_gram_opts)
         self._user_gram_opts = frozenset()
@@ -479,7 +483,7 @@ class LBFGS(Optimizer):
         return self
 
     def set_ingest_options(self, wire_dtype=None, prefetch_depth=None,
-                           pipeline=None, retry=None):
+                           pipeline=None, retry=None, wire_compress=None):
         """Host→device ingest-pipeline knobs for the streamed builds
         (``tpu_sgd/io``; README "Ingestion pipeline"): opt-in bf16 wire
         (half the bytes per chunk, f32+ accumulation unchanged),
@@ -487,12 +491,16 @@ class LBFGS(Optimizer):
         master switch — same contract as
         ``GradientDescent.set_ingest_options``, including the ``retry``
         reliability knob (a ``tpu_sgd.reliability.RetryPolicy``; heals
-        transient host-feed faults on the host-streamed schedules)."""
+        transient host-feed faults on the host-streamed schedules).
+        ``wire_compress="topk:<frac>"`` compresses the MESHED streamed
+        totals merge — per-shard top-k + error-feedback segments with
+        one dense residual flush (README "Compressed wire")."""
         from tpu_sgd.plan import apply_user_ingest_options
 
         apply_user_ingest_options(self, wire_dtype=wire_dtype,
                                   prefetch_depth=prefetch_depth,
-                                  pipeline=pipeline, retry=retry)
+                                  pipeline=pipeline, retry=retry,
+                                  wire_compress=wire_compress)
         return self
 
     def set_streamed_stats(self, flag: bool = True, block_rows: int = None):
@@ -622,7 +630,7 @@ class LBFGS(Optimizer):
             )
         entry = self._streamed_gram_entry
         ingest = (self.ingest_wire_dtype, self.ingest_prefetch_depth,
-                  self.ingest_pipeline)
+                  self.ingest_pipeline, self.ingest_wire_compress)
         opts = (self.gram_block_rows, self.gram_batch_rows, self.mesh,
                 ingest)
         if (entry is not None and entry[0] is X and entry[1] is y
@@ -646,6 +654,8 @@ class LBFGS(Optimizer):
                 wire_dtype=self.ingest_wire_dtype,
                 prefetch_depth=self.ingest_prefetch_depth,
                 pipeline=self.ingest_pipeline,
+                wire_compress=(self.ingest_wire_compress
+                               if self.ingest_pipeline else None),
             )
             g = GramLeastSquaresGradient(data)
         else:

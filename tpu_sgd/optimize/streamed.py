@@ -100,6 +100,7 @@ def optimize_host_streamed(
     stop_signal=None,
     superstep_k: int = 1,
     resident_cadence: int = 0,
+    wire_compress=None,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the dataset resident on the HOST.
 
@@ -177,12 +178,28 @@ def optimize_host_streamed(
     device for the full-batch and fully-resident-slab feeds (README
     "Device-resident training"); host-sampled feeds keep the superstep
     driver (warned — the host hop is the data feed).
+
+    Compressed gradient wire (``wire_compress="topk:<frac>"``; README
+    "Compressed wire"): the per-step gradient combine ships top-k
+    ``(values, indices)`` segments with per-shard error-feedback state
+    instead of a dense all-reduce (``make_compressed_step``).  The EF
+    accumulator is optimizer state: it rides the superstep scan carry,
+    is checkpointed (``extras={"ef": ...}``) at every save — cadence,
+    convergence, and preemption — and restores on resume, so an
+    interrupted+resumed compressed run is bitwise equal to its
+    uninterrupted twin.  Composes with ``superstep_k``; partial
+    residency and the whole-run resident driver fall back to the dense
+    wire / superstep driver with a warning (the resident ring does not
+    yet carry EF state).
     """
     import time as _time
 
-    from tpu_sgd.io import Prefetcher, resolve_wire_dtype, wire_cast
+    from tpu_sgd.io import (Prefetcher, parse_wire_compress,
+                            resolve_wire_dtype, wire_cast)
+    from tpu_sgd.obs.counters import record_wire
     from tpu_sgd.obs.spans import span
-    from tpu_sgd.optimize.gradient_descent import make_step, step_norms
+    from tpu_sgd.optimize.gradient_descent import (make_compressed_step,
+                                                   make_step, step_norms)
     from tpu_sgd.reliability.failpoints import failpoint
     from tpu_sgd.utils.events import IterationEvent, RunEvent
 
@@ -194,6 +211,17 @@ def optimize_host_streamed(
     if n == 0:
         return w, np.zeros((0,), np.float32)
     wd = resolve_wire_dtype(wire_dtype, X.dtype)
+    comp_frac = parse_wire_compress(wire_compress)
+    if comp_frac is not None and resident_rows:
+        import warnings
+
+        warnings.warn(
+            "wire_compress does not compose with partial residency "
+            "(the resident-window step has no EF carry); running the "
+            "dense gradient wire",
+            RuntimeWarning, stacklevel=3,
+        )
+        comp_frac = None
 
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
@@ -246,27 +274,56 @@ def optimize_host_streamed(
             RuntimeWarning, stacklevel=3,
         )
         C = 0
+    if C >= 2 and comp_frac is not None:
+        import warnings
+
+        # DEVIATION, recorded loudly: the resident while-loop's ring
+        # carries (w, loss, reg, count, norms) but not yet the EF
+        # accumulator, and a cadence checkpoint without iteration-exact
+        # EF state would break the bitwise-resume contract — so the
+        # compressed wire runs the fused superstep driver (same compiled
+        # scan body, one dispatch per superstep instead of per run)
+        warnings.warn(
+            "wire_compress composes with the fused superstep driver; "
+            "the whole-run resident loop does not yet carry EF state "
+            "in its ring — running the superstep driver",
+            RuntimeWarning, stacklevel=3,
+        )
+        C = 0
     if mesh is None:
         if device is None:
             device = jax.devices()[0]
         w_sharding = device
         base_step = make_step(gradient, updater, step_cfg)
-        step = jax.jit(base_step)
+        if comp_frac is not None:
+            step = jax.jit(make_compressed_step(
+                gradient, updater, step_cfg, comp_frac))
+        else:
+            step = jax.jit(base_step)
         row_sharding = mask_sharding = device
         super_row_sharding = super_mask_sharding = device
+        ef_sharding = device
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from tpu_sgd.parallel.data_parallel import dp_step_fn
+        from tpu_sgd.parallel.data_parallel import (dp_compressed_step_fn,
+                                                    dp_step_fn)
         from tpu_sgd.parallel.mesh import DATA_AXIS, superchunk_specs
 
-        step = dp_step_fn(gradient, updater, step_cfg, mesh, with_valid=True)
+        if comp_frac is not None:
+            step = dp_compressed_step_fn(
+                gradient, updater, step_cfg, comp_frac, mesh,
+                with_valid=True)
+        else:
+            step = dp_step_fn(gradient, updater, step_cfg, mesh,
+                              with_valid=True)
         w_sharding = NamedSharding(mesh, P())
         row_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
         mask_sharding = NamedSharding(mesh, P(DATA_AXIS))
         spec_xs, spec_ys, _ = superchunk_specs()
         super_row_sharding = NamedSharding(mesh, spec_xs)
         super_mask_sharding = NamedSharding(mesh, spec_ys)
+        ef_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
     w = jax.device_put(w, w_sharding)
 
     _, reg_val = updater.compute(
@@ -341,11 +398,17 @@ def optimize_host_streamed(
     # doubles the host feed cost the overlap exists to hide)
     _full_batch = [None]
 
+    _wire_fmt = "bf16" if wd is not None else "dense-f32"
+
     def _put_batch(Xb, yb, valid):
         """The host→device hop of one assembled batch — THE transfer
         fault-injection site (``io.device_put``); retries, when
         configured, wrap the whole sample via the prefetcher."""
         failpoint("io.device_put")
+        record_wire(
+            _wire_fmt,
+            logical_nbytes=int(Xb.size * 4 + yb.nbytes + valid.nbytes),
+            physical_nbytes=int(Xb.nbytes + yb.nbytes + valid.nbytes))
         return ("batch", (
             jax.device_put(Xb, row_sharding),
             jax.device_put(yb, mask_sharding),
@@ -436,6 +499,10 @@ def optimize_host_streamed(
         ``superchunk_specs`` (row axis sharded on a mesh, step axis
         replicated)."""
         failpoint("io.device_put")
+        record_wire(
+            _wire_fmt,
+            logical_nbytes=int(Xs.size * 4 + Ys.nbytes + Vs.nbytes),
+            physical_nbytes=int(Xs.nbytes + Ys.nbytes + Vs.nbytes))
         return (jax.device_put(Xs, super_row_sharding),
                 jax.device_put(Ys, super_mask_sharding),
                 jax.device_put(Vs, super_mask_sharding))
@@ -502,6 +569,7 @@ def optimize_host_streamed(
     losses = []
     start_iter = 1
     config_key = repr((type(gradient).__name__, type(updater).__name__, cfg))
+    ef_resume = None
     if checkpoint_manager is not None:
         state = checkpoint_manager.restore()
         if state is not None:
@@ -518,6 +586,31 @@ def optimize_host_streamed(
             reg_val = state["reg_val"]
             losses = list(np.asarray(state["loss_history"], np.float32))
             start_iter = state["iteration"] + 1
+            ef_resume = state.get("extras", {}).get("ef")
+    ef = None
+    if comp_frac is not None:
+        # error feedback is OPTIMIZER STATE (ADVICE.md): a fresh run
+        # starts the accumulator at zero; a resumed compressed run MUST
+        # restore the checkpointed accumulator or it stops being
+        # bitwise vs its uninterrupted twin
+        dim = int(w.shape[-1])
+        if mesh is None:
+            ef0 = np.zeros((dim,), np.float32)
+        else:
+            ef0 = np.zeros((mesh.shape[DATA_AXIS], dim), np.float32)
+        if ef_resume is not None:
+            ef0 = np.asarray(ef_resume, np.float32).reshape(ef0.shape)
+        elif start_iter > 1:
+            import warnings
+
+            warnings.warn(
+                "resuming a compressed run from a checkpoint without EF "
+                "state (written by an uncompressed run?); the "
+                "accumulator restarts at zero — the trajectory will not "
+                "be bitwise vs an uninterrupted compressed run",
+                RuntimeWarning, stacklevel=3,
+            )
+        ef = jax.device_put(jnp.asarray(ef0), ef_sharding)
     t_run = _time.perf_counter()
     converged = False
     if K > 1:
@@ -543,9 +636,21 @@ def optimize_host_streamed(
         shared_full_batch = frac >= 1.0
         window_resident = bool(R) and not shared_full_batch
 
+        # iteration-exact EF for mid-superstep checkpoint saves: the
+        # replay's save_cb fires at iteration ii inside the CURRENT
+        # superstep, whose per-step post-update accumulators sit in the
+        # ys' seventh leaf (installed here before each replay)
+        _ef_window = {"efs": None, "i0": start_iter}
+
         def _save(ii, w_np, rv):
+            extras = None
+            if comp_frac is not None:
+                efs = _ef_window["efs"]
+                extras = {"ef": (efs[ii - _ef_window["i0"]]
+                                 if efs is not None else np.asarray(ef))}
             checkpoint_manager.save(ii, np.asarray(w_np), rv,
-                                    np.asarray(losses), config_key)
+                                    np.asarray(losses), config_key,
+                                    extras=extras)
 
         def _full_batch_transfer():
             # THE one-time full-batch device_put, inside the ingest
@@ -645,13 +750,23 @@ def optimize_host_streamed(
 
         if mesh is not None:
             from tpu_sgd.parallel.data_parallel import (
+                dp_compressed_shared_superstep_fn,
+                dp_compressed_superstep_fn,
                 dp_shared_superstep_fn,
                 dp_superstep_fn,
             )
 
             if shared_full_batch:
-                fused = dp_shared_superstep_fn(
-                    gradient, updater, step_cfg, K, mesh, True)
+                if comp_frac is not None:
+                    fused = dp_compressed_shared_superstep_fn(
+                        gradient, updater, step_cfg, comp_frac, K,
+                        mesh, True)
+                else:
+                    fused = dp_shared_superstep_fn(
+                        gradient, updater, step_cfg, K, mesh, True)
+            elif comp_frac is not None:
+                fused = dp_compressed_superstep_fn(
+                    gradient, updater, step_cfg, comp_frac, mesh)
             else:
                 fused = dp_superstep_fn(gradient, updater, step_cfg,
                                         mesh)
@@ -659,11 +774,26 @@ def optimize_host_streamed(
             # the full-batch "sample" is identical every iteration:
             # transfer it ONCE and let the scan reuse it — zero
             # per-iteration AND zero per-superstep transfer
-            fused = jax.jit(make_shared_batch_superstep(
-                gradient, updater, step_cfg, K))
+            if comp_frac is not None:
+                from tpu_sgd.optimize.gradient_descent import (
+                    make_compressed_shared_superstep,
+                )
+
+                fused = jax.jit(make_compressed_shared_superstep(
+                    gradient, updater, step_cfg, comp_frac, K))
+            else:
+                fused = jax.jit(make_shared_batch_superstep(
+                    gradient, updater, step_cfg, K))
         elif window_resident:
             fused = jax.jit(make_resident_window_superstep(
                 gradient, updater, step_cfg, m_fixed))
+        elif comp_frac is not None:
+            from tpu_sgd.optimize.gradient_descent import (
+                make_compressed_superstep,
+            )
+
+            fused = jax.jit(make_compressed_superstep(
+                gradient, updater, step_cfg, comp_frac))
         else:
             fused = jax.jit(make_superstep(gradient, updater, step_cfg))
 
@@ -695,9 +825,14 @@ def optimize_host_streamed(
                 # pin in tests/test_obs.py)
                 with span("train.superstep", i0=i0, steps=steps):
                     if shared_full_batch:
-                        w_dev, ys = fused(
-                            w, jnp.asarray(reg_val, jnp.float32),
-                            jnp.asarray(i0, jnp.int32), Xd, yd, vd)
+                        if comp_frac is not None:
+                            w_dev, ef, ys = fused(
+                                w, ef, jnp.asarray(reg_val, jnp.float32),
+                                jnp.asarray(i0, jnp.int32), Xd, yd, vd)
+                        else:
+                            w_dev, ys = fused(
+                                w, jnp.asarray(reg_val, jnp.float32),
+                                jnp.asarray(i0, jnp.int32), Xd, yd, vd)
                     elif window_resident:
                         w_dev, ys = fused(
                             w, jnp.asarray(reg_val, jnp.float32),
@@ -707,13 +842,24 @@ def optimize_host_streamed(
                             nxt = next(prefetch)
                     else:
                         Xs, Ys, Vs = nxt
-                        w_dev, ys = fused(
-                            w, jnp.asarray(reg_val, jnp.float32),
-                            jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
+                        if comp_frac is not None:
+                            w_dev, ef, ys = fused(
+                                w, ef, jnp.asarray(reg_val, jnp.float32),
+                                jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
+                        else:
+                            w_dev, ys = fused(
+                                w, jnp.asarray(reg_val, jnp.float32),
+                                jnp.asarray(i0, jnp.int32), Xs, Ys, Vs)
                         if i0 + K <= cfg.num_iterations:
                             nxt = next(prefetch)
                     ys_host = tuple(np.asarray(a) for a in ys)
                 dt = _time.perf_counter() - t0
+                efs_host = None
+                if comp_frac is not None:
+                    # seventh ys leaf = per-step post-update EF state
+                    efs_host, ys_host = ys_host[6], ys_host[:6]
+                    _ef_window["efs"] = efs_host
+                    _ef_window["i0"] = i0
                 t_last, reg_val, converged = _replay_fused_steps(
                     ys_host, i0, steps, losses, reg_val, cfg,
                     listener=listener, wall_dt=dt / steps,
@@ -741,7 +887,10 @@ def optimize_host_streamed(
                         checkpoint_manager.save(
                             # graftlint: disable=host-sync -- preemption save: fires once at the superstep boundary unwind, not per trip
                             boundary, np.asarray(w), reg_val,
-                            np.asarray(losses), config_key)
+                            np.asarray(losses), config_key,
+                            extras=(
+                                {"ef": efs_host[steps - 1]}
+                                if comp_frac is not None else None))
                     raise TrainingPreempted(boundary)
                 i0 += steps
         finally:
@@ -792,6 +941,16 @@ def optimize_host_streamed(
                         jnp.asarray(i, jnp.int32),
                         jnp.asarray(reg_val, jnp.float32),
                     )
+                elif comp_frac is not None:
+                    # compressed wire: the EF accumulator is carried
+                    # across iterations like the weights (a skipped
+                    # empty batch passes it through unchanged)
+                    Xb, yb, valid = payload
+                    new_w, ef, loss_i, new_reg, c = step(
+                        w, ef, Xb, yb, jnp.asarray(i, jnp.int32),
+                        jnp.asarray(reg_val, jnp.float32),
+                        valid,
+                    )
                 else:
                     Xb, yb, valid = payload
                     new_w, loss_i, new_reg, c = step(
@@ -840,7 +999,9 @@ def optimize_host_streamed(
                     checkpoint_manager.save(
                         # graftlint: disable=host-sync -- checkpoint save: cadence-gated (every checkpoint_every iterations), the documented host hop
                         i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key
+                        config_key,
+                        extras=({"ef": np.asarray(ef)}  # graftlint: disable=host-sync -- checkpoint save: EF state rides the same cadence-gated hop
+                                if comp_frac is not None else None)
                     )
             if (not converged and stop_signal is not None
                     and stop_signal()):
@@ -857,7 +1018,9 @@ def optimize_host_streamed(
                     checkpoint_manager.save(
                         # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
                         i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key
+                        config_key,
+                        extras=({"ef": np.asarray(ef)}  # graftlint: disable=host-sync -- preemption save: EF state rides the unwind save
+                                if comp_frac is not None else None)
                     )
                 raise TrainingPreempted(i)
             i += 1

@@ -206,6 +206,108 @@ def dp_shared_superstep_fn(
         mesh, body, in_specs, (P(), _SUPERSTEP_YS_SPECS)))
 
 
+#: per-shard error-feedback state of the compressed gradient wire: one
+#: (dim,) accumulator per shard, globally a (n_shards, dim) array
+#: sharded over 'data' — state, like the weights, but NOT replicated
+#: (each shard's accumulator holds ITS dropped mass)
+_EF_SPEC = P(DATA_AXIS, None)
+
+
+def dp_compressed_step_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    mesh: Mesh,
+    with_valid: bool,
+):
+    """Jitted shard_map'ed single step over the COMPRESSED gradient
+    wire (``make_compressed_step`` with the 'data' axis): the gradient
+    all-reduce ships top-k ``(values, indices)`` segments with per-shard
+    error-feedback state instead of a dense ``(d,)`` psum — README
+    "Compressed wire".  Signature: ``fn(w, ef, X, y, i, reg_val[,
+    valid]) -> (new_w, new_ef, loss, new_reg, count)`` where ``ef`` is
+    the ``(n_shards, dim)`` sharded accumulator."""
+    from tpu_sgd.optimize.gradient_descent import make_compressed_step
+
+    step = make_compressed_step(gradient, updater, config, topk_frac,
+                                axis_name=DATA_AXIS)
+
+    def body(w, ef, X, y, i, rv, valid=None):
+        new_w, new_ef, loss, new_rv, c = step(w, ef[0], X, y, i, rv,
+                                              valid)
+        return new_w, new_ef[None], loss, new_rv, c
+
+    in_specs = (P(), _EF_SPEC, P(DATA_AXIS, None), P(DATA_AXIS), P(),
+                P())
+    if with_valid:
+        in_specs = in_specs + (P(DATA_AXIS),)
+    return jax.jit(shard_map_fn(
+        mesh, body, in_specs, (P(), _EF_SPEC, P(), P(), P())))
+
+
+def dp_compressed_superstep_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    mesh: Mesh,
+):
+    """:func:`dp_superstep_fn` over the compressed wire: K fused
+    compressed steps per dispatch, the per-shard EF accumulator carried
+    in the scan and the per-step post-update accumulators returned as a
+    ``(K, n_shards, dim)`` ys leaf (iteration-exact EF for
+    mid-superstep checkpoints).  ``fn(w, ef, reg_val, i0, Xs, ys,
+    valids) -> (w, ef, (*step_ys, efs))``."""
+    from tpu_sgd.optimize.gradient_descent import (
+        make_compressed_superstep,
+    )
+
+    sstep = make_compressed_superstep(gradient, updater, config,
+                                      topk_frac, axis_name=DATA_AXIS)
+
+    def body(w, ef, rv, i0, Xs, ys, valids):
+        new_w, new_ef, out = sstep(w, ef[0], rv, i0, Xs, ys, valids)
+        return new_w, new_ef[None], out[:6] + (out[6][:, None, :],)
+
+    in_specs = (P(), _EF_SPEC, P(), P()) + superchunk_specs()
+    out_specs = (P(), _EF_SPEC,
+                 _SUPERSTEP_YS_SPECS + (P(None, DATA_AXIS, None),))
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
+def dp_compressed_shared_superstep_fn(
+    gradient: Gradient,
+    updater: Updater,
+    config: SGDConfig,
+    topk_frac: float,
+    k: int,
+    mesh: Mesh,
+    with_valid: bool,
+):
+    """:func:`dp_shared_superstep_fn` over the compressed wire (one
+    shared sharded batch, K fused compressed steps; same EF
+    carry-and-ys contract as :func:`dp_compressed_superstep_fn`)."""
+    from tpu_sgd.optimize.gradient_descent import (
+        make_compressed_shared_superstep,
+    )
+
+    sstep = make_compressed_shared_superstep(
+        gradient, updater, config, topk_frac, k, axis_name=DATA_AXIS)
+
+    def body(w, ef, rv, i0, X, y, valid=None):
+        new_w, new_ef, out = sstep(w, ef[0], rv, i0, X, y, valid)
+        return new_w, new_ef[None], out[:6] + (out[6][:, None, :],)
+
+    in_specs = (P(), _EF_SPEC, P(), P(), P(DATA_AXIS, None),
+                P(DATA_AXIS))
+    if with_valid:
+        in_specs = in_specs + (P(DATA_AXIS),)
+    out_specs = (P(), _EF_SPEC,
+                 _SUPERSTEP_YS_SPECS + (P(None, DATA_AXIS, None),))
+    return jax.jit(shard_map_fn(mesh, body, in_specs, out_specs))
+
+
 def dp_run_fn(
     gradient: Gradient,
     updater: Updater,

@@ -127,6 +127,20 @@ class CostModel:
     #: choose_superstep picks the smallest K with
     #: ``dispatch_overhead_s / K <= frac * per-iteration wall``
     superstep_dispatch_frac: float = 0.05
+    #: gradient all-reduce link rate for the compressed-wire decision
+    #: (choose_wire_compress).  ICI within a slice is far faster, but
+    #: the rate that matters for the wires this planner can choose to
+    #: compress is the slowest link the update crosses — DCN / host
+    #: tunnel class; like host_feed_gb_s it is environment-bound
+    allreduce_gb_s: float = 10.0
+    #: fixed per-step cost of the compress/decompress stages (host
+    #: top-k selection + segment scatter-add dispatch); compression
+    #: pays only when the predicted wire-byte saving dominates this
+    compress_overhead_s: float = 2.0e-4
+    #: top-k fraction the planner proposes when compression pays; 1%
+    #: of coordinates = ~50x fewer physical bytes (value + int32 index
+    #: per entry), the SparCML operating point
+    wire_compress_frac: float = 0.01
     #: set by :meth:`calibrate` — raw probe readings plus which probes
     #: were rejected and fell back to the persisted defaults; excluded
     #: from equality/repr (two models with the same rates ARE the same
@@ -310,6 +324,14 @@ class Plan:
     #: window holds at least 2 supersteps); 0 = the per-superstep
     #: host-dispatched driver
     residency: int = 0
+    #: compressed gradient wire for the meshed host_streamed schedule
+    #: (README "Compressed wire"): "topk:<frac>" when
+    #: choose_wire_compress says the per-step all-reduce bytes dominate
+    #: the compress cost, else None.  NOTE the compressed wire changes
+    #: the UPDATE RULE (top-k + error feedback — convergent at matched
+    #: final loss, not bitwise), so the planner proposes it only where
+    #: a real multi-shard all-reduce exists; user wire_compress wins
+    wire_compress: Optional[str] = None
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -394,6 +416,9 @@ def apply_gram_knobs(optimizer, p: "Plan") -> None:
     if ("residency" not in user
             and hasattr(optimizer, "resident_cadence")):
         optimizer.resident_cadence = int(getattr(p, "residency", 0) or 0)
+    if ("wire_compress" not in user
+            and hasattr(optimizer, "ingest_wire_compress")):
+        optimizer.ingest_wire_compress = getattr(p, "wire_compress", None)
 
 
 #: THE user-facing gram knob table: name -> (optimizer attribute,
@@ -436,7 +461,7 @@ def apply_user_gram_knobs(optimizer, **knobs) -> None:
 
 def apply_user_ingest_options(optimizer, wire_dtype=None,
                               prefetch_depth=None, pipeline=None,
-                              retry=None) -> None:
+                              retry=None, wire_compress=None) -> None:
     """Validate-all-then-apply for USER-set ingest-pipeline knobs (the
     ``set_ingest_options`` body, shared by GradientDescent and LBFGS) —
     the ingest sibling of :func:`apply_user_gram_knobs`, with the same
@@ -453,10 +478,20 @@ def apply_user_ingest_options(optimizer, wire_dtype=None,
     ``retry``: a ``tpu_sgd.reliability.RetryPolicy`` healing transient
     host-feed faults on the host-streamed SGD path (``False`` clears a
     previously set policy); retries never change the sampled sequence,
-    so results are unaffected."""
-    from tpu_sgd.io import resolve_wire_dtype
+    so results are unaffected.  ``wire_compress``: ``"topk:<frac>"``
+    engages the compressed sparse gradient wire
+    (``tpu_sgd/io/sparse_wire.py``; README "Compressed wire"),
+    validated eagerly like ``wire_dtype``; ``False`` clears it."""
+    from tpu_sgd.io import parse_wire_compress, resolve_wire_dtype
 
     provided = {}
+    if wire_compress is not None:
+        if wire_compress is False:
+            provided["wire_compress"] = ("ingest_wire_compress", None)
+        else:
+            parse_wire_compress(wire_compress)  # validate, keep spec
+            provided["wire_compress"] = ("ingest_wire_compress",
+                                         str(wire_compress))
     if retry is not None:
         if retry is False:
             provided["retry"] = ("ingest_retry_policy", None)
@@ -524,6 +559,9 @@ def reset_plan_owned_gram_knobs(optimizer) -> None:
     if ("residency" not in user
             and hasattr(optimizer, "resident_cadence")):
         optimizer.resident_cadence = 0
+    if ("wire_compress" not in user
+            and hasattr(optimizer, "ingest_wire_compress")):
+        optimizer.ingest_wire_compress = None
 
 
 def _stack_bytes(n_local: int, block_rows: int, d: int) -> float:
@@ -600,6 +638,40 @@ def choose_superstep(window_rows: int, d: int, itemsize: int,
     target = cm.superstep_dispatch_frac * max(iter_s, 1e-9)
     k_amortize = math.ceil(cm.dispatch_overhead_s / target)
     return int(max(1, min(cap, k_amortize, k_budget)))
+
+
+def choose_wire_compress(dim: int, n_devices: int,
+                         cost_model: CostModel = DEFAULT_COST_MODEL
+                         ) -> Optional[str]:
+    """Compressed-wire decision for the per-step gradient all-reduce
+    (README "Compressed wire"): compression pays ONLY when the
+    predicted wire bytes dominate the compress/decompress cost.
+
+    The per-step dense wire moves one ``(dim,)`` f32 update per shard
+    (``dim * 4`` bytes at ``allreduce_gb_s``); top-k at
+    ``wire_compress_frac`` shrinks that to ``2 * frac`` of the bytes
+    (each surviving entry carries an int32 index beside its f32 value)
+    at a fixed ``compress_overhead_s`` per step (host/device top-k
+    selection + the segment scatter-add).  Returns ``"topk:<frac>"``
+    when the byte-time saving exceeds the overhead, else None.  Two
+    structural gates: a single device has no all-reduce wire (``None``
+    — the single-device EF rule stays a user opt-in for A/B runs), and
+    the kept segment must hold at least one entry.
+
+    Deliberately conservative: the compressed wire CHANGES the update
+    rule (top-k + error feedback — matched final loss, not matched
+    trajectory), so the planner proposes it only where the cost model
+    says the wire genuinely dominates; borderline cases keep the dense
+    wire and its bitwise contracts."""
+    cm = cost_model
+    if int(n_devices) <= 1 or int(dim) < 2:
+        return None
+    frac = float(cm.wire_compress_frac)
+    dense_s = dim * 4.0 / (cm.allreduce_gb_s * 1e9)
+    saved_s = dense_s * (1.0 - 2.0 * frac)
+    if saved_s <= cm.compress_overhead_s:
+        return None
+    return f"topk:{frac:g}"
 
 
 def choose_residency(k: int, checkpoint_every: int = 10,
@@ -902,6 +974,13 @@ def plan(
                     K = K_res
                     est["superstep"] = K
             est["residency"] = Cres
+            # compressed gradient wire: only where a real multi-shard
+            # all-reduce exists and its bytes dominate the compress
+            # cost (choose_wire_compress — the compressed update rule
+            # is matched-loss, not matched-trajectory, so the proposal
+            # is loud in the reason string)
+            wc = choose_wire_compress(d, n_devices, cost_model=cm)
+            est["wire_compress"] = wc
             fused_note = (
                 f"; K={K} fused steps per dispatch amortize the "
                 f"~{cm.dispatch_overhead_s * 1e3:.1f} ms/iter host "
@@ -910,6 +989,12 @@ def plan(
                 fused_note += (
                     f"; device-resident run loop (cadence {Cres} "
                     "supersteps/host hop — one dispatch per run)")
+            if wc:
+                fused_note += (
+                    f"; compressed gradient wire ({wc}: top-k + error "
+                    "feedback — matched final loss, NOT a bitwise "
+                    "trajectory; pass wire_compress=False to keep the "
+                    "dense all-reduce)")
             chosen = Plan(
                 "host_streamed",
                 f"data ({_fmt_gb(data_bytes_local)}) exceeds HBM "
@@ -917,7 +1002,8 @@ def plan(
                 "double-buffered per-iteration batches "
                 f"(~{streamed_iter_s:.2f}s/iter at {cm.host_feed_gb_s} "
                 f"GB/s feed){fused_note}",
-                superstep=K, residency=Cres, estimates=est,
+                superstep=K, residency=Cres, wire_compress=wc,
+                estimates=est,
             )
 
     if not host_resident_ok and chosen.schedule in (

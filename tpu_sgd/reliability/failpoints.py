@@ -151,6 +151,7 @@ def inject_latency(ms: float, *, nth: int = 0, prob: float = 0.0,
 HOOK_SITES = {
     "io.prefetch.produce": "tpu_sgd/io/prefetch.py",
     "io.superstep": "tpu_sgd/io/chunking.py",
+    "io.sparse_wire": "tpu_sgd/io/sparse_wire.py",
     "io.resident_callback": "tpu_sgd/optimize/resident_driver.py",
     "io.device_put": "tpu_sgd/optimize/streamed.py",
     "optimize.streamed.step": "tpu_sgd/optimize/streamed.py",
