@@ -1,0 +1,251 @@
+"""Async replica benchmark: staleness-vs-convergence, push/pull
+counts, and wire bytes.
+
+Sweeps the bounded-staleness driver (``tpu_sgd/replica``) over
+τ ∈ {0, 1, 4, ∞} × workers ∈ {1, 2, 4} on a full-batch least-squares
+workload (full batch so the loss history IS the exact objective
+sequence and "iterations to matched loss" is well-defined), headlining
+what the 2-core harness can measure honestly (ROADMAP policy;
+BENCH_RESIDENT.json's basis note):
+
+* **iterations-to-matched-loss** — the first applied version whose
+  loss is within 1% of the same-worker-count synchronous (τ=0) final
+  loss, plus the final full-batch objective ratio (acceptance bar:
+  ≤ 1.01 for every τ>0 cell — asserted, not just recorded);
+* **push/pull counts** — accepted/rejected pushes and pulls from the
+  store snapshot: the protocol's structural cost, exact and
+  noise-free (a rejected push is a discarded gradient computation —
+  the price of the bound);
+* **staleness bound from the trace** — every accepted ``replica.push``
+  trace event's staleness, max asserted ≤ τ;
+* **wire bytes, dense vs top-k** — the per-push update wire measured
+  by the obs wire counters (logical vs physical; the top-k cell ships
+  ``~2·frac`` of the dense bytes);
+* **chaos cell** — one τ=4 × 4-worker run with a worker KILLED
+  mid-sweep (one-shot ``replica.push`` failpoint, no worker retry) and
+  rejoined: rejoin count, bound, and final objective ratio recorded
+  and asserted.
+
+End-to-end walls are SECONDARY on this harness (2 cores share one DRAM
+wall; thread-scheduling noise dominates) — each cell records its wall
+with a basis string, but counts and bytes are the transferable result.
+
+Writes ``BENCH_ASYNC.json``; env knobs: ``REPLICA_ROWS``,
+``REPLICA_DIM``, ``REPLICA_ITERS``.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "BENCH_ASYNC.json")
+
+ROWS = int(os.environ.get("REPLICA_ROWS", "2048"))
+DIM = int(os.environ.get("REPLICA_DIM", "256"))
+ITERS = int(os.environ.get("REPLICA_ITERS", "240"))
+REG = 0.01
+TAUS = (0, 1, 4, None)
+WORKERS = (1, 2, 4)
+TOPK_FRAC = 0.05
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(ROWS, DIM)).astype(np.float32)
+    w_true = rng.normal(size=DIM).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=ROWS)).astype(np.float32)
+    return X, y, np.zeros(DIM, np.float32)
+
+
+def _objective(X, y, w):
+    r = X @ np.asarray(w) - y
+    return float(0.5 * np.mean(r * r)
+                 + 0.5 * REG * np.sum(np.asarray(w) ** 2))
+
+
+def _driver(tau, workers, wire=None):
+    from tpu_sgd.ops.gradients import LeastSquaresGradient
+    from tpu_sgd.ops.updaters import SquaredL2Updater
+    from tpu_sgd.replica import ReplicaDriver
+
+    drv = (ReplicaDriver(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(0.1).set_num_iterations(ITERS)
+           .set_mini_batch_fraction(1.0).set_convergence_tol(0.0)
+           .set_reg_param(REG).set_seed(7)
+           .set_workers(workers).set_staleness(tau))
+    if wire is not None:
+        drv.set_wire_compress(wire)
+    return drv
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, payload):
+        self.records.append((kind, dict(payload)))
+
+
+def _run_cell(X, y, w0, tau, workers, wire=None, faults=None,
+              rejoin_seed=None):
+    """One sweep cell under trace + wire counters; returns the record
+    plus the raw counter snapshot."""
+    from tpu_sgd.obs import counters as obs_counters
+    from tpu_sgd.obs import spans
+    from tpu_sgd.reliability import failpoints as fp
+    from tpu_sgd.reliability.retry import RetryPolicy
+
+    drv = _driver(tau, workers, wire)
+    if rejoin_seed is not None:
+        drv.set_rejoin(RetryPolicy(max_attempts=5, base_backoff_s=0.005,
+                                   seed=rejoin_seed))
+    sink = _ListSink()
+    spans.enable_tracing(sink)
+    obs_counters.enable()
+    obs_counters.reset()  # per-cell counts: the registry is process-wide
+    try:
+        t0 = time.perf_counter()
+        if faults:
+            with fp.inject_faults(faults):
+                w, h = drv.optimize_with_history((X, y), w0)
+        else:
+            w, h = drv.optimize_with_history((X, y), w0)
+        wall = time.perf_counter() - t0
+        counts = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+        spans.disable_tracing()
+    pushes = [p for k, p in sink.records
+              if k == "trace_event" and p["name"] == "replica.push"]
+    accepted = [p["staleness"] for p in pushes if p["accepted"]]
+    snap = drv.last_store_snapshot
+    tau_bound = float("inf") if tau is None else tau
+    worst = max(accepted) if accepted else 0
+    assert worst <= tau_bound, (
+        f"trace bound violated: tau={tau} worst={worst}")
+    rec = {
+        "tau": ("inf" if tau is None else tau),
+        "workers": workers,
+        "final_objective": _objective(X, y, w),
+        "pulls": snap["pulls"],
+        "pushes_accepted": snap["pushes_accepted"],
+        "pushes_rejected": snap["pushes_rejected"],
+        "max_accepted_staleness_trace": worst,
+        "wall_s": round(wall, 3),
+        "wall_basis": ("end-to-end wall on the shared 2-core harness; "
+                       "thread-scheduling noise dominates — counts and "
+                       "bytes are the headline"),
+    }
+    return rec, np.asarray(h), w, counts, drv
+
+
+def main() -> int:
+    from tpu_sgd.obs.counters import wire_ratios
+
+    X, y, w0 = _data()
+    report = {
+        "config": {"rows": ROWS, "dim": DIM, "iters": ITERS,
+                   "reg": REG, "feed": "full-batch per shard",
+                   "plugins": "LeastSquaresGradient + SquaredL2Updater",
+                   "topk_frac": TOPK_FRAC},
+        "policy": ("2-core harness: iterations-to-matched-loss, "
+                   "push/pull counts, and wire bytes headline; "
+                   "end-to-end walls secondary with basis strings "
+                   "(ROADMAP.md harness note)"),
+        "sweep": [],
+    }
+
+    # -- τ × workers sweep --------------------------------------------------
+    sync_final = {}
+    sync_hist_final = {}
+    for workers in WORKERS:
+        for tau in TAUS:
+            rec, h, w, _, _ = _run_cell(X, y, w0, tau, workers)
+            if tau == 0:
+                sync_final[workers] = rec["final_objective"]
+                sync_hist_final[workers] = float(h[-1])
+                rec["role"] = "sync reference for this worker count"
+            rec["objective_ratio_vs_sync"] = (
+                rec["final_objective"] / sync_final[workers])
+            # iterations to the first recorded loss within 1% of the
+            # sync run's FINAL loss (full-batch feed: the history is
+            # the exact objective sequence, so this is well-defined)
+            match = np.nonzero(
+                h <= sync_hist_final[workers] * 1.01)[0]
+            rec["iterations_to_matched_loss"] = (
+                int(match[0]) + 1 if len(match) else None)
+            if tau != 0:
+                assert rec["objective_ratio_vs_sync"] <= 1.01, rec
+            report["sweep"].append(rec)
+            print(f"tau={rec['tau']} W={workers}: "
+                  f"obj_ratio={rec['objective_ratio_vs_sync']:.4f} "
+                  f"match@{rec['iterations_to_matched_loss']} "
+                  f"acc={rec['pushes_accepted']} "
+                  f"rej={rec['pushes_rejected']} "
+                  f"stale_max={rec['max_accepted_staleness_trace']}")
+
+    # -- wire bytes: dense vs top-k ----------------------------------------
+    wire = {}
+    for label, spec in (("dense", None), (f"topk:{TOPK_FRAC}",
+                                          f"topk:{TOPK_FRAC}")):
+        rec, _, w, counts, _ = _run_cell(X, y, w0, 1, 4, wire=spec)
+        ratios = wire_ratios(counts)
+        wire[label] = {
+            "final_objective": rec["final_objective"],
+            "push_wire": {k: v for k, v in ratios.items()
+                          if k.startswith("replica.wire.")},
+        }
+    dense_push = wire["dense"]["push_wire"]["replica.wire.dense-f32"]
+    topk_push = wire[f"topk:{TOPK_FRAC}"]["push_wire"][
+        "replica.wire.topk"]
+    wire["push_bytes_ratio_dense_vs_topk"] = round(
+        dense_push["physical_bytes"] / topk_push["physical_bytes"], 2)
+    wire["basis"] = ("physical bytes of the push wire only (the pull "
+                     "wire is identical dense weights in both cells); "
+                     "top-k ships ~2*frac of the dense update bytes — "
+                     "each surviving entry carries an int32 index "
+                     "beside its f32 value")
+    report["wire"] = wire
+    print(f"push wire dense/topk bytes ratio: "
+          f"{wire['push_bytes_ratio_dense_vs_topk']}x")
+
+    # -- chaos cell: kill + rejoin mid-sweep --------------------------------
+    from tpu_sgd.reliability import failpoints as fp
+
+    # one-shot kill aimed mid-sweep: pushes ~= applied versions at
+    # τ>=1, so hit ITERS/2 lands in the middle
+    rec, h, w, _, drv = _run_cell(
+        X, y, w0, 4, 4,
+        faults={"replica.push": fp.fail_nth(ITERS // 2)},
+        rejoin_seed=11)
+    members = drv.last_membership_snapshot
+    rejoins = sum(max(0, m["joins"] - 1) for m in members.values())
+    assert rejoins >= 1, f"chaos cell never rejoined: {members}"
+    assert rec["final_objective"] <= sync_final[4] * 1.01, rec
+    rec["rejoins"] = rejoins
+    rec["objective_ratio_vs_sync"] = (rec["final_objective"]
+                                      / sync_final[4])
+    report["chaos"] = rec
+    print(f"chaos kill/rejoin: rejoins={rejoins} "
+          f"ratio={rec['objective_ratio_vs_sync']:.4f} "
+          f"stale_max={rec['max_accepted_staleness_trace']}")
+
+    with open(OUT, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,8 @@ DEFAULT_SLOS = {"slos": [
      "span": "serve.batch", "max": 30.0},
     {"name": "callback-windows-counted", "metric": "counter",
      "counter": "train.io_callback", "min": 1},
+    {"name": "replica-pushes-counted", "metric": "counter",
+     "counter": "replica.push.accepted", "min": 1},
 ]}
 
 
@@ -431,6 +433,97 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         say(f"sparse wire survived: triggers="
             f"{summary['sparse_triggers']}, BITWISE equal to fault-free")
 
+        # ---- phase 1d: ASYNC REPLICA fleet under fire --------------------
+        # the bounded-staleness subsystem (tpu_sgd/replica): transient
+        # pull/push faults heal in place under the worker RetryPolicy
+        # (τ=0: BITWISE vs fault-free — the protocol mutates nothing
+        # before its failpoints), and a worker KILLED mid-run
+        # deregisters (no fleet stall), rejoins with backoff, and the
+        # run still lands at the synchronous final loss with the
+        # staleness bound intact (asserted from the store snapshot
+        # here, and from the replica.push trace events in phase 3)
+        from tpu_sgd.replica import ReplicaDriver
+
+        deadline = Deadline(300.0)
+        rep_iters = max(24, iters)
+
+        def _make_replica(tau, retry=None, rejoin_seed=None):
+            drv = (ReplicaDriver()
+                   .set_num_iterations(rep_iters).set_step_size(0.1)
+                   .set_mini_batch_fraction(1.0)
+                   .set_convergence_tol(0.0).set_reg_param(0.01)
+                   .set_seed(7).set_workers(4).set_staleness(tau))
+            if retry is not None:
+                drv.set_retry(retry)
+            if rejoin_seed is not None:
+                drv.set_rejoin(RetryPolicy(max_attempts=5,
+                                           base_backoff_s=0.005,
+                                           seed=rejoin_seed))
+            return drv
+
+        w_rep_ref, h_rep_ref = _make_replica(0).optimize_with_history(
+            (X, y), w0)
+        w_rep_ref = np.asarray(w_rep_ref)
+        replica_faults = {
+            "replica.pull": fp.fail_prob(0.05, seed=seed + 40),
+            "replica.push": fp.fail_prob(0.05, seed=seed + 41),
+        }
+        heal_drv = _make_replica(
+            0, retry=RetryPolicy(max_attempts=6, base_backoff_s=0.002,
+                                 seed=seed + 42))
+        with inject_faults(replica_faults):
+            w_rh, h_rh = heal_drv.optimize_with_history((X, y), w0)
+            summary["replica_hits"] = {
+                k: fp.hits(k) for k in replica_faults}
+            summary["replica_triggers"] = {
+                k: fp.triggers(k) for k in replica_faults}
+        deadline.check("replica heal chaos phase")
+        assert all(n > 0 for n in summary["replica_hits"].values()), (
+            "replica hook sites never reached")
+        np.testing.assert_array_equal(
+            np.asarray(w_rh), w_rep_ref,
+            err_msg="healed replica τ=0 weights diverged from fault-free")
+        np.testing.assert_array_equal(
+            h_rh, h_rep_ref,
+            err_msg="healed replica τ=0 loss history diverged")
+        say(f"replica τ=0 fleet healed pull/push faults BITWISE, "
+            f"triggers={summary['replica_triggers']}")
+
+        # kill + rejoin mid-run at τ=2: the staleness bound must hold
+        # and the final full-batch objective must match sync within 1%
+        def _objective(wv):
+            r = X @ np.asarray(wv) - y
+            return float(0.5 * np.mean(r * r)
+                         + 0.5 * 0.01 * np.sum(np.asarray(wv) ** 2))
+
+        # aim the one-shot kill mid-run: pushes ~= applied versions at
+        # τ>=1 (each accepted push IS one version), so hit N/2 lands in
+        # the middle of the sweep
+        kill_drv = _make_replica(2, rejoin_seed=seed + 43)
+        with inject_faults(
+                {"replica.push": fp.fail_nth(rep_iters // 2)}):
+            w_rk, h_rk = kill_drv.optimize_with_history((X, y), w0)
+        deadline.check("replica kill/rejoin chaos phase")
+        snap = kill_drv.last_store_snapshot
+        members = kill_drv.last_membership_snapshot
+        assert snap["version"] == rep_iters, snap
+        assert snap["max_accepted_staleness"] <= 2, snap
+        assert any(m["joins"] > 1 for m in members.values()), (
+            f"no replica worker ever rejoined: {members}")
+        obj_ref = _objective(w_rep_ref)
+        obj_kill = _objective(w_rk)
+        assert obj_kill <= obj_ref * 1.01, (
+            f"kill/rejoin objective {obj_kill} vs sync {obj_ref}")
+        summary["replica_kill"] = {
+            "rejoins": sum(max(0, m["joins"] - 1)
+                           for m in members.values()),
+            "max_accepted_staleness": snap["max_accepted_staleness"],
+            "pushes_rejected": snap["pushes_rejected"],
+            "objective_ratio_vs_sync": obj_kill / obj_ref,
+        }
+        say(f"replica kill/rejoin at τ=2 survived: "
+            f"{summary['replica_kill']}")
+
         # ---- phase 2: serving under reload faults ------------------------
         deadline = Deadline(120.0)
         breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.05)
@@ -514,6 +607,25 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         assert not any("torn" in k for k in kinds)
         summary["events_logged"] = len(events)
         say(f"event log: {len(events)} events replayed past the torn tail")
+
+        if trace_path is not None:
+            # the replica staleness bound, asserted from the TRACE
+            # itself (every replica.push trace_event carries the
+            # staleness its application observed), not just the store's
+            # own counters: phase 1d ran τ=0 and τ=2 fleets, so no
+            # accepted push anywhere in this soak may exceed 2
+            pushes = [e for e in events
+                      if e.get("kind") == "trace_event"
+                      and e.get("name") == "replica.push"]
+            accepted = [e for e in pushes if e.get("accepted")]
+            assert accepted, "no replica.push events in the trace"
+            worst = max(e["staleness"] for e in accepted)
+            assert worst <= 2, (
+                f"trace shows an accepted push {worst} versions stale")
+            summary["replica_trace_pushes"] = len(pushes)
+            summary["replica_trace_max_accepted_staleness"] = worst
+            say(f"replica staleness bound held in the trace: "
+                f"{len(accepted)} accepted pushes, worst {worst}")
 
     summary["ok"] = True
     return summary

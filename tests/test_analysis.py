@@ -1477,20 +1477,25 @@ def test_mutation_deleted_memo_key_field_fails_lint():
 
 
 def test_mutation_item_in_resident_loop_body_fails_lint():
-    """Insert a ``.item()`` on the carried weights inside the observed
-    streamed loop: the host-sync rule must catch the new per-iteration
-    sync."""
+    """Insert a ``.item()`` on the step's result inside the observed
+    streamed K=1 loop (just before its contractual barrier — the one
+    spot the PR 10 observe_step extraction left in the loop body): the
+    host-sync rule must catch the new per-iteration sync."""
     gd = _real_module("tpu_sgd/optimize/gradient_descent.py")
     intact = _real_module("tpu_sgd/optimize/streamed.py")
     res = lint([intact, gd], [HostSyncRule()])
     assert by_rule(res, "host-sync") == []
 
+    barrier = (
+        "                # graftlint: disable=host-sync -- observed "
+        "driver: one barrier per step precedes the scalar reads below\n"
+        "                new_w = jax.block_until_ready(new_w)")
+    assert barrier in intact.source  # anchor must track the real loop
     mutated = _real_module(
         "tpu_sgd/optimize/streamed.py",
         lambda s: s.replace(
-            "                w = new_w\n",
-            "                w = new_w\n"
-            "                probe = w.item()\n", 1))
+            barrier,
+            "                probe = new_w.item()\n" + barrier, 1))
     res = lint([mutated, gd], [HostSyncRule()])
     found = by_rule(res, "host-sync")
     assert any(".item()" in f.message for f in found)

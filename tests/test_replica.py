@@ -1,0 +1,557 @@
+"""Async elastic multi-replica training: the bounded-staleness
+contracts.
+
+The load-bearing pins:
+
+* τ=0 (bulk-synchronous rounds) is BITWISE the synchronous
+  data-parallel trajectory — weights AND loss history — against the
+  meshed observed stepwise driver over the same shard count, because
+  the workers run the shared ``_make_local_sums`` recipe with the
+  shard index folded exactly where ``axis_index`` folds, and the store
+  combines contributions in shard order with ``make_step``'s exact
+  post-psum math.
+* τ>0: no ACCEPTED push ever exceeds the bound — asserted from the
+  obs trace (every ``replica.push`` event carries its staleness), not
+  from the store's own counters alone.
+* Elasticity: a worker killed mid-run deregisters (a τ=0 round in
+  flight completes with the survivors — no fleet stall), rejoins with
+  backoff, and the run converges to the synchronous final loss.
+* The store checkpoint round-trips version + per-worker error-feedback
+  state, and a supervised preempt-resume at τ=0 is bitwise.
+"""
+
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import LeastSquaresGradient, LogisticGradient
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+from tpu_sgd.parallel.mesh import DATA_AXIS
+from tpu_sgd.replica import (ParameterStore, ReplicaDriver,
+                             ReplicaMembership, StalenessContract,
+                             shard_rows)
+from tpu_sgd.reliability import failpoints as fp
+from tpu_sgd.reliability.retry import RetryPolicy
+from tpu_sgd.utils.checkpoint import CheckpointManager
+from tpu_sgd.utils.events import CollectingListener
+
+
+def _data(n=256, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, np.zeros(d, np.float32)
+
+
+def _mesh(n_shards):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n_shards]), (DATA_AXIS,))
+
+
+def _driver(gradient, updater, *, iters=24, frac=0.5, step=0.3,
+            reg=0.1, workers=4, tau=0, tol=0.0):
+    return (ReplicaDriver(gradient, updater)
+            .set_step_size(step).set_num_iterations(iters)
+            .set_mini_batch_fraction(frac).set_convergence_tol(tol)
+            .set_reg_param(reg).set_workers(workers).set_staleness(tau))
+
+
+def _sync_reference(gradient, updater, X, y, w0, *, iters=24, frac=0.5,
+                    step=0.3, reg=0.1, workers=4, tol=0.0):
+    """The synchronous data-parallel trajectory: the meshed OBSERVED
+    stepwise driver (per-iteration ``dp_step_fn`` under shard_map with
+    the psum all-reduce) over the same shard count."""
+    opt = (GradientDescent(gradient, updater)
+           .set_step_size(step).set_num_iterations(iters)
+           .set_mini_batch_fraction(frac).set_convergence_tol(tol)
+           .set_reg_param(reg).set_mesh(_mesh(workers))
+           .set_listener(CollectingListener()))
+    w, h = opt.optimize_with_history((X, y), w0)
+    return np.asarray(w), np.asarray(h)
+
+
+class _ListSink:
+    """Minimal obs sink: collects (kind, payload) records."""
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, payload):
+        self.records.append((kind, dict(payload)))
+
+
+# -- staleness contract -------------------------------------------------------
+
+
+def test_staleness_contract_semantics():
+    c0 = StalenessContract(0)
+    assert c0.synchronous and c0.bounded
+    assert c0.check(5, 5).admissible
+    assert not c0.check(5, 4).admissible
+    assert c0.check(5, 3).staleness == 2
+
+    c2 = StalenessContract(2)
+    assert not c2.synchronous and c2.bounded
+    assert c2.check(7, 5).admissible
+    assert not c2.check(8, 5).admissible
+
+    import math
+
+    for unbounded in (None, math.inf):
+        cu = StalenessContract(unbounded)
+        assert not cu.bounded and not cu.synchronous
+        assert cu.check(1000, 0).admissible
+
+    with pytest.raises(ValueError):
+        StalenessContract(-1)
+    with pytest.raises(ValueError):
+        StalenessContract(1.5)
+    with pytest.raises(ValueError):
+        StalenessContract(2).check(3, 5)  # basis ahead of head
+
+
+def test_shard_rows_matches_mesh_layout():
+    """Replica shard ``i`` must hold bit-identical rows to mesh shard
+    ``i`` (the τ=0 comparison's precondition)."""
+    from tpu_sgd.parallel.data_parallel import pad_to_multiple
+
+    X, y, _ = _data(n=203, d=5)
+    shards = shard_rows(X, y, 4)
+    Xp, yp, valid = pad_to_multiple(X, y, 4)
+    n_local = Xp.shape[0] // 4
+    for s, (Xs, ys, vs) in enumerate(shards):
+        sl = slice(s * n_local, (s + 1) * n_local)
+        np.testing.assert_array_equal(Xs, Xp[sl])
+        np.testing.assert_array_equal(ys, yp[sl])
+        np.testing.assert_array_equal(vs, valid[sl])
+    # divisible row count: no mask at all, like shard_dataset's None
+    shards = shard_rows(X[:200], y[:200], 4)
+    assert all(v is None for _, _, v in shards)
+
+
+# -- τ=0: bitwise vs the synchronous data-parallel path ----------------------
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_tau0_bitwise_vs_sync_data_parallel(workers):
+    X, y, w0 = _data()
+    w_ref, h_ref = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0,
+        workers=workers)
+    drv = _driver(LeastSquaresGradient(), SquaredL2Updater(),
+                  workers=workers, tau=0)
+    w_rep, h_rep = drv.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_rep), w_ref)
+    np.testing.assert_array_equal(h_rep, h_ref)
+    snap = drv.last_store_snapshot
+    assert snap["version"] == 24
+    assert snap["max_accepted_staleness"] == 0
+    assert snap["pushes_accepted"] == 24 * workers
+
+
+def test_tau0_bitwise_uneven_shards_and_simple_updater():
+    """n not divisible by the worker count: the padding valid-mask path
+    must stay bitwise too (mask & bernoulli, like the meshed step)."""
+    X, y, w0 = _data(n=203, d=7, seed=3)
+    w_ref, h_ref = _sync_reference(
+        LeastSquaresGradient(), SimpleUpdater(), X, y, w0, workers=4,
+        reg=0.0)
+    drv = _driver(LeastSquaresGradient(), SimpleUpdater(), workers=4,
+                  tau=0, reg=0.0)
+    w_rep, h_rep = drv.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_rep), w_ref)
+    np.testing.assert_array_equal(h_rep, h_ref)
+
+
+def test_tau0_bitwise_logistic_full_batch():
+    X, y, w0 = _data(n=192, d=6, seed=5)
+    y = (y > 0).astype(np.float32)
+    w_ref, h_ref = _sync_reference(
+        LogisticGradient(), SquaredL2Updater(), X, y, w0, workers=2,
+        frac=1.0, iters=15)
+    drv = _driver(LogisticGradient(), SquaredL2Updater(), workers=2,
+                  tau=0, frac=1.0, iters=15)
+    w_rep, h_rep = drv.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_rep), w_ref)
+    np.testing.assert_array_equal(h_rep, h_ref)
+
+
+def test_tau0_convergence_tol_early_exit():
+    """The store's observe_step convergence matches the sync driver's
+    detected iteration (same norms rule, same tolerance math)."""
+    X, y, w0 = _data(n=128, d=6, seed=7)
+    kwargs = dict(iters=60, frac=1.0, step=0.5, reg=0.0, workers=2,
+                  tol=1e-3)
+    w_ref, h_ref = _sync_reference(LeastSquaresGradient(),
+                                   SimpleUpdater(), X, y, w0, **kwargs)
+    drv = _driver(LeastSquaresGradient(), SimpleUpdater(), **kwargs,
+                  tau=0)
+    w_rep, h_rep = drv.optimize_with_history((X, y), w0)
+    assert len(h_rep) < 60, "tolerance never fired; test is vacuous"
+    np.testing.assert_array_equal(h_rep, h_ref)
+    np.testing.assert_array_equal(np.asarray(w_rep), np.asarray(w_ref))
+    assert drv.last_store_snapshot["converged"]
+
+
+# -- τ>0: the bound holds, asserted from the trace ---------------------------
+
+
+@pytest.mark.parametrize("tau", [1, 4])
+def test_staleness_bound_never_violated_in_trace(tau):
+    from tpu_sgd.obs import spans
+
+    X, y, w0 = _data()
+    sink = _ListSink()
+    spans.enable_tracing(sink)
+    try:
+        drv = _driver(LeastSquaresGradient(), SquaredL2Updater(),
+                      workers=4, tau=tau, iters=48, step=0.1)
+        drv.optimize_with_history((X, y), w0)
+    finally:
+        spans.disable_tracing()
+    pushes = [p for k, p in sink.records
+              if k == "trace_event" and p["name"] == "replica.push"]
+    accepted = [p for p in pushes if p["accepted"]]
+    assert len(accepted) == 48, "every applied version leaves one record"
+    assert max(p["staleness"] for p in accepted) <= tau
+    # rejected pushes (if any) were all OVER the bound — rejection is
+    # never spurious
+    for p in pushes:
+        if not p["accepted"]:
+            assert p["staleness"] > tau
+    snap = drv.last_store_snapshot
+    assert snap["max_accepted_staleness"] <= tau
+    assert snap["pushes_rejected"] == len(pushes) - len(accepted)
+
+
+def test_unbounded_staleness_accepts_everything():
+    X, y, w0 = _data()
+    drv = _driver(LeastSquaresGradient(), SquaredL2Updater(),
+                  workers=4, tau=None, iters=40, step=0.1)
+    drv.optimize_with_history((X, y), w0)
+    assert drv.last_store_snapshot["pushes_rejected"] == 0
+    assert drv.last_store_snapshot["version"] == 40
+
+
+# -- reliability: failpoint heal, kill/rejoin --------------------------------
+
+
+def test_push_pull_failpoints_heal_bitwise():
+    """Transient replica.pull/replica.push faults healed by the worker
+    RetryPolicy leave the τ=0 trajectory bitwise (the protocol mutates
+    nothing before the failpoint)."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0, workers=2)
+    drv = (_driver(LeastSquaresGradient(), SquaredL2Updater(),
+                   workers=2, tau=0)
+           .set_retry(RetryPolicy(max_attempts=4, base_backoff_s=0.001,
+                                  seed=5)))
+    with fp.inject_faults({
+            "replica.pull": fp.fail_prob(0.05, seed=1),
+            "replica.push": fp.fail_prob(0.05, seed=2)}):
+        w_rep, h_rep = drv.optimize_with_history((X, y), w0)
+        assert fp.hits("replica.pull") > 0
+        assert fp.hits("replica.push") > 0
+    np.testing.assert_array_equal(np.asarray(w_rep), w_ref)
+    np.testing.assert_array_equal(h_rep, h_ref)
+
+
+def _full_objective(X, y, w, reg):
+    """Exact full-batch objective (mean squared residual / 2 + L2 reg)
+    — the matched-loss metric, immune to minibatch sampling noise."""
+    r = X @ np.asarray(w) - y
+    return float(0.5 * np.mean(r * r) + 0.5 * reg * np.sum(
+        np.asarray(w) ** 2))
+
+
+@pytest.mark.parametrize("tau", [0, 2])
+def test_worker_kill_and_rejoin_converges(tau):
+    """A worker killed mid-run (one-shot failpoint, no worker retry)
+    deregisters — a τ=0 round in flight completes with the survivors,
+    the fleet never stalls — rejoins with backoff, and the run still
+    converges to the synchronous final loss (matched objective, not
+    bitwise: the fleet composition changed mid-run)."""
+    X, y, w0 = _data(n=512, d=10, seed=11)
+    iters = 160
+    w_ref, _ = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0,
+        workers=4, iters=iters, frac=1.0, step=0.2, reg=0.01)
+    ref_obj = _full_objective(X, y, w_ref, 0.01)
+    drv = (_driver(LeastSquaresGradient(), SquaredL2Updater(),
+                   workers=4, tau=tau, iters=iters, frac=1.0, step=0.2,
+                   reg=0.01)
+           .set_rejoin(RetryPolicy(max_attempts=5,
+                                   base_backoff_s=0.005, seed=7)))
+    with fp.inject_faults({"replica.push": fp.fail_nth(30)}):
+        w_k, h_k = drv.optimize_with_history((X, y), w0)
+    assert len(h_k) == iters
+    membership = drv.last_membership_snapshot
+    assert any(rec["joins"] > 1 for rec in membership.values()), (
+        f"no worker ever rejoined: {membership}")
+    assert any(rec["failures"] > 0 for rec in membership.values())
+    obj = _full_objective(X, y, w_k, 0.01)
+    assert obj <= ref_obj * 1.01, (
+        f"kill/rejoin run objective {obj} vs sync {ref_obj}")
+
+
+def test_fatal_worker_error_propagates():
+    """An unretryable worker death (rejoin budget cannot absorb it)
+    aborts the run with the real error — never a hang."""
+    X, y, w0 = _data()
+    drv = (_driver(LeastSquaresGradient(), SquaredL2Updater(),
+                   workers=2, tau=0, iters=40)
+           .set_rejoin(RetryPolicy(max_attempts=2,
+                                   base_backoff_s=0.001, seed=1)))
+    with fp.inject_faults(
+            {"replica.pull": fp.fail_nth(10, exc=ValueError)}):
+        with pytest.raises(ValueError):
+            drv.optimize_with_history((X, y), w0)
+
+
+# -- async convergence: matched final loss -----------------------------------
+
+
+@pytest.mark.parametrize("tau", [1, 4, None])
+def test_async_converges_to_matched_loss(tau):
+    X, y, w0 = _data(n=512, d=10, seed=11)
+    iters = 160
+    w_ref, _ = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0,
+        workers=4, iters=iters, frac=1.0, step=0.2, reg=0.01)
+    ref_obj = _full_objective(X, y, w_ref, 0.01)
+    drv = _driver(LeastSquaresGradient(), SquaredL2Updater(),
+                  workers=4, tau=tau, iters=iters, frac=1.0, step=0.2,
+                  reg=0.01)
+    w_a, h_a = drv.optimize_with_history((X, y), w0)
+    assert len(h_a) == iters
+    obj = _full_objective(X, y, w_a, 0.01)
+    assert obj <= ref_obj * 1.01, (
+        f"tau={tau} objective {obj} vs sync {ref_obj}")
+
+
+# -- compressed wire ----------------------------------------------------------
+
+
+def test_compressed_wire_matched_loss_and_wire_bytes():
+    from tpu_sgd.obs import counters as obs_counters
+    from tpu_sgd.obs import spans
+
+    X, y, w0 = _data(n=512, d=64, seed=13)
+    iters = 200
+    w_ref, _ = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0,
+        workers=2, iters=iters, frac=1.0, step=0.2, reg=0.01)
+    ref_obj = _full_objective(X, y, w_ref, 0.01)
+    drv = (_driver(LeastSquaresGradient(), SquaredL2Updater(),
+                   workers=2, tau=1, iters=iters, frac=1.0, step=0.2,
+                   reg=0.01)
+           .set_wire_compress("topk:0.125"))
+    # tracing must be on for the counters' subsystem attribution (the
+    # replica.step span tags the worker thread)
+    spans.enable_tracing(_ListSink())
+    obs_counters.enable()
+    obs_counters.reset()  # the registry is process-wide
+    try:
+        w_c, h_c = drv.optimize_with_history((X, y), w0)
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+        spans.disable_tracing()
+    obj = _full_objective(X, y, w_c, 0.01)
+    assert obj <= ref_obj * 1.01, (
+        f"compressed objective {obj} vs sync {ref_obj}")
+    # the push wire shipped topk segments, and their physical bytes are
+    # a real compression of the logical update bytes (counter name is
+    # <subsystem>.wire.topk — the replica.step span tags the worker)
+    from tpu_sgd.obs.counters import wire_ratios
+
+    ratios = wire_ratios(snap)
+    topk = ratios.get("replica.wire.topk")
+    assert topk is not None, f"no topk wire counted: {sorted(ratios)}"
+    assert topk["physical_bytes"] > 0
+    assert topk["physical_bytes"] < 0.5 * topk["logical_bytes"]
+
+
+def test_rejected_compressed_push_conserves_ef_mass():
+    from tpu_sgd.io.sparse_wire import ErrorFeedback
+
+    ef = ErrorFeedback(16, 0.25)
+    update = np.arange(16, dtype=np.float32) - 8.0
+    idx, vals = ef.compress(update.copy())
+    # delivered: acc + extracted == update
+    np.testing.assert_allclose(
+        ef.acc.sum() + vals.sum(), update.sum(), rtol=1e-6)
+    # rejection path: restore the segment — the accumulator holds the
+    # WHOLE update again, nothing leaked
+    ef.restore_segment(idx, vals)
+    np.testing.assert_allclose(ef.acc, update, rtol=1e-6)
+
+
+# -- checkpoint / resume ------------------------------------------------------
+
+
+def test_store_checkpoint_roundtrips_version_and_ef_state(tmp_path):
+    import jax.numpy as jnp
+
+    cfg = SGDConfig(step_size=0.1, num_iterations=50,
+                    convergence_tol=0.0, reg_param=0.01)
+    mgr = CheckpointManager(os.fspath(tmp_path))
+    store = ParameterStore(
+        SquaredL2Updater(), cfg, np.zeros(8, np.float32), staleness=2,
+        checkpoint_manager=mgr, checkpoint_every=100, config_key="ck")
+    store.register_worker("w0", 0)
+    store.register_worker("w1", 1)
+    ef0 = store.error_feedback("w0", 0.25)
+    ef1 = store.error_feedback("w1", 0.25)
+    rng = np.random.default_rng(0)
+    # alternate pushers: the SSP progress bound (staleness.py) blocks
+    # a worker running more than τ accepted pushes ahead of the
+    # slowest active one, so a single-threaded driver must interleave
+    for wid in ("w0", "w1", "w0"):
+        pulled = store.pull(wid)
+        g = jnp.asarray(rng.normal(size=8).astype(np.float32))
+        res = store.push(wid, pulled.version, g,
+                         jnp.asarray(4.0), jnp.asarray(8.0))
+        assert res.accepted
+    gn = rng.normal(size=8).astype(np.float32)
+    idx, vals = ef1.compress(gn)
+    assert store.push_compressed("w1", store.version, idx, vals, 4.0,
+                                 8.0).accepted
+    store.save_now()
+
+    state = mgr.restore()
+    assert state["iteration"] == 4 == store.version
+    restored = ParameterStore(
+        SquaredL2Updater(), cfg, state["weights"], staleness=2,
+        config_key="ck", resume_state=state)
+    assert restored.version == 4
+    np.testing.assert_array_equal(np.asarray(restored.weights),
+                                  np.asarray(store.weights))
+    np.testing.assert_array_equal(restored.loss_history(),
+                                  store.loss_history())
+    # per-worker EF accumulators round-trip bitwise
+    np.testing.assert_array_equal(
+        restored.error_feedback("w0", 0.25).acc, ef0.acc)
+    np.testing.assert_array_equal(
+        restored.error_feedback("w1", 0.25).acc, ef1.acc)
+
+
+def test_supervised_preempt_resume_bitwise(tmp_path):
+    from tpu_sgd.reliability.supervisor import TrainingSupervisor
+
+    X, y, w0 = _data()
+    w_ref, h_ref = _sync_reference(
+        LeastSquaresGradient(), SquaredL2Updater(), X, y, w0, workers=2,
+        iters=40)
+    mgr = CheckpointManager(os.fspath(tmp_path))
+    drv = _driver(LeastSquaresGradient(), SquaredL2Updater(),
+                  workers=2, tau=0, iters=40)
+    sup = TrainingSupervisor(drv, checkpoint_manager=mgr,
+                             checkpoint_every=10,
+                             install_signal_handlers=False)
+
+    class _PreemptAt(CollectingListener):
+        def on_iteration(self, ev):
+            super().on_iteration(ev)
+            if ev.iteration == 12:
+                sup.request_preempt()
+
+    drv.set_listener(_PreemptAt())
+    res = sup.run((X, y), w0)
+    assert res.status == "preempted"
+    assert 0 < res.preempted_at < 40
+    drv.set_listener(None)
+    res2 = sup.run((X, y), w0)
+    assert res2.completed
+    np.testing.assert_array_equal(np.asarray(res2.weights), w_ref)
+    np.testing.assert_array_equal(res2.loss_history, h_ref)
+
+
+# -- membership / health ------------------------------------------------------
+
+
+def test_membership_records_and_stragglers():
+    m = ReplicaMembership()
+    rec = m.join("w0", 0)
+    m.join("w1", 1)
+    assert set(m.active_ids()) == {"w0", "w1"}
+    rec.heartbeat.beat()
+    assert m.stragglers(stall_after_s=1e-9) == ["w0"]  # w1 never beat
+    m.leave("w1", error=RuntimeError("boom"))
+    assert m.active_ids() == ["w0"]
+    snap = m.snapshot()
+    assert snap["w1"]["failures"] == 1
+    assert "RuntimeError" in snap["w1"]["last_error"]
+    rec2 = m.join("w1", 1)  # rejoin keeps the record identity
+    assert rec2.joins == 2
+    assert len(m.heartbeats()) == 2
+
+
+def test_store_lock_discipline_validated_at_runtime():
+    """The GRAFTLINT_LOCKS declaration for ParameterStore, validated
+    dynamically on a live multi-worker run (the runtime twin of the
+    lexical rule)."""
+    from tpu_sgd.analysis.runtime import instrument_object
+    from tpu_sgd.replica import store as store_mod
+
+    X, y, w0 = _data(n=64, d=6)
+    cfg = SGDConfig(step_size=0.2, num_iterations=10,
+                    mini_batch_fraction=0.5, convergence_tol=0.0,
+                    reg_param=0.01)
+    store = ParameterStore(SquaredL2Updater(), cfg, w0, staleness=1)
+    recorder = instrument_object(
+        store, store_mod.GRAFTLINT_LOCKS["ParameterStore"])
+    from tpu_sgd.replica import ReplicaWorker
+
+    shards = shard_rows(X, y, 2)
+    workers = [
+        ReplicaWorker(f"w{s}", s, store, LeastSquaresGradient(), cfg,
+                      *shards[s])
+        for s in range(2)
+    ]
+    for s in range(2):
+        store.register_worker(f"w{s}", s)
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert store.version == 10
+    assert recorder.checked_accesses > 0
+    assert recorder.violations == []
+
+
+# -- planner ------------------------------------------------------------------
+
+
+def test_choose_replicas_scaling():
+    from tpu_sgd.plan import Plan, choose_replicas, plan
+
+    # tiny workload: the store would serialize the fleet — stay sync
+    assert choose_replicas(1000, 16, n_devices=8) == 0
+    # a single device can never place a fleet, whatever the cost model
+    assert choose_replicas(10_000_000, 1000, n_devices=1) == 0
+    # north-star shape: a real fleet pays, bounded by devices and cap
+    w_big = choose_replicas(10_000_000, 1000, n_devices=8)
+    assert 2 <= w_big <= 8
+    # more devices never shrink the choice; caps bind
+    assert choose_replicas(10_000_000, 1000, n_devices=2) <= 2
+    assert choose_replicas(10_000_000, 1000, n_devices=8, cap=3) <= 3
+    # monotone in workload size
+    assert (choose_replicas(10_000_000, 1000, n_devices=8)
+            >= choose_replicas(100_000, 1000, n_devices=8))
+    # the Plan carries the advice; default stays synchronous
+    assert Plan(schedule="resident", reason="r").replicas == 0
+    # ...and plan() stamps it on every returned plan
+    p = plan(10_000_000, 1000, n_devices=8)
+    assert p.replicas == w_big
+    assert p.estimates["replicas"] == w_big
+    assert plan(4096, 16, n_devices=8).replicas == 0

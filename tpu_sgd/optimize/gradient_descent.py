@@ -80,41 +80,55 @@ def _coerce_w0(gradient, initial_weights, n_features):
     return w0
 
 
-def _sample_key(key, i, axis_name):
+def _sample_key(key, i, axis_name, shard_index=None):
     """THE per-iteration (and per-shard, like Spark's per-partition
     sampler) sample-key recipe, deterministic in (seed, iteration, shard
     index).  One definition shared by the Bernoulli mask and the
     indexed/sliced streams so an edit to the fold order cannot silently
-    desync them."""
+    desync them.
+
+    ``shard_index`` is the OUT-OF-MESH spelling of the shard fold: a
+    replica worker (``tpu_sgd/replica``) runs its shard's local sums as
+    a standalone program — no ``shard_map``, so no ``axis_index`` — and
+    folds its static shard index exactly where the meshed program folds
+    the axis index, which is what makes the τ=0 replica trajectory
+    bitwise-equal to the synchronous data-parallel path (the fold order
+    is identical, so the per-shard sample keys are identical bits)."""
     k = jax.random.fold_in(key, i)
     if axis_name is not None:
         k = jax.random.fold_in(k, jax.lax.axis_index(axis_name))
+    elif shard_index is not None:
+        k = jax.random.fold_in(k, shard_index)
     return k
 
 
-def _make_mask(cfg: SGDConfig, key, i, n_local, valid, axis_name):
+def _make_mask(cfg: SGDConfig, key, i, n_local, valid, axis_name,
+               shard_index=None):
     """Per-iteration Bernoulli mini-batch mask (None = take everything)."""
     if cfg.mini_batch_fraction < 1.0:
-        k = _sample_key(key, i, axis_name)
+        k = _sample_key(key, i, axis_name, shard_index)
         mask = jax.random.bernoulli(k, cfg.mini_batch_fraction, (n_local,))
         return mask if valid is None else mask & valid
     return valid
 
 
-def _make_local_sums(gradient, cfg, key, axis_name, model_axis_name):
+def _make_local_sums(gradient, cfg, key, axis_name, model_axis_name,
+                     shard_index=None):
     """THE per-iteration LOCAL ``(grad_sum, loss_sum, count)`` recipe —
     sampling (bernoulli / indexed / sliced) + the fused batch sums,
     pre-psum.  One definition shared by :func:`make_step` (dense
-    all-reduce) and :func:`make_compressed_step` (top-k + error-feedback
-    all-reduce) so the sampled sequence can never drift between the two
-    wires."""
+    all-reduce), :func:`make_compressed_step` (top-k + error-feedback
+    all-reduce), and the async replica workers
+    (``tpu_sgd/replica/worker.py``, via ``shard_index`` — see
+    :func:`_sample_key`) so the sampled sequence can never drift between
+    the wires."""
     indexed = cfg.sampling == "indexed" and cfg.mini_batch_fraction < 1.0
     sliced = cfg.sampling == "sliced" and cfg.mini_batch_fraction < 1.0
 
     def local_sums(weights, X, y, i, valid):
         if sliced or indexed:
             m = max(1, round(cfg.mini_batch_fraction * X.shape[0]))
-            k = _sample_key(key, i, axis_name)
+            k = _sample_key(key, i, axis_name, shard_index)
         if sliced:
             # HBM-optimal path: a contiguous row window at a random offset —
             # one sequential DMA (zero-copy under PallasGradient) instead of
@@ -134,7 +148,8 @@ def _make_local_sums(gradient, cfg, key, axis_name, model_axis_name):
             mask = None if valid is None else valid[idx]
         else:
             Xb, yb = X, y
-            mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name)
+            mask = _make_mask(cfg, key, i, X.shape[0], valid, axis_name,
+                              shard_index)
         return gradient.batch_sums(
             Xb, yb, weights, mask, margin_axis_name=model_axis_name
         )
@@ -294,6 +309,71 @@ def pack_step_ys(prev_w, new_w, loss_i, new_rv, count, f32: bool = False):
 #: lowers identically fused or not)
 step_norms = jax.jit(lambda new_w, w: jnp.stack(
     (jnp.linalg.norm(new_w - w), jnp.linalg.norm(new_w))))
+
+
+def observe_step(
+    i, prev_w, new_w, loss_i, new_reg, count, losses, reg_val, cfg, *,
+    listener=None, wall_dt=0.0, check_numerics=False,
+    save_cb=None, save_every=0,
+):
+    """One OBSERVED iteration's host bookkeeping — THE single definition
+    of the per-step record/convergence/checkpoint recipe the stepwise
+    drivers share (the fused twin is :func:`_replay_fused_steps`, which
+    replays the same recipe from scan ys).
+
+    Consumers: the dense host-streamed K=1 loop
+    (``optimize/streamed.py``), the sparse host-streamed K=1 loop
+    (``optimize/streamed_sparse.py``), and the async replica parameter
+    store's push-apply (``tpu_sgd/replica/store.py``) — extracted after
+    the PR 9 review flagged the first two as duplicated and the replica
+    driver would have made a third copy.
+
+    Takes the step's DEVICE results plus the host-side running state;
+    fetches each scalar exactly once (the observed-driver contract: the
+    per-iteration host hop IS the bookkeeping), appends to ``losses``
+    in place, and fires ``save_cb(i, w_np, reg_val)`` on the legacy
+    cadence (``i % save_every == 0``, on convergence, and at the final
+    iteration).  An empty sampled batch (``count == 0``) records
+    nothing and returns ``prev_w`` unchanged, exactly as the loops it
+    replaced did.
+
+    Returns ``(w, reg_val, converged)`` — ``w`` is ``new_w`` when the
+    step recorded, else ``prev_w``.
+    """
+    import numpy as np
+
+    from tpu_sgd.utils.events import IterationEvent
+
+    c_host = int(count)  # count gates the whole bookkeeping branch (fetched ONCE)
+    converged = False
+    if c_host <= 0:
+        return prev_w, reg_val, converged
+    loss_f = float(loss_i)  # per-iteration loss history is the contract
+    if check_numerics and not np.isfinite(loss_f):
+        _raise_if_nonfinite([loss_f], first_iteration=i)
+    losses.append(loss_f)
+    reg_val = float(new_reg)  # feeds the next step's host-side argument
+    # ONE fused program + ONE fetch for both norms (the host-sync
+    # finding the PR 7 sweep fixed; step_norms is the shared program)
+    delta, w_norm = (
+        float(v)
+        for v in np.asarray(step_norms(new_w, prev_w))
+    )
+    if listener is not None:
+        listener.on_iteration(IterationEvent(
+            iteration=i,
+            loss=loss_f,
+            weight_delta_norm=delta,
+            mini_batch_size=c_host,
+            wall_time_s=wall_dt,
+        ))
+    if cfg.convergence_tol > 0 and i > 1:
+        converged = delta < cfg.convergence_tol * max(w_norm, 1.0)
+    if save_cb is not None and (
+            (save_every and i % save_every == 0)
+            or converged or i == cfg.num_iterations):
+        save_cb(i, np.asarray(new_w), reg_val)
+    return new_w, reg_val, converged
 
 
 def make_superstep(

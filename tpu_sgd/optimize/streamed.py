@@ -199,9 +199,9 @@ def optimize_host_streamed(
     from tpu_sgd.obs.counters import record_wire
     from tpu_sgd.obs.spans import span
     from tpu_sgd.optimize.gradient_descent import (make_compressed_step,
-                                                   make_step, step_norms)
+                                                   make_step, observe_step)
     from tpu_sgd.reliability.failpoints import failpoint
-    from tpu_sgd.utils.events import IterationEvent, RunEvent
+    from tpu_sgd.utils.events import RunEvent
 
     cfg = config
     n = X.shape[0]
@@ -613,6 +613,24 @@ def optimize_host_streamed(
         ef = jax.device_put(jnp.asarray(ef0), ef_sharding)
     t_run = _time.perf_counter()
     converged = False
+
+    # iteration-exact EF for mid-superstep checkpoint saves: the
+    # replay's save_cb fires at iteration ii inside the CURRENT
+    # superstep, whose per-step post-update accumulators sit in the
+    # ys' seventh leaf (installed before each replay); the K=1 loop
+    # never installs a window, so its saves read the live accumulator
+    _ef_window = {"efs": None, "i0": start_iter}
+
+    def _save(ii, w_np, rv):
+        extras = None
+        if comp_frac is not None:
+            efs = _ef_window["efs"]
+            extras = {"ef": (efs[ii - _ef_window["i0"]]
+                             if efs is not None else np.asarray(ef))}
+        checkpoint_manager.save(ii, np.asarray(w_np), rv,
+                                np.asarray(losses), config_key,
+                                extras=extras)
+
     if K > 1:
         # Superstep executor: ONE compiled lax.scan program advances K
         # iterations per dispatch; the prefetcher stages whole
@@ -635,22 +653,6 @@ def optimize_host_streamed(
 
         shared_full_batch = frac >= 1.0
         window_resident = bool(R) and not shared_full_batch
-
-        # iteration-exact EF for mid-superstep checkpoint saves: the
-        # replay's save_cb fires at iteration ii inside the CURRENT
-        # superstep, whose per-step post-update accumulators sit in the
-        # ys' seventh leaf (installed here before each replay)
-        _ef_window = {"efs": None, "i0": start_iter}
-
-        def _save(ii, w_np, rv):
-            extras = None
-            if comp_frac is not None:
-                efs = _ef_window["efs"]
-                extras = {"ef": (efs[ii - _ef_window["i0"]]
-                                 if efs is not None else np.asarray(ef))}
-            checkpoint_manager.save(ii, np.asarray(w_np), rv,
-                                    np.asarray(losses), config_key,
-                                    extras=extras)
 
         def _full_batch_transfer():
             # THE one-time full-batch device_put, inside the ingest
@@ -966,43 +968,17 @@ def optimize_host_streamed(
                 # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
                 new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
-            c_host = int(c)  # graftlint: disable=host-sync -- observed driver: count gates the whole bookkeeping branch (fetched ONCE; it used to sync twice per step)
-            if c_host > 0:
-                losses.append(float(loss_i))  # graftlint: disable=host-sync -- observed driver: per-iteration loss history is the contract
-                reg_val = float(new_reg)  # graftlint: disable=host-sync -- observed driver: reg_val feeds the next step's host-side argument
-                # ONE fused program + ONE fetch for both norms (was two
-                # eager norms with separate syncs — host-sync finding)
-                delta, w_norm = (
-                    float(v)
-                    for v in np.asarray(step_norms(new_w, w))  # graftlint: disable=host-sync -- observed driver: the single per-step norm fetch, post-barrier
-                )
-                if listener is not None:
-                    listener.on_iteration(
-                        IterationEvent(
-                            iteration=i,
-                            loss=losses[-1],
-                            weight_delta_norm=delta,
-                            mini_batch_size=c_host,
-                            wall_time_s=dt,
-                        )
-                    )
-                if cfg.convergence_tol > 0 and i > 1:
-                    converged = delta < cfg.convergence_tol * max(
-                        w_norm, 1.0
-                    )
-                w = new_w
-                if checkpoint_manager is not None and (
-                    i % checkpoint_every == 0
-                    or converged
-                    or i == cfg.num_iterations
-                ):
-                    checkpoint_manager.save(
-                        # graftlint: disable=host-sync -- checkpoint save: cadence-gated (every checkpoint_every iterations), the documented host hop
-                        i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key,
-                        extras=({"ef": np.asarray(ef)}  # graftlint: disable=host-sync -- checkpoint save: EF state rides the same cadence-gated hop
-                                if comp_frac is not None else None)
-                    )
+            # the shared observed-loop bookkeeping (one definition for
+            # this driver, the sparse streamed driver, and the replica
+            # store — see observe_step): barrier above, then each
+            # scalar fetched exactly once
+            w, reg_val, converged = observe_step(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
+                i, w, new_w, loss_i, new_reg, c, losses, reg_val, cfg,
+                listener=listener, wall_dt=dt,
+                save_cb=(_save if checkpoint_manager is not None
+                         else None),
+                save_every=checkpoint_every,
+            )
             if (not converged and stop_signal is not None
                     and stop_signal()):
                 # cooperative preemption (TrainingSupervisor): persist
@@ -1015,13 +991,7 @@ def optimize_host_streamed(
                 )
 
                 if checkpoint_manager is not None:
-                    checkpoint_manager.save(
-                        # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
-                        i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key,
-                        extras=({"ef": np.asarray(ef)}  # graftlint: disable=host-sync -- preemption save: EF state rides the unwind save
-                                if comp_frac is not None else None)
-                    )
+                    _save(i, np.asarray(w), reg_val)  # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
                 raise TrainingPreempted(i)
             i += 1
     finally:

@@ -174,8 +174,8 @@ def optimize_host_streamed_sparse(
     from tpu_sgd.obs.counters import record_wire
     from tpu_sgd.obs.spans import span
     from tpu_sgd.optimize.gradient_descent import (_replay_fused_steps,
-                                                   step_norms)
-    from tpu_sgd.utils.events import IterationEvent, RunEvent
+                                                   observe_step)
+    from tpu_sgd.utils.events import RunEvent
 
     cfg = config
     if cfg.mini_batch_fraction < 1.0 and cfg.sampling != "bernoulli":
@@ -447,34 +447,17 @@ def optimize_host_streamed_sparse(
                 # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
                 new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
-            c_host = int(c)  # graftlint: disable=host-sync -- observed driver: count gates the whole bookkeeping branch (fetched once)
-            if c_host > 0:
-                losses.append(float(loss_i))  # graftlint: disable=host-sync -- observed driver: per-iteration loss history is the contract
-                reg_val = float(new_reg)  # graftlint: disable=host-sync -- observed driver: reg_val feeds the next step's host-side argument
-                delta, w_norm = (
-                    float(v)
-                    for v in np.asarray(step_norms(new_w, w))  # graftlint: disable=host-sync -- observed driver: the single per-step norm fetch, post-barrier
-                )
-                if listener is not None:
-                    listener.on_iteration(IterationEvent(
-                        iteration=i,
-                        loss=losses[-1],
-                        weight_delta_norm=delta,
-                        mini_batch_size=c_host,
-                        wall_time_s=dt,
-                    ))
-                if cfg.convergence_tol > 0 and i > 1:
-                    converged = delta < cfg.convergence_tol * max(
-                        w_norm, 1.0)
-                w = new_w
-                if checkpoint_manager is not None and (
-                        i % checkpoint_every == 0
-                        or converged
-                        or i == cfg.num_iterations):
-                    checkpoint_manager.save(
-                        # graftlint: disable=host-sync -- checkpoint save: cadence-gated, the documented host hop
-                        i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key)
+            # the shared observed-loop bookkeeping (one definition for
+            # this driver, the dense streamed driver, and the replica
+            # store — see observe_step): barrier above, then each
+            # scalar fetched exactly once
+            w, reg_val, converged = observe_step(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
+                i, w, new_w, loss_i, new_reg, c, losses, reg_val, cfg,
+                listener=listener, wall_dt=dt,
+                save_cb=(_save if checkpoint_manager is not None
+                         else None),
+                save_every=checkpoint_every,
+            )
             if (not converged and stop_signal is not None
                     and stop_signal()):
                 from tpu_sgd.reliability.supervisor import (
@@ -482,10 +465,7 @@ def optimize_host_streamed_sparse(
                 )
 
                 if checkpoint_manager is not None:
-                    checkpoint_manager.save(
-                        # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
-                        i, np.asarray(w), reg_val, np.asarray(losses),
-                        config_key)
+                    _save(i, np.asarray(w), reg_val)  # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
                 raise TrainingPreempted(i)
             i += 1
     finally:

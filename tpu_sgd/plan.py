@@ -332,6 +332,17 @@ class Plan:
     #: final loss, not bitwise), so the planner proposes it only where
     #: a real multi-shard all-reduce exists; user wire_compress wins
     wire_compress: Optional[str] = None
+    #: async replica-worker count for the bounded-staleness driver
+    #: (``tpu_sgd/replica``; README "Async replicas"): how many
+    #: ``ReplicaDriver`` workers the cost model says this workload can
+    #: keep busy (``choose_replicas``; 0 = stay synchronous), stamped
+    #: on every plan :func:`plan` returns (also in
+    #: ``estimates["replicas"]``).  NOT a schedule the planner
+    #: auto-applies — ``tau > 0`` changes the update rule (matched
+    #: final loss, not matched trajectory), so going async is always
+    #: the USER's call; this field is the sizing advice they read when
+    #: they make it
+    replicas: int = 0
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -674,6 +685,56 @@ def choose_wire_compress(dim: int, n_devices: int,
     return f"topk:{frac:g}"
 
 
+#: fraction of a replica worker's per-push compute wall the SERIALIZED
+#: store work (one apply dispatch + the update wire) may consume at the
+#: chosen fleet size before the store becomes the bottleneck —
+#: ``choose_replicas`` keeps the store at most half busy so push
+#: arrivals queue on compute, not on each other
+REPLICA_STORE_HEADROOM = 0.5
+
+
+def choose_replicas(n: int, d: int, itemsize: int = 4,
+                    n_devices: int = 1,
+                    mini_batch_fraction: float = 1.0,
+                    cost_model: CostModel = DEFAULT_COST_MODEL,
+                    cap: int = 8) -> int:
+    """Replica-worker count W for the async bounded-staleness driver
+    (``tpu_sgd/replica``), from the existing cost model.
+
+    The async fleet's structural bottleneck is the STORE: every
+    accepted push costs one serialized apply — a program dispatch
+    (``dispatch_overhead_s``) plus the update-shaped wire both ways
+    (pulled weights + pushed contribution, ``2 * d * 4`` bytes at
+    ``allreduce_gb_s``) — while the workers' shard gradients run
+    concurrently (each a two-pass read of its sampled rows,
+    ``2 * (n/W) * frac * d * itemsize / hbm_gb_s``).  W workers
+    generate one push per per-shard compute wall, so the store's busy
+    fraction is ``W * store_s / compute_s(W)`` and grows as W² (more
+    pushers, each pushing sooner).  W is the LARGEST count — capped by
+    ``n_devices`` and ``cap`` — that keeps the store under
+    :data:`REPLICA_STORE_HEADROOM` busy; 0 when even W=2 saturates it
+    (tiny workloads stay synchronous — the same "smallest that pays"
+    honesty as ``choose_residency``'s crossover).
+
+    Like :data:`Plan.replicas`, this is SIZING advice, not a schedule
+    decision: ``tau > 0`` changes the update rule (matched final loss,
+    not matched trajectory), so the async switch itself is always the
+    user's."""
+    cm = cost_model
+    store_s = (cm.dispatch_overhead_s
+               + 2.0 * d * 4.0 / (cm.allreduce_gb_s * 1e9))
+    best = 0
+    # an empty range when fewer than 2 devices: a single device cannot
+    # place a fleet, whatever the cost model says
+    for w in range(2, min(int(n_devices), int(cap)) + 1):
+        rows_local = max(1.0, float(n) / w)
+        compute_s = (2.0 * rows_local * mini_batch_fraction * d
+                     * itemsize / (cm.hbm_gb_s * 1e9))
+        if w * store_s <= REPLICA_STORE_HEADROOM * compute_s:
+            best = w
+    return best
+
+
 def choose_residency(k: int, checkpoint_every: int = 10,
                      preempt_latency_iters: Optional[int] = None,
                      cap: int = 64) -> int:
@@ -1006,6 +1067,14 @@ def plan(
                 estimates=est,
             )
 
+    # async replica sizing advice (tpu_sgd/replica; README "Async
+    # replicas"), stamped on EVERY returned plan: not a schedule choice
+    # (τ>0 changes the update rule, so going async is the user's call),
+    # just what the cost model says a fleet could be if they make it
+    replicas = choose_replicas(n, d, itemsize, n_devices,
+                               mini_batch_fraction=frac, cost_model=cm)
+    est["replicas"] = replicas
+
     if not host_resident_ok and chosen.schedule in (
             "partial_residency", "host_streamed", "streamed_virtual_gram"):
         chosen = Plan(
@@ -1039,8 +1108,8 @@ def plan(
                 f"fit the budget (sampling={sampling!r}, frac={frac}, "
                 f"n_devices={n_devices})"
             )
-        return forced
-    return chosen
+        return dataclasses.replace(forced, replicas=replicas)
+    return dataclasses.replace(chosen, replicas=replicas)
 
 
 def _forced_plan(force, chosen, est, *, fits, free_hbm, data_bytes_local,

@@ -155,6 +155,8 @@ HOOK_SITES = {
     "io.resident_callback": "tpu_sgd/optimize/resident_driver.py",
     "io.device_put": "tpu_sgd/optimize/streamed.py",
     "optimize.streamed.step": "tpu_sgd/optimize/streamed.py",
+    "replica.pull": "tpu_sgd/replica/store.py",
+    "replica.push": "tpu_sgd/replica/store.py",
     "checkpoint.save": "tpu_sgd/utils/checkpoint.py",
     "checkpoint.load": "tpu_sgd/utils/checkpoint.py",
     "serve.registry.reload": "tpu_sgd/serve/registry.py",

@@ -1,0 +1,42 @@
+"""Async elastic multi-replica training with a bounded-staleness
+parameter store (README "Async replicas"; arXiv:1505.04956 +
+SparCML-style compressed pushes via the PR 9 top-k/error-feedback
+wire).
+
+Layers, bottom-up:
+
+* ``staleness``  — the admission contract (``tau``; enforced at
+  push-accept, never at pull — ADVICE.md "Staleness is a contract,
+  not a tuning knob");
+* ``store``      — the device-resident, version-stamped parameter
+  store: lock-disciplined delta inbox, jitted donated apply, τ=0
+  barrier-and-combine (bitwise the synchronous data-parallel
+  trajectory), checkpointing with per-worker EF extras;
+* ``worker``     — one replica: pull → local shard gradient (the
+  shared ``_make_local_sums`` sampling recipe, shard index folded) →
+  push, under failpoint/retry healing;
+* ``membership`` — elastic fleet bookkeeping: join/leave/rejoin,
+  heartbeats, stragglers;
+* ``driver``     — the user-facing ``ReplicaDriver`` facade (a
+  ``TrainingSupervisor``-compatible optimizer surface).
+"""
+
+from tpu_sgd.replica.driver import ReplicaDriver, shard_rows
+from tpu_sgd.replica.membership import ReplicaMembership, WorkerRecord
+from tpu_sgd.replica.staleness import PushDecision, StalenessContract
+from tpu_sgd.replica.store import ParameterStore, PulledState, PushResult
+from tpu_sgd.replica.worker import ReplicaWorker, make_shard_local_sums
+
+__all__ = [
+    "ReplicaDriver",
+    "ReplicaMembership",
+    "ReplicaWorker",
+    "ParameterStore",
+    "PulledState",
+    "PushResult",
+    "PushDecision",
+    "StalenessContract",
+    "WorkerRecord",
+    "make_shard_local_sums",
+    "shard_rows",
+]

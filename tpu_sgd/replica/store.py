@@ -1,0 +1,553 @@
+"""Device-resident, version-stamped parameter store with bounded
+staleness.
+
+The store owns the three things the async fleet must agree on:
+
+* **the weights** — ONE device-resident array, replaced (never mutated)
+  by each applied update, so a pulled reference stays valid for as long
+  as the worker computes on it (which is exactly why the weights are
+  NOT donated to the apply program: pulls outlive applies — the
+  donated buffer is the pushed delta, which the store takes ownership
+  of at push);
+* **the version** — the number of applied optimization steps.  A pull
+  returns ``(weights, version)``; a push carries the ``basis_version``
+  it computed against and is admitted by the
+  :class:`~tpu_sgd.replica.staleness.StalenessContract` at APPLY time
+  (``head - basis <= tau``; ADVICE.md "Staleness is a contract, not a
+  tuning knob");
+* **the update rule** — workers push *gradient contributions*
+  ``(grad_sum, loss_sum, count)``, the store runs the updater.  This
+  is the store-side division of labor that makes ``tau = 0``
+  degenerate to the synchronous data-parallel path **bitwise**: a
+  τ=0 round barriers until every active worker's contribution is in,
+  sums them in shard order (the ``psum`` re-association), and applies
+  ONE combined update — the same local-sums programs, the same
+  combine order, the same updater math as the meshed
+  ``dp_step_fn`` path (pinned in ``tests/test_replica.py``).  Pushing
+  *applied deltas* instead would compose per-shard updater steps,
+  which no synchronous trajectory matches (documented in ADVICE.md).
+
+Async mode (``tau >= 1`` or unbounded): each admitted push applies
+immediately as its own update step — version increments per push, the
+step index ``version + 1`` drives the step-size decay, and the loss
+history records one entry per applied step through the SAME shared
+``observe_step`` bookkeeping the streamed drivers use.
+
+Compressed pushes (``wire_compress="topk:<frac>"``, the PR 9 wire):
+the worker normalizes its contribution to a batch-mean gradient,
+folds it through its persistent per-worker :class:`ErrorFeedback`
+accumulator, and ships only the top-k ``(indices, values)`` segment;
+the store scatter-adds segments and applies the mean.  EF state is
+OPTIMIZER STATE: it is registered here so :meth:`checkpoint_extras`
+can persist every worker's accumulator (``ef_<worker_id>``) and a
+rejoining worker re-attaches its dropped mass instead of losing it.
+
+Lock discipline: ONE condition (``_cond``) guards all mutable state —
+version/weights/inbox/membership mirror/EF registry — because the τ=0
+barrier needs to *wait* on round application, and a second lock would
+invite ordering bugs for zero concurrency win (applies must serialize
+anyway: version order is the contract).  Declared in
+``GRAFTLINT_LOCKS`` below and enforced by graftlint's lock-discipline
+rule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.io.sparse_wire import ErrorFeedback
+from tpu_sgd.obs.counters import inc, record_wire
+from tpu_sgd.obs.spans import event, span
+from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.replica.staleness import StalenessContract
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): every
+#: field below is read/written from N worker threads plus the driver's
+#: monitor thread; the barrier waits on ``_cond``, so the condition's
+#: lock is THE lock.  ``_apply_*`` jitted programs are write-once in
+#: ``__init__`` (construction-exempt) and immutable after.
+GRAFTLINT_LOCKS = {
+    "ParameterStore": {
+        "_w": "_cond",
+        "_version": "_cond",
+        "_reg_val": "_cond",
+        "_losses": "_cond",
+        "_inbox": "_cond",
+        "_inbox_order": "_cond",
+        "_active": "_cond",
+        "_clocks": "_cond",
+        "_ef": "_cond",
+        "_ef_pending": "_cond",
+        "_converged": "_cond",
+        "_stopped": "_cond",
+        "_pushes_accepted": "_cond",
+        "_pushes_rejected": "_cond",
+        "_pulls": "_cond",
+        "_max_accepted_staleness": "_cond",
+        "_t_last_apply": "_cond",
+    },
+}
+
+
+class PulledState(NamedTuple):
+    """One pull's snapshot: an immutable device weights reference plus
+    the version it is HEAD at.  ``done`` tells the worker the run is
+    over (budget exhausted, converged, or stopped) — no more pushes
+    will be admitted."""
+
+    weights: object
+    version: int
+    reg_val: float
+    done: bool
+
+
+class PushResult(NamedTuple):
+    """One push's outcome.  ``accepted=False, done=False`` means the
+    push was STALE (``staleness > tau``): the worker must re-pull and
+    recompute — the contract's whole point is that this work is
+    discarded, not applied late."""
+
+    accepted: bool
+    version: int
+    staleness: int
+    done: bool
+
+
+class ParameterStore:
+    """See module docstring.  Construct once per run; workers interact
+    through :meth:`pull` / :meth:`push` / :meth:`push_compressed` only.
+
+    ``resume_state``: a ``CheckpointManager.restore()`` dict — the
+    driver passes it so version / reg_val / loss history / per-worker
+    EF accumulators resume exactly (weights ride ``initial_weights``).
+    """
+
+    def __init__(
+        self,
+        updater,
+        config,
+        initial_weights,
+        *,
+        staleness=0,
+        device=None,
+        listener=None,
+        checkpoint_manager=None,
+        checkpoint_every: int = 10,
+        config_key: str = "",
+        resume_state: Optional[dict] = None,
+    ):
+        self.updater = updater
+        self.config = config
+        self.contract = (staleness
+                         if isinstance(staleness, StalenessContract)
+                         else StalenessContract(staleness))
+        self._device = device if device is not None else jax.devices()[0]
+        self._listener = listener
+        self._checkpoint_manager = checkpoint_manager
+        self._checkpoint_every = int(checkpoint_every)
+        self._config_key = config_key
+        self._cond = threading.Condition()
+
+        w = jnp.asarray(initial_weights)
+        if not jnp.issubdtype(w.dtype, jnp.inexact):
+            w = w.astype(jnp.float32)
+        self._w = jax.device_put(w, self._device)
+        self._dim = int(np.prod(self._w.shape))
+        # regVal probe init, exactly as every driver initializes it
+        _, rv0 = updater.compute(
+            self._w, jnp.zeros_like(self._w), 0.0,
+            jnp.asarray(1, jnp.int32), config.reg_param)
+        self._reg_val = float(rv0)
+        self._version = 0
+        self._losses: list = []
+        self._inbox: Dict[str, tuple] = {}
+        self._inbox_order: Dict[str, int] = {}
+        self._active: Dict[str, int] = {}
+        self._clocks: Dict[str, int] = {}
+        self._ef: Dict[str, ErrorFeedback] = {}
+        self._ef_pending: Dict[str, np.ndarray] = {}
+        self._converged = False
+        self._stopped = False
+        self._pushes_accepted = 0
+        self._pushes_rejected = 0
+        self._pulls = 0
+        self._max_accepted_staleness = 0
+        self._t_last_apply = time.perf_counter()
+
+        if resume_state is not None:
+            self._version = int(resume_state["iteration"])
+            self._reg_val = float(resume_state["reg_val"])
+            self._losses = list(np.asarray(resume_state["loss_history"],
+                                           np.float32))
+            for k, v in resume_state.get("extras", {}).items():
+                if k.startswith("ef_"):
+                    self._ef_pending[k[3:]] = np.asarray(v, np.float32)
+
+        cfg = config
+
+        def _apply_sums(w, g, l, c, i, rv):
+            # make_step's post-combine math, minus the psum (the store
+            # IS the combine): identical bits to the meshed sync step
+            has_batch = c > 0
+            safe_c = jnp.maximum(c, 1.0)
+            loss_i = l / safe_c + rv
+            new_w, new_reg = updater.compute(
+                w, g / safe_c, cfg.step_size, i, cfg.reg_param)
+            new_w = jnp.where(has_batch, new_w, w)
+            new_reg = jnp.where(has_batch, new_reg, rv)
+            return new_w, loss_i, new_reg
+
+        def _apply_mean(w, g, denom, l, c, i, rv):
+            # compressed wire: g is already a (mean of) batch-mean
+            # gradient approximation(s); only the loss needs the count
+            has_batch = c > 0
+            safe_c = jnp.maximum(c, 1.0)
+            loss_i = l / safe_c + rv
+            new_w, new_reg = updater.compute(
+                w, g / denom, cfg.step_size, i, cfg.reg_param)
+            new_w = jnp.where(has_batch, new_w, w)
+            new_reg = jnp.where(has_batch, new_reg, rv)
+            return new_w, loss_i, new_reg
+
+        def _acc3(g, l, c, gi, li, ci):
+            return g + gi, l + li, c + ci
+
+        def _scatter(g, idx, vals):
+            return g.at[idx].add(vals.astype(g.dtype))
+
+        # the DONATED apply: the pushed/accumulated delta buffer (g) is
+        # store-owned by protocol — the worker hands it off at push and
+        # never reads it again — so XLA may scribble the output into
+        # it.  The WEIGHTS are deliberately not donated: pulled
+        # references are still computing on them in worker threads.
+        self._apply_sums = jax.jit(_apply_sums, donate_argnums=1)
+        self._apply_mean = jax.jit(_apply_mean, donate_argnums=1)
+        self._acc3 = jax.jit(_acc3, donate_argnums=(0, 1, 2))
+        self._scatter = jax.jit(_scatter, donate_argnums=0)
+
+    # -- membership mirror --------------------------------------------------
+    def register_worker(self, worker_id: str, shard_index: int) -> None:
+        """Admit ``worker_id`` to the active set (the τ=0 barrier's
+        denominator and the progress bound's clock set).  A joining —
+        or REJOINING — worker's clock starts at the slowest active
+        worker's: a zero (or stale pre-death) clock would make every
+        faster worker progress-block until the newcomer ground through
+        the whole backlog, which is exactly the fleet-wide stall
+        elasticity exists to avoid; it resumes at the fleet's slowest
+        pace instead.  Re-registering a still-active worker is
+        idempotent (its clock is live)."""
+        with self._cond:
+            rejoining = worker_id not in self._active
+            self._active[worker_id] = int(shard_index)
+            if rejoining:
+                others = [self._clocks.get(w, 0) for w in self._active
+                          if w != worker_id]
+                self._clocks[worker_id] = min(others) if others else \
+                    self._clocks.get(worker_id, 0)
+            self._cond.notify_all()
+
+    def deregister_worker(self, worker_id: str) -> None:
+        """Remove a (dead or leaving) worker from the active set.  At
+        τ=0 this may complete a pending round — the remaining workers'
+        contributions apply rather than waiting forever on a corpse
+        (elasticity: the fleet never stalls on a death)."""
+        with self._cond:
+            self._active.pop(worker_id, None)
+            if self.contract.synchronous and self._round_complete_locked():
+                self._apply_payloads_locked(self._drain_inbox_locked())
+            self._cond.notify_all()
+
+    def error_feedback(self, worker_id: str, frac: float) -> ErrorFeedback:
+        """The per-worker EF accumulator for the compressed wire —
+        created on first request, re-attached (with its carried dropped
+        mass, or its checkpointed state) on rejoin/resume."""
+        with self._cond:
+            ef = self._ef.get(worker_id)
+            if ef is None:
+                ef = ErrorFeedback(self._dim, frac)
+                pending = self._ef_pending.pop(worker_id, None)
+                if pending is not None:
+                    ef.load_state(pending)
+                self._ef[worker_id] = ef
+            return ef
+
+    # -- the worker protocol ------------------------------------------------
+    def pull(self, worker_id: str = "") -> PulledState:
+        """Snapshot ``(weights, version, reg_val)`` at HEAD.  Never
+        blocks and never gates on staleness (the contract lives at
+        push-accept; see ``staleness.py``).  The returned weights are
+        an immutable device array — safe to compute on for as long as
+        the worker likes; only its eventual push pays for the lag."""
+        failpoint("replica.pull")
+        with self._cond:
+            self._pulls += 1
+            inc("replica.pull")
+            record_wire("dense-f32",
+                        logical_nbytes=int(self._w.nbytes),
+                        physical_nbytes=int(self._w.nbytes))
+            event("replica.pull", worker=worker_id,
+                  version=self._version)
+            return PulledState(self._w, self._version, self._reg_val,
+                               self._done_locked())
+
+    def push(self, worker_id: str, basis_version: int, grad_sum,
+             loss_sum, count) -> PushResult:
+        """One DENSE gradient-contribution push (the bitwise sync
+        wire).  ``grad_sum``/``loss_sum``/``count`` are the worker's
+        raw local sums — the store normalizes, exactly like the psum
+        path.  Blocks at τ=0 until the round containing this
+        contribution applies (or the run ends)."""
+        failpoint("replica.push")
+        g = jax.device_put(grad_sum, self._device)
+        l = jax.device_put(loss_sum, self._device)
+        c = jax.device_put(count, self._device)
+        record_wire("dense-f32",
+                    logical_nbytes=int(g.nbytes + l.nbytes + c.nbytes),
+                    physical_nbytes=int(g.nbytes + l.nbytes + c.nbytes))
+        return self._admit(worker_id, basis_version, ("sums", g, l, c))
+
+    def push_compressed(self, worker_id: str, basis_version: int,
+                        indices, values, loss_sum: float,
+                        count: float) -> PushResult:
+        """One COMPRESSED push: the top-k ``(indices, values)`` segment
+        of the worker's EF-folded batch-mean gradient (selected by the
+        worker's :class:`ErrorFeedback`, which already counted the wire
+        bytes), plus host-scalar loss/count.  Matched-final-loss, not
+        bitwise — the dropped mass ships on later pushes."""
+        failpoint("replica.push")
+        idx = jax.device_put(np.asarray(indices, np.int32), self._device)
+        vals = jax.device_put(np.asarray(values, np.float32),
+                              self._device)
+        return self._admit(worker_id, basis_version,
+                           ("topk", idx, vals, float(loss_sum),
+                            float(count)))
+
+    # -- internals ----------------------------------------------------------
+    def _admit(self, worker_id: str, basis_version: int,
+               payload: tuple) -> PushResult:
+        with self._cond:
+            if self._done_locked():
+                return PushResult(False, self._version, 0, True)
+            if (self.contract.bounded and not self.contract.synchronous
+                    and worker_id in self._active):
+                # the SSP PROGRESS bound, the basis bound's fairness
+                # twin: a worker more than τ accepted pushes ahead of
+                # the slowest active worker WAITS here.  Without it a
+                # tight bound self-selects the fastest worker — it
+                # re-pulls right after its own apply, so its next push
+                # is always freshest while everyone else's goes stale,
+                # and the fixed point drifts toward ITS shard's
+                # objective (measured ~5% off sync at τ=1 x 4 workers).
+                # The slowest active worker is never blocked, so the
+                # fleet always progresses; deaths deregister and
+                # re-evaluate (notify_all).
+                while (not self._done_locked()
+                       and worker_id in self._active
+                       and self._clocks.get(worker_id, 0)
+                       - min(self._clocks.get(w, 0)
+                             for w in self._active)
+                       >= self.contract.tau):
+                    self._cond.wait(timeout=0.5)
+                if self._done_locked():
+                    return PushResult(False, self._version, 0, True)
+            decision = self.contract.check(self._version,
+                                           int(basis_version))
+            if not decision.admissible:
+                self._pushes_rejected += 1
+                inc("replica.push.rejected")
+                event("replica.push", worker=worker_id,
+                      basis=int(basis_version),
+                      staleness=decision.staleness, accepted=False,
+                      version=self._version)
+                return PushResult(False, self._version,
+                                  decision.staleness, False)
+            self._pushes_accepted += 1
+            if decision.staleness > self._max_accepted_staleness:
+                self._max_accepted_staleness = decision.staleness
+            inc("replica.push.accepted")
+            event("replica.push", worker=worker_id,
+                  basis=int(basis_version),
+                  staleness=decision.staleness, accepted=True,
+                  version=self._version)
+            if self.contract.synchronous:
+                # τ=0: deposit into the round's inbox; the contribution
+                # that completes the round applies it (combined, shard
+                # order), everyone else waits for the version to move
+                self._inbox[worker_id] = payload
+                self._inbox_order[worker_id] = self._active.get(
+                    worker_id, 1 << 30)
+                if self._round_complete_locked():
+                    self._apply_payloads_locked(
+                        self._drain_inbox_locked())
+                else:
+                    basis = int(basis_version)
+                    while (self._version <= basis
+                           and not self._done_locked()
+                           and worker_id in self._inbox):
+                        self._cond.wait(timeout=0.5)
+                return PushResult(True, self._version, decision.staleness,
+                                  self._done_locked())
+            # async (τ >= 1 / unbounded): this push IS the next step
+            self._clocks[worker_id] = self._clocks.get(worker_id, 0) + 1
+            self._apply_payloads_locked([payload])
+            return PushResult(True, self._version, decision.staleness,
+                              self._done_locked())
+
+    def _round_complete_locked(self) -> bool:
+        return bool(self._active) and set(self._active) <= set(self._inbox)
+
+    def _drain_inbox_locked(self) -> list:
+        """Pop the round's contributions in SHARD order — the
+        deterministic combine order the τ=0 bitwise contract needs
+        (arrival order is thread-scheduling noise)."""
+        order = sorted(self._inbox,
+                       key=lambda k: (self._inbox_order.get(k, 1 << 30), k))
+        payloads = [self._inbox.pop(k) for k in order]
+        self._inbox_order.clear()
+        return payloads
+
+    def _apply_payloads_locked(self, payloads) -> None:
+        """Combine ``payloads`` (already admitted; shard order for a
+        τ=0 round) into ONE applied update: version += 1 and the shared
+        observed-loop bookkeeping (``observe_step`` — loss history,
+        listener event, convergence, checkpoint cadence)."""
+        from tpu_sgd.optimize.gradient_descent import observe_step
+
+        i = self._version + 1
+        i_dev = jnp.asarray(i, jnp.int32)
+        rv_dev = jnp.asarray(self._reg_val, jnp.float32)
+        with span("replica.apply", version=i, n_payloads=len(payloads)):
+            if payloads[0][0] == "sums":
+                _, g, l, c = payloads[0]
+                for _, gi, li, ci in payloads[1:]:
+                    g, l, c = self._acc3(g, l, c, gi, li, ci)
+                new_w, loss_i, new_reg = self._apply_sums(
+                    self._w, g, l, c, i_dev, rv_dev)
+                count = c
+            else:
+                g = jax.device_put(np.zeros((self._dim,), np.float32),
+                                   self._device)
+                l_host = 0.0
+                c_host = 0.0
+                for _, idx, vals, li, ci in payloads:
+                    g = self._scatter(g, idx, vals)
+                    l_host += li
+                    c_host += ci
+                new_w, loss_i, new_reg = self._apply_mean(
+                    self._w, g, jnp.asarray(len(payloads), jnp.float32),
+                    jnp.asarray(l_host, jnp.float32),
+                    jnp.asarray(c_host, jnp.float32), i_dev, rv_dev)
+                count = jnp.asarray(c_host, jnp.float32)
+            inc("replica.apply")
+            now = time.perf_counter()
+            dt, self._t_last_apply = now - self._t_last_apply, now
+            # the shared observed-loop bookkeeping — this store is the
+            # third consumer, after the two streamed drivers
+            self._w, self._reg_val, conv = observe_step(
+                i, self._w, new_w, loss_i, new_reg, count,
+                self._losses, self._reg_val, self.config,
+                listener=self._listener, wall_dt=dt,
+                save_cb=(self._save
+                         if self._checkpoint_manager is not None
+                         else None),
+                save_every=self._checkpoint_every,
+            )
+        self._version = i
+        if conv:
+            self._converged = True
+        self._cond.notify_all()
+
+    def _save(self, iteration: int, w_np, reg_val: float) -> None:
+        """Checkpoint the store: weights + version (the ``iteration``
+        field) + loss history + every worker's EF accumulator as
+        ``ef_<worker_id>`` extras.  Runs under ``_cond`` always: its
+        direct call site (``save_now``) holds it, and as
+        ``observe_step``'s ``save_cb`` it fires inside
+        ``_apply_payloads_locked``'s locked region."""
+        extras = ({f"ef_{wid}": ef.state()
+                   for wid, ef in self._ef.items()}
+                  or None)
+        self._checkpoint_manager.save(
+            iteration, np.asarray(w_np), reg_val,
+            np.asarray(self._losses), self._config_key,
+            extras=extras)
+
+    def _done_locked(self) -> bool:
+        return (self._version >= self.config.num_iterations
+                or self._converged or self._stopped)
+
+    # -- driver surface -----------------------------------------------------
+    def stop(self) -> None:
+        """Cooperative stop: wakes every τ=0 waiter and makes the next
+        pull/push report ``done`` — the preemption path's first half
+        (the driver then checkpoints via :meth:`save_now`)."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def save_now(self) -> None:
+        """Persist the CURRENT state (preemption / final save) through
+        the attached ``CheckpointManager`` — weights, version (as the
+        ``iteration`` field), reg_val, loss history, and every
+        registered worker's EF accumulator as ``ef_<worker_id>``
+        extras."""
+        with self._cond:
+            if self._checkpoint_manager is not None:
+                self._save(self._version, np.asarray(self._w),
+                           self._reg_val)
+
+    def wait_done(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until the run is done (budget / convergence / stop);
+        returns False on timeout."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._cond:
+            while not self._done_locked():
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=(0.5 if remaining is None
+                                         else min(0.5, remaining)))
+            return True
+
+    @property
+    def version(self) -> int:
+        with self._cond:
+            return self._version
+
+    @property
+    def weights(self):
+        with self._cond:
+            return self._w
+
+    @property
+    def converged(self) -> bool:
+        with self._cond:
+            return self._converged
+
+    def loss_history(self) -> np.ndarray:
+        with self._cond:
+            return np.asarray(self._losses, np.float32)
+
+    def snapshot(self) -> dict:
+        """Ops/bench snapshot: version, push/pull counters, the maximum
+        staleness any ACCEPTED push carried (the trace-level bound
+        assertion's cheap twin), and the active-worker count."""
+        with self._cond:
+            return {
+                "version": self._version,
+                "pulls": self._pulls,
+                "pushes_accepted": self._pushes_accepted,
+                "pushes_rejected": self._pushes_rejected,
+                "max_accepted_staleness": self._max_accepted_staleness,
+                "active_workers": len(self._active),
+                "converged": self._converged,
+                "stopped": self._stopped,
+            }

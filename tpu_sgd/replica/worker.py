@@ -1,0 +1,189 @@
+"""One async replica: pull → local shard gradient → push, repeatedly.
+
+A :class:`ReplicaWorker` owns one shard of the example axis (the same
+row-block layout ``parallel.data_parallel.shard_dataset`` gives shard
+``i`` of a mesh) staged once on ITS device, plus one compiled
+local-sums program built from the SHARED sampling recipe
+(``optimize.gradient_descent._make_local_sums`` with
+``shard_index=i``): the worker folds its static shard index into the
+sample key exactly where the meshed program folds ``axis_index``, so
+the per-shard sampled sequence is bit-identical to the synchronous
+data-parallel path's — the foundation of the τ=0 bitwise contract
+(``tpu_sgd/replica/store.py``).
+
+The loop is the async-SGD worker protocol (arXiv:1505.04956):
+
+1. ``pull`` HEAD ``(weights, version)`` from the store (never blocks);
+2. compute the shard's local ``(grad_sum, loss_sum, count)`` at
+   iteration ``version + 1`` — ONE program dispatch;
+3. ``push`` the contribution with ``basis_version = version``.  A
+   rejection (stale beyond the bound) discards the work and re-pulls;
+   at τ=0 the push blocks until the barrier round applies.
+
+Reliability: the ``replica.pull`` / ``replica.push`` failpoints fire at
+the protocol hops and heal in place under the worker's ``RetryPolicy``;
+an unretryable (or retry-exhausted) error kills the worker thread,
+which the elastic driver detects, deregisters, and rejoins
+(``tpu_sgd/replica/driver.py``).  The worker ticks a ``Heartbeat`` per
+cycle so the health monitor can spot stragglers.
+
+Compressed wire (``topk:<frac>``): the worker normalizes its
+contribution to a batch-mean gradient, folds it through its persistent
+per-worker :class:`~tpu_sgd.io.sparse_wire.ErrorFeedback` accumulator
+(registered with the STORE, so it checkpoints and survives rejoin),
+and ships only the top-k segment.  A rejected compressed push restores
+its extracted segment into the accumulator — staleness rejections must
+not leak gradient mass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_sgd.obs.spans import span
+
+
+def make_shard_local_sums(gradient, config, shard_index: int,
+                          with_valid: bool):
+    """The worker's one compiled program: its shard's per-iteration
+    LOCAL ``(grad_sum, loss_sum, count)`` — ``make_step``'s pre-psum
+    half, via the shared ``_make_local_sums`` recipe with the static
+    ``shard_index`` key fold (see module docstring).  ``fn(w, X, y, i)``
+    or ``fn(w, X, y, i, valid)``."""
+    from tpu_sgd.optimize.gradient_descent import _make_local_sums
+
+    key = jax.random.PRNGKey(config.seed)
+    local = _make_local_sums(gradient, config, key, None, None,
+                             shard_index=int(shard_index))
+    if with_valid:
+        return jax.jit(local)
+    return jax.jit(lambda w, X, y, i: local(w, X, y, i, None))
+
+
+class ReplicaWorker:
+    """See module docstring.  ``X_shard``/``y_shard`` are the worker's
+    HOST rows (staged to ``device`` once here); ``valid`` masks padding
+    rows exactly like the meshed path's ``shard_dataset`` mask."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        shard_index: int,
+        store,
+        gradient,
+        config,
+        X_shard,
+        y_shard,
+        valid=None,
+        *,
+        device=None,
+        retry_policy=None,
+        heartbeat=None,
+        wire_frac: Optional[float] = None,
+    ):
+        self.worker_id = worker_id
+        self.shard_index = int(shard_index)
+        self.store = store
+        self.config = config
+        self.device = device if device is not None else jax.devices()[0]
+        self.retry_policy = retry_policy
+        self.heartbeat = heartbeat
+        self._X = jax.device_put(np.asarray(X_shard), self.device)
+        self._y = jax.device_put(np.asarray(y_shard), self.device)
+        self._valid = (None if valid is None
+                       else jax.device_put(np.asarray(valid), self.device))
+        self._local_sums = make_shard_local_sums(
+            gradient, config, self.shard_index,
+            with_valid=self._valid is not None)
+        self.ef = (None if wire_frac is None
+                   else store.error_feedback(worker_id, wire_frac))
+        self.cycles = 0
+        self.rejected = 0
+
+    def _call(self, fn, *args):
+        if self.retry_policy is not None:
+            return self.retry_policy.call(fn, *args)
+        return fn(*args)
+
+    def run_once(self) -> bool:
+        """One pull → compute → push cycle; False when the run is done
+        (the worker's loop exits)."""
+        pulled = self._call(self.store.pull, self.worker_id)
+        if pulled.done:
+            return False
+        i = pulled.version + 1
+        w = pulled.weights
+        if w.devices() != {self.device}:
+            # the pull wire: HEAD weights hop to this worker's device
+            # (byte-exact copy — placement never changes the math)
+            w = jax.device_put(w, self.device)
+        # ONE span per cycle — compute, (compress,) and push all tag
+        # the 'replica' subsystem for the wire/dispatch counters; at
+        # τ=0 the push blocks on the round barrier, so the span
+        # duration honestly shows where a straggling fleet's wall
+        # clock goes
+        with span("replica.step", worker=self.worker_id,
+                  basis=pulled.version, i=i):
+            if self._valid is not None:
+                g, l, c = self._local_sums(
+                    w, self._X, self._y, jnp.asarray(i, jnp.int32),
+                    self._valid)
+            else:
+                g, l, c = self._local_sums(
+                    w, self._X, self._y, jnp.asarray(i, jnp.int32))
+            if self.ef is not None:
+                # compressed wire: batch-mean normalize HOST-side (EF
+                # state must accumulate at one scale), fold + select
+                # top-k.  This is the wire boundary: the segment
+                # selection runs in host numpy (the shape-trap rule),
+                # so the contribution comes home here — one bulk fetch
+                # plus its two scalars
+                c_host = float(c)
+                l_host = float(l)
+                if c_host <= 0.0:
+                    # empty sampled batch: the store's apply is a no-op
+                    # (has_batch gates the update), so folding the EF
+                    # accumulator here would extract mass an ACCEPTED
+                    # push then silently discards — ship an empty
+                    # segment instead (the push still advances the
+                    # protocol; the accumulator is untouched)
+                    idx = np.zeros((0,), np.int32)
+                    vals = np.zeros((0,), np.float32)
+                else:
+                    gn = np.asarray(g).reshape(-1) / max(c_host, 1.0)
+                    idx, vals = self.ef.compress(gn)
+                try:
+                    res = self._call(
+                        self.store.push_compressed, self.worker_id,
+                        pulled.version, idx, vals, l_host, c_host)
+                except BaseException:
+                    # the push never produced a result (retry budget
+                    # exhausted, or a kill): this worker may die and
+                    # REJOIN re-attached to the same accumulator — the
+                    # extracted mass must go back first, or every such
+                    # death leaks gradient
+                    self.ef.restore_segment(idx, vals)
+                    raise
+                if not res.accepted and not res.done:
+                    # stale push: the extracted mass must go back into
+                    # the accumulator or the rejection silently drops
+                    # gradient
+                    self.ef.restore_segment(idx, vals)
+            else:
+                res = self._call(self.store.push, self.worker_id,
+                                 pulled.version, g, l, c)
+        self.cycles += 1
+        if not res.accepted and not res.done:
+            self.rejected += 1
+        if self.heartbeat is not None:
+            self.heartbeat.beat()
+        return not res.done
+
+    def run(self) -> None:
+        """The worker main loop (the driver runs this on a thread)."""
+        while self.run_once():
+            pass
