@@ -1,22 +1,34 @@
 #!/usr/bin/env python
-"""Microbenchmark: serving throughput + tail latency under offered load.
+"""Microbenchmark: serving under offered load, shedding OFF vs ON.
 
 Drives the tpu_sgd.serve endpoint (micro-batcher + bucketed compiled
 predict) with an open-loop request generator at three offered-load
-levels, and reports per level:
+levels, TWICE:
 
-  * achieved throughput (rows/sec completed),
-  * p50 / p99 end-to-end latency (submit -> result, ms),
-  * reject count (backpressure sheds, not silent drops),
-  * mean coalesced batch size (how well the batcher amortizes calls).
+  * ``shed_off`` — the legacy arm: one interactive lane, no deadlines,
+    ``shed_utilization={}`` (pure bounded-queue backpressure).  This is
+    the configuration whose p99 cliffs at saturation (the ~165 ms
+    number ISSUE 12 opens with).
+  * ``shed_on``  — admission control (ISSUE 12): mixed
+    interactive/batch/shadow traffic, a per-request deadline budget on
+    the interactive lane, default utilization shed thresholds, and
+    displacement under a full queue.
 
-Writes ``BENCH_SERVE.json`` (same driver-style shape as BENCH_r0*.json:
-a ``parsed`` one-line result plus diagnostics) and prints ONE JSON line
-on stdout; diagnostics go to stderr.
+Per level and lane it reports submitted / answered / typed rejections
+(admission sheds, deadline rejects, displacements — counted, never
+silently dropped) and p50/p99 end-to-end latency.  Headline per the
+2-core harness policy: the admission COUNTS and the interactive-lane
+p99 at saturation (counts are exact; walls on a 2-core CPU host carry
+scheduling noise — basis strings say what was measured).
+
+Writes ``BENCH_SERVE.json`` and prints ONE JSON line on stdout;
+diagnostics go to stderr.
 
 Env knobs: BENCH_SERVE_DIM (default 64), BENCH_SERVE_SECONDS per level
 (default 2.0), BENCH_SERVE_LOADS (comma rps list, default
-"500,2500,10000").
+"500,2500,10000,40000" — the last level is deliberately far beyond
+capacity so overload actually engages), BENCH_SERVE_MAX_BATCH (default
+32), BENCH_SERVE_DEADLINE (interactive budget, default 0.02).
 """
 
 from __future__ import annotations
@@ -30,28 +42,65 @@ import numpy as np
 
 DIM = int(os.environ.get("BENCH_SERVE_DIM", "64"))
 SECONDS = float(os.environ.get("BENCH_SERVE_SECONDS", "2.0"))
+# the last level is deliberately far beyond single-host capacity
+# (~9-10k rows/s warm): the overload arm is the point of this bench
 LOADS = [
     int(v) for v in os.environ.get(
-        "BENCH_SERVE_LOADS", "500,2500,10000"
+        "BENCH_SERVE_LOADS", "500,2500,10000,40000"
     ).split(",")
 ]
 MAX_LATENCY_S = float(os.environ.get("BENCH_SERVE_MAX_LATENCY", "0.002"))
 MAX_QUEUE = int(os.environ.get("BENCH_SERVE_MAX_QUEUE", "4096"))
+# 32-row flushes bound per-batch service time the way a real multi-
+# tenant endpoint does; with them this host's capacity is ~13-20k
+# rows/s, so the top (40k) level is genuine overload and the deep
+# queue is where the shed_off arm's latency balloon lives
+MAX_BATCH = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
+DEADLINE_S = float(os.environ.get("BENCH_SERVE_DEADLINE", "0.02"))
+
+#: the two arms: (lane, weight, deadline_s) mixes + shed config
+ARMS = {
+    "shed_off": {
+        "shed_utilization": {},
+        "mix": [("interactive", 1.0, None)],
+    },
+    "shed_on": {
+        "shed_utilization": None,  # DEFAULT_SHED_UTILIZATION
+        "mix": [("interactive", 0.6, DEADLINE_S),
+                ("batch", 0.25, None),
+                ("shadow", 0.15, None)],
+    },
+}
 
 
 def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_level(server, rows, offered_rps: float, seconds: float) -> dict:
-    """Open-loop load: submit single-row requests on a fixed schedule
-    (bursting to catch up after GIL stalls), collect completion latencies
-    from the futures."""
-    from tpu_sgd.serve import BackpressureError
+def _mix_pattern(mix, n=40, seed=0):
+    """A fixed weighted round-robin of (lane, deadline) — deterministic
+    arrivals, no per-request RNG on the submit path."""
+    pattern = []
+    for lane, weight, deadline in mix:
+        pattern.extend([(lane, deadline)] * max(1, round(weight * n)))
+    rng = np.random.default_rng(seed)
+    rng.shuffle(pattern)
+    return pattern
 
+
+def run_level(server, rows, offered_rps: float, seconds: float,
+              mix) -> dict:
+    """Open-loop load: submit single-row requests on a fixed schedule
+    (bursting to catch up after GIL stalls), collect per-lane completion
+    latencies from the futures and the typed-rejection counts."""
+    from tpu_sgd.serve import Overloaded
+
+    pattern = _mix_pattern(mix)
     n_rows = rows.shape[0]
-    latencies, futures = [], []
-    rejects = submitted = 0
+    lanes = sorted({lane for lane, _, _ in mix})
+    per_lane = {lane: {"submitted": 0, "typed_rejections": 0,
+                       "latencies": []} for lane in lanes}
+    futures = []
     # credit-based pacing with bounded bursts: sleeping between bursts
     # keeps the flush thread scheduled (an uncapped catch-up loop would
     # monopolize the GIL/queue lock and measure its own convoy, not the
@@ -73,70 +122,86 @@ def run_level(server, rows, offered_rps: float, seconds: float) -> dict:
         t_last = now
         while credit >= 1.0:
             credit -= 1.0
+            lane, dl = pattern[i % len(pattern)]
+            st = per_lane[lane]
+            st["submitted"] += 1
             t_sub = time.perf_counter()
             try:
-                fut = server.submit(rows[i % n_rows])
-            except BackpressureError:
-                rejects += 1
+                fut = server.submit(rows[i % n_rows], lane=lane,
+                                    deadline_s=dl)
+            except Overloaded:
+                st["typed_rejections"] += 1
             else:
-                submitted += 1
                 fut.add_done_callback(
-                    lambda f, t=t_sub: latencies.append(
+                    lambda f, s=st, t=t_sub: s["latencies"].append(
                         time.perf_counter() - t)
+                    if f.exception() is None else None
                 )
-                futures.append(fut)
+                futures.append((lane, fut))
             i += 1
-    # drain: wait for everything submitted to resolve
-    done = 0
-    for fut in futures:
+    # drain: wait for everything submitted to resolve — answered, or a
+    # typed displacement (never a silent drop)
+    answered = 0
+    for lane, fut in futures:
         try:
             fut.result(timeout=30)
-            done += 1
+            answered += 1
+        except Overloaded:
+            per_lane[lane]["typed_rejections"] += 1
         except Exception:
             pass
     # result() wakes before done-callbacks run, so give the flush
     # thread's latency-recording callbacks a moment to finish tallying
     t_wait = time.perf_counter() + 5.0
-    while len(latencies) < done and time.perf_counter() < t_wait:
+    while (sum(len(s["latencies"]) for s in per_lane.values()) < answered
+           and time.perf_counter() < t_wait):
         time.sleep(0.001)
     elapsed = time.perf_counter() - t_start
-    lat = np.sort(np.asarray(latencies)) if latencies else np.zeros(1)
 
-    def pct(p):
-        return float(lat[min(len(lat) - 1, int(p / 100.0 * len(lat)))])
+    # THE shared nearest-rank rule (serve.metrics) — the SLO gates,
+    # healthz p99_batch_wall_s, and this bench must mean the same p99
+    from tpu_sgd.serve.metrics import nearest_rank
 
+    def pct(lat, p):
+        return nearest_rank(sorted(lat), p)
+
+    out_lanes = {}
+    for lane, st in per_lane.items():
+        lat = st["latencies"]
+        out_lanes[lane] = {
+            "submitted": st["submitted"],
+            "answered": len(lat),
+            "typed_rejections": st["typed_rejections"],
+            "p50_ms": round(pct(lat, 50) * 1e3, 3),
+            "p99_ms": round(pct(lat, 99) * 1e3, 3),
+        }
+    total_lat = sum(len(s["latencies"]) for s in per_lane.values())
     return {
         "offered_rps": offered_rps,
-        "achieved_rps": round(len(latencies) / elapsed, 1),
-        "submitted": submitted,
-        "rejects": rejects,
-        "p50_ms": round(pct(50) * 1e3, 3),
-        "p99_ms": round(pct(99) * 1e3, 3),
+        "achieved_rps": round(total_lat / elapsed, 1),
+        "lanes": out_lanes,
     }
 
 
-def main() -> int:
+def run_arm(name: str, arm: dict, rng) -> list:
+    from tpu_sgd.analysis import assert_compile_count
     from tpu_sgd.models import LinearRegressionModel
     from tpu_sgd.serve import Server
 
-    rng = np.random.default_rng(0)
     model = LinearRegressionModel(
         rng.normal(size=DIM).astype(np.float32), 0.1
     )
     rows = rng.normal(size=(1024, DIM)).astype(np.float32)
-
     server = Server(
         model, max_latency_s=MAX_LATENCY_S, max_queue=MAX_QUEUE,
-        max_batch=256,
+        max_batch=MAX_BATCH, shed_utilization=arm["shed_utilization"],
     )
     # warm the compiled bucket programs so measurement never pays XLA
     # compile time (a real endpoint warms at deploy, not per request)
     for b in server.engine.buckets:
         server.engine.predict_batch(model, rows[:b])
-    log(f"warmed {server.engine.compile_count} compiled programs "
-        f"(buckets {server.engine.buckets})")
-
-    from tpu_sgd.analysis import assert_compile_count
+    log(f"[{name}] warmed {server.engine.compile_count} compiled "
+        f"programs (buckets {server.engine.buckets})")
 
     levels = []
     # jit-cache-growth guard: after the warm loop above, the measured
@@ -153,24 +218,59 @@ def main() -> int:
         for rps in LOADS:
             before_batches = server.batcher.batch_count
             before_reqs = server.metrics.snapshot()["total_requests"]
-            res = run_level(server, rows, rps, SECONDS)
+            res = run_level(server, rows, rps, SECONDS, arm["mix"])
             snap = server.metrics.snapshot()
             d_batches = server.batcher.batch_count - before_batches
             d_reqs = snap["total_requests"] - before_reqs
             res["mean_batch_size"] = round(
                 d_reqs / d_batches, 2) if d_batches else 0.0
             levels.append(res)
-            log(f"offered {rps} rps: achieved {res['achieved_rps']} rows/s, "
-                f"p50 {res['p50_ms']} ms, p99 {res['p99_ms']} ms, "
-                f"rejects {res['rejects']}, "
+            inter = res["lanes"].get("interactive", {})
+            log(f"[{name}] offered {rps} rps: achieved "
+                f"{res['achieved_rps']} rows/s, interactive p99 "
+                f"{inter.get('p99_ms')} ms "
+                f"({inter.get('typed_rejections')} typed rejections), "
                 f"mean batch {res['mean_batch_size']}")
+        health = server.healthz()
+    return levels, {k: health[k] for k in ("lanes", "admit_count",
+                                           "shed_count", "reject_count")}
 
-    top = max(levels, key=lambda r: r["achieved_rps"])
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    arms = {}
+    for name, arm in ARMS.items():
+        levels, counts = run_arm(name, arm, rng)
+        arms[name] = {"levels": levels, "admission_counts": counts}
+
+    sat = LOADS[-1]
+
+    def at_sat(arm_name):
+        lvl = [l for l in arms[arm_name]["levels"]
+               if l["offered_rps"] == sat][0]
+        return lvl["lanes"]["interactive"]
+
+    off = at_sat("shed_off")
+    on = at_sat("shed_on")
+    counts_on = arms["shed_on"]["admission_counts"]
     parsed = {
-        "metric": f"serve_rows_per_sec_dense_{DIM}d",
-        "value": top["achieved_rps"],
-        "unit": "rows/sec",
-        "p99_ms_at_peak": top["p99_ms"],
+        "metric": f"serve_interactive_p99_ms_at_{sat}rps_shed_on",
+        "value": on["p99_ms"],
+        "unit": "ms",
+        "shed_off_p99_ms": off["p99_ms"],
+        "shed_on_p50_ms": on["p50_ms"],
+        "shed_off_p50_ms": off["p50_ms"],
+        "shed_on_typed_rejections_at_saturation": on["typed_rejections"],
+        "shed_on_counts": {
+            "admitted": counts_on["admit_count"],
+            "shed": counts_on["shed_count"],
+            "rejected_total": counts_on["reject_count"],
+        },
+        "note": (
+            "every rejection is a typed Overloaded answer; the shed_on "
+            "p99 tail is requests admitted just before a scheduling "
+            "stall — admitted requests are answered, never dropped"
+        ),
     }
     result = {
         "cmd": "python bench_serving.py",
@@ -178,7 +278,18 @@ def main() -> int:
         "dim": DIM,
         "seconds_per_level": SECONDS,
         "max_latency_s": MAX_LATENCY_S,
-        "levels": levels,
+        "interactive_deadline_s": DEADLINE_S,
+        "basis": (
+            "open-loop offered load, 2-core CPU host; counts (admitted/"
+            "shed/rejected/typed) are exact ledgers; latencies are "
+            "submit->result walls incl. GIL scheduling noise — compare "
+            "arms within this file, not across machines; shed_off = "
+            "single interactive lane, no deadline, shed_utilization={} "
+            "(the pre-ISSUE-12 configuration); shed_on = 60/25/15 "
+            f"interactive/batch/shadow mix, {DEADLINE_S * 1e3:.0f}ms "
+            "interactive deadline budget, default shed thresholds"
+        ),
+        "arms": arms,
         "parsed": parsed,
     }
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -74,6 +74,13 @@ DEFAULT_SLOS = {"slos": [
      "counter": "train.io_callback", "min": 1},
     {"name": "replica-pushes-counted", "metric": "counter",
      "counter": "replica.push.accepted", "min": 1},
+    # the overload-burst phase (2b) deliberately drowns a 16-deep queue,
+    # so the premium lane DOES shed there — but displacement (shadow and
+    # batch evicted first) must keep its typed-rejection fraction under
+    # the bound while the low lanes absorb the loss (ISSUE 12; the
+    # scenario harness gates the tighter production bound of 0.5)
+    {"name": "serve-sheds-bounded", "metric": "lane_shed_fraction",
+     "lane": "interactive", "max": 0.9},
 ]}
 
 
@@ -447,9 +454,11 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         deadline = Deadline(300.0)
         rep_iters = max(24, iters)
 
-        def _make_replica(tau, retry=None, rejoin_seed=None):
+        def _make_replica(tau, retry=None, rejoin_seed=None,
+                          iters=None):
             drv = (ReplicaDriver()
-                   .set_num_iterations(rep_iters).set_step_size(0.1)
+                   .set_num_iterations(iters if iters is not None
+                                       else rep_iters).set_step_size(0.1)
                    .set_mini_batch_fraction(1.0)
                    .set_convergence_tol(0.0).set_reg_param(0.01)
                    .set_seed(7).set_workers(4).set_staleness(tau))
@@ -498,15 +507,21 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
 
         # aim the one-shot kill mid-run: pushes ~= applied versions at
         # τ>=1 (each accepted push IS one version), so hit N/2 lands in
-        # the middle of the sweep
-        kill_drv = _make_replica(2, rejoin_seed=seed + 43)
+        # the middle of the sweep.  The kill cell runs 4x the budget:
+        # the rejoin is a RACE against the surviving workers' remaining
+        # work (death detection + seeded backoff ≈ tens of ms, and a
+        # fleet that finishes first never rejoins), so the post-kill
+        # runway must dwarf that window or this phase flakes under load
+        kill_iters = 4 * rep_iters
+        kill_drv = _make_replica(2, rejoin_seed=seed + 43,
+                                 iters=kill_iters)
         with inject_faults(
                 {"replica.push": fp.fail_nth(rep_iters // 2)}):
             w_rk, h_rk = kill_drv.optimize_with_history((X, y), w0)
         deadline.check("replica kill/rejoin chaos phase")
         snap = kill_drv.last_store_snapshot
         members = kill_drv.last_membership_snapshot
-        assert snap["version"] == rep_iters, snap
+        assert snap["version"] == kill_iters, snap
         assert snap["max_accepted_staleness"] <= 2, snap
         assert any(m["joins"] > 1 for m in members.values()), (
             f"no replica worker ever rejoined: {members}")
@@ -589,6 +604,72 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         summary["breaker"] = health["registry"]["breaker"]
         say(f"serving: {answered} answered correctly, {rejected} shed "
             f"by injected admission faults, breaker={summary['breaker']}")
+
+        # ---- phase 2b: overload burst with serve.admit armed -------------
+        # admission control under fire: a 300-request burst drowns a
+        # deliberately tiny endpoint (16-deep queue, 8-row batches)
+        # across all three priority lanes while the serve.admit
+        # failpoint (which fires BEFORE any queue mutation, so a healed
+        # retry replays nothing twice) randomly rejects arrivals.  The
+        # invariant is the typed-rejection ledger: every one of the 300
+        # submissions is answered, typed-Overloaded (shed / queue_full /
+        # displaced), or FaultInjected — no hangs, no silent drops.
+        from tpu_sgd.serve import Overloaded
+
+        deadline = Deadline(120.0)
+        burst_faults = {"serve.admit": fail_prob(0.2, seed=seed + 8)}
+        b_answered = b_overloaded = b_faulted = 0
+        burst_n = 300
+        lanes_cycle = ("interactive", "interactive", "batch", "shadow")
+        with inject_faults(burst_faults):
+            with Server(LinearRegressionModel(w_ref, 0.0), max_batch=8,
+                        max_latency_s=0.001, max_queue=16,
+                        event_log=event_log) as bsrv:
+                bfuts = []
+                for i in range(burst_n):
+                    deadline.check("overload burst submit loop")
+                    lane = lanes_cycle[i % len(lanes_cycle)]
+                    try:
+                        bfuts.append(bsrv.submit(
+                            Xq[i % Xq.shape[0]], lane=lane,
+                            deadline_s=(0.25 if lane == "interactive"
+                                        else None)))
+                    except fp.FaultInjected:
+                        b_faulted += 1  # injected admission fault: typed
+                    except Overloaded as e:
+                        assert e.reason in ("queue_full", "deadline",
+                                            "shed"), e.reason
+                        b_overloaded += 1
+                for f in bfuts:
+                    try:
+                        got = np.asarray(f.result(timeout=30))  # no-hang
+                        assert np.all(np.isfinite(got))
+                        b_answered += 1
+                    except Overloaded as e:  # displaced: typed answer
+                        assert e.reason == "displaced", e.reason
+                        b_overloaded += 1
+                burst_health = bsrv.healthz()
+            assert fp.hits("serve.admit") > 0, (
+                "the serve.admit hook site was never reached")
+        deadline.check("overload burst phase")
+        assert b_answered + b_overloaded + b_faulted == burst_n, (
+            f"burst ledger does not conserve: {b_answered} answered + "
+            f"{b_overloaded} typed + {b_faulted} faulted != {burst_n}")
+        assert b_answered > 0, "the burst answered nothing"
+        assert b_overloaded > 0, (
+            "a 300-request burst at a 16-deep queue shed nothing — "
+            "admission control never engaged")
+        lane_counts = burst_health["lanes"]
+        assert burst_health["shed_count"] + burst_health["reject_count"] > 0
+        summary["burst"] = {
+            "answered": b_answered, "typed_rejections": b_overloaded,
+            "admission_faults": b_faulted,
+            "lanes": {k: {kk: vv for kk, vv in v.items() if kk != "depth"}
+                      for k, v in lane_counts.items()},
+        }
+        say(f"overload burst: {b_answered} answered, {b_overloaded} "
+            f"typed rejections, {b_faulted} injected admission faults "
+            f"— ledger conserved, no hangs")
 
         # ---- phase 3: event log survives a torn tail ---------------------
         if trace_path is not None:

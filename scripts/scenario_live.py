@@ -1,0 +1,68 @@
+"""Live production scenario: serving under admission control while the
+replica fleet retrains, hot-reloads, and loses a worker — SLO-gated.
+
+The executable face of ``tpu_sgd/scenario`` (ROADMAP item 1, ISSUE 12):
+one seeded run drives an open-loop traffic schedule (warm → overload
+burst → cool; mixed dense/sparse/multinomial requests across
+interactive/batch/shadow priority lanes) at three serving endpoints
+while a bounded-staleness replica fleet retrains on a drifting stream
+with compressed pushes, one worker is killed and rejoined mid-run, and
+the registry hot-reloads each fresh checkpoint under the traffic.
+
+The run's single JSONL trace then feeds ``python -m tpu_sgd.obs.report
+--slo`` and the report's exit code is THIS script's exit code:
+
+* 0 — every SLO holds: per-lane p99 bounds, interactive-lane shed
+  fraction bounded, served-weight staleness bounded, ZERO dropped
+  requests (every submission answered or typed-rejected), >= 2 hot
+  reloads, the worker rejoined;
+* 1 — an SLO was violated;
+* 2 — usage/parse error.
+
+Usage::
+
+    python scripts/scenario_live.py --smoke [--seed 0] [--out DIR]
+    python scripts/scenario_live.py                     # full-size run
+    python scripts/scenario_live.py --smoke --violate interactive-p99
+                                                        # MUST exit 1
+
+``--violate <slo-name>`` deliberately breaks one SLO bound so CI can
+prove the gate fails a bad run (tests/test_scenario.py pins both exit
+codes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale seeded run (the CI spelling)")
+    ap.add_argument("--out", metavar="DIR", default=None,
+                    help="keep trace/SLO/Chrome/summary artifacts here "
+                         "(default: temp dir, discarded)")
+    ap.add_argument("--violate", metavar="SLO_NAME", default=None,
+                    help="deliberately break one named SLO bound; the "
+                         "run must then exit 1")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    # registry/driver warnings are expected noise under live reload
+    logging.basicConfig(level=logging.ERROR)
+
+    from tpu_sgd.scenario import run_scenario
+
+    return run_scenario(seed=args.seed, smoke=args.smoke,
+                        out_dir=args.out, violate=args.violate,
+                        verbose=not args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

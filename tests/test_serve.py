@@ -21,6 +21,7 @@ from tpu_sgd.serve import (
     MicroBatcher,
     ModelRegistry,
     NoModelError,
+    Overloaded,
     PredictEngine,
     Server,
 )
@@ -554,6 +555,297 @@ def test_batch_failure_fails_futures_not_server(rng):
         fut2 = batcher.submit(np.zeros(4, np.float32))
         with pytest.raises(RuntimeError, match="bad batch"):
             fut2.result(timeout=10)
+
+
+# -- admission control: lanes, deadlines, shedding (ISSUE 12) --------------
+def _engine_batcher(rng, model=None, **kw):
+    model = model if model is not None else _linear_model(rng)
+    engine = PredictEngine()
+    return MicroBatcher(
+        lambda X: engine.predict_batch(model, X), **kw
+    ), model
+
+
+def test_queue_full_rejection_is_typed_overloaded(rng):
+    """The legacy backpressure case now answers with the typed
+    Overloaded (still a BackpressureError, so old callers keep
+    working), naming the rule and the lane."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=1.0, max_queue=4)
+    x = rng.normal(size=12).astype(np.float32)
+    futs = [batcher.submit(x) for _ in range(4)]
+    with pytest.raises(Overloaded) as ei:
+        batcher.submit(x)
+    assert isinstance(ei.value, BackpressureError)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.lane == "interactive"
+    assert batcher.reject_count == 1
+    with batcher:
+        pass  # drain answers the queued four
+    assert all(np.isfinite(f.result(timeout=10)) for f in futs)
+
+
+def test_unknown_lane_rejected_eagerly(rng):
+    batcher, _ = _engine_batcher(rng)
+    with pytest.raises(ValueError, match="unknown lane"):
+        batcher.submit(np.zeros(12, np.float32), lane="premium")
+
+
+def test_priority_inversion_impossible_by_construction(rng):
+    """A FULL batch-lane queue cannot starve an interactive arrival:
+    every flush drains the interactive lane first, so the interactive
+    request boards the FIRST batch even though max_batch batch-lane
+    requests were queued ahead of it in time."""
+    model = _linear_model(rng)
+    engine = PredictEngine()
+    first_batch_rows = []
+
+    def recording(X):
+        if not first_batch_rows:
+            first_batch_rows.append(np.asarray(X))
+        return engine.predict_batch(model, X)
+
+    batcher = MicroBatcher(recording, max_batch=4, max_latency_s=0.01)
+    slow = rng.normal(size=(4, 12)).astype(np.float32)
+    urgent = np.full(12, 9.0, np.float32)
+    for i in range(4):  # the batch lane fills a whole flush...
+        batcher.submit(slow[i], lane="batch")
+    fut = batcher.submit(urgent, lane="interactive")  # ...then this lands
+    with batcher:
+        got = fut.result(timeout=10)
+    assert np.isfinite(got)
+    # the interactive row rode the FIRST flush, at position 0
+    np.testing.assert_array_equal(first_batch_rows[0][0], urgent)
+    assert first_batch_rows[0].shape[0] == 4  # still a full batch
+
+
+def test_utilization_shedding_sheds_low_lanes_first(rng):
+    """Default thresholds: shadow sheds at 50% utilization, batch at
+    75%, interactive never (queue-full is its only limit)."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=1.0, max_queue=8)
+    x = rng.normal(size=12).astype(np.float32)
+    futs = [batcher.submit(x) for _ in range(4)]  # depth 4 = 50%
+    with pytest.raises(Overloaded) as ei:
+        batcher.submit(x, lane="shadow")
+    assert ei.value.reason == "shed" and ei.value.lane == "shadow"
+    futs.append(batcher.submit(x, lane="batch"))  # 50% < 75%: admitted
+    futs.append(batcher.submit(x, lane="batch"))  # depth 6 = 75%...
+    with pytest.raises(Overloaded) as ei:
+        batcher.submit(x, lane="batch")  # ...so batch sheds now
+    assert ei.value.reason == "shed" and ei.value.lane == "batch"
+    futs.append(batcher.submit(x))  # interactive still admits
+    counts = batcher.lane_snapshot()
+    assert counts["shadow"]["shed"] == 1
+    assert counts["batch"]["shed"] == 1
+    assert counts["interactive"]["admitted"] == 5
+    with batcher:
+        pass
+    assert all(np.isfinite(f.result(timeout=10)) for f in futs)
+
+
+def test_shedding_disabled_restores_pure_backpressure(rng):
+    """shed_utilization={} is the legacy A/B arm: nothing sheds below
+    queue-full."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=1.0, max_queue=4,
+        shed_utilization={})
+    x = rng.normal(size=12).astype(np.float32)
+    futs = [batcher.submit(x, lane="shadow") for _ in range(4)]
+    with pytest.raises(Overloaded) as ei:  # full, same-lane: no victim
+        batcher.submit(x, lane="shadow")
+    assert ei.value.reason == "queue_full"
+    with batcher:
+        pass
+    assert all(np.isfinite(f.result(timeout=10)) for f in futs)
+
+
+def test_deadline_early_rejection_prices_against_p99_wall(rng):
+    """A request whose budget cannot cover the predicted wait (rolling
+    p99 batch wall x batches ahead) is rejected at enqueue; a generous
+    budget passes; and with a COLD wall window nothing is rejected."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=4, max_latency_s=1.0, max_queue=64)
+    x = rng.normal(size=12).astype(np.float32)
+    # cold window: deadline requests admit freely (warm-up must not
+    # reject on zero evidence)
+    fut = batcher.submit(x, deadline_s=1e-6)
+    with batcher._cond:  # seed the predictor: 50ms p99 batch wall
+        # (the cached p99 is recomputed once per flush; seeding the
+        # window alone would leave the cold-start 0.0 in place)
+        from tpu_sgd.serve.metrics import nearest_rank
+
+        batcher._flush_walls.extend([0.05] * 8)
+        batcher._p99_wall = nearest_rank(
+            sorted(batcher._flush_walls), 99)
+    with pytest.raises(Overloaded) as ei:
+        batcher.submit(x, deadline_s=0.01)  # 10ms budget < 50ms wall
+    assert ei.value.reason == "deadline"
+    fut2 = batcher.submit(x, deadline_s=10.0)  # generous budget: admitted
+    assert batcher.lane_snapshot()["interactive"]["rejected"] == 1
+    with batcher:
+        pass
+    assert np.isfinite(fut.result(timeout=10))
+    assert np.isfinite(fut2.result(timeout=10))
+
+
+def test_admitted_request_with_expired_deadline_is_answered(rng):
+    """Reject at admission, never at completion (ADVICE.md): a request
+    admitted with positive slack whose deadline expires WHILE QUEUED is
+    still answered — the wait is sunk cost; dropping it at completion
+    would make the spent latency pure waste."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=0.01, max_queue=8)
+    x = rng.normal(size=12).astype(np.float32)
+    fut = batcher.submit(x, deadline_s=0.05)  # positive budget at enqueue
+    time.sleep(0.12)  # ...which is long gone now
+    with batcher:
+        got = fut.result(timeout=10)
+    assert np.isfinite(got)  # answered, not shed
+
+
+def test_displacement_evicts_newest_lowest_lane_with_typed_answer(rng):
+    """Full queue + higher-priority arrival: the newest request of the
+    lowest queued lane is evicted with a typed Overloaded answer and
+    the arrival takes its slot."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=1.0, max_queue=3,
+        shed_utilization={})
+    x = rng.normal(size=12).astype(np.float32)
+    shadow = [batcher.submit(x, lane="shadow") for _ in range(3)]
+    urgent = batcher.submit(x, lane="interactive")  # displaces newest shadow
+    with pytest.raises(Overloaded) as ei:
+        shadow[2].result(timeout=10)
+    assert ei.value.reason == "displaced" and ei.value.lane == "shadow"
+    counts = batcher.lane_snapshot()
+    # displaced is its OWN bucket (the request was also admitted — a
+    # shared shed bucket would double-count it in offered totals)
+    assert counts["shadow"]["displaced"] == 1
+    assert counts["shadow"]["shed"] == 0
+    assert counts["shadow"]["admitted"] == 3
+    assert counts["interactive"]["admitted"] == 1
+    with batcher:
+        pass
+    assert np.isfinite(urgent.result(timeout=10))
+    assert all(np.isfinite(f.result(timeout=10)) for f in shadow[:2])
+    # a shadow arrival at a shadow-full queue finds no LOWER lane: the
+    # arrival itself is rejected, never a peer
+    batcher2, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=1.0, max_queue=2,
+        shed_utilization={})
+    keep = [batcher2.submit(x, lane="shadow") for _ in range(2)]
+    with pytest.raises(Overloaded) as ei:
+        batcher2.submit(x, lane="shadow")
+    assert ei.value.reason == "queue_full"
+    with batcher2:
+        pass
+    assert all(np.isfinite(f.result(timeout=10)) for f in keep)
+
+
+def test_cancelled_futures_during_shed_wave_dont_kill_flush(rng):
+    """Clients bailing out (cancelling) while displacement is evicting
+    around them must neither crash the displacement path nor the flush
+    thread."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=512, max_latency_s=0.01, max_queue=3,
+        shed_utilization={})
+    x = rng.normal(size=12).astype(np.float32)
+    shadow = [batcher.submit(x, lane="shadow") for _ in range(3)]
+    assert shadow[2].cancel()  # client gave up on the NEWEST shadow...
+    urgent = batcher.submit(x, lane="interactive")
+    # ...which displacement then pops: the cancelled future keeps its
+    # cancellation (no InvalidStateError), the slot is still freed
+    assert shadow[2].cancelled()
+    assert shadow[1].cancel()  # and one still-queued request bails too
+    with batcher:
+        assert np.isfinite(urgent.result(timeout=10))
+        assert np.isfinite(shadow[0].result(timeout=10))
+        # the flush thread survived the whole wave
+        assert np.isfinite(batcher.predict(x, timeout=10))
+
+
+def test_overload_burst_conserves_every_request(rng):
+    """The zero-silent-drops ledger under a real burst: every one of
+    300 rapid submissions against a tiny queue is either answered or
+    typed-rejected within a bounded wait — nothing hangs, nothing
+    vanishes."""
+    batcher, _ = _engine_batcher(
+        rng, max_batch=8, max_latency_s=0.001, max_queue=16)
+    lanes = ("interactive", "interactive", "batch", "shadow")
+    X = rng.normal(size=(300, 12)).astype(np.float32)
+    with batcher:
+        futs, typed = [], 0
+        for i in range(300):
+            try:
+                futs.append(batcher.submit(X[i], lane=lanes[i % 4]))
+            except Overloaded:
+                typed += 1
+        answered = 0
+        for f in futs:
+            try:
+                assert np.isfinite(f.result(timeout=30))
+                answered += 1
+            except Overloaded as e:  # displaced mid-queue: typed answer
+                assert e.reason == "displaced"
+                typed += 1
+    assert answered + typed == 300
+    assert answered > 0
+    counts = batcher.lane_snapshot()
+    assert sum(c["admitted"] for c in counts.values()) == len(futs)
+
+
+def test_healthz_exposes_lane_counters_and_breaker(rng, tmp_path):
+    from tpu_sgd.reliability import CircuitBreaker
+
+    d = 4
+    w_true = rng.normal(size=d).astype(np.float32)
+    alg = StreamingLinearRegressionWithSGD(step_size=0.3, num_iterations=5)
+    alg.set_initial_weights(np.zeros(d, np.float32))
+    alg.set_checkpoint(str(tmp_path), every=1)
+    alg.train_on_batch(*_stream_batch(rng, w_true, n=64))
+    registry = ModelRegistry(
+        str(tmp_path), alg.algorithm.create_model,
+        breaker=CircuitBreaker(failure_threshold=3, reset_timeout_s=0.1))
+    with Server(registry=registry, max_latency_s=0.005,
+                max_queue=4) as server:
+        x = rng.normal(size=d).astype(np.float32)
+        assert np.isfinite(server.predict(x, timeout=10))
+        with pytest.raises(Overloaded):
+            # drown the 4-deep queue from a stopped-clock submit loop
+            for _ in range(64):
+                server.submit(x, lane="shadow")
+        h = server.healthz()
+    assert h["breaker"]["state"] == "closed"
+    assert h["registry"]["load_failed_count"] == 0
+    assert set(h["lanes"]) == {"interactive", "batch", "shadow"}
+    assert h["admit_count"] >= 1
+    assert h["shed_count"] + h["reject_count"] >= 1
+    assert h["lanes"]["interactive"]["admitted"] >= 1
+    snap = server.metrics.snapshot()
+    assert sum(snap["rejects_by_lane"].values()) == snap["total_rejects"]
+    assert snap["rejects_by_reason"]  # the reason breakdown exists
+
+
+def test_serve_batch_event_carries_lane_composition(rng, tmp_path):
+    model = _linear_model(rng)
+    path = str(tmp_path / "lane_events.jsonl")
+    log = JsonLinesEventLog(path)
+    server = Server(model, max_latency_s=0.05, event_log=log)
+    X = rng.normal(size=(3, 12)).astype(np.float32)
+    futs = [server.submit(X[0], lane="interactive"),
+            server.submit(X[1], lane="interactive"),
+            server.submit(X[2], lane="batch")]
+    with server:
+        [f.result(timeout=10) for f in futs]
+    log.close()
+    batches = [e for e in JsonLinesEventLog.read(path)
+               if e["kind"] == "serve_batch" and e.get("lanes")]
+    assert batches, "no serve_batch event with lane composition"
+    lanes = batches[0]["lanes"]
+    assert lanes["interactive"]["n"] == 2
+    assert lanes["batch"]["n"] == 1
+    for st in lanes.values():
+        assert st["max_latency_s"] >= 0.0
 
 
 # -- satellite: streaming on_model_update hook -----------------------------

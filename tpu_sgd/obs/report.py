@@ -35,12 +35,20 @@ SLO file format (README "Observability")::
 
 ``metric`` kinds: ``span_p50_s`` / ``span_p99_s`` / ``span_max_s`` /
 ``span_mean_s`` / ``span_count`` (over ``span`` name), ``counter``
-(delta ``n`` of ``counter``; ``field: "bytes"`` selects bytes), and
+(delta ``n`` of ``counter``; ``field: "bytes"`` selects bytes),
 ``staleness_s`` — for every ``serve_reload``-kind ``reloaded`` record,
 the age of the served weights at swap time: reload ts minus the ts of
 the ``checkpoint.save`` span that wrote that version (reloads of
 checkpoints older than the trace window are skipped — their save is
-simply not in the trace).  Every SLO takes ``max`` and/or ``min``.
+simply not in the trace) — and two per-lane serving metrics (ISSUE 12,
+both take a ``"lane"`` field): ``lane_p99_s`` — p99 over the per-batch
+per-lane max latencies the ``serve_batch`` records carry (a
+conservative UPPER estimate of the per-request p99, since each sample
+is a batch's worst row) — and ``lane_shed_fraction`` — typed
+rejections (rejected + shed + displaced) over offered requests for the
+lane, from the ``serve.admitted/rejected/shed/displaced.<lane>``
+counter deltas (offered counts each request once: displaced requests
+already sit in admitted).  Every SLO takes ``max`` and/or ``min``.
 
 Parsing reuses ``JsonLinesEventLog.read`` — a crash-torn trailing line
 is tolerated (the soak/crash forensics contract), a malformed interior
@@ -146,6 +154,67 @@ def staleness_samples(records: List[dict]) -> List[dict]:
     return out
 
 
+def lane_latency_stats(records: List[dict]) -> Dict[str, dict]:
+    """Per-priority-lane serving latency aggregate from the
+    ``serve_batch`` records' ``lanes`` composition: ``{lane: {batches,
+    requests, p50_s, p99_s, max_s}}``.  The percentile samples are each
+    batch's per-lane MAX latency, so p99 here upper-bounds the true
+    per-request p99 — the conservative direction for an SLO gate."""
+    by_lane: Dict[str, List[float]] = {}
+    requests: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") != "serve_batch" or not r.get("lanes"):
+            continue
+        for lane, st in r["lanes"].items():
+            by_lane.setdefault(lane, []).append(float(st["max_latency_s"]))
+            requests[lane] = requests.get(lane, 0) + int(st["n"])
+    out = {}
+    for lane, maxima in sorted(by_lane.items()):
+        out[lane] = {
+            "batches": len(maxima),
+            "requests": requests[lane],
+            "p50_s": _percentile(maxima, 50),
+            "p99_s": _percentile(maxima, 99),
+            "max_s": max(maxima),
+        }
+    return out
+
+
+def lane_admission_stats(records: List[dict]) -> Dict[str, dict]:
+    """Per-lane admission-control table from the counter deltas:
+    ``{lane: {admitted, rejected, shed, displaced, offered,
+    reject_rate}}``.  ``offered`` counts each request ONCE —
+    admitted + rejected + shed (a displaced request already sits in
+    ``admitted``; that is why displacement is its own counter) — and
+    ``reject_rate = (rejected + shed + displaced) / offered``: the
+    fraction of offered requests that ended in a typed rejection, the
+    number the overload scenario's verdict gates on."""
+    deltas = counter_deltas(records)
+    lanes: Dict[str, dict] = {}
+
+    def bucket(prefix: str, key: str):
+        for name, c in deltas.items():
+            if name.startswith(prefix):
+                lane = name[len(prefix):]
+                if "." in lane:
+                    continue  # not a lane leaf (e.g. a wire counter)
+                st = lanes.setdefault(
+                    lane, {"admitted": 0, "rejected": 0, "shed": 0,
+                           "displaced": 0})
+                st[key] += int(c["n"])
+
+    bucket("serve.admitted.", "admitted")
+    bucket("serve.rejected.", "rejected")
+    bucket("serve.shed.", "shed")
+    bucket("serve.displaced.", "displaced")
+    for st in lanes.values():
+        st["offered"] = st["admitted"] + st["rejected"] + st["shed"]
+        st["reject_rate"] = (
+            (st["rejected"] + st["shed"] + st["displaced"])
+            / st["offered"] if st["offered"] else 0.0)
+    return dict(sorted(lanes.items()))
+
+
 # -- Chrome trace-event export ----------------------------------------------
 
 def to_chrome_trace(records: List[dict]) -> dict:
@@ -249,6 +318,33 @@ def evaluate_slos(records: List[dict], slo_doc: dict) -> List[dict]:
                 detail = "no reload-with-traced-save pairs in trace"
             else:
                 value = max(s["staleness_s"] for s in samples)
+        elif metric == "lane_p99_s":
+            lane = slo.get("lane")
+            if not lane:
+                raise ValueError(f"SLO {name!r}: lane metrics need a "
+                                 '"lane" field')
+            st = lane_latency_stats(records).get(lane)
+            if st is None:
+                # a latency bound over a lane that never served cannot
+                # be evaluated and must not silently pass
+                value = None
+                detail = f"lane {lane!r} absent from serve_batch records"
+            else:
+                value = st["p99_s"]
+        elif metric == "lane_shed_fraction":
+            lane = slo.get("lane")
+            if not lane:
+                raise ValueError(f"SLO {name!r}: lane metrics need a "
+                                 '"lane" field')
+            st = lane_admission_stats(records).get(lane)
+            if st is None:
+                # no admission counters for the lane at all: the trace
+                # never ran admission control — unevaluable, not green
+                value = None
+                detail = (f"no serve.admitted/rejected/shed.{lane} "
+                          "counters in trace")
+            else:
+                value = st["reject_rate"]
         else:
             raise ValueError(f"SLO {name!r}: unknown metric {metric!r}")
         lo, hi = slo.get("min"), slo.get("max")
@@ -309,6 +405,24 @@ def render_report(records: List[dict]) -> str:
                     f"  physical={r['physical_bytes']:>12}"
                     f"  logical={r['logical_bytes']:>12}"
                     f"  ratio={r['ratio']:.1f}x")
+    lane_lat = lane_latency_stats(records)
+    lane_adm = lane_admission_stats(records)
+    if lane_lat or lane_adm:
+        lines.append("serving lanes (admission control + per-batch "
+                     "lane-max latency):")
+        lines.append(f"  {'lane':<14}{'admitted':>9}{'rejected':>9}"
+                     f"{'shed':>7}{'displ':>7}{'rej-rate':>9}"
+                     f"{'p50':>12}{'p99':>12}")
+        for lane in sorted(set(lane_lat) | set(lane_adm)):
+            a = lane_adm.get(lane, {})
+            lt = lane_lat.get(lane)
+            lines.append(
+                f"  {lane:<14}{a.get('admitted', 0):>9}"
+                f"{a.get('rejected', 0):>9}{a.get('shed', 0):>7}"
+                f"{a.get('displaced', 0):>7}"
+                f"{a.get('reject_rate', 0.0):>8.1%}"
+                + (f"{_fmt_s(lt['p50_s']):>12}{_fmt_s(lt['p99_s']):>12}"
+                   if lt else f"{'-':>12}{'-':>12}"))
     stale = staleness_samples(records)
     if stale:
         worst = max(s["staleness_s"] for s in stale)
@@ -365,7 +479,9 @@ def main(argv=None) -> int:
         out = {"spans": span_stats(records),
                "counters": counter_deltas(records),
                "wire": wire_ratios(counter_deltas(records)),
-               "staleness": staleness_samples(records)}
+               "staleness": staleness_samples(records),
+               "lanes": {"latency": lane_latency_stats(records),
+                         "admission": lane_admission_stats(records)}}
         if verdicts is not None:
             out["slos"] = verdicts
         print(json.dumps(out, indent=2))

@@ -161,6 +161,9 @@ HOOK_SITES = {
     "checkpoint.load": "tpu_sgd/utils/checkpoint.py",
     "serve.registry.reload": "tpu_sgd/serve/registry.py",
     "serve.batcher.enqueue": "tpu_sgd/serve/batcher.py",
+    # fires FIRST in submit(), before any queue mutation or admission
+    # tally, so a healed admission retry replays nothing twice
+    "serve.admit": "tpu_sgd/serve/batcher.py",
 }
 
 # -- arming registry --------------------------------------------------------

@@ -1,0 +1,32 @@
+"""tpu_sgd.scenario: the production scenario harness (ROADMAP item 1).
+
+The subsystems — async replica training (``tpu_sgd/replica``), hot
+reload (``serve.ModelRegistry``), the admission-controlled micro-batcher
+(``serve.batcher``), chaos failpoints, and the SLO-verdict trace report
+(``obs.report``) — run here *as one system*: an open-loop load
+generator (:mod:`tpu_sgd.scenario.loadgen`) drives mixed
+dense/sparse/multinomial traffic across priority lanes, including a
+deliberate overload burst, while a replica fleet retrains on a drifting
+stream (compressed pushes, a worker killed and rejoined mid-run) and
+the serving tier hot-reloads the fleet's checkpoints on a cadence
+(:mod:`tpu_sgd.scenario.harness`).
+
+The whole run is gated by declarative SLOs evaluated over the run's own
+trace by ``python -m tpu_sgd.obs.report`` — per-lane p99, bounded
+interactive-lane shed fraction, served-weight staleness, zero dropped
+requests, the structural reload/rejoin counts — and the report's exit
+code IS the harness exit code (``scripts/scenario_live.py``).
+"""
+
+from __future__ import annotations
+
+from tpu_sgd.scenario.harness import build_slos, run_scenario
+from tpu_sgd.scenario.loadgen import OpenLoopLoadGen, Phase, TrafficSpec
+
+__all__ = [
+    "OpenLoopLoadGen",
+    "Phase",
+    "TrafficSpec",
+    "build_slos",
+    "run_scenario",
+]

@@ -35,7 +35,8 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-from tpu_sgd.serve.batcher import BackpressureError, MicroBatcher
+from tpu_sgd.serve.batcher import (LANES, BackpressureError, MicroBatcher,
+                                   Overloaded)
 from tpu_sgd.serve.engine import DEFAULT_BUCKETS, PredictEngine, stack_rows
 from tpu_sgd.serve.metrics import ServingMetrics
 from tpu_sgd.serve.registry import ModelRegistry, NoModelError
@@ -45,6 +46,15 @@ class Server:
     """Facade wiring engine + batcher + registry + metrics into one
     endpoint.  Exactly one of ``model`` (static) or ``registry``
     (hot-reloading) must be given.
+
+    Overload (README "Overload behavior"; ADVICE.md "Reject at
+    admission, never at completion"): :meth:`submit` takes a priority
+    ``lane`` and an optional ``deadline_s`` budget, and every admission
+    rejection — queue-full, unmeetable deadline, utilization shed, or
+    displacement by a higher lane — is a typed
+    :class:`~tpu_sgd.serve.batcher.Overloaded` answer, never a silent
+    drop; ``shed_utilization`` tunes (or with ``{}`` disables) the
+    per-lane shed thresholds.
 
     Reliability (README "Reliability"; ``tpu_sgd/reliability``): pass
     the registry a ``CircuitBreaker`` (``ModelRegistry(...,
@@ -68,6 +78,7 @@ class Server:
         event_log=None,
         auto_reload: bool = True,
         reload_interval_s: float = 0.1,
+        shed_utilization=None,
     ):
         if (model is None) == (registry is None):
             raise ValueError("pass exactly one of model= or registry=")
@@ -107,6 +118,7 @@ class Server:
             max_queue=max_queue,
             metrics=self.metrics,
             padded_size_fn=self.engine.bucket_for,
+            shed_utilization=shed_utilization,
         )
 
     # -- model access ------------------------------------------------------
@@ -142,14 +154,23 @@ class Server:
         return self.engine.predict_batch(self.model, X)
 
     # -- request path ------------------------------------------------------
-    def submit(self, x):
+    def submit(self, x, lane: str = "interactive",
+               deadline_s: Optional[float] = None):
         """Async single-row predict; returns a ``concurrent.futures.Future``.
-        Raises :class:`BackpressureError` when the queue is full."""
-        return self.batcher.submit(x)
 
-    def predict(self, x, timeout: Optional[float] = None):
+        ``lane`` picks the priority lane (``serve.LANES``:
+        interactive > batch > shadow) and ``deadline_s`` the request's
+        remaining latency budget — see README "Overload behavior".
+        Raises :class:`Overloaded` (a :class:`BackpressureError`) on any
+        typed admission rejection."""
+        return self.batcher.submit(x, lane=lane, deadline_s=deadline_s)
+
+    def predict(self, x, timeout: Optional[float] = None, *,
+                lane: str = "interactive",
+                deadline_s: Optional[float] = None):
         """Blocking single-row predict through the micro-batching path."""
-        return self.batcher.predict(x, timeout)
+        return self.batcher.predict(x, timeout, lane=lane,
+                                    deadline_s=deadline_s)
 
     def predict_batch(self, X):
         """Direct batch predict through the bucketed compiled path,
@@ -163,6 +184,7 @@ class Server:
         and (when a registry is attached) the reload/breaker picture.
         Cheap enough to scrape per second — no locks beyond the
         registry's own, no model access, never raises."""
+        lanes = self.batcher.lane_snapshot()
         h = {
             "serving": self.batcher._thread is not None,
             "model_version": self.model_version,
@@ -170,9 +192,20 @@ class Server:
             "reject_count": self.batcher.reject_count,
             "batch_count": self.batcher.batch_count,
             "flush_heartbeat_age_s": self.batcher.heartbeat.age_s(),
+            # admission-control picture (ISSUE 12): per-lane
+            # admit/shed/reject tallies + depth, the aggregate counts,
+            # and the p99 batch wall the deadline rule prices against
+            "lanes": lanes,
+            "admit_count": sum(s["admitted"] for s in lanes.values()),
+            "shed_count": sum(s["shed"] + s["displaced"]
+                              for s in lanes.values()),
+            "p99_batch_wall_s": self.batcher.p99_batch_wall_s(),
         }
         if self.registry is not None:
             h["registry"] = self.registry.healthz()
+            # the breaker state, surfaced at the top level too — the
+            # one field an overload dashboard alerts on
+            h["breaker"] = h["registry"]["breaker"]
         return h
 
     # -- lifecycle ---------------------------------------------------------
@@ -193,9 +226,11 @@ class Server:
 __all__ = [
     "BackpressureError",
     "DEFAULT_BUCKETS",
+    "LANES",
     "MicroBatcher",
     "ModelRegistry",
     "NoModelError",
+    "Overloaded",
     "PredictEngine",
     "Server",
     "ServingMetrics",

@@ -149,11 +149,15 @@ class PredictEngine:
         # thread (batch size rides that parent); the engine's result
         # fetch (np.asarray on the scored bucket) is the
         # request/response boundary — the documented, deliberate sync
-        with span("serve.predict"):
+        sp = span("serve.predict")
+        with sp:
             if not is_sparse(X):
                 X = np.asarray(X)
                 if X.ndim == 1:
                     X = X[None, :]
+                # rows is host shape metadata (numpy / BCOO static
+                # shape), never a device fetch — the obs-discipline rule
+                sp.set(rows=int(X.shape[0]))
                 if X.shape[0] == 0:
                     return np.zeros((0,), np.float32)
                 return self._score_dense(model, X)
@@ -161,6 +165,7 @@ class PredictEngine:
                 from tpu_sgd.ops.sparse import row_matrix_bcoo
 
                 X = row_matrix_bcoo(X)
+            sp.set(rows=int(X.shape[0]), sparse=True)
             return self._predict_sparse(model, X)
 
     def _score_dense(self, model, X: np.ndarray) -> np.ndarray:

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpu_sgd.utils.events import ServeBatchEvent, ServeReloadEvent
 
@@ -48,6 +48,11 @@ class ServingMetrics:
         self.total_batches = 0
         self.total_rejects = 0
         self.total_padded_rows = 0
+        #: typed admission rejections by reason ("queue_full" /
+        #: "deadline" / "shed" / "displaced") and by lane — the scrape
+        #: twins of the batcher's own lane_counts (ISSUE 12)
+        self.rejects_by_reason: Dict[str, int] = {}
+        self.rejects_by_lane: Dict[str, int] = {}
         #: resolves the serving model version at record time (set by the
         #: Server facade when a registry is attached)
         self.version_source: Optional[Callable[[], int]] = None
@@ -58,9 +63,14 @@ class ServingMetrics:
         except Exception:
             return -1
 
-    def record_reject(self):
+    def record_reject(self, lane: str = "interactive",
+                      reason: str = "queue_full"):
         with self._lock:
             self.total_rejects += 1
+            self.rejects_by_reason[reason] = (
+                self.rejects_by_reason.get(reason, 0) + 1)
+            self.rejects_by_lane[lane] = (
+                self.rejects_by_lane.get(lane, 0) + 1)
 
     def record_batch(
         self,
@@ -72,12 +82,16 @@ class ServingMetrics:
         reject_count: int,
         enqueue_depth: int = 0,
         deadline_slack_s: float = 0.0,
+        lanes: Optional[dict] = None,
     ):
         """``enqueue_depth``/``deadline_slack_s`` (ISSUE 8) are the
         admission-control inputs: the queue depth the batch's oldest
         request saw at its own enqueue, and the deadline slack left when
-        the batch flushed (negative = missed).  Both default so older
-        callers keep working; they ride the event as two more keys."""
+        the batch flushed (negative = missed).  ``lanes`` (ISSUE 12) is
+        the batch's lane composition — ``{lane: {n, max_latency_s}}`` —
+        the record the per-lane p99 SLOs in ``obs.report`` evaluate
+        over.  All default so older callers keep working; new records
+        simply carry more keys."""
         with self._lock:
             self.total_batches += 1
             self.total_requests += batch_size
@@ -92,6 +106,7 @@ class ServingMetrics:
             model_version=self._version(),
             enqueue_depth=int(enqueue_depth),
             deadline_slack_s=float(deadline_slack_s),
+            lanes=lanes,
         )
         if self.listener is not None:
             self.listener.on_serve_batch(event)
@@ -115,10 +130,14 @@ class ServingMetrics:
             n_bat = self.total_batches
             padded = self.total_padded_rows
             rejects = self.total_rejects
+            by_reason = dict(self.rejects_by_reason)
+            by_lane = dict(self.rejects_by_lane)
         return {
             "total_requests": n_req,
             "total_batches": n_bat,
             "total_rejects": rejects,
+            "rejects_by_reason": by_reason,
+            "rejects_by_lane": by_lane,
             "mean_batch_size": n_req / n_bat if n_bat else 0.0,
             # padding efficiency: real rows per padded row actually scored
             "pad_efficiency": n_req / padded if padded else 0.0,

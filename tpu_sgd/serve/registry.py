@@ -43,6 +43,7 @@ GRAFTLINT_LOCKS = {
         "_previous_version": "_lock:w",
         "_pinned": "_lock:w",
         "bad_versions": "_lock",
+        "load_failed_count": "_lock:w",
     },
 }
 
@@ -88,6 +89,10 @@ class ModelRegistry:
         #: retried, so one corrupt file cannot wedge reload in a loop
         self.bad_versions: Dict[int, str] = {}
         self.reload_count = 0
+        #: cumulative failed load ATTEMPTS (transient + corrupt) — the
+        #: registry-side rejection counter healthz surfaces next to the
+        #: serving tier's admit/shed/reject tallies (ISSUE 12)
+        self.load_failed_count = 0
 
     # -- read side ---------------------------------------------------------
     @property
@@ -184,12 +189,14 @@ class ModelRegistry:
                         "transient I/O error loading checkpoint version "
                         "%d (%s: %s); will retry", v, type(e).__name__, e,
                     )
+                    self.load_failed_count += 1
                     emits.append(("load_failed", v, str(e)))
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     continue
                 except Exception as e:
                     self.bad_versions[v] = f"{type(e).__name__}: {e}"
+                    self.load_failed_count += 1
                     logger.warning(
                         "serving reload of checkpoint version %d failed "
                         "(%s: %s); keeping version %s",
@@ -227,6 +234,7 @@ class ModelRegistry:
             "pinned": self._pinned,
             "bad_versions": bad,
             "reload_count": self.reload_count,
+            "load_failed_count": self.load_failed_count,
             "breaker": (None if self.breaker is None
                         else self.breaker.snapshot()),
         }

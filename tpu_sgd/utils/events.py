@@ -73,9 +73,16 @@ class ServeBatchEvent:
     flush deadline was left when the batch actually flushed (negative =
     the deadline was missed by that much) — the two admission-control
     inputs: sustained high enqueue depth says shed earlier, sustained
-    negative slack says the deadline is unkeepable at this load.  Both
-    default (old readers of the JSONL stream and positional
-    constructors keep working; new records simply carry two more keys).
+    negative slack says the deadline is unkeepable at this load.
+
+    ``lanes`` (ISSUE 12) is the batch's priority-lane composition:
+    ``{lane: {"n": rows, "max_latency_s": worst end-to-end latency of
+    that lane's rows in this batch}}`` — what the per-lane p99 SLOs in
+    ``obs.report`` evaluate over (a per-batch lane MAX, so the offline
+    p99 is a conservative upper estimate of the per-request p99).
+
+    All extras default (old readers of the JSONL stream and positional
+    constructors keep working; new records simply carry more keys).
     """
 
     queue_depth: int
@@ -86,6 +93,7 @@ class ServeBatchEvent:
     model_version: int
     enqueue_depth: int = 0
     deadline_slack_s: float = 0.0
+    lanes: Optional[dict] = None
 
 
 @dataclass
