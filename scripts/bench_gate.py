@@ -1,0 +1,238 @@
+"""Bench regression gate: committed BENCH_*.json headline counts and
+bytes-ratios become a CI gate instead of a file.
+
+The 2-core harness policy (ROADMAP): wall clocks there are weather, so
+the benches headline COUNTS (dispatches, syncs, compiles, admissions)
+and BYTES-RATIOS (wire compression) — structural numbers that
+reproduce exactly or near-exactly.  This gate pins them: every metric
+in :data:`GATES` is compared candidate-vs-baseline with a declared
+tolerance band in the metric's GOOD direction (an improvement always
+passes; only a regression beyond the band fails).  Wall-clock fields
+are deliberately ungated.
+
+Modes::
+
+    python scripts/bench_gate.py
+        # self-check: baseline == candidate == the repo's committed
+        # files.  Verifies every gated metric EXISTS and parses —
+        # schema drift (a vanished headline number) fails here, and a
+        # freshly committed BENCH file is validated at commit time.
+
+    python scripts/bench_gate.py --candidate-dir /tmp/fresh
+        # the real comparison: freshly produced BENCH files (a local
+        # bench re-run) against the committed baselines.  CI also runs
+        # this against a deliberately perturbed copy and requires exit
+        # 1 — a gate only ever seen passing is a gate nobody tested.
+
+Exit codes (the ``obs.report`` contract): 0 = every gate holds, 1 = a
+regression / missing candidate metric, 2 = unreadable baseline or
+usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import List
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose — a single-threaded offline comparator, no shared state.
+GRAFTLINT_LOCKS: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Gate:
+    """One gated metric.
+
+    ``path`` is a ``/``-separated JSON path (``arms/shed_off/
+    levels[3]/x`` — list indices in brackets; ``/`` rather than ``.``
+    because bench keys like ``d47236_topk0.01`` contain dots).
+    ``better`` declares the good
+    direction: ``"higher"`` (ratios, throughput counts) fails when the
+    candidate drops more than the band below baseline; ``"lower"``
+    (dispatch/sync/compile counts) fails when it rises more than the
+    band above; ``"equal"`` (structural counts like spans-per-run)
+    fails on ANY deviation beyond the band either way.  The band is
+    ``rel_tol * |baseline| + abs_tol``."""
+
+    path: str
+    better: str  # "higher" | "lower" | "equal"
+    rel_tol: float = 0.0
+    abs_tol: float = 0.0
+    note: str = ""
+
+
+#: the declared tolerance bands — counts/ratios only, per the 2-core
+#: policy (walls are weather; they stay in the files as context, not
+#: gates).
+GATES = {
+    "BENCH_OBS.json": [
+        # the PR 8 acceptance pin, as numbers: enabled obs adds ZERO
+        # runtime events on the warmed drivers — any nonzero delta is
+        # a regression with no noise excuse
+        Gate("headline/superstep_count_deltas/dispatches", "lower",
+             note="enabled-minus-disabled must stay 0"),
+        Gate("headline/superstep_count_deltas/host_syncs", "lower"),
+        Gate("headline/superstep_count_deltas/compiles", "lower"),
+        Gate("headline/resident_count_deltas/dispatches", "lower"),
+        Gate("headline/resident_count_deltas/host_syncs", "lower"),
+        Gate("headline/resident_count_deltas/compiles", "lower"),
+        # structural per-run counts on the warmed drivers: exact by
+        # construction; a small band absorbs a deliberate driver
+        # change landing with a refreshed baseline
+        Gate("detail/superstep/counts_enabled/dispatches", "lower",
+             rel_tol=0.05),
+        Gate("detail/resident/counts_enabled/dispatches", "lower",
+             rel_tol=0.05),
+        Gate("detail/superstep/trace_spans_per_run", "equal",
+             note="span inventory drift = instrumentation regression"),
+        Gate("detail/resident/trace_spans_per_run", "equal"),
+    ],
+    "BENCH_SERVE.json": [
+        # admission ledgers over the fixed offered schedule: total
+        # admitted ~ sustained throughput as a COUNT; the wide band is
+        # the 2-core load-timing noise, a collapse still fails
+        Gate("arms/shed_off/admission_counts/admit_count", "higher",
+             rel_tol=0.5),
+        Gate("arms/shed_on/admission_counts/admit_count", "higher",
+             rel_tol=0.5),
+        # coalescing at saturation: mean rows per flushed batch
+        Gate("arms/shed_off/levels[3]/mean_batch_size", "higher",
+             rel_tol=0.3, note="batcher stopped coalescing"),
+    ],
+    "BENCH_SPARSE_WIRE.json": [
+        Gate("sparse_feed/wire_bytes/ratio", "higher", rel_tol=0.10,
+             note="BCOO feed physical-vs-dense-f32 compression"),
+        Gate("sparse_feed/counts/dispatches_per_run", "lower",
+             rel_tol=0.05),
+        Gate("topk_compress/d47236_topk0.01/ratio", "higher",
+             rel_tol=0.05),
+        Gate("topk_compress/d1000000_topk0.01/ratio", "higher",
+             rel_tol=0.05),
+        Gate("merge_wire/ratio", "higher", rel_tol=0.10),
+    ],
+}
+
+_SEG = re.compile(r"^(?P<key>.*?)(?P<idx>(\[\d+\])*)$")
+
+
+def lookup(doc, path: str):
+    """Resolve a ``/``-separated path (``a/b[3]/c``); raises KeyError
+    with the failing segment named."""
+    cur = doc
+    for seg in path.split("/"):
+        m = _SEG.match(seg)
+        key = m.group("key")
+        try:
+            if key:
+                cur = cur[key]
+            for idx in re.findall(r"\[(\d+)\]", m.group("idx")):
+                cur = cur[int(idx)]
+        except (KeyError, IndexError, TypeError):
+            raise KeyError(f"{path!r}: missing segment {seg!r}")
+    return cur
+
+
+def check_gate(gate: Gate, baseline, candidate) -> dict:
+    """One verdict dict: {path, better, baseline, candidate, ok,
+    detail?} — the SLO-verdict shape, for the same reasons."""
+    v = {"path": gate.path, "better": gate.better}
+    try:
+        b = float(lookup(baseline, gate.path))
+    except (KeyError, ValueError, TypeError) as e:
+        return {**v, "ok": False, "detail": f"baseline: {e}"}
+    try:
+        c = float(lookup(candidate, gate.path))
+    except (KeyError, ValueError, TypeError) as e:
+        # a vanished candidate metric IS a regression (the headline
+        # number someone stopped measuring), never a skip
+        return {**v, "baseline": b, "ok": False,
+                "detail": f"candidate: {e}"}
+    band = gate.rel_tol * abs(b) + gate.abs_tol
+    if gate.better == "higher":
+        ok = c >= b - band
+    elif gate.better == "lower":
+        ok = c <= b + band
+    elif gate.better == "equal":
+        ok = abs(c - b) <= band
+    else:
+        return {**v, "ok": False,
+                "detail": f"unknown direction {gate.better!r}"}
+    out = {**v, "baseline": b, "candidate": c, "band": band, "ok": ok}
+    if not ok and gate.note:
+        out["detail"] = gate.note
+    return out
+
+
+def run_gate(baseline_dir: str, candidate_dir: str) -> List[dict]:
+    """Every verdict for every gated file.  Raises OSError /
+    json.JSONDecodeError on an unreadable BASELINE (exit-2 class);
+    unreadable candidates are per-file regressions (exit-1 class)."""
+    verdicts = []
+    for fname, gates in GATES.items():
+        with open(os.path.join(baseline_dir, fname)) as f:
+            baseline = json.load(f)
+        try:
+            with open(os.path.join(candidate_dir, fname)) as f:
+                candidate = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            for gate in gates:
+                verdicts.append({
+                    "path": f"{fname}:{gate.path}", "better": gate.better,
+                    "ok": False,
+                    "detail": f"candidate file unreadable: {e}"})
+            continue
+        for gate in gates:
+            v = check_gate(gate, baseline, candidate)
+            v["path"] = f"{fname}:{v['path']}"
+            verdicts.append(v)
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/bench_gate.py",
+        description=__doc__.split("\n")[0])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--baseline-dir", default=repo,
+                    help="committed baselines (default: repo root)")
+    ap.add_argument("--candidate-dir", default=None,
+                    help="freshly produced BENCH files (default: the "
+                         "baseline dir — the self-check mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit verdicts as JSON")
+    args = ap.parse_args(argv)
+    candidate = args.candidate_dir or args.baseline_dir
+    try:
+        verdicts = run_gate(args.baseline_dir, candidate)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read baseline: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(verdicts, indent=2))
+    else:
+        for v in verdicts:
+            state = "PASS" if v["ok"] else "FAIL"
+            if "candidate" in v:
+                print(f"GATE {state}: {v['path']}: {v['candidate']:g} "
+                      f"vs baseline {v['baseline']:g} "
+                      f"(better={v['better']}, band={v['band']:g})"
+                      + (f"  ({v['detail']})" if v.get("detail") else ""))
+            else:
+                print(f"GATE {state}: {v['path']}: "
+                      f"{v.get('detail', 'missing')}")
+    bad = [v for v in verdicts if not v["ok"]]
+    if bad:
+        print(f"{len(bad)} of {len(verdicts)} gates FAILED",
+              file=sys.stderr)
+        return 1
+    print(f"all {len(verdicts)} bench gates hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,14 @@ train windows, checkpoint saves, and serve batches).  Exit code 0 =
 invariants held AND every SLO passed; an SLO violation exits nonzero
 through the report CLI's own exit-code contract.
 
+The live metrics plane (ISSUE 13) is armed over the same trace: the
+detector engine rides 0.25s windows (the overload burst must trip the
+shed-rate rule; a dedicated straggler cell — phase 1e, a kill with a
+long deterministic rejoin backoff — must trip the replica-straggler
+rule; both gated by ``alert_count`` SLOs), and the flight recorder
+dumps its ring + window snapshots to ``<trace>.flightrec.jsonl`` on
+every alert transition, schema-validated before the report runs.
+
 Exit code 0 = all invariants held.  Also exposed as the ``slow``-marked
 ``tests/test_reliability.py::test_chaos_soak`` (excluded from tier-1).
 """
@@ -81,6 +89,14 @@ DEFAULT_SLOS = {"slos": [
     # scenario harness gates the tighter production bound of 0.5)
     {"name": "serve-sheds-bounded", "metric": "lane_shed_fraction",
      "lane": "interactive", "max": 0.9},
+    # the detectors really detected (ISSUE 13): the overload burst must
+    # trip the shed-rate rule and the dedicated straggler cell (phase
+    # 1e) the replica-straggler rule — typed obs_alert records on the
+    # trace, not grepped log lines
+    {"name": "shed-rate-alert-fired", "metric": "alert_count",
+     "rule": "shed-rate", "min": 1},
+    {"name": "straggler-alert-fired", "metric": "alert_count",
+     "rule": "replica-straggler", "min": 1},
 ]}
 
 
@@ -179,12 +195,22 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             # (which read() correctly refuses to tolerate)
             os.truncate(trace_path, 0)
         event_log = JsonLinesEventLog(log_path, fsync=True)
+        flight_path = (trace_path + ".flightrec.jsonl"
+                       if trace_path is not None else None)
         if trace_path is not None:
             # ONE stream: listener events, serve_reload records, and
             # the obs layer's trace_span/trace_event/metric_counters
             # all interleave on the caller-owned log — the spelling
-            # tests/test_obs.py pins and obs.report consumes whole
-            obs.enable(event_log)
+            # tests/test_obs.py pins and obs.report consumes whole.
+            # ISSUE 13: the detector engine rides the 0.25s windowed
+            # time-series (shed-rate must trip under the burst, the
+            # straggler rule in phase 1e) and the flight recorder arms
+            # over the same stream — a stale dump from a previous run
+            # must not satisfy this run's schema check
+            if os.path.exists(flight_path):
+                os.remove(flight_path)
+            obs.enable(event_log, detect=True, window_s=0.25,
+                       flightrec=flight_path)
         quarantined = []
         manager = CheckpointManager(
             ckpt_dir,
@@ -539,6 +565,51 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         say(f"replica kill/rejoin at τ=2 survived: "
             f"{summary['replica_kill']}")
 
+        # ---- phase 1e: straggler DETECTOR validation (ISSUE 13) ----------
+        # the live-metrics twin of 1d: a dedicated kill cell tuned so
+        # the victim's silence SPANS detector windows — the rejoin
+        # backoff is long and DETERMINISTIC (jitter=0: the dead period
+        # must cover >= 2 of the 0.25s windows every run, not most
+        # runs) while the survivors keep stepping, and the budget gives
+        # them enough runway that the rejoin still lands before the run
+        # ends.  The replica-straggler rule must trip (a typed
+        # obs_alert on the trace + the obs.alert counter), and the
+        # driver's live `windows` snapshot must show the per-worker
+        # replica.step series the rule evaluated.
+        if trace_path is not None:
+            deadline = Deadline(300.0)
+            strag_drv = (_make_replica(2, iters=1200)
+                         .set_rejoin(RetryPolicy(max_attempts=5,
+                                                 base_backoff_s=0.8,
+                                                 jitter=0.0,
+                                                 seed=seed + 50)))
+            with inject_faults({"replica.push": fp.fail_nth(24)}):
+                strag_drv.optimize_with_history((X, y), w0)
+            deadline.check("straggler detector phase")
+            obs.flush_windows()  # the trailing window evaluates too
+            strag_members = strag_drv.last_membership_snapshot
+            assert any(m["joins"] > 1 for m in strag_members.values()), (
+                f"straggler cell: victim never rejoined: {strag_members}")
+            strag_trips = obs.snapshot().get(
+                "obs.alert.replica-straggler", {"n": 0})["n"]
+            assert strag_trips >= 1, (
+                "the kill left a worker silent for >= 2 windows while "
+                "the fleet ran, but the straggler detector never "
+                "tripped")
+            wins = strag_drv.last_windows_snapshot
+            assert wins and any(
+                name.startswith("replica.step[")
+                for w in wins for name in w["series"]), (
+                "driver windows snapshot carries no per-worker series")
+            summary["straggler_detector"] = {
+                "alerts": strag_trips,
+                "rejoins": sum(max(0, m["joins"] - 1)
+                               for m in strag_members.values()),
+                "windows": len(wins),
+            }
+            say(f"straggler detector tripped {strag_trips} time(s) "
+                f"across {len(wins)} live windows; victim rejoined")
+
         # ---- phase 2: serving under reload faults ------------------------
         deadline = Deadline(120.0)
         breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.05)
@@ -671,6 +742,22 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             f"typed rejections, {b_faulted} injected admission faults "
             f"— ledger conserved, no hangs")
 
+        # the burst must have TRIPPED the shed-rate detector (ISSUE 13):
+        # per-lane typed-rejection rate over the windowed admission
+        # counters, evaluated live at window close — the alert is a
+        # typed obs_alert on this soak's trace (the SLO gate re-asserts
+        # it offline) and the flight recorder dumped on the transition
+        if trace_path is not None:
+            obs.flush_windows()
+            shed_trips = obs.snapshot().get(
+                "obs.alert.shed-rate", {"n": 0})["n"]
+            assert shed_trips >= 1, (
+                "a 300-request burst at a 16-deep queue shed heavily "
+                "but the shed-rate detector never tripped")
+            summary["shed_rate_alerts"] = shed_trips
+            say(f"shed-rate detector tripped {shed_trips} time(s) "
+                "under the burst")
+
         # ---- phase 3: event log survives a torn tail ---------------------
         if trace_path is not None:
             # flushes the cumulative counter snapshot as the trace's
@@ -707,6 +794,32 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             summary["replica_trace_max_accepted_staleness"] = worst
             say(f"replica staleness bound held in the trace: "
                 f"{len(accepted)} accepted pushes, worst {worst}")
+
+            # the flight recorder's standalone dump (the detector trips
+            # above triggered it) schema-validates: a meta header, the
+            # ring of real trace records, and the windowed snapshots a
+            # post-mortem renders without replaying the full trace
+            frec = JsonLinesEventLog.read(flight_path)
+            assert frec and frec[0]["kind"] == "flightrec_meta", (
+                f"flight record at {flight_path} missing its meta "
+                "header")
+            frec_kinds = {r["kind"] for r in frec}
+            assert "obs_window" in frec_kinds, (
+                f"flight record carries no window snapshots: "
+                f"{sorted(frec_kinds)}")
+            assert frec_kinds & {"trace_span", "trace_event",
+                                 "obs_alert"}, (
+                f"flight record ring is empty of trace records: "
+                f"{sorted(frec_kinds)}")
+            summary["flightrec"] = {
+                "path": flight_path,
+                "records": len(frec),
+                "reason": frec[0]["reason"],
+                "dumps": frec[0]["dump_ordinal"],
+            }
+            say(f"flight record validated: {len(frec)} records, "
+                f"last trigger {frec[0]['reason']!r} "
+                f"(dump #{frec[0]['dump_ordinal']})")
 
     summary["ok"] = True
     return summary

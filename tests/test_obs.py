@@ -348,11 +348,12 @@ def test_enabled_obs_superstep_driver_zero_added_runtime_events(rng):
     base_dispatch, base_sync = dc["n"], sc["n"]
 
     sink = ListSink()
-    obs.enable(sink)  # tracing + counters, the full production config
+    obs.enable(sink)  # tracing + counters + TIME-SERIES, the full config
     try:
         obs_counters.reset()
         o.optimize_with_history((X, y), w0)
         snap = obs_counters.snapshot()
+        wins = obs.windows_snapshot()
     finally:
         obs.disable()
 
@@ -366,6 +367,14 @@ def test_enabled_obs_superstep_driver_zero_added_runtime_events(rng):
     # and the trace really observed the run: one span per superstep
     assert len(sink.spans("train.superstep")) == 24 // 4
     assert all(s["i0"] % 4 == 1 for s in sink.spans("train.superstep"))
+    # ISSUE 13 re-pin: the counts above were measured with the windowed
+    # time-series ON (obs.enable default), and it really recorded — the
+    # span durations, the per-step loss scalars, and the counter series
+    # all landed in the live window ring at ZERO added runtime events
+    series = {name for w in wins for name in w["series"]}
+    assert "train.superstep" in series
+    assert "train.loss" in series
+    assert "train.dispatch" in series
 
 
 def test_enabled_obs_compressed_wire_zero_added_runtime_events(rng):
@@ -564,6 +573,10 @@ def _mk_trace(tmp_path, name="t.jsonl"):
         "thread": "MainThread", "subsystem": "ingest", "attempt": 1})
     log.emit("serve_reload", {"ts": 130.0, "event": "reloaded",
                               "version": 40, "previous_version": None})
+    log.emit("obs_alert", {
+        "ts": 131.0, "rule": "shed-rate", "series": "serve.lane.batch",
+        "value": 0.6, "bound": 0.3, "window_index": 131,
+        "t_start": 131.0, "t_end": 132.0, "detail": "test alert"})
     log.emit("metric_counters", {"ts": 200.0, "counters": {
         "train.dispatch": {"n": 25, "bytes": 0},
         "serve.reject": {"n": 1, "bytes": 0}}})
@@ -691,3 +704,614 @@ def test_report_tolerates_crash_torn_tail(tmp_path):
         f.write('ed"}\n{"interior": garbage}\n{"kind": "x"}\n')
     with pytest.raises(json.JSONDecodeError):
         obs_report.load_trace(trace)
+
+
+# -- windowed time-series (ISSUE 13) -----------------------------------------
+
+def _mk_store(width=1.0, **kw):
+    """A WindowStore on a synthetic clock (no sleeping in tests)."""
+    from tpu_sgd.obs.timeseries import WindowStore
+
+    clock = {"t": 0.0}
+    store = WindowStore(width_s=width, clock=lambda: clock["t"], **kw)
+    return store, clock
+
+
+def test_window_store_aggregates_and_nearest_rank_parity():
+    """Per-window count/sum/max are exact and the window p50/p99 agree
+    with ServingMetrics' live scrape — ONE percentile rule everywhere
+    (serve.metrics.nearest_rank)."""
+    from tpu_sgd.serve.metrics import ServingMetrics
+
+    store, clock = _mk_store()
+    samples = [0.010, 0.012, 0.011, 0.200, 0.003, 0.050, 0.007]
+    for v in samples:
+        store.observe("serve.batch", value=v)
+    clock["t"] = 1.5  # roll the window
+    store.observe("serve.batch", value=1.0)
+    w0 = store.snapshot()[0]
+    assert w0["closed"] is True
+    s = w0["series"]["serve.batch"]
+    assert s["count"] == len(samples)
+    assert s["sum"] == pytest.approx(sum(samples))
+    assert s["max"] == 0.200
+    metrics = ServingMetrics()
+    metrics.record_batch(queue_depth=0, batch_size=len(samples),
+                         padded_size=8, latencies=samples,
+                         reject_count=0)
+    assert s["p50"] == metrics.latency_percentile(50)
+    assert s["p99"] == metrics.latency_percentile(99)
+
+
+def test_window_store_ring_and_sample_bounds_under_long_run():
+    """The acceptance bound: memory is bounded by WINDOW COUNT, never
+    run length — a 10k-window synthetic run retains max_windows closed
+    windows, and a 10k-observation window caps its sample buffer while
+    count/sum/max stay exact."""
+    store, clock = _mk_store(width=1.0, max_windows=32,
+                             samples_per_series=64)
+    for i in range(10_000):
+        clock["t"] = float(i)
+        store.observe("train.loss", value=float(i % 7))
+    assert len(store._windows) == 32          # the ring, full and bounded
+    assert len(store.snapshot()) == 33        # + the open window
+    # one giant window: samples capped, exact aggregates kept
+    store2, _ = _mk_store(samples_per_series=64)
+    for i in range(10_000):
+        store2.observe("x", value=float(i))
+    s = store2.snapshot()[0]["series"]["x"]
+    assert s["count"] == 10_000
+    assert s["samples_capped"] is True
+    assert s["max"] == 9999.0
+    assert s["sum"] == pytest.approx(sum(range(10_000)))
+
+
+def test_window_store_flush_and_late_records():
+    """flush() closes the open window (fires listeners) so a finished
+    run's trailing data evaluates; a record with an OLDER ts than the
+    open window folds into the open window, never reopens a closed
+    one."""
+    store, clock = _mk_store()
+    closed = []
+    store.add_close_listener(lambda w: closed.append(w))
+    clock["t"] = 5.5
+    store.observe("a", value=1.0)
+    store.observe("b", ts=4.2)  # late cross-thread record: folds in
+    store.flush()
+    assert len(closed) == 1
+    assert closed[0]["series"]["a"]["count"] == 1
+    assert closed[0]["series"]["b"]["count"] == 1
+    assert store.snapshot() == [closed[0]]  # flush closed it into the ring
+    # a mid-run flush must not duplicate a ring index: the rest of the
+    # same wall-clock second lands in the NEXT window
+    store.observe("a", value=2.0)  # clock still inside flushed window 5
+    store.flush()
+    assert [w["index"] for w in store.snapshot()] == [5, 6]
+
+
+# -- detectors: trip / no-trip fixtures per rule -----------------------------
+
+def _run_detector(detector, feeds, width=1.0):
+    """Drive windows through a private store+engine: ``feeds`` is one
+    dict per window, series -> list of observe kwargs."""
+    from tpu_sgd.obs.detect import DetectorEngine
+    from tpu_sgd.obs.timeseries import WindowStore
+
+    clock = {"t": 0.5}
+    store = WindowStore(width_s=width, clock=lambda: clock["t"])
+    engine = DetectorEngine([detector])
+    store.add_close_listener(engine.on_window_close)
+    for wi, feed in enumerate(feeds):
+        clock["t"] = wi + 0.5
+        for series, obs_list in feed.items():
+            for kw in obs_list:
+                store.observe(series, **kw)
+    store.flush()
+    return engine
+
+
+def _vals(v, n=1):
+    return [{"value": v}] * n
+
+
+def test_detector_loss_divergence_trip_and_no_trip():
+    from tpu_sgd.obs.detect import LossDivergenceDetector
+
+    steady = [{"train.loss": _vals(1.0, 4)}] * 3
+    eng = _run_detector(LossDivergenceDetector(),
+                        steady + [{"train.loss": _vals(10.0, 4)}])
+    assert eng.trip_counts() == {"loss-divergence": 1}
+    # a converging run never trips
+    eng = _run_detector(LossDivergenceDetector(), [
+        {"train.loss": _vals(1.0 / (i + 1), 4)} for i in range(6)])
+    assert eng.trip_counts() == {}
+
+
+def test_detector_loss_plateau_trip_and_not_in_defaults():
+    from tpu_sgd.obs.detect import LossPlateauDetector, default_detectors
+
+    flat = [{"train.loss": _vals(0.5, 4)}] * 5
+    eng = _run_detector(LossPlateauDetector(), flat)
+    assert eng.trip_counts() == {"loss-plateau": 1}
+    falling = [{"train.loss": _vals(1.0 / (i + 1), 4)} for i in range(5)]
+    eng = _run_detector(LossPlateauDetector(), falling)
+    assert eng.trip_counts() == {}
+    # a converged run plateaus legitimately: the rule is control-plane
+    # opt-in, NOT part of the default anomaly set
+    assert "loss-plateau" not in {d.rule for d in default_detectors()}
+
+
+def test_detector_staleness_creep_trip_and_no_trip():
+    from tpu_sgd.obs.detect import StalenessCreepDetector
+
+    eng = _run_detector(StalenessCreepDetector(max_staleness=8),
+                        [{"replica.push.staleness": _vals(2.0, 5)}])
+    assert eng.trip_counts() == {}
+    eng = _run_detector(StalenessCreepDetector(max_staleness=8),
+                        [{"replica.push.staleness": _vals(2.0, 5)},
+                         {"replica.push.staleness": _vals(12.0, 1)}])
+    assert eng.trip_counts() == {"staleness-creep": 1}
+
+
+def test_detector_shed_rate_trip_no_trip_and_min_offered():
+    from tpu_sgd.obs.detect import LaneRejectionDetector
+
+    def lane_feed(admitted, shed):
+        return {"serve.admitted.interactive": [{}] * admitted,
+                "serve.shed.interactive": [{}] * shed}
+
+    eng = _run_detector(LaneRejectionDetector(), [lane_feed(30, 30)])
+    assert eng.trip_counts() == {"shed-rate": 1}
+    # healthy lane: rate under threshold
+    eng = _run_detector(LaneRejectionDetector(), [lane_feed(30, 2)])
+    assert eng.trip_counts() == {}
+    # a tiny window cannot trip on 3 requests (min_offered)
+    eng = _run_detector(LaneRejectionDetector(), [lane_feed(1, 2)])
+    assert eng.trip_counts() == {}
+
+
+def test_detector_straggler_trip_no_trip_and_fleet_silence():
+    """The rule is cumulative over fleet PROGRESS, not wall clock: a
+    silent worker trips once its peers accumulate min_fleet_steps
+    steps — however many windows that takes — so ambient load that
+    slows the whole fleet down equally can never flake it."""
+    from tpu_sgd.obs.detect import StragglerDetector
+
+    def fleet(*counts):
+        return {f"replica.step[w{i}]": _vals(0.01, c)
+                for i, c in enumerate(counts) if c}
+
+    active = [fleet(5, 5, 5)]
+    # w1 goes silent while the others accumulate 10 peer steps: trip —
+    # whether the progress arrives fast (one window) or slow (many)
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        active + [fleet(5, 0, 5)])
+    assert eng.trip_counts() == {"replica-straggler": 1}
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        active + [fleet(1, 0, 1)] * 5)
+    assert eng.trip_counts() == {"replica-straggler": 1}
+    # a lagging-but-alive worker under the threshold: no trip (the SSP
+    # progress bound caps live lag at ~(n-1)*tau peer steps)
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        active + [fleet(2, 0, 2), fleet(2, 1, 2)] * 3)
+    assert eng.trip_counts() == {}
+    # the whole fleet goes silent (round ended): NOT a straggler
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        active + [fleet(0, 0, 0)] * 6)
+    assert eng.trip_counts() == {}
+
+
+def test_detector_straggler_membership_events_drive_the_roster():
+    """Membership is event-driven (the replica.join/rejoin/leave
+    fan-out): a CLEAN leave removes the worker — its residual deficit
+    cannot false-trip the NEXT fleet sharing this engine — while a
+    death-leave (the .error twin) keeps accumulating until the rejoin,
+    and a joined-but-never-stepped worker is tracked from its join."""
+    from tpu_sgd.obs.detect import StragglerDetector
+
+    def fleet(*counts, extra=None):
+        d = {f"replica.step[w{i}]": _vals(0.01, c)
+             for i, c in enumerate(counts) if c}
+        d.update(extra or {})
+        return d
+
+    # run A ends with w1 slightly behind, leaves CLEANLY; run B's
+    # early windows must not inherit the deficit
+    run_a_end = fleet(4, 0, 4, extra={
+        "replica.leave[w0]": [{}], "replica.leave[w1]": [{}],
+        "replica.leave[w2]": [{}]})
+    run_b = [fleet(0, 0, 0, extra={f"replica.join[w{i}]": [{}]
+                                   for i in range(3)}),
+             fleet(4, 0, 4), fleet(2, 1, 2)]
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        [fleet(3, 3, 3), run_a_end] + run_b)
+    assert eng.trip_counts() == {}
+    # a DEATH-leave keeps the entry hunting: the deficit crosses the
+    # threshold while the worker is gone
+    death = [fleet(3, 3, 3),
+             fleet(3, 0, 3, extra={"replica.leave.error[w1]": [{}]}),
+             fleet(3, 0, 3)]
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10), death)
+    assert eng.trip_counts() == {"replica-straggler": 1}
+    # a worker that JOINED but never stepped is tracked from the join:
+    # peers moving on without it trips the rule
+    spawn_dead = [fleet(0, 0, extra={"replica.join[w0]": [{}],
+                                     "replica.join[w1]": [{}]}),
+                  fleet(6, 0), fleet(6, 0)]
+    eng = _run_detector(StragglerDetector(min_fleet_steps=10),
+                        spawn_dead)
+    assert eng.trip_counts() == {"replica-straggler": 1}
+
+
+def test_detector_wire_ratio_collapse_trip_exempt_and_no_trip():
+    from tpu_sgd.obs.detect import WireRatioDetector
+
+    def wire(fmt, phys, logical):
+        return {f"replica.wire.{fmt}": [{"nbytes": phys}],
+                f"replica.wire.{fmt}.logical": [{"nbytes": logical}]}
+
+    # compression collapsed: topk shipping nearly-dense bytes
+    eng = _run_detector(WireRatioDetector(), [wire("topk", 100_000,
+                                                  105_000)])
+    assert eng.trip_counts() == {"wire-ratio-collapse": 1}
+    # healthy compression
+    eng = _run_detector(WireRatioDetector(), [wire("topk", 10_000,
+                                                  500_000)])
+    assert eng.trip_counts() == {}
+    # dense-f32's 1.0x ratio is BY CONSTRUCTION, never a collapse
+    eng = _run_detector(WireRatioDetector(), [wire("dense-f32", 100_000,
+                                                  100_000)])
+    assert eng.trip_counts() == {}
+
+
+def test_detector_dispatch_regression_trip_no_trip_and_floor():
+    from tpu_sgd.obs.detect import DispatchRegressionDetector
+
+    steady = [{"train.dispatch": [{"n": 100}]}] * 4
+    eng = _run_detector(DispatchRegressionDetector(),
+                        steady + [{"train.dispatch": [{"n": 400}]}])
+    assert eng.trip_counts() == {"dispatch-regression": 1}
+    eng = _run_detector(DispatchRegressionDetector(), steady * 2)
+    assert eng.trip_counts() == {}
+    # idle-phase noise (median under the floor) cannot trip
+    tiny = [{"train.dispatch": [{"n": 2}]}] * 4
+    eng = _run_detector(DispatchRegressionDetector(),
+                        tiny + [{"train.dispatch": [{"n": 12}]}])
+    assert eng.trip_counts() == {}
+
+
+def test_detector_engine_transition_dedup_and_rearm():
+    """A rule that stays tripped across consecutive windows emits ONE
+    alert; after a clean window it re-arms and a new episode emits a
+    new alert."""
+    from tpu_sgd.obs.detect import StalenessCreepDetector
+
+    hot = {"replica.push.staleness": _vals(12.0, 2)}
+    cool = {"replica.push.staleness": _vals(1.0, 2)}
+    eng = _run_detector(StalenessCreepDetector(max_staleness=8),
+                        [hot, hot, hot, cool, hot])
+    assert eng.trip_counts() == {"staleness-creep": 2}
+
+
+def test_detector_alert_is_typed_record_counter_and_flightrec(tmp_path):
+    """The full alert contract end-to-end through the facade: a shed
+    spike trips the rule, the trip is a typed obs_alert record on the
+    trace sink, an obs.alert.<rule> counter, an active alert on the
+    engine, and a flight-recorder dump."""
+    import os
+
+    fr = str(tmp_path / "fr.jsonl")
+    sink = ListSink()
+    obs.enable(sink, detect=True, window_s=0.05, flightrec=fr)
+    try:
+        for _ in range(30):
+            obs_counters.inc("serve.admitted.interactive")
+            obs_counters.inc("serve.shed.interactive")
+        time.sleep(0.06)
+        obs_counters.inc("serve.admitted.interactive")
+        obs.flush_windows()
+        alerts = [p for k, p in sink.records if k == "obs_alert"]
+        assert alerts and alerts[0]["rule"] == "shed-rate"
+        assert alerts[0]["series"] == "serve.lane.interactive"
+        assert obs_counters.snapshot()["obs.alert.shed-rate"]["n"] >= 1
+        eng = obs.detector_engine()
+        assert eng is not None
+        assert eng.trip_counts().get("shed-rate", 0) >= 1
+        assert os.path.exists(fr)
+        recs = JsonLinesEventLog.read(fr)
+        assert recs[0]["kind"] == "flightrec_meta"
+        assert recs[0]["reason"].startswith("alert:shed-rate")
+        assert any(r["kind"] == "obs_window" for r in recs)
+    finally:
+        obs.disable()
+    assert obs.detector_engine() is None  # torn down with the layer
+
+
+def test_clean_seeded_run_trips_no_detectors(rng):
+    """The no-false-positive pin: a fault-free seeded train + serve
+    flow under the DEFAULT detector set raises zero alerts."""
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import Server
+
+    X, y = _data(rng)
+    w0 = np.zeros(6, np.float32)
+    o = _opt()
+    o.optimize_with_history((X, y), w0)  # warm before enabling
+    sink = ListSink()
+    obs.enable(sink, detect=True, window_s=0.25)
+    try:
+        w, _ = o.optimize_with_history((X, y), w0)
+        with Server(LinearRegressionModel(np.asarray(w), 0.0),
+                    max_latency_s=0.002) as srv:
+            futs = [srv.submit(X[i]) for i in range(64)]
+            for f in futs:
+                f.result(timeout=30)
+        obs.flush_windows()
+        assert [k for k, _ in sink.records if k == "obs_alert"] == []
+        assert obs.detector_engine().trip_counts() == {}
+    finally:
+        obs.disable()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_dumps_on_error_unwind(tmp_path):
+    """An error crossing a span boundary triggers a dump: the ring
+    holds the erroring span record itself, the meta header names the
+    span, and the run keeps going (the recorder never re-raises)."""
+    trace = str(tmp_path / "t.jsonl")
+    fr = str(tmp_path / "fr.jsonl")
+    obs.enable(trace, flightrec=fr)
+    try:
+        with obs.span("serve.batch", batch=4):
+            pass  # a healthy span first: it must be IN the ring
+        with pytest.raises(ValueError):
+            with obs.span("train.superstep", i0=9):
+                raise ValueError("boom")
+    finally:
+        obs.disable()
+    recs = JsonLinesEventLog.read(fr)
+    meta = recs[0]
+    assert meta["kind"] == "flightrec_meta"
+    assert meta["reason"] == "span-error:train.superstep"
+    assert meta["detail"] == "ValueError"
+    spans = [r for r in recs if r["kind"] == "trace_span"]
+    assert [s["name"] for s in spans] == ["serve.batch",
+                                          "train.superstep"]
+    assert spans[1]["error"] == "ValueError"
+
+
+def test_flight_recorder_ring_is_bounded_and_dump_replaces(tmp_path):
+    from tpu_sgd.obs.flightrec import FlightRecorder
+
+    fr = FlightRecorder(str(tmp_path / "fr.jsonl"), capacity=8)
+    for i in range(100):
+        fr.record("trace_event", {"name": "e", "i": i})
+    assert fr.trigger("first") is not None
+    recs = JsonLinesEventLog.read(fr.path)
+    assert len(recs) == 1 + 8  # meta + the BOUNDED ring tail
+    assert [r["i"] for r in recs[1:]] == list(range(92, 100))
+    fr.record("trace_event", {"name": "e", "i": 100})
+    fr.trigger("second", detail="why")
+    recs = JsonLinesEventLog.read(fr.path)  # replaced, not appended
+    assert recs[0]["reason"] == "second"
+    assert recs[0]["dump_ordinal"] == 2
+    assert recs[-1]["i"] == 100
+
+
+# -- live series feeds -------------------------------------------------------
+
+def test_server_healthz_carries_windows_snapshot(rng):
+    from tpu_sgd.models import LinearRegressionModel
+    from tpu_sgd.serve import Server
+
+    X, _ = _data(rng)
+    model = LinearRegressionModel(np.zeros(6, np.float32), 0.0)
+    with Server(model, max_latency_s=0.002) as srv:
+        srv.predict(X[0], timeout=30)
+        assert srv.healthz()["windows"] is None  # layer off: honest None
+    sink = ListSink()
+    obs.enable(sink, window_s=0.05)
+    try:
+        with Server(model, max_latency_s=0.002) as srv:
+            for i in range(8):
+                srv.predict(X[i], timeout=30)
+            wins = srv.healthz()["windows"]
+    finally:
+        obs.disable()
+    assert wins, "no serve windows recorded"
+    names = {n for w in wins for n in w["series"]}
+    assert any(n.startswith("serve.") for n in names)
+
+
+def test_replica_driver_windows_snapshot(rng):
+    from tpu_sgd.replica import ReplicaDriver
+
+    X, y = _data(rng, n=64)
+    w0 = np.zeros(6, np.float32)
+    sink = ListSink()
+    obs.enable(sink, window_s=0.05)
+    try:
+        drv = (ReplicaDriver().set_num_iterations(8).set_step_size(0.1)
+               .set_mini_batch_fraction(1.0).set_convergence_tol(0.0)
+               .set_seed(3).set_workers(2).set_staleness(0))
+        drv.optimize_with_history((X, y), w0)
+        wins = drv.last_windows_snapshot
+    finally:
+        obs.disable()
+    assert wins, "no replica windows recorded"
+    names = {n for w in wins for n in w["series"]}
+    assert any(n.startswith("replica.step[") for n in names)
+    assert "replica.push.staleness" in names  # the version-gap series
+    assert drv.windows() is None  # layer off again: honest None
+
+
+# -- report: windows, alerts, window SLO metrics -----------------------------
+
+def test_report_windowed_stats_alerts_and_staleness_buckets(tmp_path):
+    records = obs_report.load_trace(_mk_trace(tmp_path))
+    wins = obs_report.windowed_stats(records, 1.0)
+    by_idx = {w["index"]: w for w in wins}
+    # the four serve.batch spans land one per second at ts 10..13
+    for i in range(10, 14):
+        assert by_idx[i]["spans"]["serve.batch"]["count"] == 1
+    assert by_idx[131]["alerts"][0]["rule"] == "shed-rate"
+    # the staleness join gains its time dimension: bucketed at reload ts
+    assert by_idx[130]["staleness"] == [
+        {"version": 40, "staleness_s": 30.0}]
+    txt = obs_report.render_windows(wins)
+    assert "window 10" in txt and "ALERT [shed-rate]" in txt
+    stats = obs_report.alert_stats(records)
+    assert stats["count"] == 1 and stats["by_rule"] == {"shed-rate": 1}
+    # a foreign/drifted obs_alert missing value/bound degrades the
+    # render, never crashes the report or the live watcher
+    weird = records + [{"kind": "obs_alert", "ts": 132.0,
+                        "rule": "custom", "series": "x"}]
+    assert "value=?" in obs_report.render_report(weird)
+    assert "value=?" in obs_report.render_windows(
+        obs_report.windowed_stats(weird, 1.0))
+
+
+def test_report_window_slo_metrics_absent_is_violation(tmp_path):
+    records = obs_report.load_trace(_mk_trace(tmp_path))
+    verdicts = obs_report.evaluate_slos(records, {"slos": [
+        {"name": "w-p99-bad", "metric": "window_span_p99_s",
+         "span": "serve.batch", "window_s": 1.0, "max": 0.05},
+        {"name": "w-p99-ok", "metric": "window_span_p99_s",
+         "span": "serve.batch", "window_s": 1.0, "max": 0.5},
+        {"name": "w-absent", "metric": "window_span_p99_s",
+         "span": "never.fired", "window_s": 1.0, "max": 10.0},
+        {"name": "w-gap", "metric": "window_span_count_min",
+         "span": "serve.batch", "window_s": 1.0, "min": 1},
+        {"name": "alerts-any", "metric": "alert_count", "max": 0},
+        {"name": "alerts-rule", "metric": "alert_count",
+         "rule": "shed-rate", "min": 1},
+        {"name": "alerts-other", "metric": "alert_count",
+         "rule": "replica-straggler", "max": 0},
+    ]})
+    by = {v["name"]: v for v in verdicts}
+    # the ts-13 window holds the 0.200s span: worst window p99
+    assert not by["w-p99-bad"]["ok"] and by["w-p99-bad"]["value"] == 0.200
+    assert by["w-p99-ok"]["ok"]
+    # a windowed latency bound over a span that never fired: violation
+    assert not by["w-absent"]["ok"] and by["w-absent"]["value"] is None
+    # the grid spans ts 10..131 — the gap windows count ZERO, never
+    # silent green
+    assert not by["w-gap"]["ok"] and by["w-gap"]["value"] == 0
+    assert not by["alerts-any"]["ok"]  # the trace carries one alert
+    assert by["alerts-rule"]["ok"]
+    assert by["alerts-other"]["ok"]    # absent rule counts 0, max 0 holds
+    with pytest.raises(ValueError):
+        obs_report.evaluate_slos(records, {"slos": [
+            {"name": "no-width", "metric": "window_span_p99_s",
+             "span": "serve.batch", "max": 1.0}]})
+
+
+def test_report_cli_window_flag_and_json(tmp_path, capsys):
+    trace = _mk_trace(tmp_path)
+    assert obs_report.main([trace, "--window", "1.0"]) == 0
+    out = capsys.readouterr().out
+    assert "time-bucketed tables" in out and "window 10" in out
+    assert "alerts (1 typed obs_alert trips)" in out
+    assert obs_report.main([trace, "--window", "1.0", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["alerts"]["by_rule"] == {"shed-rate": 1}
+    assert any(w["index"] == 131 for w in doc["windows"])
+
+
+# -- the watch CLI -----------------------------------------------------------
+
+def test_watch_once_renders_windows_and_alerts(tmp_path, capsys):
+    from tpu_sgd.obs import watch as obs_watch
+
+    trace = _mk_trace(tmp_path)
+    with open(trace, "a") as f:
+        f.write('{"kind": "torn_mid')  # a live producer mid-write
+    assert obs_watch.main([trace, "--once", "--window", "1.0",
+                           "--active-s", "1000"]) == 0
+    out = capsys.readouterr().out
+    assert "window 10" in out
+    assert "ACTIVE ALERTS" in out and "shed-rate" in out
+    assert "parse_errors" not in out  # the torn tail is buffered, not
+    #                                   an error
+    assert obs_watch.main([str(tmp_path / "missing.jsonl"),
+                           "--once"]) == 2
+
+
+def test_watch_tail_is_incremental_and_tolerant(tmp_path):
+    from tpu_sgd.obs.watch import TraceTail
+
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write('{"kind": "trace_event", "name": "a", "ts": 1.0}\n')
+        f.write('{"kind": "trace_')  # torn mid-write
+    tail = TraceTail(path)
+    recs = tail.poll()
+    assert [r["name"] for r in recs] == ["a"]
+    with open(path, "a") as f:  # the producer finishes the line
+        f.write('event", "name": "b", "ts": 2.0}\n')
+        f.write('garbage line\n')  # malformed interior: skipped, counted
+        f.write('{"kind": "trace_event", "name": "c", "ts": 3.0}\n')
+    recs = tail.poll()
+    assert [r["name"] for r in recs] == ["b", "c"]
+    assert tail.parse_errors == 1
+    assert tail.poll() == []  # EOF: nothing new
+    tail.close()
+
+
+# -- the bench regression gate -----------------------------------------------
+
+def test_bench_gate_self_check_perturbed_and_missing(tmp_path, capsys):
+    """The CI contract: exit 0 on the committed baselines, 1 on a
+    deliberately perturbed candidate (the gate provably fails bad
+    numbers), 1 on a candidate missing a headline metric, 2 on an
+    unreadable baseline."""
+    import os
+    import shutil
+
+    from scripts import bench_gate
+
+    assert bench_gate.main([]) == 0  # the committed files gate green
+    capsys.readouterr()
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(bench_gate.__file__)))
+    cand = tmp_path / "cand"
+    cand.mkdir()
+    for fname in bench_gate.GATES:
+        shutil.copy(os.path.join(repo, fname), cand / fname)
+    with open(cand / "BENCH_OBS.json") as f:
+        doc = json.load(f)
+    doc["headline"]["superstep_count_deltas"]["dispatches"] = 3
+    with open(cand / "BENCH_OBS.json", "w") as f:
+        json.dump(doc, f)
+    assert bench_gate.main(["--candidate-dir", str(cand)]) == 1
+    assert "GATE FAIL" in capsys.readouterr().out
+    # a vanished candidate metric is a regression, not a skip
+    del doc["headline"]["superstep_count_deltas"]
+    doc["headline"]["superstep_count_deltas"] = {}
+    with open(cand / "BENCH_OBS.json", "w") as f:
+        json.dump(doc, f)
+    assert bench_gate.main(["--candidate-dir", str(cand)]) == 1
+    capsys.readouterr()
+    # unreadable baseline = usage-error class
+    assert bench_gate.main(["--baseline-dir",
+                            str(tmp_path / "nope")]) == 2
+
+
+def test_bench_gate_direction_semantics():
+    from scripts.bench_gate import Gate, check_gate
+
+    base = {"x": {"ratio": 100.0, "count": 10}}
+    # higher-is-better: improvement passes, collapse beyond band fails
+    g = Gate("x/ratio", "higher", rel_tol=0.1)
+    assert check_gate(g, base, {"x": {"ratio": 150.0}})["ok"]
+    assert check_gate(g, base, {"x": {"ratio": 91.0}})["ok"]
+    assert not check_gate(g, base, {"x": {"ratio": 85.0}})["ok"]
+    # lower-is-better: fewer dispatches always pass
+    g = Gate("x/count", "lower", rel_tol=0.1)
+    assert check_gate(g, base, {"x": {"count": 5}})["ok"]
+    assert not check_gate(g, base, {"x": {"count": 12}})["ok"]
+    # equal: drift either way beyond the band fails
+    g = Gate("x/count", "equal")
+    assert check_gate(g, base, {"x": {"count": 10}})["ok"]
+    assert not check_gate(g, base, {"x": {"count": 9}})["ok"]

@@ -216,12 +216,14 @@ class HostSyncRule(Rule):
 
 
 #: the observability layer's record producers: a ``span()`` attr, an
-#: ``event()`` attr, or an ``inc()`` count that receives a DEVICE value
+#: ``event()`` attr, an ``inc()`` count, or an ``observe_scalar()``
+#: time-series value (ISSUE 13) that receives a DEVICE value
 #: serializes it (json.dumps / arithmetic on the payload), forcing a
 #: device->host sync at the record site — on a traced hot path that is
 #: the exact stall the span exists to observe, now CAUSED by observing.
-_OBS_MODULES = {"tpu_sgd.obs", "tpu_sgd.obs.spans", "tpu_sgd.obs.counters"}
-_OBS_FUNCS = {"span", "event", "inc"}
+_OBS_MODULES = {"tpu_sgd.obs", "tpu_sgd.obs.spans", "tpu_sgd.obs.counters",
+                "tpu_sgd.obs.timeseries"}
+_OBS_FUNCS = {"span", "event", "inc", "observe_scalar", "observe"}
 
 
 class ObsDisciplineRule(Rule):

@@ -75,11 +75,21 @@ _ENABLED = False
 class RuntimeCounters:
     """Thread-safe ``name -> {n, bytes}`` accumulator.  Names are
     dotted, leading segment = subsystem (``train.dispatch``,
-    ``serve.host_sync``, ``ingest.h2d_bytes`` ride ``n``/``bytes``)."""
+    ``serve.host_sync``, ``ingest.h2d_bytes`` ride ``n``/``bytes``).
+
+    ``forward`` (a GIL-atomic single reference, default ``None``) tees
+    every inc to a second consumer — the windowed time-series store
+    (``tpu_sgd.obs.timeseries``) installs it on THE global instance so
+    per-window counter series exist without a second set of hook
+    sites.  It is called OUTSIDE the lock (the forward target has its
+    own lock; holding both would invert against the window store's
+    close listeners) and is pure host work, so the zero-added-runtime
+    pin holds with it installed."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: Dict[str, Dict[str, int]] = {}
+        self.forward = None
 
     def inc(self, name: str, n: int = 1, nbytes: int = 0) -> None:
         with self._lock:
@@ -88,6 +98,13 @@ class RuntimeCounters:
                 c = self._counts[name] = {"n": 0, "bytes": 0}
             c["n"] += n
             c["bytes"] += nbytes
+        fwd = self.forward
+        if fwd is not None:
+            try:
+                fwd(name, n, nbytes)
+            except Exception:  # accounting must never kill the hot path
+                logger.warning("counter forward raised; dropped",
+                               exc_info=True)
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
         with self._lock:

@@ -48,7 +48,16 @@ is a batch's worst row) — and ``lane_shed_fraction`` — typed
 rejections (rejected + shed + displaced) over offered requests for the
 lane, from the ``serve.admitted/rejected/shed/displaced.<lane>``
 counter deltas (offered counts each request once: displaced requests
-already sit in admitted).  Every SLO takes ``max`` and/or ``min``.
+already sit in admitted).  ISSUE 13 adds ``alert_count`` (``obs_alert``
+records, optional ``rule`` filter; absent = 0, honest for both a
+``max: 0`` clean gate and a ``min: 1`` the-detector-tripped gate) and
+two WINDOWED metrics taking ``span`` + ``window_s``:
+``window_span_p99_s`` (the worst per-window p99 — unevaluable when the
+span fired in no window, a violation, never silent green) and
+``window_span_count_min`` (the minimum per-window count over the
+trace's whole window grid — a window the span skipped counts ZERO, so
+a mid-run stall fails a ``min`` bound).  Every SLO takes ``max``
+and/or ``min``.
 
 Parsing reuses ``JsonLinesEventLog.read`` — a crash-torn trailing line
 is tolerated (the soak/crash forensics contract), a malformed interior
@@ -151,6 +160,67 @@ def staleness_samples(records: List[dict]) -> List[dict]:
             if v in save_ts:
                 out.append({"version": v,
                             "staleness_s": float(r["ts"]) - save_ts[v]})
+    return out
+
+
+def alert_stats(records: List[dict]) -> dict:
+    """The trace's typed detector trips (``obs_alert`` records,
+    ``tpu_sgd.obs.detect``): ``{"count", "by_rule": {rule: n},
+    "alerts": [records...]}`` — the report's alerts section and the
+    ``alert_count`` SLO metric both read this."""
+    alerts = [r for r in records if r.get("kind") == "obs_alert"]
+    by_rule: Dict[str, int] = {}
+    for a in alerts:
+        rule = a.get("rule", "?")
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+    return {"count": len(alerts), "by_rule": by_rule, "alerts": alerts}
+
+
+def windowed_stats(records: List[dict], width_s: float) -> List[dict]:
+    """Time-bucketed per-stage tables: ``trace_span`` records bucketed
+    by their epoch ``ts`` into fixed ``width_s`` windows — the OFFLINE
+    twin of the live ``obs.timeseries`` ring (same fixed-width
+    windowing, same nearest-rank percentiles), computed from the raw
+    records so any trace gains a time dimension after the fact.  Each
+    entry: ``{index, t_start, t_end, spans: {name: span_stats-row},
+    alerts: [obs_alert records], staleness: [samples]}``.  Windows the
+    trace never touched are ABSENT here; the window SLO metrics treat
+    absent as zero/violation, never silent green."""
+    if width_s <= 0:
+        raise ValueError(f"window width must be > 0, got {width_s}")
+    buckets: Dict[int, List[dict]] = {}
+    for r in records:
+        kind = r.get("kind")
+        if kind not in ("trace_span", "obs_alert") or "ts" not in r:
+            continue
+        # an alert DESCRIBES a window (its t_start) but is EMITTED at
+        # dispatch time, at least one window later (arbitrarily later
+        # after a stall) — bucket it where the anomaly happened, next
+        # to the spans it indicts, not where the detector ran
+        ts = (float(r.get("t_start", r["ts"])) if kind == "obs_alert"
+              else float(r["ts"]))
+        buckets.setdefault(int(ts // width_s), []).append(r)
+    # the staleness join gains its time dimension here: each sample is
+    # bucketed at its RELOAD's ts (the moment the gap was served)
+    stale_by_idx: Dict[int, List[dict]] = {}
+    reload_ts = {int(r["version"]): float(r["ts"]) for r in records
+                 if r.get("kind") == "serve_reload"
+                 and r.get("event") == "reloaded"}
+    for s in staleness_samples(records):
+        ts = reload_ts.get(s["version"])
+        if ts is not None:
+            stale_by_idx.setdefault(int(ts // width_s), []).append(s)
+    out = []
+    for idx in sorted(set(buckets) | set(stale_by_idx)):
+        bucket = buckets.get(idx, [])
+        out.append({
+            "index": idx,
+            "t_start": idx * width_s,
+            "t_end": (idx + 1) * width_s,
+            "spans": span_stats(bucket),
+            "alerts": [r for r in bucket if r.get("kind") == "obs_alert"],
+            "staleness": stale_by_idx.get(idx, []),
+        })
     return out
 
 
@@ -280,6 +350,22 @@ def evaluate_slos(records: List[dict], slo_doc: dict) -> List[dict]:
         raise ValueError('SLO document must have a "slos" list')
     stats = span_stats(records)
     counters = counter_deltas(records)
+    # pure functions of the records: compute once per document, not
+    # once per SLO (a soak trace runs to 10^5 records, and the harness
+    # documents carry several alert/window entries)
+    alerts_memo: List[Optional[dict]] = [None]
+    windows_memo: Dict[float, List[dict]] = {}
+
+    def _alerts() -> dict:
+        if alerts_memo[0] is None:
+            alerts_memo[0] = alert_stats(records)
+        return alerts_memo[0]
+
+    def _windows(width: float) -> List[dict]:
+        if width not in windows_memo:
+            windows_memo[width] = windowed_stats(records, width)
+        return windows_memo[width]
+
     verdicts = []
     for i, slo in enumerate(slos):
         metric = slo.get("metric")
@@ -345,6 +431,49 @@ def evaluate_slos(records: List[dict], slo_doc: dict) -> List[dict]:
                           "counters in trace")
             else:
                 value = st["reject_rate"]
+        elif metric == "alert_count":
+            # typed detector trips (ISSUE 13): an absent rule counts 0
+            # — honest for both directions (max 0 = clean-run gate,
+            # min 1 = the-detector-really-tripped gate)
+            rule = slo.get("rule")
+            stats_a = _alerts()
+            value = (stats_a["by_rule"].get(rule, 0)
+                     if rule else stats_a["count"])
+        elif metric in ("window_span_p99_s", "window_span_count_min"):
+            span_name = slo.get("span")
+            width = slo.get("window_s")
+            if not span_name or not width:
+                raise ValueError(f"SLO {name!r}: window metrics need "
+                                 '"span" and "window_s" fields')
+            wins = _windows(float(width))
+            per = [w["spans"][span_name] for w in wins
+                   if span_name in w["spans"]]
+            if metric == "window_span_p99_s":
+                if not per:
+                    # a windowed latency bound over a span that never
+                    # fired cannot be evaluated — a violation, never
+                    # silent green
+                    value = None
+                    detail = (f"span {span_name!r} absent from every "
+                              "window")
+                else:
+                    value = max(st["p99_s"] for st in per)
+            else:
+                if not wins:
+                    value = None
+                    detail = "trace has no windows at all"
+                else:
+                    # the MINIMUM per-window count over the trace's
+                    # whole [first, last] window grid: a window the
+                    # span skipped counts ZERO (a serving stall is a
+                    # gap, not a missing row)
+                    lo = min(w["index"] for w in wins)
+                    hi = max(w["index"] for w in wins)
+                    by_idx = {w["index"]: w for w in wins}
+                    value = min(
+                        by_idx.get(i, {"spans": {}})["spans"]
+                        .get(span_name, {"count": 0})["count"]
+                        for i in range(lo, hi + 1))
         else:
             raise ValueError(f"SLO {name!r}: unknown metric {metric!r}")
         lo, hi = slo.get("min"), slo.get("max")
@@ -370,6 +499,13 @@ def evaluate_slos(records: List[dict], slo_doc: dict) -> List[dict]:
 
 def _fmt_s(x: float) -> str:
     return f"{x * 1e3:9.3f}ms" if x < 1.0 else f"{x:8.3f}s "
+
+
+def _fmt_num(x) -> str:
+    """Alert value/bound formatting that survives a record missing the
+    field (a foreign producer or schema drift must degrade the render,
+    never crash the report or the live watcher)."""
+    return f"{x:.4g}" if isinstance(x, (int, float)) else "?"
 
 
 def render_report(records: List[dict]) -> str:
@@ -428,6 +564,52 @@ def render_report(records: List[dict]) -> str:
         worst = max(s["staleness_s"] for s in stale)
         lines.append(f"served-weight staleness: {len(stale)} reload(s), "
                      f"worst {worst:.3f}s")
+    alerts = alert_stats(records)
+    if alerts["count"]:
+        lines.append(f"alerts ({alerts['count']} typed obs_alert "
+                     "trips):")
+        for rule, n in sorted(alerts["by_rule"].items()):
+            lines.append(f"  {rule:<28}{n:>5}")
+        for a in alerts["alerts"][:20]:
+            lines.append(
+                f"    [{a.get('rule')}] {a.get('series')}: "
+                f"value={_fmt_num(a.get('value'))} "
+                f"bound={_fmt_num(a.get('bound'))}"
+                f"  {a.get('detail', '')}")
+        if alerts["count"] > 20:
+            lines.append(f"    ... {alerts['count'] - 20} more")
+    return "\n".join(lines)
+
+
+def render_windows(windows: List[dict], last: Optional[int] = None) -> str:
+    """Text tables for :func:`windowed_stats` output (shared by the
+    report CLI's ``--window`` and the live watch CLI)."""
+    lines = []
+    if last is not None:
+        windows = windows[-int(last):]
+    if not windows:
+        return "no windowed records"
+    for w in windows:
+        head = (f"window {w['index']}  [{w['t_start']:.3f}, "
+                f"{w['t_end']:.3f})")
+        if w["alerts"]:
+            head += f"  ALERTS={len(w['alerts'])}"
+        lines.append(head)
+        if w["spans"]:
+            lines.append(f"  {'span':<28}{'count':>7}{'p50':>12}"
+                         f"{'p99':>12}{'max':>12}{'err':>5}")
+            for name, st in w["spans"].items():
+                lines.append(
+                    f"  {name:<28}{st['count']:>7}"
+                    f"{_fmt_s(st['p50_s']):>12}{_fmt_s(st['p99_s']):>12}"
+                    f"{_fmt_s(st['max_s']):>12}{st['errors']:>5}")
+        for a in w["alerts"]:
+            lines.append(f"  ALERT [{a.get('rule')}] {a.get('series')}: "
+                         f"value={_fmt_num(a.get('value'))} "
+                         f"bound={_fmt_num(a.get('bound'))}")
+        for s in w["staleness"]:
+            lines.append(f"  staleness: version {s['version']} served "
+                         f"{s['staleness_s']:.3f}s old")
     return "\n".join(lines)
 
 
@@ -441,9 +623,20 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", metavar="SLO.json",
                     help="evaluate a declarative SLO file; exit 1 on "
                          "violation")
+    ap.add_argument("--window", metavar="SECONDS", type=float,
+                    default=None,
+                    help="add time-bucketed per-stage tables at this "
+                         "window width (the offline twin of the live "
+                         "obs.timeseries ring)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     args = ap.parse_args(argv)
+    if args.window is not None and args.window <= 0:
+        # the exit-code contract: 2 is the usage-error class, never a
+        # traceback (1 is reserved for SLO violations)
+        print(f"error: --window must be > 0, got {args.window}",
+              file=sys.stderr)
+        return 2
     try:
         records = load_trace(args.trace)
     except (OSError, json.JSONDecodeError) as e:
@@ -481,12 +674,18 @@ def main(argv=None) -> int:
                "wire": wire_ratios(counter_deltas(records)),
                "staleness": staleness_samples(records),
                "lanes": {"latency": lane_latency_stats(records),
-                         "admission": lane_admission_stats(records)}}
+                         "admission": lane_admission_stats(records)},
+               "alerts": alert_stats(records)}
+        if args.window:
+            out["windows"] = windowed_stats(records, args.window)
         if verdicts is not None:
             out["slos"] = verdicts
         print(json.dumps(out, indent=2))
     else:
         print(render_report(records))
+        if args.window:
+            print(f"time-bucketed tables ({args.window:g}s windows):")
+            print(render_windows(windowed_stats(records, args.window)))
         if verdicts is not None:
             for v in verdicts:
                 bound = " ".join(

@@ -81,6 +81,15 @@ _SINK = None                  # object with .emit(kind, payload)
 _IDS = itertools.count(1)     # process-wide span ids (atomic under GIL)
 _TL = threading.local()       # .stack: list of _Span; .tag: str
 
+#: the windowed time-series hooks (``tpu_sgd.obs.timeseries`` installs
+#: them): ``_ON_SPAN(name, dur_s, ts, attrs, error)`` fires on every
+#: span close, ``_ON_EVENT(name, ts, attrs)`` on every instant event —
+#: both GIL-atomic single references swapped whole like ``_SINK``, both
+#: pure host work (the zero-added-runtime-events pin holds with the
+#: time-series ON), and a raising hook is dropped, never propagated.
+_ON_SPAN = None
+_ON_EVENT = None
+
 
 def _stack():
     st = getattr(_TL, "stack", None)
@@ -192,6 +201,14 @@ class _Span:
             except Exception:  # observability must never kill hot paths
                 logger.warning("trace sink raised; span record dropped",
                                exc_info=True)
+        hook = _ON_SPAN
+        if hook is not None:
+            try:
+                hook(self.name, dur, self.ts, self.attrs,
+                     exc_type.__name__ if exc_type is not None else None)
+            except Exception:
+                logger.warning("time-series span hook raised; dropped",
+                               exc_info=True)
         return False
 
 
@@ -231,6 +248,13 @@ def event(name: str, **attrs) -> None:
     except Exception:
         logger.warning("trace sink raised; event record dropped",
                        exc_info=True)
+    hook = _ON_EVENT
+    if hook is not None:
+        try:
+            hook(name, payload["ts"], attrs)
+        except Exception:
+            logger.warning("time-series event hook raised; dropped",
+                           exc_info=True)
 
 
 def enable_tracing(sink) -> None:

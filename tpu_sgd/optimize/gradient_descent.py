@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from tpu_sgd.config import SGDConfig
 from tpu_sgd.obs.spans import span
+from tpu_sgd.obs.timeseries import observe_scalar
 from tpu_sgd.ops.gradients import Gradient, LeastSquaresGradient
 from tpu_sgd.ops.gram import DEFAULT_BLOCK_ROWS
 from tpu_sgd.ops.sparse import is_sparse
@@ -359,6 +360,11 @@ def observe_step(
         float(v)
         for v in np.asarray(step_norms(new_w, prev_w))
     )
+    # the live loss/variance series (obs.timeseries): these are the
+    # host floats the bookkeeping already fetched — the near-free
+    # AdaBatch sensor, ZERO added syncs; disabled = one global load
+    observe_scalar("train.loss", loss_f)
+    observe_scalar("train.weight_delta", delta)
     if listener is not None:
         listener.on_iteration(IterationEvent(
             iteration=i,
@@ -733,6 +739,11 @@ def _replay_fused_steps(
                 _raise_if_nonfinite([loss_f], first_iteration=i)
             losses.append(loss_f)
             reg_val = float(rs[t])
+            # live loss/variance series from the replayed ys — the
+            # values are ALREADY host numpy (one bulk fetch per
+            # superstep), so the zero-added-syncs pin holds
+            observe_scalar("train.loss", loss_f)
+            observe_scalar("train.weight_delta", float(dns[t]))
             if listener is not None:
                 listener.on_iteration(IterationEvent(
                     iteration=i,

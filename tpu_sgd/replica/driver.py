@@ -119,6 +119,7 @@ class ReplicaDriver:
         self._loss_history = None
         self.last_store_snapshot = None
         self.last_membership_snapshot = None
+        self.last_windows_snapshot = None
 
     # -- fluent config (the GradientDescent subset that applies) -----------
     def set_step_size(self, s: float):
@@ -216,6 +217,18 @@ class ReplicaDriver:
     @property
     def loss_history(self):
         return self._loss_history
+
+    def windows(self):
+        """The LIVE windowed time-series for the replica subsystem
+        (``tpu_sgd.obs.timeseries``): per-window ``replica.step[wid]``
+        durations/counts (the per-worker straggler-skew surface),
+        push/pull counters, and the accepted-push ``staleness`` value
+        series.  Scrape it from another thread mid-run; ``None`` when
+        the obs layer is off.  The final snapshot of a finished run
+        survives as ``last_windows_snapshot``."""
+        from tpu_sgd.obs import timeseries
+
+        return timeseries.snapshot(prefix="replica")
 
     def optimize(self, data, initial_weights):
         w, _ = self.optimize_with_history(data, initial_weights)
@@ -353,6 +366,7 @@ class ReplicaDriver:
                 t.join(timeout=60.0)
             self.last_store_snapshot = store.snapshot()
             self.last_membership_snapshot = membership.snapshot()
+            self.last_windows_snapshot = self.windows()
 
         if fatal is not None:
             raise fatal

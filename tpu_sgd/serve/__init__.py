@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+from tpu_sgd.obs import timeseries as obs_timeseries
 from tpu_sgd.serve.batcher import (LANES, BackpressureError, MicroBatcher,
                                    Overloaded)
 from tpu_sgd.serve.engine import DEFAULT_BUCKETS, PredictEngine, stack_rows
@@ -200,6 +201,11 @@ class Server:
             "shed_count": sum(s["shed"] + s["displaced"]
                               for s in lanes.values()),
             "p99_batch_wall_s": self.batcher.p99_batch_wall_s(),
+            # the live windowed time-series for the serve subsystem
+            # (ISSUE 13): per-window span/counter aggregates from the
+            # bounded obs.timeseries ring, or None when the layer is
+            # off — pure host dict reads, still cheap enough to scrape
+            "windows": obs_timeseries.snapshot(prefix="serve", last=8),
         }
         if self.registry is not None:
             h["registry"] = self.registry.healthz()
