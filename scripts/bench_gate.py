@@ -115,6 +115,23 @@ GATES = {
              rel_tol=0.05),
         Gate("merge_wire/ratio", "higher", rel_tol=0.10),
     ],
+    "BENCH_ASYNC.json": [
+        # the HA failover cell (ISSUE 14): structural counts — a kill
+        # must produce EXACTLY one promotion, and the τ=0 post-failover
+        # trajectory must stay bitwise (1 = equal; any drift is a
+        # correctness regression, not noise)
+        Gate("failover/failovers", "equal",
+             note="the store-kill cell must fail over exactly once"),
+        Gate("failover/bitwise_vs_fault_free", "equal",
+             note="τ=0 failover must replay, not fork — ADVICE.md "
+                  "'Failover is a replay, not a restart'"),
+        # the compressed failover twin's matched-objective bar: the
+        # baseline sits well UNDER 1.0 (EF carry beats dense sync at
+        # this config), the wide band absorbs τ>=1 interleaving noise,
+        # and the bench's own <=1.01 assertion stays the hard ceiling
+        Gate("failover/compressed/objective_ratio_vs_sync", "lower",
+             rel_tol=0.25),
+    ],
 }
 
 _SEG = re.compile(r"^(?P<key>.*?)(?P<idx>(\[\d+\])*)$")

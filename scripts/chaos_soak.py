@@ -47,6 +47,14 @@ rule; both gated by ``alert_count`` SLOs), and the flight recorder
 dumps its ring + window snapshots to ``<trace>.flightrec.jsonl`` on
 every alert transition, schema-validated before the report runs.
 
+The HA store layer (ISSUE 14) is soaked in phase 1f: a τ=0 fleet with
+one standby has its PRIMARY STORE killed mid-round (bitwise vs
+fault-free asserted — failover is a replay), and a τ=2 compressed
+fleet carries one worker PARTITIONED through a full failover (fenced
+stale-epoch pushes counted, matched objective, zero lost EF mass);
+the ``replica.failover`` span, its downtime bound, and the failover
+detector's typed alert are all gated by the default SLOs.
+
 Exit code 0 = all invariants held.  Also exposed as the ``slow``-marked
 ``tests/test_reliability.py::test_chaos_soak`` (excluded from tier-1).
 """
@@ -97,6 +105,16 @@ DEFAULT_SLOS = {"slos": [
      "rule": "shed-rate", "min": 1},
     {"name": "straggler-alert-fired", "metric": "alert_count",
      "rule": "replica-straggler", "min": 1},
+    # the HA store failover (phase 1f): the promotion really ran (its
+    # span is the downtime surface — bounded loosely here, the 2-core
+    # walls are weather) and the failover detector emitted its typed
+    # alert on this trace
+    {"name": "store-failover-traced", "metric": "span_count",
+     "span": "replica.failover", "min": 1},
+    {"name": "failover-downtime-bounded", "metric": "span_max_s",
+     "span": "replica.failover", "max": 30.0},
+    {"name": "failover-alert-fired", "metric": "alert_count",
+     "rule": "failover", "min": 1},
 ]}
 
 
@@ -609,6 +627,89 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             }
             say(f"straggler detector tripped {strag_trips} time(s) "
                 f"across {len(wins)} live windows; victim rejoined")
+
+        # ---- phase 1f: HA store failover (ISSUE 14) ----------------------
+        # the availability layer under fire: (a) τ=0 with ONE standby
+        # and the primary store KILLED mid-round (the replica.store_fail
+        # failpoint raising StoreFailed at a store access) must be
+        # BITWISE the fault-free τ=0 run — failover is a replay, not a
+        # restart; (b) τ=2 with compressed pushes, one worker
+        # PARTITIONED through a full failover (partition → primary kill
+        # → heal, all while the fleet runs) must complete every version
+        # with the staleness bound intact, fenced stale-epoch pushes
+        # counted, and a matched objective — the partition is just a
+        # longer rejection, zero EF mass lost
+        from tpu_sgd.replica import StoreFailed
+
+        deadline = Deadline(300.0)
+        ha_drv = _make_replica(0).set_standbys(1)
+        # ~8 store accesses per τ=0 version (4 pulls + 4 pushes): the
+        # one-shot kill at 4*rep_iters lands mid-run (~version N/2)
+        with inject_faults({"replica.store_fail": fp.fail_nth(
+                4 * rep_iters, exc=StoreFailed)}):
+            w_ha, h_ha = ha_drv.optimize_with_history((X, y), w0)
+        deadline.check("HA store-kill chaos phase")
+        ha_snap = ha_drv.last_failover_snapshot
+        assert ha_snap["failovers"] == 1, ha_snap
+        np.testing.assert_array_equal(
+            np.asarray(w_ha), w_rep_ref,
+            err_msg="τ=0 weights diverged across the store failover")
+        np.testing.assert_array_equal(
+            h_ha, h_rep_ref,
+            err_msg="τ=0 loss history diverged across the store failover")
+        summary["store_failover"] = ha_snap["records"][0]
+        say(f"store failover at τ=0 BITWISE: {ha_snap['records'][0]}")
+
+        # (b) partition one worker THROUGH the failover
+        deadline = Deadline(300.0)
+        part_iters = 8 * rep_iters
+        part_drv = (_make_replica(
+            2, retry=RetryPolicy(max_attempts=400, base_backoff_s=0.01,
+                                 max_backoff_s=0.05, seed=seed + 70),
+            iters=part_iters)
+            .set_standbys(1).set_wire_compress("topk:0.25"))
+        import threading as _threading
+
+        timers = [
+            _threading.Timer(0.25, part_drv.partition_worker, ("w1",)),
+            _threading.Timer(0.6, part_drv.kill_primary),
+            _threading.Timer(1.2, part_drv.heal_worker, ("w1",)),
+        ]
+        for t in timers:
+            t.start()
+        try:
+            w_pt, h_pt = part_drv.optimize_with_history((X, y), w0)
+        finally:
+            for t in timers:
+                t.cancel()
+        deadline.check("HA partition chaos phase")
+        pt_snap = part_drv.last_store_snapshot
+        assert part_drv.last_failover_snapshot["failovers"] == 1, (
+            part_drv.last_failover_snapshot)
+        assert pt_snap["version"] == part_iters, pt_snap
+        assert pt_snap["max_accepted_staleness"] <= 2, pt_snap
+        assert pt_snap["pushes_fenced"] >= 1, (
+            "no push was ever epoch-fenced across the failover")
+        obj_pt = _objective(w_pt)
+        assert obj_pt <= _objective(w_rep_ref) * 1.01, (
+            f"partitioned-through-failover objective {obj_pt}")
+        summary["store_partition"] = {
+            "failovers": part_drv.last_failover_snapshot["failovers"],
+            "pushes_fenced": pt_snap["pushes_fenced"],
+            "pushes_rejected": pt_snap["pushes_rejected"],
+            "objective_ratio_vs_sync": obj_pt / _objective(w_rep_ref),
+        }
+        say(f"partition through failover survived: "
+            f"{summary['store_partition']}")
+        if trace_path is not None:
+            obs.flush_windows()
+            fo_trips = obs.snapshot().get(
+                "obs.alert.failover", {"n": 0})["n"]
+            assert fo_trips >= 1, (
+                "two store promotions ran but the failover detector "
+                "never tripped")
+            summary["failover_alerts"] = fo_trips
+            say(f"failover detector tripped {fo_trips} time(s)")
 
         # ---- phase 2: serving under reload faults ------------------------
         deadline = Deadline(120.0)

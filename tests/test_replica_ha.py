@@ -1,0 +1,701 @@
+"""Highly-available parameter store: replicated delta log,
+deterministic failover, partition-tolerant workers
+(``tpu_sgd/replica/ha.py``).
+
+The load-bearing pins:
+
+* a standby replaying the delta log is BITWISE the primary at every
+  version (loss AND weight-delta per applied version, via listeners on
+  both stores);
+* τ=0 with the primary killed mid-round is BITWISE the fault-free run
+  after failover — failover is a replay, not a restart (ADVICE.md);
+* epoch fencing: a stale-epoch push comes back ``fenced`` (never
+  merged), a resurrected primary's delta records are refused at the
+  log, and a fenced old primary's late checkpoint save never shadows
+  the promoted store's state (the ``(epoch, version)`` restore order);
+* a worker partitioned through a full failover rejoins the contract
+  with ZERO lost error-feedback mass;
+* double failure (primary and every standby) falls back to checkpoint
+  cold recovery with a loud warning — and at τ=0 is STILL bitwise,
+  because the lost versions recompute from ``(seed, version)``;
+* preemption during an in-flight failover waits for promotion to
+  settle, so ``TrainingPreempted`` unwinds from a consistent
+  ``(epoch, version)`` (the PR's recorded bugfix), and a stopped store
+  never applies a partial τ=0 round (the preempt-poison regression).
+"""
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.updaters import SquaredL2Updater
+from tpu_sgd.replica import (ParameterStore, ReplicaDriver, ReplicaWorker,
+                             StoreFailed, StoreFenced, StoreSupervisor,
+                             StoreUnreachable, shard_rows)
+from tpu_sgd.replica.ha import DeltaRecord
+from tpu_sgd.reliability import failpoints as fp
+from tpu_sgd.reliability.retry import RetryPolicy
+from tpu_sgd.utils.checkpoint import CheckpointManager
+from tpu_sgd.utils.events import CollectingListener
+
+
+def _data(n=256, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, np.zeros(d, np.float32)
+
+
+def _driver(*, iters=24, frac=0.5, step=0.3, reg=0.1, workers=4, tau=0,
+            standbys=0):
+    drv = (ReplicaDriver(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(step).set_num_iterations(iters)
+           .set_mini_batch_fraction(frac).set_convergence_tol(0.0)
+           .set_reg_param(reg).set_workers(workers).set_staleness(tau))
+    if standbys:
+        drv.set_standbys(standbys)
+    return drv
+
+
+def _full_objective(X, y, w, reg):
+    r = X @ np.asarray(w) - y
+    return float(0.5 * np.mean(r * r)
+                 + 0.5 * reg * np.sum(np.asarray(w) ** 2))
+
+
+def _cfg(**kw):
+    base = dict(step_size=0.2, num_iterations=40,
+                mini_batch_fraction=1.0, convergence_tol=0.0,
+                reg_param=0.01)
+    base.update(kw)
+    return SGDConfig(**base)
+
+
+def _store_pair(cfg, w0, *, tau=0, shared_ef=None, primary_listener=None,
+                standby_listener=None, **sup_kw):
+    """A primary + one standby under a supervisor (the direct, no-driver
+    composition unit tests drive)."""
+    ef = shared_ef if shared_ef is not None else {}
+    primary = ParameterStore(SquaredL2Updater(), cfg, w0, staleness=tau,
+                             listener=primary_listener, ef_registry=ef,
+                             name="s0")
+    standby = ParameterStore(SquaredL2Updater(), cfg, w0, staleness=tau,
+                             listener=standby_listener, ef_registry=ef,
+                             name="s1")
+    sup = StoreSupervisor([primary, standby], **sup_kw)
+    return primary, standby, sup
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, payload):
+        self.records.append((kind, dict(payload)))
+
+
+# -- standby bitwise ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("tau", [0, 2])
+def test_standby_bitwise_at_every_version(tau):
+    """The delta log replays, it does not approximate: the standby's
+    per-version loss and weight-delta (listener events) and its final
+    weights are bitwise the primary's."""
+    X, y, w0 = _data(n=128, d=8, seed=3)
+    cfg = _cfg(num_iterations=20, mini_batch_fraction=0.5, step_size=0.3)
+    p_lis, s_lis = CollectingListener(), CollectingListener()
+    primary, standby, sup = _store_pair(
+        cfg, w0, tau=tau, primary_listener=p_lis,
+        standby_listener=s_lis)
+    client = sup.client()
+    shards = shard_rows(X, y, 2)
+    workers = [ReplicaWorker(f"w{s}", s, client, LeastSquaresGradient(),
+                             cfg, *shards[s]) for s in range(2)]
+    for s in range(2):
+        client.register_worker(f"w{s}", s)
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    sup.stop()  # drains the standby to the log head
+    np.testing.assert_array_equal(standby.loss_history(),
+                                  primary.loss_history())
+    np.testing.assert_array_equal(np.asarray(standby.weights),
+                                  np.asarray(primary.weights))
+    assert len(p_lis.iterations) == len(s_lis.iterations) == 20
+    for pe, se in zip(p_lis.iterations, s_lis.iterations):
+        assert (pe.iteration, pe.loss, pe.weight_delta_norm) == (
+            se.iteration, se.loss, se.weight_delta_norm)
+
+
+def test_ha_fault_free_bitwise_vs_single_store():
+    """Replication is pure observation: a fault-free HA run is bitwise
+    the single-store run (weights AND loss history)."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _driver(tau=0).optimize_with_history((X, y), w0)
+    drv = _driver(tau=0, standbys=1)
+    w_ha, h_ha = drv.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_ha), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_ha, h_ref)
+    assert drv.last_failover_snapshot["failovers"] == 0
+    # and the standby store ended bitwise too (drained at stop)
+    standby = drv.last_supervisor._stores[1]
+    np.testing.assert_array_equal(standby.loss_history(), h_ref)
+
+
+# -- kill the primary mid-round ----------------------------------------------
+
+
+def test_tau0_kill_primary_mid_round_bitwise():
+    """THE acceptance pin: τ=0 with the primary store killed mid-round
+    is BITWISE the fault-free run after failover — the promoted standby
+    replays the log gap and the workers' re-routed (fenced → re-pull →
+    recompute) rounds are deterministic in (seed, version)."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _driver(tau=0).optimize_with_history((X, y), w0)
+    drv = _driver(tau=0, standbys=1)
+    # ~8 store accesses per version (4 pulls + 4 pushes): hit 100 lands
+    # the kill mid-run
+    with fp.inject_faults({"replica.store_fail":
+                           fp.fail_nth(100, exc=StoreFailed)}):
+        w_k, h_k = drv.optimize_with_history((X, y), w0)
+    snap = drv.last_failover_snapshot
+    assert snap["failovers"] == 1, snap
+    rec = snap["records"][0]
+    assert rec["old_primary"] == "s0" and rec["new_primary"] == "s1"
+    assert rec["epoch"] == 1 and not rec["cold_recovery"]
+    assert rec["gap_replayed"] >= 0
+    np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_k, h_ref)
+    # the membership log carries the failover next to join/leave
+    store_snap = drv.last_store_snapshot
+    assert store_snap["epoch"] == 1
+    assert store_snap["version"] == 24
+
+
+def test_tau2_kill_primary_mid_round_converges():
+    X, y, w0 = _data(n=512, d=10, seed=11)
+    iters = 160
+    ref = _driver(tau=0, iters=iters, frac=1.0, step=0.2, reg=0.01)
+    w_ref, _ = ref.optimize_with_history((X, y), w0)
+    ref_obj = _full_objective(X, y, w_ref, 0.01)
+    drv = _driver(tau=2, iters=iters, frac=1.0, step=0.2, reg=0.01,
+                  standbys=1)
+    with fp.inject_faults({"replica.store_fail":
+                           fp.fail_nth(400, exc=StoreFailed)}):
+        w_k, h_k = drv.optimize_with_history((X, y), w0)
+    assert drv.last_failover_snapshot["failovers"] == 1
+    assert len(h_k) == iters
+    assert drv.last_store_snapshot["max_accepted_staleness"] <= 2
+    obj = _full_objective(X, y, w_k, 0.01)
+    assert obj <= ref_obj * 1.01, (
+        f"kill-primary objective {obj} vs sync {ref_obj}")
+
+
+# -- epoch fencing ------------------------------------------------------------
+
+
+def test_fenced_epoch_push_rejected_and_old_store_refuses():
+    """A push whose basis belongs to the superseded epoch is FENCED
+    (never discounted into the promoted line); the fenced old store
+    refuses the whole protocol with the typed re-route error."""
+    import jax.numpy as jnp
+
+    _, _, w0 = _data(n=32, d=8)
+    cfg = _cfg(num_iterations=50)
+    primary, standby, sup = _store_pair(cfg, w0, tau=2)
+    client = sup.client()
+    client.register_worker("w0", 0)
+    pulled = client.pull("w0")
+    assert pulled.epoch == 0
+    g = jnp.asarray(np.ones(8, np.float32))
+    assert client.push("w0", pulled.version, g, jnp.asarray(1.0),
+                       jnp.asarray(8.0), basis_epoch=pulled.epoch).accepted
+    assert sup.kill_primary()
+    assert sup.epoch == 1 and sup.primary() is standby
+    # the old basis is fenced on the promoted store...
+    res = standby.push("w0", pulled.version,
+                       jnp.asarray(np.ones(8, np.float32)),
+                       jnp.asarray(1.0), jnp.asarray(8.0), basis_epoch=0)
+    assert res.fenced and not res.accepted
+    assert standby.snapshot()["pushes_fenced"] == 1
+    # ...the fenced old store refuses pulls and pushes outright...
+    with pytest.raises(StoreFenced):
+        primary.pull("w0")
+    with pytest.raises(StoreFenced):
+        primary.push("w0", 0, g, jnp.asarray(1.0), jnp.asarray(8.0))
+    # ...and the CLIENT hides all of it: a fresh pull carries epoch 1
+    pulled2 = client.pull("w0")
+    assert pulled2.epoch == 1
+    assert client.push("w0", pulled2.version,
+                       jnp.asarray(np.ones(8, np.float32)),
+                       jnp.asarray(1.0), jnp.asarray(8.0),
+                       basis_epoch=pulled2.epoch).accepted
+
+
+def test_resurrected_primary_delta_records_refused_by_log():
+    """A fenced old primary that comes back and keeps applying is
+    rejected BY EPOCH at the delta log — its stale applies are refused
+    at the serialization point, never silently merged."""
+    _, _, w0 = _data(n=32, d=8)
+    primary, standby, sup = _store_pair(_cfg(), w0, tau=2)
+    sup.kill_primary()
+    log = sup._log
+    assert log.epoch == 1
+    stale = DeltaRecord(epoch=0, version=standby.version + 1,
+                        kind="sums",
+                        payloads=(("sums", np.zeros(8, np.float32),
+                                   np.zeros((), np.float32),
+                                   np.ones((), np.float32)),))
+    with pytest.raises(StoreFenced):
+        log.append(stale)
+    # a fenced store also refuses direct replica-record application
+    with pytest.raises(StoreFenced):
+        primary.apply_replica_record(stale)
+
+
+def test_fenced_old_primary_late_save_never_shadows(tmp_path):
+    """The satellite-1 pin: restore() prefers the highest
+    ``(epoch, version)`` — a fenced old primary's LATE save with a
+    higher iteration number never shadows the promoted store's
+    lower-numbered, newer-epoch state."""
+    mgr = CheckpointManager(os.fspath(tmp_path), keep=8)
+    w_old = np.full(4, 7.0, np.float32)
+    w_new = np.full(4, 9.0, np.float32)
+    mgr.save(38, w_new, 0.0, np.zeros(38), "ck", epoch=1)
+    # the fenced old primary's late save: higher iteration, older epoch
+    mgr.save(40, w_old, 0.0, np.zeros(40), "ck", epoch=0)
+    state = mgr.restore()
+    assert state["iteration"] == 38 and state["epoch"] == 1
+    np.testing.assert_array_equal(state["weights"], w_new)
+    # the same iteration saved in both epochs: the promoted copy wins
+    mgr.save(40, w_new, 0.0, np.zeros(40), "ck", epoch=1)
+    assert mgr.restore()["epoch"] == 1
+    st = mgr.restore_version(40)
+    assert st["epoch"] == 1
+    np.testing.assert_array_equal(st["weights"], w_new)
+    # versions() dedupes across epochs, (epoch, iteration) order
+    assert mgr.versions() == [40, 38]
+    assert mgr.latest_version() == 40
+
+
+def test_checkpoint_epoch_roundtrips_and_prunes_oldest_epoch(tmp_path):
+    mgr = CheckpointManager(os.fspath(tmp_path), keep=2)
+    for it in (10, 20):
+        mgr.save(it, np.zeros(3), 0.0, np.zeros(it), "ck")  # epoch 0
+    mgr.save(15, np.ones(3), 0.0, np.zeros(15), "ck", epoch=2)
+    # keep=2: the oldest (epoch, iteration) — epoch-0 iteration 10 —
+    # is pruned; the epoch-2 save is newest despite its lower iteration
+    assert mgr.versions() == [20, 15]
+    assert mgr.restore()["epoch"] == 2
+    assert mgr.restore()["iteration"] == 15
+    # an epoch-0 file parsed back reports epoch 0 (legacy readers)
+    assert mgr.restore_version(20)["epoch"] == 0
+
+
+# -- partition tolerance ------------------------------------------------------
+
+
+def test_partitioned_push_conserves_ef_mass_and_rejoins_after_failover():
+    """The zero-lost-gradient-mass pin, end to end: a compressed push
+    that cannot reach any store restores its extracted top-k segment
+    into the error-feedback accumulator; after a failover the SAME
+    accumulator (the registry is shared by the whole store group) is
+    live on the promoted primary and the carried mass ships."""
+    X, y, w0 = _data(n=64, d=16, seed=5)
+    cfg = _cfg(num_iterations=50, step_size=0.1)
+    shared_ef = {}
+    primary, standby, sup = _store_pair(cfg, w0, tau=2,
+                                        shared_ef=shared_ef)
+    client = sup.client()
+    client.register_worker("w0", 0)
+    shards = shard_rows(X, y, 1)
+    worker = ReplicaWorker("w0", 0, client, LeastSquaresGradient(), cfg,
+                           *shards[0], wire_frac=0.25)
+    assert worker.run_once()  # one clean cycle: EF live and registered
+    acc_before = worker.ef.acc.copy()
+    # the failpoint kills the PUSH (access 2 of the cycle), after the
+    # pull and the EF fold/extract — exactly the partition moment that
+    # would leak mass if the worker did not restore the segment
+    with fp.inject_faults({"replica.store_fail": fp.fail_nth(2)}):
+        with pytest.raises(fp.FaultInjected):
+            worker.run_once()
+    # the accumulator holds the WHOLE folded update: recompute what the
+    # cycle folded in and check nothing leaked
+    import jax.numpy as jnp
+
+    pulled = client.pull("w0")
+    g, l, c = worker._local_sums(pulled.weights, worker._X, worker._y,
+                                 jnp.asarray(pulled.version + 1,
+                                             jnp.int32))
+    gn = np.asarray(g).reshape(-1) / max(float(c), 1.0)
+    np.testing.assert_allclose(worker.ef.acc, acc_before + gn,
+                               rtol=1e-5, atol=1e-7)
+    # a full partition raises the typed unreachable error (heals under
+    # the worker RetryPolicy; here it just propagates)
+    client.partition("w0")
+    with pytest.raises(StoreUnreachable):
+        worker.run_once()
+    client.heal("w0")
+    # failover: the promoted primary hands back the SAME accumulator
+    assert sup.kill_primary()
+    assert sup.primary() is standby
+    assert sup.primary().error_feedback("w0", 0.25) is worker.ef
+    v_before = standby.version
+    assert worker.run_once()  # fenced re-pull happens inside: push lands
+    assert standby.version == v_before + 1
+    assert worker.fenced == 0  # pull already carried the new epoch
+
+
+def test_partition_through_full_failover_driver():
+    """Driver-level: one worker partitioned across a primary kill (τ=2,
+    compressed wire) retries under its RetryPolicy, rejoins the
+    contract after the heal, and the run completes every version with
+    a matched objective — a partition is just a longer rejection."""
+    X, y, w0 = _data(n=512, d=10, seed=11)
+    ref = _driver(tau=0, iters=160, frac=1.0, step=0.2, reg=0.01)
+    w_ref, _ = ref.optimize_with_history((X, y), w0)
+    ref_obj = _full_objective(X, y, w_ref, 0.01)
+    iters = 320
+    drv = (_driver(tau=2, iters=iters, frac=1.0, step=0.2, reg=0.01,
+                   standbys=1)
+           .set_wire_compress("topk:0.25")
+           .set_retry(RetryPolicy(max_attempts=400, base_backoff_s=0.01,
+                                  max_backoff_s=0.05, seed=3)))
+    timers = [threading.Timer(0.25, drv.partition_worker, ("w1",)),
+              threading.Timer(0.5, drv.kill_primary),
+              threading.Timer(1.2, drv.heal_worker, ("w1",))]
+    for t in timers:
+        t.start()
+    try:
+        w_p, h_p = drv.optimize_with_history((X, y), w0)
+    finally:
+        for t in timers:
+            t.cancel()
+    snap = drv.last_store_snapshot
+    assert drv.last_failover_snapshot["failovers"] == 1
+    assert snap["version"] == iters and len(h_p) == iters
+    assert snap["max_accepted_staleness"] <= 2
+    obj = _full_objective(X, y, w_p, 0.01)
+    assert obj <= ref_obj * 1.01, (
+        f"partitioned run objective {obj} vs sync {ref_obj}")
+
+
+# -- double failure -----------------------------------------------------------
+
+
+def test_double_failure_cold_recovery_bitwise_with_loud_warning(
+        tmp_path, caplog):
+    """Primary AND standby down: the supervisor cold-recovers a fresh
+    store from the last checkpoint — loudly — and at τ=0 the run is
+    STILL bitwise (the lost versions recompute from (seed, version))."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _driver(tau=0, iters=60).optimize_with_history(
+        (X, y), w0)
+    mgr = CheckpointManager(os.fspath(tmp_path))
+    drv = (_driver(tau=0, iters=60, standbys=1)
+           .set_checkpoint(mgr, every=5))
+
+    class _KillTwice(CollectingListener):
+        def __init__(self):
+            super().__init__()
+            self.killed = set()
+
+        def on_iteration(self, ev):
+            super().on_iteration(ev)
+            if ev.iteration in (15, 30) and ev.iteration not in self.killed:
+                self.killed.add(ev.iteration)
+                drv.kill_primary()
+
+    drv.set_listener(_KillTwice())
+    with caplog.at_level(logging.WARNING, logger="tpu_sgd.replica.ha"):
+        w_d, h_d = drv.optimize_with_history((X, y), w0)
+    snap = drv.last_failover_snapshot
+    assert snap["failovers"] == 2
+    assert not snap["records"][0]["cold_recovery"]
+    assert snap["records"][1]["cold_recovery"]
+    assert any("cold-recovering" in r.message for r in caplog.records)
+    np.testing.assert_array_equal(np.asarray(w_d), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_d, h_ref)
+    # the final checkpoints carry the promoted epoch
+    assert mgr.restore()["epoch"] == 2
+
+
+# -- preemption vs failover (the recorded bugfix) -----------------------------
+
+
+def test_preempt_waits_for_inflight_failover_to_settle():
+    """``stop()``/``save_now()`` during an in-flight promotion block on
+    ``await_settled`` — the preempted checkpoint is the PROMOTED
+    store's consistent (epoch, version), never a mid-failover limbo."""
+    import jax.numpy as jnp
+
+    _, _, w0 = _data(n=32, d=8)
+    primary, standby, sup = _store_pair(_cfg(), w0, tau=2)
+    client = sup.client()
+    client.register_worker("w0", 0)
+    pulled = client.pull("w0")
+    client.push("w0", pulled.version, jnp.asarray(np.ones(8, np.float32)),
+                jnp.asarray(1.0), jnp.asarray(8.0),
+                basis_epoch=pulled.epoch)
+    # stretch the promotion with injected latency, stop() mid-flight
+    with fp.inject_faults({"replica.failover":
+                           fp.inject_latency(1000.0)}):
+        killer = threading.Thread(target=sup.kill_primary)
+        killer.start()
+        time.sleep(0.25)  # the promotion is now sleeping in its span
+        t0 = time.monotonic()
+        client.stop()
+        waited = time.monotonic() - t0
+        killer.join(timeout=30)
+    assert waited >= 0.25, (
+        f"stop() returned in {waited:.3f}s while a 1s promotion was in "
+        "flight — preemption did not wait for failover to settle")
+    assert sup.failover_count == 1
+    snap = client.snapshot()
+    assert snap["epoch"] == 1 and snap["stopped"]
+    assert sup.primary() is standby
+
+
+def test_supervised_preempt_resume_bitwise_with_standby(tmp_path):
+    """The PR 10 preempt-resume contract survives the HA layer: the
+    checkpointed (epoch, version) resumes bitwise."""
+    from tpu_sgd.reliability.supervisor import TrainingSupervisor
+
+    X, y, w0 = _data()
+    w_ref, h_ref = _driver(tau=0, workers=2, iters=40) \
+        .optimize_with_history((X, y), w0)
+    mgr = CheckpointManager(os.fspath(tmp_path))
+    drv = _driver(tau=0, workers=2, iters=40, standbys=1)
+    sup = TrainingSupervisor(drv, checkpoint_manager=mgr,
+                             checkpoint_every=10,
+                             install_signal_handlers=False)
+
+    class _PreemptAt(CollectingListener):
+        def on_iteration(self, ev):
+            super().on_iteration(ev)
+            if ev.iteration == 12:
+                sup.request_preempt()
+
+    drv.set_listener(_PreemptAt())
+    res = sup.run((X, y), w0)
+    assert res.status == "preempted"
+    drv.set_listener(None)
+    res2 = sup.run((X, y), w0)
+    assert res2.completed
+    np.testing.assert_array_equal(np.asarray(res2.weights),
+                                  np.asarray(w_ref))
+    np.testing.assert_array_equal(res2.loss_history, h_ref)
+
+
+def test_stopped_store_never_applies_partial_round():
+    """Regression (the preempt-poison race): at τ=0, a worker exiting
+    AFTER stop() must not 'complete' a round holding only its peer's
+    contribution — a half-batch update applied after the preempt
+    version was read would silently poison the resume trajectory."""
+    import jax.numpy as jnp
+
+    _, _, w0 = _data(n=32, d=8)
+    store = ParameterStore(SquaredL2Updater(), _cfg(), w0, staleness=0)
+    store.register_worker("w0", 0)
+    store.register_worker("w1", 1)
+    results = []
+
+    def _push():
+        results.append(store.push(
+            "w0", 0, jnp.asarray(np.ones(8, np.float32)),
+            jnp.asarray(1.0), jnp.asarray(8.0)))
+
+    t = threading.Thread(target=_push)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with store._cond:
+            if "w0" in store._inbox:
+                break
+        time.sleep(0.005)
+    store.stop()                    # preemption: version read here
+    store.deregister_worker("w1")   # the peer's clean exit
+    t.join(timeout=30)
+    assert store.version == 0, (
+        "a stopped store applied a HALF round (one of two registered "
+        "contributions) — the preempt checkpoint is now off-trajectory")
+
+
+# -- delta-log memory / retention ---------------------------------------------
+
+
+def test_delta_log_trims_to_live_replication_gap():
+    """The log's working set is the live gap, not the retention
+    backstop: records every reader has applied are trimmed on append,
+    and a run's end leaves a near-empty log (the retained payloads are
+    full per-version gradient copies — retain×W×d bytes would dwarf
+    the model at production widths)."""
+    X, y, w0 = _data(n=128, d=8)
+    drv = _driver(tau=0, workers=2, iters=40, standbys=1)
+    drv.optimize_with_history((X, y), w0)
+    log = drv.last_supervisor._log
+    with log._cond:
+        # the standby drained and kept advancing its cursor: only the
+        # tail of the live gap survives, never the whole run
+        assert len(log._records) <= 4, len(log._records)
+        assert log._readers == {}  # stop() released every cursor
+
+
+def test_standby_off_retention_window_marks_failed_never_promotes():
+    """A standby that falls off the log's retention backstop can never
+    catch up: it marks its store failed (loudly) and releases its
+    cursor — promotion then skips it (here: straight to cold
+    recovery) instead of fencing the primary and dying mid-promote."""
+    from tpu_sgd.replica import DeltaLog, DeltaRecord, StandbyReplica
+
+    _, _, w0 = _data(n=32, d=8)
+    cfg = _cfg()
+    store = ParameterStore(SquaredL2Updater(), cfg, w0, staleness=2,
+                           name="s1")
+    log = DeltaLog(retain=2)
+    rep = StandbyReplica(store, log, name="s1")
+    payload = ("sums", np.ones(8, np.float32),
+               np.asarray(1.0, np.float32), np.asarray(8.0, np.float32))
+    # the standby was not reading while versions 1..5 shipped: the
+    # backstop evicted its next records before it ever registered
+    for v in range(1, 6):
+        log.append(DeltaRecord(0, v, "sums", (payload,)))
+    rep.start()
+    deadline = time.monotonic() + 10
+    while not store.failed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert store.failed, (
+        "a standby off the retention window stayed promotion-eligible")
+    with log._cond:
+        assert "s1" not in log._readers
+    rep.halt()
+
+
+# -- lock discipline ----------------------------------------------------------
+
+
+def test_supervisor_lock_discipline_validated_at_runtime():
+    """GRAFTLINT_LOCKS for StoreSupervisor, validated dynamically on a
+    live run with a mid-run failover (the runtime twin of the lexical
+    rule)."""
+    from tpu_sgd.analysis.runtime import instrument_object
+    from tpu_sgd.replica import ha as ha_mod
+
+    X, y, w0 = _data(n=64, d=6)
+    cfg = _cfg(num_iterations=30, step_size=0.2,
+               mini_batch_fraction=0.5)
+    primary, standby, sup = _store_pair(cfg, w0, tau=1)
+    recorder = instrument_object(
+        sup, ha_mod.GRAFTLINT_LOCKS["StoreSupervisor"])
+    client = sup.client()
+    shards = shard_rows(X, y, 2)
+    workers = [ReplicaWorker(f"w{s}", s, client, LeastSquaresGradient(),
+                             cfg, *shards[s]) for s in range(2)]
+    for s in range(2):
+        client.register_worker(f"w{s}", s)
+    killer = threading.Timer(0.1, sup.kill_primary)
+    killer.start()
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    killer.cancel()
+    sup.stop()
+    assert sup.primary().version == 30
+    assert recorder.checked_accesses > 0
+    assert recorder.violations == []
+
+
+# -- the obs surface ----------------------------------------------------------
+
+
+def test_failover_detector_trips_on_failover_window_only():
+    from tpu_sgd.obs.detect import (DetectorEngine, FailoverDetector,
+                                    default_detectors)
+
+    assert "failover" in {d.rule for d in default_detectors()}
+
+    def _win(idx, series):
+        return {"index": idx, "t_start": float(idx),
+                "t_end": float(idx) + 1.0, "series": series}
+
+    def _cnt(n):
+        return {"count": n, "sum": 0.0, "mean": 0.0, "max": None,
+                "bytes": 0}
+
+    eng = DetectorEngine([FailoverDetector()])
+    eng.on_window_close(_win(0, {"replica.step[w0]": _cnt(5)}))
+    assert eng.trip_counts() == {}
+    eng.on_window_close(_win(1, {"replica.failover": _cnt(1)}))
+    assert eng.trip_counts() == {"failover": 1}
+    # stays-tripped dedup + re-arm after a clean window
+    eng.on_window_close(_win(2, {"replica.failover": _cnt(1)}))
+    assert eng.trip_counts() == {"failover": 1}
+    eng.on_window_close(_win(3, {}))
+    eng.on_window_close(_win(4, {"replica.failover": _cnt(1)}))
+    assert eng.trip_counts() == {"failover": 2}
+
+
+def test_straggler_roster_survives_failover_window():
+    """A promotion stalls the WHOLE fleet (re-route + recompute): the
+    failover window resets the straggler deficits so the healed fleet
+    never false-trips — while a worker still silent AFTER the failover
+    keeps accumulating and trips."""
+    from tpu_sgd.obs.detect import DetectorEngine, StragglerDetector
+
+    def _win(idx, series):
+        return {"index": idx, "t_start": float(idx),
+                "t_end": float(idx) + 1.0, "series": series}
+
+    def _cnt(n):
+        return {"count": n, "sum": 0.0, "mean": 0.0, "max": None,
+                "bytes": 0}
+
+    det = StragglerDetector(min_fleet_steps=6)
+    eng = DetectorEngine([det])
+    eng.on_window_close(_win(0, {"replica.step[w0]": _cnt(3),
+                                 "replica.step[w1]": _cnt(3)}))
+    eng.on_window_close(_win(1, {"replica.step[w0]": _cnt(4)}))
+    assert eng.trip_counts() == {}  # w1 deficit 4 < 6
+    # failover window: deficits reset — without the reset w1 would be
+    # at 8 >= 6 here and false-trip on re-routing latency
+    eng.on_window_close(_win(2, {"replica.failover": _cnt(1),
+                                 "replica.step[w0]": _cnt(4)}))
+    assert eng.trip_counts() == {}
+    # still silent after the failover: the rule keeps hunting
+    eng.on_window_close(_win(3, {"replica.step[w0]": _cnt(4)}))
+    assert eng.trip_counts() == {"replica-straggler": 1}
+
+
+def test_membership_failover_record_and_event():
+    from tpu_sgd.obs import spans
+    from tpu_sgd.obs.timeseries import EVENT_FANOUT
+    from tpu_sgd.replica import ReplicaMembership
+
+    assert EVENT_FANOUT.get("replica.failover") == "new_primary"
+    m = ReplicaMembership()
+    sink = _ListSink()
+    spans.enable_tracing(sink)
+    try:
+        m.failover("s0", "s1", 1, 7)
+    finally:
+        spans.disable_tracing()
+    recs = m.failover_records()
+    assert recs == [{"old_primary": "s0", "new_primary": "s1",
+                     "epoch": 1, "gap_replayed": 7,
+                     "cold_recovery": False}]
+    evs = [p for k, p in sink.records
+           if k == "trace_event" and p["name"] == "replica.failover"]
+    assert len(evs) == 1
+    assert evs[0]["new_primary"] == "s1" and evs[0]["gap"] == 7

@@ -41,6 +41,11 @@ production set):
   past ``factor`` x the median of the trailing closed windows (with a
   floor so idle phases cannot trip on noise): the live spelling of the
   bench gate's dispatch-count headline.
+* **failover** — a ``replica.failover`` observation landed in the
+  window (the promotion span and the membership event both feed the
+  series): a store promotion is ALWAYS an incident worth a typed
+  alert + flight-recorder dump, even when the system healed itself —
+  a failover nobody noticed is a standby budget silently spent.
 
 Trip semantics: the engine tracks active ``(rule, series)`` pairs and
 emits one ``obs_alert`` per TRANSITION into the tripped state; a rule
@@ -62,7 +67,7 @@ __all__ = ["Alert", "Detector", "DetectorEngine", "default_detectors",
            "LossDivergenceDetector", "LossPlateauDetector",
            "StalenessCreepDetector", "LaneRejectionDetector",
            "StragglerDetector", "WireRatioDetector",
-           "DispatchRegressionDetector"]
+           "DispatchRegressionDetector", "FailoverDetector"]
 
 logger = logging.getLogger("tpu_sgd.obs")
 
@@ -285,6 +290,14 @@ class StragglerDetector(Detector):
 
     def _membership(self, window) -> None:
         mp = self.membership_prefix
+        if (mp + "failover") in window["series"]:
+            # a store failover stalls the WHOLE fleet (workers re-route,
+            # re-pull, recompute): the roster survives, but accumulated
+            # deficits from the promotion window are re-routing latency,
+            # not straggling — reset so a healed failover never
+            # false-trips the worker that happened to be mid-push
+            for wid in self._behind:
+                self._behind[wid] = 0
         for name in window["series"]:
             for kind in ("join[", "rejoin["):
                 pre = mp + kind
@@ -385,10 +398,34 @@ class DispatchRegressionDetector(Detector):
         return []
 
 
+class FailoverDetector(Detector):
+    """Trips whenever a ``replica.failover`` observation lands in the
+    window — the promotion span close and the membership event both
+    feed the series, and a clean run records neither, so the rule has
+    no false-positive surface.  The trip's ``obs_alert`` (plus the
+    flight-recorder dump the engine's ``on_alert`` hook triggers) is
+    the post-mortem's entry point for a store promotion."""
+
+    rule = "failover"
+
+    def __init__(self, series: str = "replica.failover"):
+        self.series = series
+
+    def evaluate(self, window, history):
+        n = _count(window, self.series)
+        if n < 1:
+            return []
+        return [self._alert(
+            window, self.series, float(n), 1.0,
+            "store primary promoted (see the replica.failover span / "
+            "membership record for old/new primary, epoch, gap)")]
+
+
 def default_detectors() -> List[Detector]:
-    """The production rule set (the ISSUE 13 six).  Thresholds are the
-    wide, low-false-positive defaults a clean seeded run never trips
-    (pinned in tests); harnesses tighten per scenario."""
+    """The production rule set (the ISSUE 13 six plus the failover
+    rule).  Thresholds are the wide, low-false-positive defaults a
+    clean seeded run never trips (pinned in tests); harnesses tighten
+    per scenario."""
     return [
         LossDivergenceDetector(),
         StalenessCreepDetector(),
@@ -396,6 +433,7 @@ def default_detectors() -> List[Detector]:
         StragglerDetector(),
         WireRatioDetector(),
         DispatchRegressionDetector(),
+        FailoverDetector(),
     ]
 
 

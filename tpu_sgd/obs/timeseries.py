@@ -123,6 +123,12 @@ EVENT_FANOUT: Dict[str, str] = {
     "replica.join": "worker",
     "replica.rejoin": "worker",
     "replica.leave": "worker",
+    # store failovers land next to the worker churn: the plain
+    # ``replica.failover`` count series feeds the failover detector
+    # (and the straggler detector's roster reset — a promotion's
+    # fleet-wide stall must not read as one worker lagging), the
+    # fanned ``replica.failover[s1]`` series names the promoted store
+    "replica.failover": "new_primary",
 }
 
 #: fast-path gate (the failpoints discipline): every hook reads this

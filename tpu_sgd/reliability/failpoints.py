@@ -157,6 +157,16 @@ HOOK_SITES = {
     "optimize.streamed.step": "tpu_sgd/optimize/streamed.py",
     "replica.pull": "tpu_sgd/replica/store.py",
     "replica.push": "tpu_sgd/replica/store.py",
+    # fires on every routed store access in the HA client, BEFORE the
+    # store is touched: armed with exc=StoreFailed it IS the primary
+    # kill switch (the client reports the failure and the supervisor
+    # promotes); armed with the default FaultInjected it is a transient
+    # network blip healed by the worker's own RetryPolicy
+    "replica.store_fail": "tpu_sgd/replica/ha.py",
+    # fires at the top of the promotion critical section (inside the
+    # replica.failover span): inject latency here to stretch a failover
+    # — the preempt-during-failover regression test does exactly that
+    "replica.failover": "tpu_sgd/replica/ha.py",
     "checkpoint.save": "tpu_sgd/utils/checkpoint.py",
     "checkpoint.load": "tpu_sgd/utils/checkpoint.py",
     "serve.registry.reload": "tpu_sgd/serve/registry.py",

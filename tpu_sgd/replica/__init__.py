@@ -16,12 +16,20 @@ Layers, bottom-up:
   shared ``_make_local_sums`` sampling recipe, shard index folded) →
   push, under failpoint/retry healing;
 * ``membership`` — elastic fleet bookkeeping: join/leave/rejoin,
-  heartbeats, stragglers;
+  heartbeats, stragglers, store-failover records;
+* ``ha``         — the availability layer (README "Store failover"):
+  the replicated delta log, standby replicas, the deterministic
+  ``StoreSupervisor`` failover, and the partition-tolerant
+  ``StoreClient`` workers reach the group through;
 * ``driver``     — the user-facing ``ReplicaDriver`` facade (a
-  ``TrainingSupervisor``-compatible optimizer surface).
+  ``TrainingSupervisor``-compatible optimizer surface;
+  ``set_standbys(n)`` turns the HA layer on).
 """
 
 from tpu_sgd.replica.driver import ReplicaDriver, shard_rows
+from tpu_sgd.replica.ha import (DeltaLog, DeltaRecord, StandbyReplica,
+                                StoreClient, StoreFailed, StoreFenced,
+                                StoreSupervisor, StoreUnreachable)
 from tpu_sgd.replica.membership import ReplicaMembership, WorkerRecord
 from tpu_sgd.replica.staleness import PushDecision, StalenessContract
 from tpu_sgd.replica.store import ParameterStore, PulledState, PushResult
@@ -37,6 +45,14 @@ __all__ = [
     "PushDecision",
     "StalenessContract",
     "WorkerRecord",
+    "DeltaLog",
+    "DeltaRecord",
+    "StandbyReplica",
+    "StoreClient",
+    "StoreFailed",
+    "StoreFenced",
+    "StoreSupervisor",
+    "StoreUnreachable",
     "make_shard_local_sums",
     "shard_rows",
 ]

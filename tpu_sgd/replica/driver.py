@@ -41,6 +41,16 @@ push-accept, not pull — are in ``staleness.py`` and ADVICE.md
   push as a top-k segment through the worker's persistent
   ``ErrorFeedback`` accumulator (PR 9's wire) — matched final loss,
   ~``2*frac``× the dense push bytes.
+* **High availability**: ``set_standbys(n)`` replicates the store —
+  every applied version ships as a delta-log record to ``n`` standby
+  stores, a ``StoreSupervisor`` promotes the most-advanced standby on
+  primary loss (epoch-fenced, gap-replayed; README "Store failover",
+  ADVICE.md "Failover is a replay, not a restart"), and workers reach
+  the group through a partition-tolerant ``StoreClient``
+  (``tpu_sgd/replica/ha.py``).  τ=0 with a primary killed mid-round
+  stays BITWISE the fault-free run.  Runtime chaos/ops handles while a
+  run is live: :meth:`kill_primary`, :meth:`partition_worker`,
+  :meth:`heal_worker`.
 
 The driver deliberately does NOT subclass ``GradientDescent``: the
 async update rule is the store's, not a schedule knob on the sync
@@ -108,6 +118,7 @@ class ReplicaDriver:
         self.config = config if config is not None else SGDConfig()
         self.n_workers = int(n_workers)
         self.staleness = staleness
+        self.n_standbys = 0
         self.wire_compress = None
         self.listener = None
         self.checkpoint_manager = None
@@ -117,9 +128,13 @@ class ReplicaDriver:
         self.devices = None
         self._stop_signal = None
         self._loss_history = None
+        self._live_client = None
+        self._live_supervisor = None
         self.last_store_snapshot = None
         self.last_membership_snapshot = None
         self.last_windows_snapshot = None
+        self.last_failover_snapshot = None
+        self.last_supervisor = None
 
     # -- fluent config (the GradientDescent subset that applies) -----------
     def set_step_size(self, s: float):
@@ -168,6 +183,17 @@ class ReplicaDriver:
         Validated eagerly through :class:`StalenessContract`."""
         StalenessContract(tau)  # validate now, not mid-run
         self.staleness = tau
+        return self
+
+    def set_standbys(self, n: int):
+        """``n >= 1`` replicates the parameter store: every applied
+        version ships as a delta-log record to ``n`` standbys, and a
+        ``StoreSupervisor`` fails over deterministically on primary
+        loss (``tpu_sgd/replica/ha.py``).  ``0`` (default) keeps the
+        single-store path bit-for-bit unchanged."""
+        if int(n) < 0:
+            raise ValueError(f"n_standbys must be >= 0, got {n}")
+        self.n_standbys = int(n)
         return self
 
     def set_wire_compress(self, spec):
@@ -230,6 +256,37 @@ class ReplicaDriver:
 
         return timeseries.snapshot(prefix="replica")
 
+    # -- runtime chaos/ops handles (HA runs only, while live) ---------------
+    def kill_primary(self) -> bool:
+        """Fail the CURRENT primary store of a live HA run and promote
+        (the chaos/ops kill switch).  False when no HA run is live or
+        the run already finished."""
+        sup = self._live_supervisor
+        if sup is None:
+            return False
+        try:
+            if sup.primary().wait_done(timeout_s=0.0):
+                return False  # the run is over: nothing to fail over
+        except Exception:
+            pass
+        return sup.kill_primary()
+
+    def partition_worker(self, worker_id: str) -> bool:
+        """Cut one worker of a live HA run off from every store (its
+        accesses raise ``StoreUnreachable`` until :meth:`heal_worker`)."""
+        client = self._live_client
+        if client is None:
+            return False
+        client.partition(worker_id)
+        return True
+
+    def heal_worker(self, worker_id: str) -> bool:
+        client = self._live_client
+        if client is None:
+            return False
+        client.heal(worker_id)
+        return True
+
     def optimize(self, data, initial_weights):
         w, _ = self.optimize_with_history(data, initial_weights)
         return w
@@ -268,15 +325,60 @@ class ReplicaDriver:
 
         devices = (self.devices if self.devices is not None
                    else list(jax.devices()))
-        store = ParameterStore(
-            self.updater, cfg, w0,
-            staleness=self.staleness, device=devices[0],
-            listener=self.listener,
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_every=self.checkpoint_every,
-            config_key=config_key, resume_state=resume_state,
-        )
         membership = ReplicaMembership(listener=self.listener)
+        supervisor = None
+        if self.n_standbys > 0:
+            from tpu_sgd.replica.ha import StoreSupervisor
+
+            # ONE error-feedback registry shared by every store in the
+            # group: the per-worker accumulators (and their carried
+            # dropped mass) survive any failover by construction
+            shared_ef: dict = {}
+            epoch0 = (int(resume_state.get("epoch", 0))
+                      if resume_state is not None else 0)
+
+            def _mk_store(name, *, listener=None, manager=None,
+                          resume=resume_state, weights=w0):
+                return ParameterStore(
+                    self.updater, cfg, weights,
+                    staleness=self.staleness, device=devices[0],
+                    listener=listener, checkpoint_manager=manager,
+                    checkpoint_every=self.checkpoint_every,
+                    config_key=config_key, resume_state=resume,
+                    epoch=epoch0, ef_registry=shared_ef, name=name,
+                )
+
+            def _cold_factory(state, name):
+                # double-failure cold recovery: a fresh store from the
+                # last checkpoint (or from scratch — τ=0 recomputes the
+                # lost versions bitwise from (seed, version))
+                return _mk_store(
+                    name, resume=state,
+                    weights=(np.asarray(state["weights"])
+                             if state is not None else w0))
+
+            primary = _mk_store("s0", listener=self.listener,
+                                manager=self.checkpoint_manager)
+            standby_stores = [_mk_store(f"s{i}")
+                              for i in range(1, self.n_standbys + 1)]
+            supervisor = StoreSupervisor(
+                [primary] + standby_stores,
+                membership=membership,
+                checkpoint_manager=self.checkpoint_manager,
+                checkpoint_every=self.checkpoint_every,
+                listener=self.listener,
+                store_factory=_cold_factory,
+            )
+            store = supervisor.client()
+        else:
+            store = ParameterStore(
+                self.updater, cfg, w0,
+                staleness=self.staleness, device=devices[0],
+                listener=self.listener,
+                checkpoint_manager=self.checkpoint_manager,
+                checkpoint_every=self.checkpoint_every,
+                config_key=config_key, resume_state=resume_state,
+            )
         rejoin = (self.rejoin_policy if self.rejoin_policy is not None
                   else RetryPolicy(max_attempts=5, base_backoff_s=0.01,
                                    seed=cfg.seed))
@@ -318,6 +420,8 @@ class ReplicaDriver:
         preempted_at = None
         fatal = None
         pending_rejoins: dict = {}  # wid -> (shard, due_monotonic)
+        self._live_supervisor = supervisor
+        self._live_client = store if supervisor is not None else None
         try:
             for s in range(self.n_workers):
                 _spawn(s)
@@ -360,13 +464,21 @@ class ReplicaDriver:
         finally:
             # idempotent: a completed run is already done; an error or
             # preemption unwind must wake every τ=0 barrier waiter so
-            # the joins below cannot hang
+            # the joins below cannot hang.  Under HA, stop() first
+            # WAITS for any in-flight promotion to settle — preemption
+            # must unwind from a consistent (epoch, version), never
+            # from the middle of a failover (the PR's recorded bugfix)
             store.stop()
             for t, _ in threads.values():
                 t.join(timeout=60.0)
+            self._live_supervisor = None
+            self._live_client = None
             self.last_store_snapshot = store.snapshot()
             self.last_membership_snapshot = membership.snapshot()
             self.last_windows_snapshot = self.windows()
+            self.last_supervisor = supervisor
+            self.last_failover_snapshot = (
+                supervisor.snapshot() if supervisor is not None else None)
 
         if fatal is not None:
             raise fatal

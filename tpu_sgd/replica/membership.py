@@ -14,6 +14,12 @@ the driver's bookkeeping for that churn:
   ``replica.leave`` / ``replica.rejoin`` trace events (``tpu_sgd.obs``)
   and as ``ReliabilityEvent`` records on the run's listener — the soak
   report's evidence that elasticity actually happened;
+* store **failover** records (:meth:`ReplicaMembership.failover` —
+  old primary, new primary, epoch, log gap replayed) alongside the
+  worker churn, emitted as ``replica.failover`` events fanned through
+  ``timeseries.EVENT_FANOUT`` — the straggler detector reads the
+  failover window as a deficit reset, so a promotion's fleet-wide
+  stall never false-trips a worker that was merely re-routing;
 * :meth:`stragglers` — workers whose heartbeat age exceeds a stall
   bound (observation only: eviction policy belongs to the caller, the
   same observe-don't-kill split as ``reliability/health.py``).
@@ -42,6 +48,7 @@ from tpu_sgd.utils.events import ReliabilityEvent
 GRAFTLINT_LOCKS = {
     "ReplicaMembership": {
         "_workers": "_lock",
+        "_failovers": "_lock",
     },
 }
 
@@ -70,6 +77,7 @@ class ReplicaMembership:
     def __init__(self, listener=None):
         self._lock = threading.Lock()
         self._workers: Dict[str, WorkerRecord] = {}
+        self._failovers: List[dict] = []
         self.listener = listener
 
     def join(self, worker_id: str, shard_index: int) -> WorkerRecord:
@@ -107,6 +115,30 @@ class ReplicaMembership:
               error=(type(error).__name__ if error is not None else None))
         self._emit("leave", worker_id,
                    detail=(f"{type(error).__name__}" if error else "clean"))
+
+    def failover(self, old_primary: str, new_primary: str, epoch: int,
+                 gap_replayed: int, cold: bool = False) -> None:
+        """Record a store failover in the membership log, next to the
+        worker churn it rode through.  Emitted as a ``replica.failover``
+        event (``timeseries.EVENT_FANOUT`` fans it per new primary;
+        the failover detector and the straggler-roster reset both key
+        on the series) and a ``ReliabilityEvent`` on the listener."""
+        rec = {"old_primary": old_primary, "new_primary": new_primary,
+               "epoch": int(epoch), "gap_replayed": int(gap_replayed),
+               "cold_recovery": bool(cold)}
+        with self._lock:
+            self._failovers.append(rec)
+        event("replica.failover", old_primary=old_primary,
+              new_primary=new_primary, epoch=int(epoch),
+              gap=int(gap_replayed), cold=bool(cold))
+        self._emit("failover", new_primary,
+                   detail=(f"from {old_primary} epoch={epoch} "
+                           f"gap={gap_replayed}"
+                           + (" (cold recovery)" if cold else "")))
+
+    def failover_records(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._failovers]
 
     def record(self, worker_id: str) -> Optional[WorkerRecord]:
         with self._lock:

@@ -42,6 +42,22 @@ OPTIMIZER STATE: it is registered here so :meth:`checkpoint_extras`
 can persist every worker's accumulator (``ef_<worker_id>``) and a
 rejoining worker re-attaches its dropped mass instead of losing it.
 
+High availability (``tpu_sgd/replica/ha.py``; ADVICE.md "Failover is
+a replay, not a restart"): a store carries an **epoch** — the failover
+generation.  The primary ships every applied version as a delta-log
+record (:meth:`set_replication`; the raw admitted contributions in
+shard order, captured host-side BEFORE the apply donates the buffers)
+and standbys replay them through :meth:`apply_replica_record` — the
+same combine, the same ``observe_step``, so a standby's trajectory is
+bitwise the primary's at every version.  On promotion the old primary
+is **fenced** (:meth:`fence`): its τ=0 barrier waiters wake with
+:class:`~tpu_sgd.replica.ha.StoreFenced` and re-route, pushes whose
+``basis_epoch`` belongs to the superseded epoch come back
+``fenced=True`` (the worker re-pulls — stale work is never discounted
+into the new version line), and its late checkpoint saves are refused
+AND epoch-stamped so ``CheckpointManager.restore`` prefers the
+promoted ``(epoch, version)`` line.
+
 Lock discipline: ONE condition (``_cond``) guards all mutable state —
 version/weights/inbox/membership mirror/EF registry — because the τ=0
 barrier needs to *wait* on round application, and a second lock would
@@ -65,7 +81,13 @@ from tpu_sgd.io.sparse_wire import ErrorFeedback
 from tpu_sgd.obs.counters import inc, record_wire
 from tpu_sgd.obs.spans import event, span
 from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.reliability.health import Heartbeat
+from tpu_sgd.replica.ha import DeltaRecord, StoreFailed, StoreFenced
 from tpu_sgd.replica.staleness import StalenessContract
+
+import logging
+
+logger = logging.getLogger("tpu_sgd.replica.store")
 
 #: graftlint lock-discipline declaration (tpu_sgd/analysis): every
 #: field below is read/written from N worker threads plus the driver's
@@ -91,6 +113,14 @@ GRAFTLINT_LOCKS = {
         "_pulls": "_cond",
         "_max_accepted_staleness": "_cond",
         "_t_last_apply": "_cond",
+        "_epoch": "_cond",
+        "_fenced": "_cond",
+        "_failed": "_cond",
+        "_pushes_fenced": "_cond",
+        "_replication": "_cond",
+        "_checkpoint_manager": "_cond:w",
+        "_checkpoint_every": "_cond:w",
+        "_listener": "_cond:w",
     },
 }
 
@@ -99,24 +129,31 @@ class PulledState(NamedTuple):
     """One pull's snapshot: an immutable device weights reference plus
     the version it is HEAD at.  ``done`` tells the worker the run is
     over (budget exhausted, converged, or stopped) — no more pushes
-    will be admitted."""
+    will be admitted.  ``epoch`` is the failover generation the
+    version belongs to: a push must carry it back, so a pull taken
+    against a later-superseded primary is fenced instead of silently
+    merged (``tpu_sgd/replica/ha.py``)."""
 
     weights: object
     version: int
     reg_val: float
     done: bool
+    epoch: int = 0
 
 
 class PushResult(NamedTuple):
     """One push's outcome.  ``accepted=False, done=False`` means the
     push was STALE (``staleness > tau``): the worker must re-pull and
     recompute — the contract's whole point is that this work is
-    discarded, not applied late."""
+    discarded, not applied late.  ``fenced=True`` marks the epoch
+    spelling of the same verdict: the basis belongs to a superseded
+    primary, so the worker must re-pull from the promoted store."""
 
     accepted: bool
     version: int
     staleness: int
     done: bool
+    fenced: bool = False
 
 
 class ParameterStore:
@@ -141,9 +178,13 @@ class ParameterStore:
         checkpoint_every: int = 10,
         config_key: str = "",
         resume_state: Optional[dict] = None,
+        epoch: int = 0,
+        ef_registry: Optional[Dict[str, ErrorFeedback]] = None,
+        name: str = "store",
     ):
         self.updater = updater
         self.config = config
+        self.name = name
         self.contract = (staleness
                          if isinstance(staleness, StalenessContract)
                          else StalenessContract(staleness))
@@ -153,6 +194,10 @@ class ParameterStore:
         self._checkpoint_every = int(checkpoint_every)
         self._config_key = config_key
         self._cond = threading.Condition()
+        #: liveness marker for external watchdogs (its own lock) —
+        #: ticked per pull/admit/apply; the in-process failover trigger
+        #: is always a signaled StoreFailed, never a heartbeat age
+        self.heartbeat = Heartbeat(f"replica.store.{name}")
 
         w = jnp.asarray(initial_weights)
         if not jnp.issubdtype(w.dtype, jnp.inexact):
@@ -170,18 +215,31 @@ class ParameterStore:
         self._inbox_order: Dict[str, int] = {}
         self._active: Dict[str, int] = {}
         self._clocks: Dict[str, int] = {}
-        self._ef: Dict[str, ErrorFeedback] = {}
+        # ``ef_registry``: the HA driver hands ONE shared dict to every
+        # store in a replicated group, so the per-worker accumulators —
+        # and their carried dropped mass — survive a failover by
+        # construction.  Only the CURRENT primary ever mutates it (the
+        # promotion handoff is a happens-before edge under the
+        # supervisor lock), so the per-store lock discipline holds.
+        self._ef: Dict[str, ErrorFeedback] = (
+            ef_registry if ef_registry is not None else {})
         self._ef_pending: Dict[str, np.ndarray] = {}
         self._converged = False
         self._stopped = False
+        self._epoch = int(epoch)
+        self._fenced = False
+        self._failed = False
+        self._replication = None
         self._pushes_accepted = 0
         self._pushes_rejected = 0
+        self._pushes_fenced = 0
         self._pulls = 0
         self._max_accepted_staleness = 0
         self._t_last_apply = time.perf_counter()
 
         if resume_state is not None:
             self._version = int(resume_state["iteration"])
+            self._epoch = int(resume_state.get("epoch", epoch))
             self._reg_val = float(resume_state["reg_val"])
             self._losses = list(np.asarray(resume_state["loss_history"],
                                            np.float32))
@@ -259,7 +317,19 @@ class ParameterStore:
         (elasticity: the fleet never stalls on a death)."""
         with self._cond:
             self._active.pop(worker_id, None)
-            if self.contract.synchronous and self._round_complete_locked():
+            # a fenced/failed store must not apply (its inbox deposits
+            # are dead — the promoted primary re-forms the round from
+            # the re-routed pushes), and neither must a STOPPED one: at
+            # preemption, a worker exiting between its peer's deposit
+            # and its own would otherwise "complete" the round with a
+            # partial batch — a half-round applied after the preempt
+            # version was read, silently poisoning the resume
+            # trajectory (found as a rare τ=0 preempt-resume flake;
+            # regression-pinned in tests/test_replica_ha.py)
+            if (not self._fenced and not self._failed
+                    and not self._stopped
+                    and self.contract.synchronous
+                    and self._round_complete_locked()):
                 self._apply_payloads_locked(self._drain_inbox_locked())
             self._cond.notify_all()
 
@@ -286,6 +356,7 @@ class ParameterStore:
         the worker likes; only its eventual push pays for the lag."""
         failpoint("replica.pull")
         with self._cond:
+            self._check_live_locked("pull")
             self._pulls += 1
             inc("replica.pull")
             record_wire("dense-f32",
@@ -293,16 +364,20 @@ class ParameterStore:
                         physical_nbytes=int(self._w.nbytes))
             event("replica.pull", worker=worker_id,
                   version=self._version)
+            self.heartbeat.beat()
             return PulledState(self._w, self._version, self._reg_val,
-                               self._done_locked())
+                               self._done_locked(), self._epoch)
 
     def push(self, worker_id: str, basis_version: int, grad_sum,
-             loss_sum, count) -> PushResult:
+             loss_sum, count, *,
+             basis_epoch: Optional[int] = None) -> PushResult:
         """One DENSE gradient-contribution push (the bitwise sync
         wire).  ``grad_sum``/``loss_sum``/``count`` are the worker's
         raw local sums — the store normalizes, exactly like the psum
         path.  Blocks at τ=0 until the round containing this
-        contribution applies (or the run ends)."""
+        contribution applies (or the run ends).  ``basis_epoch``: the
+        epoch the basis was pulled at (``None`` = this store's — the
+        single-store spelling)."""
         failpoint("replica.push")
         g = jax.device_put(grad_sum, self._device)
         l = jax.device_put(loss_sum, self._device)
@@ -310,11 +385,13 @@ class ParameterStore:
         record_wire("dense-f32",
                     logical_nbytes=int(g.nbytes + l.nbytes + c.nbytes),
                     physical_nbytes=int(g.nbytes + l.nbytes + c.nbytes))
-        return self._admit(worker_id, basis_version, ("sums", g, l, c))
+        return self._admit(worker_id, basis_version, ("sums", g, l, c),
+                           basis_epoch=basis_epoch)
 
     def push_compressed(self, worker_id: str, basis_version: int,
                         indices, values, loss_sum: float,
-                        count: float) -> PushResult:
+                        count: float, *,
+                        basis_epoch: Optional[int] = None) -> PushResult:
         """One COMPRESSED push: the top-k ``(indices, values)`` segment
         of the worker's EF-folded batch-mean gradient (selected by the
         worker's :class:`ErrorFeedback`, which already counted the wire
@@ -326,12 +403,39 @@ class ParameterStore:
                               self._device)
         return self._admit(worker_id, basis_version,
                            ("topk", idx, vals, float(loss_sum),
-                            float(count)))
+                            float(count)), basis_epoch=basis_epoch)
 
     # -- internals ----------------------------------------------------------
+    def _check_live_locked(self, op: str) -> None:
+        """Caller holds ``_cond``.  A fenced/failed store refuses the
+        worker protocol with the typed error the
+        :class:`~tpu_sgd.replica.ha.StoreClient` re-routes on."""
+        if self._fenced:
+            raise StoreFenced(
+                f"store {self.name} (epoch {self._epoch}) is fenced: "
+                f"{op} must re-route to the promoted primary")
+        if self._failed:
+            raise StoreFailed(f"store {self.name} is failed: {op} must "
+                              "re-route to the promoted primary")
+
     def _admit(self, worker_id: str, basis_version: int,
-               payload: tuple) -> PushResult:
+               payload: tuple,
+               basis_epoch: Optional[int] = None) -> PushResult:
         with self._cond:
+            self._check_live_locked("push")
+            self.heartbeat.beat()
+            if basis_epoch is not None and basis_epoch != self._epoch:
+                # the epoch fence: this basis belongs to a superseded
+                # primary's version line — never discount it into ours
+                # (the versions may not even be comparable); the worker
+                # re-pulls HEAD from this store and recomputes
+                self._pushes_fenced += 1
+                inc("replica.push.fenced")
+                event("replica.push", worker=worker_id,
+                      basis=int(basis_version), staleness=0,
+                      accepted=False, fenced=True, version=self._version)
+                return PushResult(False, self._version, 0,
+                                  self._done_locked(), True)
             if self._done_locked():
                 return PushResult(False, self._version, 0, True)
             if (self.contract.bounded and not self.contract.synchronous
@@ -353,7 +457,9 @@ class ParameterStore:
                        - min(self._clocks.get(w, 0)
                              for w in self._active)
                        >= self.contract.tau):
+                    self._check_live_locked("push")  # fence wakes us
                     self._cond.wait(timeout=0.5)
+                self._check_live_locked("push")
                 if self._done_locked():
                     return PushResult(False, self._version, 0, True)
             decision = self.contract.check(self._version,
@@ -390,6 +496,14 @@ class ParameterStore:
                     while (self._version <= basis
                            and not self._done_locked()
                            and worker_id in self._inbox):
+                        if self._fenced or self._failed:
+                            # the round died with this store: drop the
+                            # deposit (the promoted primary re-forms
+                            # the round from re-routed pushes) and
+                            # re-route the waiter
+                            self._inbox.pop(worker_id, None)
+                            self._inbox_order.pop(worker_id, None)
+                            self._check_live_locked("push")
                         self._cond.wait(timeout=0.5)
                 return PushResult(True, self._version, decision.staleness,
                                   self._done_locked())
@@ -422,6 +536,11 @@ class ParameterStore:
         i = self._version + 1
         i_dev = jnp.asarray(i, jnp.int32)
         rv_dev = jnp.asarray(self._reg_val, jnp.float32)
+        # replication wire: capture the record's host bytes BEFORE the
+        # combine/apply donates the payload buffers (the delta log —
+        # not the weights — is the replication unit; ha.py docstring)
+        ship = (None if self._replication is None
+                else [self._host_payload(p) for p in payloads])
         with span("replica.apply", version=i, n_payloads=len(payloads)):
             if payloads[0][0] == "sums":
                 _, g, l, c = payloads[0]
@@ -461,22 +580,159 @@ class ParameterStore:
         self._version = i
         if conv:
             self._converged = True
+        self.heartbeat.beat()
+        if ship is not None:
+            try:
+                self._replication(DeltaRecord(
+                    self._epoch, i, ship[0][0], tuple(ship)))
+                inc("replica.replicate")
+            except StoreFenced:
+                # we were promoted over DURING this apply (the fence
+                # serialized after our lock): this version is ours
+                # alone — the promoted line recomputes it from
+                # (seed, version), so refusing the record loses nothing
+                self._fenced = True
+                logger.warning(
+                    "store %s: version %d applied after fencing; record "
+                    "refused by the delta log (the promoted primary "
+                    "recomputes it)", self.name, i)
+            except Exception:
+                # replication must not kill the primary's apply; a
+                # standby that misses a record fails its continuity
+                # check and drops to cold-recovery territory, loudly
+                logger.warning(
+                    "store %s: delta record for version %d failed to "
+                    "replicate", self.name, i, exc_info=True)
         self._cond.notify_all()
+
+    # -- replication (the HA delta log; tpu_sgd/replica/ha.py) ---------------
+    def _host_payload(self, p: tuple) -> tuple:
+        """One admitted payload as replayable HOST bytes — the bulk
+        fetch happens here, before the apply donates the buffer."""
+        if p[0] == "sums":
+            return ("sums", np.asarray(p[1]), np.asarray(p[2]),
+                    np.asarray(p[3]))
+        return ("topk", np.asarray(p[1]), np.asarray(p[2]),
+                float(p[3]), float(p[4]))
+
+    def _device_payload(self, p: tuple) -> tuple:
+        """The standby-side inverse of :meth:`_host_payload`: the same
+        bytes staged on THIS store's device, so the replayed combine is
+        bit-identical to the primary's."""
+        if p[0] == "sums":
+            return ("sums",
+                    jax.device_put(np.asarray(p[1], np.float32),
+                                   self._device),
+                    jax.device_put(np.asarray(p[2], np.float32),
+                                   self._device),
+                    jax.device_put(np.asarray(p[3], np.float32),
+                                   self._device))
+        return ("topk",
+                jax.device_put(np.asarray(p[1], np.int32), self._device),
+                jax.device_put(np.asarray(p[2], np.float32),
+                               self._device),
+                float(p[3]), float(p[4]))
+
+    def set_replication(self, ship) -> None:
+        """Route every applied version's delta record through ``ship``
+        (the supervisor wires ``DeltaLog.append`` here; ``None``
+        disables)."""
+        with self._cond:
+            self._replication = ship
+
+    def apply_replica_record(self, record) -> None:
+        """Standby-side replay of one delta record: the same shard-order
+        combine and the same ``observe_step`` bookkeeping as the
+        primary's apply, so the trajectory is bitwise at every version.
+        Records must arrive in version order (the log guarantees it);
+        a fenced/failed store refuses."""
+        with self._cond:
+            self._check_live_locked("apply_replica_record")
+            if record.version != self._version + 1:
+                raise StoreFailed(
+                    f"store {self.name}: replica record version "
+                    f"{record.version} does not chain onto local "
+                    f"version {self._version}")
+            self._apply_payloads_locked(
+                [self._device_payload(p) for p in record.payloads])
+
+    # -- the failover surface (driven by ha.StoreSupervisor) -----------------
+    def fence(self) -> None:
+        """Supersede this store: every τ=0 barrier / SSP waiter wakes
+        with :class:`StoreFenced` and re-routes, later pushes/pulls are
+        refused, and late checkpoint saves are dropped (loudly)."""
+        with self._cond:
+            self._fenced = True
+            self._cond.notify_all()
+
+    def mark_failed(self) -> None:
+        """Record a crash (a dead standby, an operator kill): the store
+        refuses the protocol but is NOT epoch-superseded."""
+        with self._cond:
+            self._failed = True
+            self._cond.notify_all()
+
+    def set_epoch(self, epoch: int) -> None:
+        """Promotion-time epoch bump (the supervisor moves every
+        surviving store forward together)."""
+        with self._cond:
+            if epoch < self._epoch:
+                raise ValueError(
+                    f"store epoch can only advance: {self._epoch} -> "
+                    f"{epoch}")
+            self._epoch = int(epoch)
+            self._cond.notify_all()
+
+    def attach_primary(self, *, checkpoint_manager=None,
+                       checkpoint_every: int = 10,
+                       listener=None) -> None:
+        """Promotion: a standby inherits the primary surface —
+        checkpoint cadence and the run listener (its applies were
+        silent until now; events resume from the promoted version)."""
+        with self._cond:
+            self._checkpoint_manager = checkpoint_manager
+            self._checkpoint_every = int(checkpoint_every)
+            self._listener = listener
+
+    @property
+    def epoch(self) -> int:
+        with self._cond:
+            return self._epoch
+
+    @property
+    def fenced(self) -> bool:
+        with self._cond:
+            return self._fenced
+
+    @property
+    def failed(self) -> bool:
+        with self._cond:
+            return self._failed
 
     def _save(self, iteration: int, w_np, reg_val: float) -> None:
         """Checkpoint the store: weights + version (the ``iteration``
         field) + loss history + every worker's EF accumulator as
-        ``ef_<worker_id>`` extras.  Runs under ``_cond`` always: its
-        direct call site (``save_now``) holds it, and as
-        ``observe_step``'s ``save_cb`` it fires inside
+        ``ef_<worker_id>`` extras, stamped with the store EPOCH so
+        ``CheckpointManager.restore`` prefers the promoted ``(epoch,
+        version)`` line over a fenced primary's late save.  Runs under
+        ``_cond`` always: its direct call site (``save_now``) holds it,
+        and as ``observe_step``'s ``save_cb`` it fires inside
         ``_apply_payloads_locked``'s locked region."""
+        if self._fenced:
+            # belt (the epoch stamp is the braces): a fenced primary
+            # must never shadow the promoted store's newer state
+            logger.warning(
+                "store %s: refusing checkpoint save at version %d — "
+                "fenced (epoch %d superseded)", self.name, iteration,
+                self._epoch)
+            return
         extras = ({f"ef_{wid}": ef.state()
                    for wid, ef in self._ef.items()}
                   or None)
         self._checkpoint_manager.save(
             iteration, np.asarray(w_np), reg_val,
             np.asarray(self._losses), self._config_key,
-            extras=extras)
+            extras=extras, epoch=self._epoch)
 
     def _done_locked(self) -> bool:
         return (self._version >= self.config.num_iterations
@@ -509,6 +765,9 @@ class ParameterStore:
                     else time.monotonic() + timeout_s)
         with self._cond:
             while not self._done_locked():
+                if self._fenced or self._failed:
+                    return False  # superseded: the caller re-polls the
+                    # promoted primary (never "done" — never hangs)
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
@@ -543,11 +802,15 @@ class ParameterStore:
         with self._cond:
             return {
                 "version": self._version,
+                "epoch": self._epoch,
                 "pulls": self._pulls,
                 "pushes_accepted": self._pushes_accepted,
                 "pushes_rejected": self._pushes_rejected,
+                "pushes_fenced": self._pushes_fenced,
                 "max_accepted_staleness": self._max_accepted_staleness,
                 "active_workers": len(self._active),
                 "converged": self._converged,
                 "stopped": self._stopped,
+                "fenced": self._fenced,
+                "failed": self._failed,
             }

@@ -27,6 +27,18 @@ which the elastic driver detects, deregisters, and rejoins
 (``tpu_sgd/replica/driver.py``).  The worker ticks a ``Heartbeat`` per
 cycle so the health monitor can spot stragglers.
 
+Partition tolerance (``tpu_sgd/replica/ha.py``): under a replicated
+store the worker's ``store`` handle is a ``StoreClient`` — a push that
+lands on a just-failed primary re-routes to the promoted one
+transparently, and comes back ``fenced`` when its basis belongs to the
+superseded epoch (handled exactly like a staleness rejection: the
+compressed wire restores its extracted segment, the worker re-pulls
+and recomputes — stale work is discarded WHOLE, its error-feedback
+mass is not).  A worker that cannot reach ANY store sees
+``StoreUnreachable`` from its ``RetryPolicy``-wrapped calls: a
+partition is just a longer rejection, healed by retry or by
+death-and-rejoin — zero gradient mass lost either way.
+
 Compressed wire (``topk:<frac>``): the worker normalizes its
 contribution to a batch-mean gradient, folds it through its persistent
 per-worker :class:`~tpu_sgd.io.sparse_wire.ErrorFeedback` accumulator
@@ -103,11 +115,12 @@ class ReplicaWorker:
                    else store.error_feedback(worker_id, wire_frac))
         self.cycles = 0
         self.rejected = 0
+        self.fenced = 0
 
-    def _call(self, fn, *args):
+    def _call(self, fn, *args, **kwargs):
         if self.retry_policy is not None:
-            return self.retry_policy.call(fn, *args)
-        return fn(*args)
+            return self.retry_policy.call(fn, *args, **kwargs)
+        return fn(*args, **kwargs)
 
     def run_once(self) -> bool:
         """One pull → compute → push cycle; False when the run is done
@@ -159,7 +172,8 @@ class ReplicaWorker:
                 try:
                     res = self._call(
                         self.store.push_compressed, self.worker_id,
-                        pulled.version, idx, vals, l_host, c_host)
+                        pulled.version, idx, vals, l_host, c_host,
+                        basis_epoch=pulled.epoch)
                 except BaseException:
                     # the push never produced a result (retry budget
                     # exhausted, or a kill): this worker may die and
@@ -175,10 +189,17 @@ class ReplicaWorker:
                     self.ef.restore_segment(idx, vals)
             else:
                 res = self._call(self.store.push, self.worker_id,
-                                 pulled.version, g, l, c)
+                                 pulled.version, g, l, c,
+                                 basis_epoch=pulled.epoch)
         self.cycles += 1
         if not res.accepted and not res.done:
-            self.rejected += 1
+            # a fenced push is the failover spelling of a staleness
+            # rejection: the basis belongs to a superseded primary —
+            # re-pull and recompute (EF mass already restored above)
+            if getattr(res, "fenced", False):
+                self.fenced += 1
+            else:
+                self.rejected += 1
         if self.heartbeat is not None:
             self.heartbeat.beat()
         return not res.done

@@ -3,12 +3,17 @@
 One run exercises the whole circulatory system at once:
 
 1. **Continuous retraining** — a :class:`~tpu_sgd.replica.ReplicaDriver`
-   fleet (bounded staleness, compressed top-k pushes) trains round
-   after round on a DRIFTING stream (each round regenerates labels from
-   drifted true weights), checkpointing on a cadence through one
-   ``CheckpointManager``.  During one round a worker is KILLED by an
-   armed ``replica.push`` failpoint and rejoins under the driver's
-   seeded rejoin policy.
+   fleet (bounded staleness, compressed top-k pushes, ONE standby
+   store under the HA supervisor — ``tpu_sgd/replica/ha.py``) trains
+   round after round on a DRIFTING stream (each round regenerates
+   labels from drifted true weights), checkpointing on a cadence
+   through one ``CheckpointManager``.  During one round a worker is
+   KILLED by an armed ``replica.push`` failpoint and rejoins under the
+   driver's seeded rejoin policy; during a LATER round the PRIMARY
+   STORE is killed mid-round and the supervisor promotes the standby
+   under live traffic — the SLO gate requires >= 1 failover, a bounded
+   ``replica.failover`` span, the failover detector's typed alert, and
+   (as ever) zero dropped requests.
 2. **Live serving under admission control** — three endpoints serve
    while the fleet retrains underneath them: a hot-reloading dense
    endpoint (interactive + shadow lanes, per-request deadlines), a
@@ -100,6 +105,17 @@ def build_slos(mode: str = "smoke", violate: Optional[str] = None) -> dict:
          "rule": "shed-rate", "min": 1},
         {"name": "alert-straggler", "metric": "alert_count",
          "rule": "replica-straggler", "min": 1},
+        # ISSUE 14: the store-kill round really failed over (the
+        # promotion span is the downtime surface — its bound is wall
+        # clock, so it gets the same CI-weather headroom as the p99),
+        # and the failover detector emitted its typed alert
+        {"name": "store-failover", "metric": "span_count",
+         "span": "replica.failover", "min": 1},
+        {"name": "failover-downtime", "metric": "span_max_s",
+         "span": "replica.failover",
+         "max": (30.0 if mode == "smoke" else 10.0)},
+        {"name": "alert-failover", "metric": "alert_count",
+         "rule": "failover", "min": 1},
     ]
     if violate is not None:
         matched = [s for s in slos if s["name"] == violate]
@@ -168,7 +184,8 @@ def run_scenario(
     iters_per_round = 20 if smoke else 40
     rounds = 3 if smoke else 4          # round 0 seeds, 1.. run live
     ckpt_every = 5
-    kill_round = 1
+    kill_round = 1        # a WORKER dies and rejoins in this round
+    store_kill_round = 2  # the PRIMARY STORE dies in this round
     phases = ([Phase("warm", 0.8, 250), Phase("burst", 1.5, 4000),
                Phase("cool", 0.8, 250)] if smoke else
               [Phase("warm", 2.0, 400), Phase("burst", 4.0, 6000),
@@ -236,6 +253,11 @@ def run_scenario(
                     .set_convergence_tol(0.0).set_reg_param(0.01)
                     .set_seed(seed + 7).set_workers(workers)
                     .set_staleness(tau).set_wire_compress(wire)
+                    # ONE standby: every round runs the HA store (ISSUE
+                    # 14) — rounds resume across epochs through the
+                    # shared checkpoint directory's (epoch, version)
+                    # ordering; the store-kill round promotes it live
+                    .set_standbys(1)
                     .set_checkpoint(manager, every=ckpt_every)
                     # jitter=0: the killed worker's dead period is a
                     # deterministic 0.5s EVERY run, not a lucky draw —
@@ -297,6 +319,7 @@ def run_scenario(
         def retrain():
             try:
                 rejoins = 0
+                failovers = 0
                 for r in range(1, rounds):
                     drv = make_driver(r)
                     data = _drift_data(seed, r, n_rows, d)
@@ -310,6 +333,33 @@ def run_scenario(
                         members = drv.last_membership_snapshot
                         rejoins += sum(max(0, m["joins"] - 1)
                                        for m in members.values())
+                    elif r == store_kill_round:
+                        # the PRIMARY STORE dies a few versions into
+                        # this round's fresh work (the listener fires
+                        # per applied version, so the kill lands at a
+                        # deterministic version offset regardless of
+                        # host load) and the supervisor promotes the
+                        # standby under live serving traffic
+                        start_v = manager.latest_version() or 0
+
+                        class _KillStoreAt:
+                            def __init__(self):
+                                self.done = False
+
+                            def on_run_start(self, c): ...
+
+                            def on_run_end(self, ev): ...
+
+                            def on_iteration(self, ev):
+                                if (not self.done
+                                        and ev.iteration >= start_v + 8):
+                                    self.done = True
+                                    drv.kill_primary()
+
+                        drv.set_listener(_KillStoreAt())
+                        drv.optimize_with_history(data, w0)
+                        failovers += drv.last_failover_snapshot[
+                            "failovers"]
                     else:
                         drv.optimize_with_history(data, w0)
                     # the reload CADENCE: the auto-reload scan catches
@@ -322,6 +372,7 @@ def run_scenario(
                         f"{manager.latest_version()}, serving "
                         f"version {registry.current_version}")
                 retrain_result["rejoins"] = rejoins
+                retrain_result["failovers"] = failovers
             except BaseException as e:  # surfaced after join
                 retrain_result["error"] = e
 
@@ -371,6 +422,7 @@ def run_scenario(
         totals = load_report["totals"]
         hot_reloads = registry.reload_count - 1  # first swap = initial load
         rejoins = retrain_result.get("rejoins", 0)
+        failovers = retrain_result.get("failovers", 0)
         obs.inc("scenario.answered", totals["answered"])
         obs.inc("scenario.rejected",
                 totals["rejected"] + totals["displaced"])
@@ -378,10 +430,11 @@ def run_scenario(
         obs.inc("scenario.dropped", totals["dropped"])
         obs.inc("scenario.reloads", hot_reloads)
         obs.inc("scenario.rejoins", rejoins)
+        obs.inc("scenario.failovers", failovers)
 
         say(f"load: {json.dumps(totals)} over {wall_s:.1f}s; "
             f"hot_reloads={hot_reloads} rejoins={rejoins} "
-            f"breaker={healthz.get('breaker')}")
+            f"failovers={failovers} breaker={healthz.get('breaker')}")
         say(f"lanes: {json.dumps(load_report['lanes'])}")
 
         # structural invariants the SLO file also gates on — asserted
@@ -399,7 +452,7 @@ def run_scenario(
                    "classes": load_report["classes"],
                    "phases": load_report["phases"],
                    "hot_reloads": hot_reloads, "rejoins": rejoins,
-                   "healthz": healthz}
+                   "failovers": failovers, "healthz": healthz}
         with open(os.path.join(out_dir, "scenario_summary.json"),
                   "w") as f:
             json.dump(summary, f, indent=2, default=str)

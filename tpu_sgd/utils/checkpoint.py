@@ -15,6 +15,7 @@ from __future__ import annotations
 import glob
 import logging
 import os
+import re
 from typing import Callable, Optional
 
 import numpy as np
@@ -24,6 +25,14 @@ from tpu_sgd.reliability.failpoints import FaultInjected, failpoint
 logger = logging.getLogger("tpu_sgd.checkpoint")
 
 FORMAT_VERSION = "1.0"
+
+#: checkpoint file names: the legacy ``ckpt_<iteration>.npz`` (epoch 0)
+#: and the failover-stamped ``ckpt_e<epoch>_<iteration>.npz`` — the
+#: replicated store (tpu_sgd/replica/ha.py) saves under the epoch of
+#: its failover generation, and ordering/restore prefer the highest
+#: ``(epoch, iteration)``, so a fenced old primary's late save can
+#: never shadow the promoted store's state.
+_CKPT_NAME = re.compile(r"^ckpt_(?:e(?P<epoch>\d+)_)?(?P<iter>\d+)\.npz$")
 
 
 class CheckpointVersionError(ValueError):
@@ -78,25 +87,39 @@ class CheckpointManager:
             except OSError:
                 pass
 
-    def _path(self, iteration: int) -> str:
+    def _path(self, iteration: int, epoch: int = 0) -> str:
+        if epoch:
+            return os.path.join(
+                self.directory, f"ckpt_e{epoch:04d}_{iteration:08d}.npz")
         return os.path.join(self.directory, f"ckpt_{iteration:08d}.npz")
 
     @staticmethod
+    def _key_of(path: str):
+        """Parsed ``(epoch, iteration)``, or None for a hand-named
+        ckpt_*.npz file (e.g. a user's 'ckpt_best.npz' copy) — those
+        are ignored rather than crashing every save/restore in the
+        directory."""
+        m = _CKPT_NAME.match(os.path.basename(path))
+        if m is None:
+            return None
+        return (int(m.group("epoch") or 0), int(m.group("iter")))
+
+    @staticmethod
     def _iteration_of(path: str):
-        """Parsed iteration, or None for a hand-named ckpt_*.npz file
-        (e.g. a user's 'ckpt_best.npz' copy) — those are ignored rather
-        than crashing every save/restore in the directory."""
-        stem = os.path.basename(path)[5:-4]
-        return int(stem) if stem.isdigit() else None
+        key = CheckpointManager._key_of(path)
+        return None if key is None else key[1]
 
     def _paths_by_iteration(self):
-        # sort by the PARSED iteration, not lexicographically: at
-        # iteration 10^8 the name grows a digit and 'ckpt_100000000'
+        # sort by the PARSED (epoch, iteration), not lexicographically:
+        # at iteration 10^8 the name grows a digit and 'ckpt_100000000'
         # sorts before 'ckpt_99999999', which would make latest_path
-        # return stale state and _prune delete every NEW checkpoint
+        # return stale state and _prune delete every NEW checkpoint.
+        # Epoch is the MAJOR key: after a store failover, the promoted
+        # epoch's saves outrank a fenced old primary's late save even
+        # when that save carries a higher iteration number.
         paths = glob.glob(os.path.join(self.directory, "ckpt_*.npz"))
-        numbered = [p for p in paths if self._iteration_of(p) is not None]
-        return sorted(numbered, key=self._iteration_of)
+        numbered = [p for p in paths if self._key_of(p) is not None]
+        return sorted(numbered, key=self._key_of)
 
     def save(
         self,
@@ -106,12 +129,16 @@ class CheckpointManager:
         loss_history,
         config_key: str = "",
         extras: Optional[dict] = None,
+        epoch: int = 0,
     ) -> str:
         """``extras``: optional named arrays saved alongside the core
         state (``x_``-prefixed in the npz so they can never collide with
         the versioned schema) — the streaming driver persists its
         ``intercept`` through this (its stream position rides the core
-        ``iteration`` field)."""
+        ``iteration`` field).  ``epoch``: the store failover generation
+        (``tpu_sgd/replica/ha.py``); stamped into the file NAME so
+        ordering and :meth:`restore` prefer the highest ``(epoch,
+        iteration)`` without opening every file."""
         from tpu_sgd.obs.spans import span
 
         # the span's ``iteration`` attr is the join key obs.report's
@@ -120,17 +147,18 @@ class CheckpointManager:
         with span("checkpoint.save", iteration=int(iteration)):
             failpoint("checkpoint.save")  # injected BEFORE any byte is
             # written: a save fault never leaves a partial file behind
-            path = self._path(iteration)
+            path = self._path(iteration, epoch)
             # Temp prefix must NOT match the ckpt_*.npz glob, or a
             # truncated file left by a crash mid-write would be picked
             # up by latest_path.
             tmp = os.path.join(self.directory,
-                               f".tmp_ckpt_{iteration:08d}.npz")
+                               ".tmp_" + os.path.basename(path))
             with open(tmp, "wb") as f:
                 np.savez(
                     f,
                     version=FORMAT_VERSION,
                     iteration=np.asarray(iteration, np.int64),
+                    epoch=np.asarray(epoch, np.int64),
                     weights=np.asarray(weights),
                     reg_val=np.asarray(reg_val, np.float64),
                     loss_history=np.asarray(loss_history, np.float64),
@@ -157,26 +185,39 @@ class CheckpointManager:
         return paths[-1] if paths else None
 
     def versions(self):
-        """Retained checkpoint iterations, ascending — the serving
-        registry's load-by-version surface (serve/registry.py)."""
-        return [self._iteration_of(p) for p in self._paths_by_iteration()]
+        """Retained checkpoint iterations in ``(epoch, iteration)``
+        order, deduplicated — the serving registry's load-by-version
+        surface (serve/registry.py).  After a store failover the list
+        may be non-monotone in the iteration number alone: the promoted
+        epoch's saves rank last (= newest) even when a fenced old
+        primary left a higher-numbered save behind."""
+        out, seen = [], set()
+        for p in self._paths_by_iteration():
+            it = self._iteration_of(p)
+            if it not in seen:
+                seen.add(it)
+                out.append(it)
+        return out
 
     def latest_version(self) -> Optional[int]:
         p = self.latest_path()
         return None if p is None else self._iteration_of(p)
 
     def restore_version(self, iteration: int) -> dict:
-        """Load exactly the checkpoint written at ``iteration``.  Explicit
+        """Load exactly the checkpoint written at ``iteration`` — the
+        HIGHEST-epoch save of that iteration when a failover wrote it
+        twice (the fenced old primary's copy never wins).  Explicit
         version requests raise on a missing or corrupt file (the caller
         named a specific version, so silently serving another would be
         wrong) — the latest-default :meth:`restore` keeps its fallback."""
-        path = self._path(int(iteration))
-        if not os.path.exists(path):
+        matches = [p for p in self._paths_by_iteration()
+                   if self._iteration_of(p) == int(iteration)]
+        if not matches:
             raise FileNotFoundError(
                 f"no checkpoint for iteration {iteration} in "
                 f"{self.directory!r} (retained: {self.versions()})"
             )
-        return self._load(path)
+        return self._load(matches[-1])
 
     def restore(self, path: Optional[str] = None) -> Optional[dict]:
         """Load a checkpoint dict or ``None`` when the directory is empty.
@@ -245,6 +286,7 @@ class CheckpointManager:
                 )
             return {
                 "iteration": int(z["iteration"]),
+                "epoch": (int(z["epoch"]) if "epoch" in z.files else 0),
                 "weights": z["weights"],
                 "reg_val": float(z["reg_val"]),
                 "loss_history": z["loss_history"],
