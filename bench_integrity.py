@@ -1,0 +1,190 @@
+"""Integrity-plane bench: what do the checksummed wires cost?
+
+Per the 2-core harness policy (ROADMAP), the headline numbers are
+COUNTS and BYTES-RATIOS — structural, reproducible — with the checksum
+wall measured STAGE-ISOLATED (CRC-32 throughput on frame-sized host
+buffers) rather than as end-to-end deltas that ambient CI noise drowns:
+
+* **zero_added_runtime** — the warmed fused streamed driver's
+  dispatch/host-sync counts with the integrity plane ON minus OFF:
+  both deltas must be ZERO (checksums are pure host work over bytes
+  the producers already hold — the PR 8 pin discipline re-asserted as
+  a gated bench number, ``scripts/bench_gate.py``).
+* **frames_verified_per_run** — how many chunk frames the run sealed
+  and verified (deterministic: one per superchunk), from the
+  ``integrity.verified.io.chunk`` counter.
+* **checksum_overhead_bytes_ratio** — payload bytes moved per run over
+  the checksum bytes added (4 per frame): the wire-size price of the
+  integrity plane, which is why it defaults ON.
+* **crc_stage** — isolated CRC-32 GB/s at representative frame sizes
+  (a 1 MiB chunk, a 256 KiB push payload, a 16 KiB top-k segment);
+  the seal+verify pair costs two passes at this rate.
+
+Writes ``BENCH_INTEGRITY.json``; ``bench_gate`` bands the headline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose — a single-threaded offline bench, no shared state.
+GRAFTLINT_LOCKS: dict = {}
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_INTEGRITY.json")
+
+
+def _data(n=768, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, np.zeros(d, np.float32)
+
+
+def _opt():
+    from tpu_sgd.optimize.gradient_descent import GradientDescent
+
+    return (GradientDescent()
+            .set_num_iterations(24).set_step_size(0.1)
+            .set_mini_batch_fraction(0.5).set_sampling("sliced")
+            .set_convergence_tol(0.0).set_seed(7)
+            .set_host_streaming(True).set_superstep(4))
+
+
+def bench_crc_stage() -> dict:
+    """Isolated CRC-32 throughput at frame-representative sizes —
+    quietest-attempt selection (min of 5), reps sized so each attempt
+    runs long enough to time."""
+    from tpu_sgd.io.integrity import checksum_arrays
+
+    out = {}
+    for name, nbytes in (("chunk_1mib", 1 << 20),
+                         ("push_256kib", 1 << 18),
+                         ("segment_16kib", 1 << 14)):
+        a = np.random.default_rng(3).random(nbytes // 4).astype(np.float32)
+        reps = max(8, int((16 << 20) // nbytes))
+        walls = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                checksum_arrays(a)
+            walls.append((time.perf_counter() - t0) / reps)
+        w = min(walls)
+        out[name] = {
+            "frame_bytes": int(a.nbytes),
+            "wall_s_per_checksum": w,
+            "gb_s": a.nbytes / w / 1e9,
+        }
+    return out
+
+
+def bench_zero_added_runtime() -> dict:
+    """Warmed fused run: dispatch/sync counts, integrity ON vs OFF —
+    the deltas are the headline and must be zero."""
+    from tpu_sgd.analysis.runtime import count_dispatches, count_host_syncs
+    from tpu_sgd.io.integrity import set_integrity
+
+    X, y, w0 = _data()
+    opt = _opt()
+    opt.optimize_with_history((X, y), w0)  # warm every program
+    with count_host_syncs() as s_on, count_dispatches() as d_on:
+        t0 = time.perf_counter()
+        opt.optimize_with_history((X, y), w0)
+        wall_on = time.perf_counter() - t0
+    set_integrity(False)
+    try:
+        with count_host_syncs() as s_off, count_dispatches() as d_off:
+            t0 = time.perf_counter()
+            opt.optimize_with_history((X, y), w0)
+            wall_off = time.perf_counter() - t0
+    finally:
+        set_integrity(True)
+    return {
+        "dispatches_on": d_on["n"], "dispatches_off": d_off["n"],
+        "host_syncs_on": s_on["n"], "host_syncs_off": s_off["n"],
+        "dispatch_delta": d_on["n"] - d_off["n"],
+        "host_sync_delta": s_on["n"] - s_off["n"],
+        "wall_on_s": wall_on, "wall_off_s": wall_off,
+    }
+
+
+def bench_frames_and_bytes() -> dict:
+    """One obs-observed run: frames verified and wire payload bytes →
+    the checksum byte-overhead ratio (4 bytes of CRC per frame)."""
+    from tpu_sgd import obs
+    from tpu_sgd.obs import counters as obs_counters
+
+    class _Sink:
+        def emit(self, kind, payload):
+            pass
+
+    X, y, w0 = _data()
+    opt = _opt()
+    opt.optimize_with_history((X, y), w0)  # warm (compiles off-ledger)
+    obs.enable(_Sink())
+    try:
+        obs_counters.reset()
+        opt.optimize_with_history((X, y), w0)
+        snap = obs_counters.snapshot()
+    finally:
+        obs.disable()
+    frames = snap.get("integrity.verified.io.chunk", {"n": 0})["n"]
+    payload = sum(v["bytes"] for k, v in snap.items()
+                  if ".wire." in k and not k.endswith(".logical"))
+    overhead = 4 * frames
+    return {
+        "frames_verified_per_run": frames,
+        "wire_payload_bytes_per_run": int(payload),
+        "checksum_overhead_bytes": overhead,
+        "checksum_overhead_bytes_ratio": (payload / overhead
+                                          if overhead else 0.0),
+    }
+
+
+def main() -> int:
+    crc = bench_crc_stage()
+    zero = bench_zero_added_runtime()
+    frames = bench_frames_and_bytes()
+    doc = {
+        "headline": {
+            "zero_added_runtime": {
+                "dispatch_delta": zero["dispatch_delta"],
+                "host_sync_delta": zero["host_sync_delta"],
+            },
+            "frames_verified_per_run": frames["frames_verified_per_run"],
+            "checksum_overhead_bytes_ratio": round(
+                frames["checksum_overhead_bytes_ratio"], 1),
+            "crc_gb_s_chunk": round(crc["chunk_1mib"]["gb_s"], 2),
+        },
+        "detail": {"crc_stage": crc, "zero_added_runtime": zero,
+                   "frames": frames},
+        "basis": (
+            "24-iteration sliced-sampling fused (K=4) host-streamed run "
+            "on the 2-core CPU harness; counts/ratios are the headline "
+            "per the ROADMAP policy (dispatch/sync deltas integrity-on "
+            "minus integrity-off on the warmed driver — MUST be 0; "
+            "frame count is one seal+verify per superchunk; byte ratio "
+            "is wire payload over 4-byte CRCs).  CRC walls are "
+            "stage-isolated min-of-5; end-to-end walls recorded for "
+            "context only — ambient-noise-bound on this harness."
+        ),
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc["headline"], indent=2))
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,6 +132,24 @@ GATES = {
         Gate("failover/compressed/objective_ratio_vs_sync", "lower",
              rel_tol=0.25),
     ],
+    "BENCH_INTEGRITY.json": [
+        # the integrity plane's acceptance pin as numbers (ISSUE 15):
+        # checksums are pure host work, so the warmed fused driver's
+        # dispatch/sync counts must be IDENTICAL with the plane on vs
+        # off — any nonzero delta is a regression with no noise excuse
+        Gate("headline/zero_added_runtime/dispatch_delta", "lower",
+             note="checksums-on must add zero dispatches"),
+        Gate("headline/zero_added_runtime/host_sync_delta", "lower",
+             note="checksums-on must add zero host syncs"),
+        # structural: one seal+verify per superchunk — exact by
+        # construction (24 iters / K=4 = 6 frames)
+        Gate("headline/frames_verified_per_run", "equal",
+             note="frame inventory drift = a wire lost its checksum"),
+        # the wire-size price: payload bytes per 4-byte CRC; exact for
+        # a fixed run shape, small band for a deliberate shape change
+        Gate("headline/checksum_overhead_bytes_ratio", "higher",
+             rel_tol=0.05),
+    ],
 }
 
 _SEG = re.compile(r"^(?P<key>.*?)(?P<idx>(\[\d+\])*)$")

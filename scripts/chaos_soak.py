@@ -55,6 +55,17 @@ stale-epoch pushes counted, matched objective, zero lost EF mass);
 the ``replica.failover`` span, its downtime bound, and the failover
 detector's typed alert are all gated by the default SLOs.
 
+The integrity plane (ISSUE 15) is soaked in phase 1g: ``corrupt_prob``
+armed at every checksummed wire (dense/sparse chunks, push payloads,
+delta-log records; EF segments verify at their extraction boundary on
+the same runs) with healed runs asserted BITWISE vs fault-free; a
+checksums-off poison cell whose NaN payloads the store's admission
+gate rejects whole at matched loss; and a forced weight-corruption
+cell that ROLLS BACK through epoch fencing to the last good
+checkpoint, replaying bitwise — all gated by the ``integrity-*``
+default SLOs (corruption injected, zero unhealed, detector tripped,
+rollback span traced).
+
 Exit code 0 = all invariants held.  Also exposed as the ``slow``-marked
 ``tests/test_reliability.py::test_chaos_soak`` (excluded from tier-1).
 """
@@ -115,6 +126,21 @@ DEFAULT_SLOS = {"slos": [
      "span": "replica.failover", "max": 30.0},
     {"name": "failover-alert-fired", "metric": "alert_count",
      "rule": "failover", "min": 1},
+    # the integrity plane (ISSUE 15, phase 1g): corruption was really
+    # injected at the checksummed wires AND every detected frame healed
+    # — integrity.unhealed counts only corruption that escaped every
+    # healing layer, and the soak's own bitwise asserts are the ground
+    # truth this counter mirrors; the detector must have turned the
+    # corrupt frames into typed alerts, and the forced weight-poison
+    # cell must have rolled back under its span
+    {"name": "integrity-corruption-injected", "metric": "counter",
+     "counter": "integrity.corrupt", "min": 1},
+    {"name": "integrity-zero-unhealed", "metric": "counter",
+     "counter": "integrity.unhealed", "max": 0},
+    {"name": "integrity-alert-fired", "metric": "alert_count",
+     "rule": "integrity", "min": 1},
+    {"name": "integrity-rollback-traced", "metric": "span_count",
+     "span": "integrity.rollback", "min": 1},
 ]}
 
 
@@ -710,6 +736,156 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
                 "never tripped")
             summary["failover_alerts"] = fo_trips
             say(f"failover detector tripped {fo_trips} time(s)")
+
+        # ---- phase 1g: END-TO-END DATA INTEGRITY (ISSUE 15) --------------
+        # the corrupting failpoint mode armed at every checksummed
+        # wire: a corrupt_prob spec silently MUTATES payload copies
+        # (bit flips, NaNs, truncations) exactly where real wire/DMA/
+        # storage damage would land, the consume-site verify turns each
+        # into a typed IntegrityError, the existing retry machinery
+        # heals it, and the healed runs are BITWISE the fault-free
+        # references this soak already computed.  Then the two poison
+        # cells: checksums OFF so NaN corruption reaches the store's
+        # numerical admission gate (poisoned pushes, matched loss), and
+        # the forced weight-corruption rollback (failover to your own
+        # past through epoch fencing).
+        from tpu_sgd.io.integrity import set_integrity
+
+        deadline = Deadline(300.0)
+        # (a) dense chunks + superchunks: corrupt_prob at io.chunk
+        chunk_opt = _make_opt(
+            iters, "sliced",
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.002,
+                              seed=seed + 80))
+        with inject_faults({"io.chunk": fp.corrupt_prob(
+                0.5, seed=seed + 81)}):
+            w_ci, h_ci = chunk_opt.optimize_with_history((X, y), w0)
+            chunk_triggers = fp.triggers("io.chunk")
+        assert chunk_triggers > 0, "io.chunk corruption never fired"
+        np.testing.assert_array_equal(
+            np.asarray(w_ci), w_ref,
+            err_msg="corrupt-chunk healed run diverged from fault-free")
+        np.testing.assert_array_equal(h_ci, h_ref)
+
+        # (b) sparse chunks: corrupt_prob (truncation) at io.sparse_chunk
+        sp_opt2 = _make_sparse_opt(
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.002,
+                              seed=seed + 82))
+        with inject_faults({"io.sparse_chunk": fp.corrupt_prob(
+                0.5, seed=seed + 83, kind="truncate")}):
+            w_sci, h_sci = sp_opt2.optimize_with_history((Xs, ys_lab),
+                                                         ws0)
+            sparse_triggers = fp.triggers("io.sparse_chunk")
+        assert sparse_triggers > 0
+        np.testing.assert_array_equal(np.asarray(w_sci),
+                                      np.asarray(w_sp_ref))
+        np.testing.assert_array_equal(h_sci, h_sp_ref)
+
+        # (c) push payloads + EF segments + delta-log records: a τ=0
+        # fleet with one standby, corruption armed on all three wires
+        # at once — pushes heal under the worker RetryPolicy, segments
+        # at the extraction boundary, log records by re-reading the
+        # intact retained record; bitwise vs the fault-free τ=0 run
+        wire_drv = (_make_replica(
+            0, retry=RetryPolicy(max_attempts=8, base_backoff_s=0.002,
+                                 seed=seed + 84)).set_standbys(1))
+        wire_faults = {
+            "replica.push.wire": fp.corrupt_prob(0.05, seed=seed + 85),
+            "replica.log.record": fp.corrupt_prob(0.2, seed=seed + 86,
+                                                  kind="nan"),
+        }
+        with inject_faults(wire_faults):
+            w_wi, h_wi = wire_drv.optimize_with_history((X, y), w0)
+            wire_triggers = {k: fp.triggers(k) for k in wire_faults}
+        assert all(n > 0 for n in wire_triggers.values()), wire_triggers
+        np.testing.assert_array_equal(
+            np.asarray(w_wi), w_rep_ref,
+            err_msg="corrupt-wire healed replica run diverged")
+        np.testing.assert_array_equal(h_wi, h_rep_ref)
+
+        # (d) POISON ADMISSION: checksums off — NaN corruption now
+        # reaches the store's numerical gate, which rejects the pushes
+        # WHOLE (typed poisoned); the workers recompute from (seed,
+        # version) and the run lands at the matched objective
+        set_integrity(False)
+        try:
+            poison_drv = _make_replica(2, iters=2 * rep_iters)
+            with inject_faults({"replica.push.wire": fp.corrupt_prob(
+                    0.08, seed=seed + 87, kind="nan")}):
+                w_po, _ = poison_drv.optimize_with_history((X, y), w0)
+        finally:
+            set_integrity(True)
+        po_snap = poison_drv.last_store_snapshot
+        assert po_snap["pushes_poisoned"] >= 1, po_snap
+        assert po_snap["version"] == 2 * rep_iters, po_snap
+        obj_po = _objective(w_po)
+        assert obj_po <= _objective(w_rep_ref) * 1.01, obj_po
+
+        # (e) CORRUPT-STATE ROLLBACK: poison planted in the live
+        # primary's weights (past any gate) — the armed controller
+        # fences the poisoned epoch, restores the last good checkpoint,
+        # and the τ=0 replay is BITWISE the clean run
+        import threading as _rb_threading
+
+        rb_dir = os.path.join(work, "rollback_ckpt")
+        rb_clean_dir = os.path.join(work, "rollback_clean")
+        rb_iters = 2 * rep_iters
+        rb_ref = _make_replica(0, iters=rb_iters)
+        rb_ref.set_checkpoint(CheckpointManager(rb_clean_dir, keep=4),
+                              every=5)
+        w_rb_ref, h_rb_ref = rb_ref.optimize_with_history((X, y), w0)
+        rb_drv = _make_replica(0, iters=rb_iters)
+        rb_drv.set_checkpoint(CheckpointManager(rb_dir, keep=4),
+                              every=5).set_integrity_rollback(True)
+
+        def _corrupter():
+            import time as _t
+
+            end = _t.monotonic() + 120
+            while _t.monotonic() < end:
+                sup = rb_drv._live_supervisor
+                if sup is not None:
+                    try:
+                        if sup.primary().version >= rb_iters // 3:
+                            rb_drv.chaos_corrupt_weights()
+                            return
+                    except Exception:
+                        pass
+                _t.sleep(0.002)
+
+        rb_t = _rb_threading.Thread(target=_corrupter, daemon=True)
+        rb_t.start()
+        w_rb, h_rb = rb_drv.optimize_with_history((X, y), w0)
+        rb_t.join(timeout=10)
+        rb_snap = rb_drv.last_failover_snapshot
+        assert rb_snap is not None and rb_snap["failovers"] >= 1, rb_snap
+        assert any(r["cold_recovery"] for r in rb_snap["records"])
+        np.testing.assert_array_equal(
+            np.asarray(w_rb), np.asarray(w_rb_ref),
+            err_msg="rollback replay diverged from the clean run")
+        np.testing.assert_array_equal(h_rb, h_rb_ref)
+        deadline.check("integrity phase")
+        summary["integrity"] = {
+            "chunk_corruptions_healed": chunk_triggers,
+            "sparse_corruptions_healed": sparse_triggers,
+            "wire_corruptions_healed": wire_triggers,
+            "pushes_poisoned": po_snap["pushes_poisoned"],
+            "poison_objective_ratio": obj_po / _objective(w_rep_ref),
+            "rollbacks": rb_snap["failovers"],
+            "rollback_epoch": rb_drv.last_store_snapshot["epoch"],
+        }
+        say(f"integrity: every corrupted wire healed BITWISE, "
+            f"{po_snap['pushes_poisoned']} poisoned pushes rejected, "
+            f"weight-corruption rolled back bitwise: "
+            f"{summary['integrity']}")
+        if trace_path is not None:
+            obs.flush_windows()
+            integ_trips = obs.snapshot().get(
+                "obs.alert.integrity", {"n": 0})["n"]
+            assert integ_trips >= 1, (
+                "corrupt frames were detected at every wire but the "
+                "integrity detector never tripped")
+            summary["integrity"]["alerts"] = integ_trips
 
         # ---- phase 2: serving under reload faults ------------------------
         deadline = Deadline(120.0)

@@ -1,0 +1,753 @@
+"""End-to-end data-integrity plane (ISSUE 15).
+
+Covers: the corrupting failpoint mode (deterministic payload mutation,
+originals untouched), per-site corrupt-then-heal BITWISE fixtures at
+every checksummed wire (dense chunks, sparse chunks, EF segments,
+replica push payloads, delta-log records), poison admission at the
+store (non-finite + norm-gate verdicts, reject-whole with EXACT EF mass
+conservation at τ∈{0,2}), corrupt-state rollback through epoch fencing
+(matched/bitwise replay), checkpoint content-checksum round-trip +
+corrupt-restore quarantine, the integrity / heartbeat-stall detectors
+(trip, no-trip, dedup/re-arm), the failpoint-coverage lint rule's
+corruptpoint awareness, and the PR 8 zero-added-runtime pin re-asserted
+with checksums ON.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.io.integrity import (IntegrityError, checksum_arrays, seal,
+                                  set_integrity, verify)
+from tpu_sgd.io.sparse_wire import ErrorFeedback
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.updaters import SimpleUpdater, SquaredL2Updater
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+from tpu_sgd.replica import ParameterStore, ReplicaDriver
+from tpu_sgd.reliability import failpoints as fp
+from tpu_sgd.reliability.retry import RetryPolicy
+from tpu_sgd.utils.checkpoint import CheckpointManager
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    """Every test leaves the failpoint registry disarmed and the
+    integrity plane ON (its production default)."""
+    yield
+    fp.deactivate()
+    set_integrity(True)
+
+
+def _data(n=256, d=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, np.zeros(d, np.float32)
+
+
+def _objective(X, y, w, reg=0.1):
+    r = X @ np.asarray(w) - y
+    return float(0.5 * np.mean(r * r)
+                 + 0.5 * reg * np.sum(np.asarray(w) ** 2))
+
+
+# -- the checksum primitive ---------------------------------------------------
+
+
+def test_checksum_covers_bytes_shape_and_dtype():
+    a = np.arange(16, dtype=np.float32)
+    base = checksum_arrays(a)
+    flipped = a.copy()
+    flipped[3] = np.float32(np.frombuffer(
+        np.int32(np.frombuffer(flipped[3].tobytes(), np.int32)[0] ^ 1)
+        .tobytes(), np.float32)[0])
+    assert checksum_arrays(flipped) != base  # one flipped bit
+    assert checksum_arrays(a[:15]) != base   # truncation
+    assert checksum_arrays(a.astype(np.float64).astype(np.float32)) \
+        == base                              # value-equal = digest-equal
+    assert checksum_arrays(a.reshape(4, 4)) != base  # shape rides along
+    assert checksum_arrays(a, None) != checksum_arrays(a)  # None leaf
+
+
+def test_verify_raises_typed_and_seal_disables():
+    a = np.arange(8, dtype=np.float32)
+    ck = seal(a)
+    verify("t.site", ck, a)  # clean passes
+    with pytest.raises(IntegrityError) as ei:
+        verify("t.site", ck, a + 1)
+    assert ei.value.site == "t.site"
+    assert ei.value.kind == "checksum"
+    assert isinstance(ei.value, RuntimeError)  # retryable by default
+    set_integrity(False)
+    assert seal(a) is None
+    verify("t.site", None, a + 1)  # unsealed frame: verify skips
+
+
+# -- the corrupting failpoint mode --------------------------------------------
+
+
+def test_corrupt_nth_mutates_copy_not_original():
+    a = np.arange(32, dtype=np.float32)
+    keep = a.copy()
+    with fp.inject_faults({"t.wire": fp.corrupt_nth(1, kind="bitflip")}):
+        (out,) = fp.corruptpoint("t.wire", (a,))
+        assert not np.array_equal(out, keep)  # the copy is damaged
+        np.testing.assert_array_equal(a, keep)  # the original is not
+        (again,) = fp.corruptpoint("t.wire", (a,))
+        np.testing.assert_array_equal(again, keep)  # one-shot: healed
+
+
+@pytest.mark.parametrize("kind", ["bitflip", "nan", "truncate"])
+def test_corrupt_kinds_all_fail_the_checksum(kind):
+    a = np.linspace(-1, 1, 64, dtype=np.float32)
+    b = np.arange(8, dtype=np.int32)
+    ck = seal(a, b)
+    with fp.inject_faults({"t.wire": fp.corrupt_nth(1, kind=kind)}):
+        out = fp.corruptpoint("t.wire", (a, b))
+    with pytest.raises(IntegrityError):
+        verify("t.wire", ck, *out)
+    if kind == "nan":
+        assert not np.isfinite(np.asarray(out[0], np.float64)).all() \
+            or not np.array_equal(out[1], b)
+
+
+def test_corrupt_prob_is_seed_deterministic():
+    a = np.arange(64, dtype=np.float32)
+
+    def trail(seed):
+        out = []
+        with fp.inject_faults({"t.wire": fp.corrupt_prob(0.5, seed=seed)}):
+            for _ in range(12):
+                (o,) = fp.corruptpoint("t.wire", (a,))
+                out.append(np.array_equal(o, a))
+        return out
+
+    assert trail(7) == trail(7)
+    assert trail(7) != trail(8)
+
+
+def test_corruptpoint_disabled_is_identity():
+    payload = (np.arange(4), "tag", 3.5)
+    assert fp.corruptpoint("t.wire", payload) is payload
+
+
+# -- hook-site coverage (graftlint enforces both directions) ------------------
+
+
+def test_corrupt_sites_registered_in_hook_sites():
+    for site in ("io.chunk", "io.sparse_chunk", "io.segment",
+                 "replica.push.wire", "replica.log.record"):
+        assert site in fp.HOOK_SITES, site
+
+
+def test_failpoint_coverage_rule_sees_corruptpoint_calls():
+    from tpu_sgd.analysis.core import ModuleFile
+    from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
+
+    wired = ModuleFile(
+        "m.py", "m.py",
+        "from tpu_sgd.reliability.failpoints import corruptpoint\n"
+        "def f(p):\n"
+        "    return corruptpoint('a.b', p)\n")
+    bare = ModuleFile("m.py", "m.py", "def f(p):\n    return p\n")
+    rogue = ModuleFile(
+        "m.py", "m.py",
+        "from tpu_sgd.reliability.failpoints import corruptpoint\n"
+        "def f(p):\n"
+        "    return corruptpoint('not.registered', p)\n")
+    rule = FailpointCoverageRule(registry={"a.b": "m.py"})
+    assert list(rule.run([wired], {})) == []
+    missing = list(rule.run([bare], {}))
+    assert len(missing) == 1 and "a.b" in missing[0].message
+    extra = list(rule.run([rogue], {}))
+    assert any("not.registered" in f.message for f in extra)
+
+
+# -- per-site corrupt-then-heal BITWISE fixtures ------------------------------
+
+
+def _streamed_opt(retry=None, superstep=1):
+    o = (GradientDescent()
+         .set_num_iterations(24).set_step_size(0.1)
+         .set_mini_batch_fraction(0.5).set_sampling("sliced")
+         .set_convergence_tol(0.0).set_seed(7)
+         .set_host_streaming(True))
+    if superstep > 1:
+        o.set_superstep(superstep)
+    if retry is not None:
+        o.set_ingest_options(retry=retry)
+    return o
+
+
+def test_corrupt_chunk_heals_bitwise_streamed():
+    """corrupt_prob armed at the dense chunk wire: every detected frame
+    raises IntegrityError inside the prefetcher retry scope and the
+    deterministic (seed, i) reassembly heals BITWISE."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _streamed_opt().optimize_with_history((X, y), w0)
+    opt = _streamed_opt(retry=RetryPolicy(max_attempts=6,
+                                          base_backoff_s=0.001, seed=3))
+    with fp.inject_faults({"io.chunk": fp.corrupt_prob(0.2, seed=11)}):
+        w_c, h_c = opt.optimize_with_history((X, y), w0)
+        assert fp.triggers("io.chunk") > 0
+    np.testing.assert_array_equal(np.asarray(w_c), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_c, h_ref)
+
+
+def test_corrupt_superchunk_heals_bitwise_fused():
+    X, y, w0 = _data()
+    w_ref, h_ref = _streamed_opt(superstep=4).optimize_with_history(
+        (X, y), w0)
+    opt = _streamed_opt(superstep=4,
+                        retry=RetryPolicy(max_attempts=6,
+                                          base_backoff_s=0.001, seed=4))
+    with fp.inject_faults({"io.chunk": fp.corrupt_nth(2, kind="nan")}):
+        w_c, h_c = opt.optimize_with_history((X, y), w0)
+        assert fp.triggers("io.chunk") == 1
+    np.testing.assert_array_equal(np.asarray(w_c), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_c, h_ref)
+
+
+def test_corrupt_sparse_chunk_heals_bitwise():
+    from tpu_sgd.ops.gradients import HingeGradient
+    from tpu_sgd.ops.sparse import sparse_data
+
+    Xs, ys, _ = sparse_data(256, 128, nnz_per_row=6, kind="svm", seed=0)
+    w0 = np.zeros(Xs.shape[1], np.float32)
+
+    def _opt(retry=None):
+        o = (GradientDescent(gradient=HingeGradient())
+             .set_num_iterations(12).set_step_size(0.2)
+             .set_mini_batch_fraction(0.4).set_convergence_tol(0.0)
+             .set_seed(7).set_host_streaming(True))
+        if retry is not None:
+            o.set_ingest_options(retry=retry)
+        return o
+
+    w_ref, h_ref = _opt().optimize_with_history((Xs, ys), w0)
+    opt = _opt(retry=RetryPolicy(max_attempts=6, base_backoff_s=0.001,
+                                 seed=5))
+    with fp.inject_faults(
+            {"io.sparse_chunk": fp.corrupt_prob(0.25, seed=12,
+                                                kind="truncate")}):
+        w_c, h_c = opt.optimize_with_history((Xs, ys), w0)
+        assert fp.triggers("io.sparse_chunk") > 0
+    np.testing.assert_array_equal(np.asarray(w_c), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_c, h_ref)
+
+
+def test_corrupt_segment_detected_before_any_ef_mutation():
+    """A corrupted top-k segment raises at the extraction boundary with
+    the accumulator UNTOUCHED — the healing retry replays the whole
+    compress and selects a bit-identical segment."""
+    ef = ErrorFeedback(32, 0.25)
+    twin = ErrorFeedback(32, 0.25)
+    update = np.linspace(-2, 2, 32, dtype=np.float32)
+    with fp.inject_faults({"io.segment": fp.corrupt_nth(1)}):
+        with pytest.raises(IntegrityError):
+            ef.compress(update.copy())
+        np.testing.assert_array_equal(ef.acc, np.zeros(32, np.float32))
+        idx, vals = ef.compress(update.copy())  # one-shot: healed
+    idx_ref, vals_ref = twin.compress(update.copy())
+    np.testing.assert_array_equal(idx, idx_ref)
+    np.testing.assert_array_equal(vals, vals_ref)
+    np.testing.assert_array_equal(ef.acc, twin.acc)
+
+
+def _replica(tau=0, workers=2, iters=24, retry=None, standbys=0,
+             compress=None):
+    drv = (ReplicaDriver(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(0.3).set_num_iterations(iters)
+           .set_mini_batch_fraction(0.5).set_convergence_tol(0.0)
+           .set_reg_param(0.1).set_workers(workers).set_staleness(tau))
+    if retry is not None:
+        drv.set_retry(retry)
+    if standbys:
+        drv.set_standbys(standbys)
+    if compress is not None:
+        drv.set_wire_compress(compress)
+    return drv
+
+
+def test_corrupt_push_wire_heals_bitwise_tau0():
+    """A push payload damaged on the wire fails the store's
+    consume-site verify; the worker's RetryPolicy re-sends the intact
+    originals and the τ=0 trajectory is BITWISE the fault-free one."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _replica().optimize_with_history((X, y), w0)
+    drv = _replica(retry=RetryPolicy(max_attempts=6,
+                                     base_backoff_s=0.001, seed=6))
+    with fp.inject_faults(
+            {"replica.push.wire": fp.corrupt_prob(0.1, seed=13)}):
+        w_c, h_c = drv.optimize_with_history((X, y), w0)
+        assert fp.triggers("replica.push.wire") > 0
+    np.testing.assert_array_equal(np.asarray(w_c), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_c, h_ref)
+
+
+def test_corrupt_compressed_push_heals_bitwise_and_conserves_ef():
+    X, y, w0 = _data()
+    ref = _replica(compress="topk:0.25")
+    w_ref, h_ref = ref.optimize_with_history((X, y), w0)
+    drv = _replica(compress="topk:0.25",
+                   retry=RetryPolicy(max_attempts=6,
+                                     base_backoff_s=0.001, seed=7))
+    with fp.inject_faults(
+            {"replica.push.wire": fp.corrupt_nth(3, kind="nan")}):
+        w_c, h_c = drv.optimize_with_history((X, y), w0)
+        assert fp.triggers("replica.push.wire") == 1
+    # the retry re-sent the SAME extracted segment, so the healed run
+    # is bitwise — corruption never touched the EF accumulator
+    np.testing.assert_array_equal(np.asarray(w_c), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_c, h_ref)
+
+
+def test_corrupt_log_record_heals_standby_bitwise():
+    """A delta-log record damaged on the replication hop is detected at
+    the standby's consume-site verify and re-read intact from the log —
+    the standby stays BITWISE the primary at every version."""
+    X, y, w0 = _data()
+    drv = _replica(standbys=1)
+    with fp.inject_faults(
+            {"replica.log.record": fp.corrupt_nth(2, kind="bitflip")}):
+        drv.optimize_with_history((X, y), w0)
+        assert fp.triggers("replica.log.record") == 1
+    sup = drv.last_supervisor
+    assert sup.failover_count == 0  # healed in place, no promotion
+    primary = sup.primary()
+    standby = next(rep for rep in sup._standbys.values())
+    assert standby.corrupt_healed >= 1
+    assert standby.store.version == primary.version
+    np.testing.assert_array_equal(np.asarray(standby.store.weights),
+                                  np.asarray(primary.weights))
+
+
+# -- poison admission ---------------------------------------------------------
+
+
+def _store(tau=0, guard=10.0, iters=200):
+    cfg = SGDConfig(num_iterations=iters, step_size=0.1,
+                    mini_batch_fraction=1.0, reg_param=0.0,
+                    convergence_tol=0.0)
+    store = ParameterStore(SimpleUpdater(), cfg,
+                           np.zeros(16, np.float32), staleness=tau,
+                           poison_guard=guard)
+    store.register_worker("w0", 0)
+    return store
+
+
+def test_non_finite_push_rejected_poisoned():
+    store = _store()
+    g = np.ones(16, np.float32)
+    g[3] = np.nan
+    res = store.push("w0", 0, g, np.float32(1.0), np.float32(4.0))
+    assert res.poisoned and not res.accepted
+    assert store.version == 0  # rejected WHOLE: the version line is clean
+    res2 = store.push("w0", 0, np.ones(16, np.float32),
+                      np.float32(1.0), np.float32(4.0))
+    assert res2.accepted and not res2.poisoned
+    snap = store.snapshot()
+    assert snap["pushes_poisoned"] == 1
+    assert snap["pushes_accepted"] == 1
+
+
+def test_norm_gate_trips_after_warmup_and_guard_none_disables():
+    store = _store(tau=1)
+    for i in range(20):  # build the rolling-median baseline
+        res = store.push("w0", store.version,
+                         np.ones(16, np.float32), np.float32(0.5),
+                         np.float32(4.0))
+        assert res.accepted
+    spike = np.full(16, 1e4, np.float32)
+    res = store.push("w0", store.version, spike, np.float32(0.5),
+                     np.float32(4.0))
+    assert res.poisoned and not res.accepted
+    # same spike through an unguarded store is admitted (the
+    # configuration whose poison the rollback controller exists for)
+    off = _store(tau=1, guard=None)
+    for i in range(20):
+        off.push("w0", off.version, np.ones(16, np.float32),
+                 np.float32(0.5), np.float32(4.0))
+    assert off.push("w0", off.version, spike, np.float32(0.5),
+                    np.float32(4.0)).accepted
+
+
+@pytest.mark.parametrize("tau", [0, 2])
+def test_poisoned_compressed_push_conserves_ef_mass_exact(tau):
+    """A poisoned compressed push is rejected WHOLE and the restored
+    segment returns the extracted mass EXACTLY (bit-for-bit) — then the
+    deterministic recompute selects the identical segment and is
+    admitted."""
+    store = _store(tau=tau)
+    ef = store.error_feedback("w0", 0.25)
+    update = (np.linspace(-3, 3, 16).astype(np.float32))
+    idx, vals = ef.compress(update.copy())
+    poisoned = vals.copy()
+    poisoned[0] = np.inf  # the wire copy is damaged, ours is not
+    res = store.push_compressed("w0", store.version, idx, poisoned,
+                                1.0, 4.0)
+    assert res.poisoned and not res.accepted
+    assert store.version == 0
+    ef.restore_segment(idx, vals)  # the worker's rejection path
+    np.testing.assert_array_equal(ef.acc, update)  # EXACT conservation
+    idx2, vals2 = ef.compress(np.zeros(16, np.float32))  # recompute
+    np.testing.assert_array_equal(np.sort(idx2), np.sort(idx))
+    res2 = store.push_compressed("w0", store.version, idx2, vals2,
+                                 1.0, 4.0)
+    assert res2.accepted
+    assert store.snapshot()["pushes_poisoned"] == 1
+
+
+def test_poison_guard_off_corruption_heals_via_guardless_objective():
+    """Checksums OFF and the guard ON: NaN-corrupted push payloads are
+    caught by the ADMISSION gate instead, the workers recompute, and
+    the run still lands at the matched objective — the guard is the
+    checksum's numerical backstop."""
+    X, y, w0 = _data()
+    set_integrity(False)  # unsealed wire: the checksum cannot catch it
+    try:
+        ref = _replica(tau=2, iters=48)
+        w_ref, _ = ref.optimize_with_history((X, y), w0)
+        drv = _replica(tau=2, iters=48)
+        with fp.inject_faults(
+                {"replica.push.wire": fp.corrupt_prob(
+                    0.1, seed=21, kind="nan")}):
+            w_p, _ = drv.optimize_with_history((X, y), w0)
+            assert fp.triggers("replica.push.wire") > 0
+    finally:
+        set_integrity(True)
+    snap = drv.last_store_snapshot
+    assert snap["pushes_poisoned"] >= 1
+    assert snap["version"] == 48
+    assert np.isfinite(np.asarray(w_p)).all()
+    assert _objective(X, y, w_p) <= _objective(X, y, w_ref) * 1.01
+
+
+# -- corrupt-state rollback ---------------------------------------------------
+
+
+def test_weight_corruption_rolls_back_through_epoch_fencing(tmp_path):
+    """The forced weight-corruption cell: NaN planted in the live
+    primary's weights mid-run.  The armed RollbackController fences the
+    poisoned line (epoch bump — in-flight pushes come back fenced,
+    never merged), cold-restores the last good checkpoint, and the τ=0
+    replay lands BITWISE on the clean run's trajectory."""
+    X, y, w0 = _data()
+    iters = 60
+    clean = CheckpointManager(str(tmp_path / "clean"), keep=4)
+    ref = _replica(iters=iters)
+    ref.set_checkpoint(clean, every=5)
+    w_ref, h_ref = ref.optimize_with_history((X, y), w0)
+
+    manager = CheckpointManager(str(tmp_path / "ckpt"), keep=4)
+    drv = _replica(iters=iters)
+    drv.set_checkpoint(manager, every=5).set_integrity_rollback(True)
+
+    def corrupter():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sup = drv._live_supervisor
+            if sup is not None:
+                try:
+                    if sup.primary().version >= 10:
+                        drv.chaos_corrupt_weights()
+                        return
+                except Exception:
+                    pass
+            time.sleep(0.002)
+
+    t = threading.Thread(target=corrupter, daemon=True)
+    t.start()
+    w_rb, h_rb = drv.optimize_with_history((X, y), w0)
+    t.join(timeout=5)
+    snap = drv.last_failover_snapshot
+    assert snap is not None and snap["failovers"] >= 1
+    assert any(r["cold_recovery"] for r in snap["records"])
+    assert drv.last_store_snapshot["epoch"] >= 1
+    assert np.isfinite(np.asarray(w_rb)).all()
+    # failover to your own past IS a replay: τ=0 recomputes the lost
+    # versions from (seed, version) and the trajectory is bitwise
+    np.testing.assert_array_equal(np.asarray(w_rb), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_rb, h_ref)
+
+
+def test_manual_rollback_handle_requires_live_ha_run():
+    drv = _replica()
+    assert drv.rollback() is False
+    assert drv.chaos_corrupt_weights() is False
+
+
+def test_rollback_rebuilds_standby_redundancy(tmp_path):
+    """One rollback must not permanently strip a set_standbys(n) fleet
+    of replication: the poisoned standbys are gone (they replayed the
+    poison), but fresh ones resume from the restored line and the HA
+    invariant survives."""
+    X, y, w0 = _data()
+    manager = CheckpointManager(str(tmp_path), keep=4)
+    drv = _replica(iters=60, standbys=1)
+    drv.set_checkpoint(manager, every=5).set_integrity_rollback(True)
+
+    def corrupter():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sup = drv._live_supervisor
+            if sup is not None:
+                try:
+                    if sup.primary().version >= 10:
+                        drv.chaos_corrupt_weights()
+                        return
+                except Exception:
+                    pass
+            time.sleep(0.002)
+
+    t = threading.Thread(target=corrupter, daemon=True)
+    t.start()
+    w_rb, _ = drv.optimize_with_history((X, y), w0)
+    t.join(timeout=5)
+    sup = drv.last_supervisor
+    assert drv.last_failover_snapshot["failovers"] >= 1
+    assert np.isfinite(np.asarray(w_rb)).all()
+    live = [rep for rep in sup._standbys.values()
+            if not (rep.store.failed or rep.store.fenced)]
+    assert live, "rollback left the fleet with zero standbys"
+    # the rebuilt standby chained onto the restored line: stop()
+    # drained it to the log head, so it ends bitwise at the primary
+    assert live[0].store.version == sup.primary().version
+    np.testing.assert_array_equal(
+        np.asarray(live[0].store.weights),
+        np.asarray(sup.primary().weights))
+
+
+def test_poison_livelock_fails_loudly_without_rollback(monkeypatch):
+    """Poison that CANNOT heal (weights corrupted, rollback unarmed):
+    the deterministic recompute reproduces the bad payload forever, so
+    the worker must give up with a typed IntegrityError after its
+    streak limit instead of silently livelocking the fleet."""
+    from tpu_sgd.replica.worker import ReplicaWorker
+
+    monkeypatch.setattr(ReplicaWorker, "POISON_STREAK_LIMIT", 8)
+    X, y, w0 = _data()
+    drv = _replica(workers=1, iters=500, standbys=1)
+    drv.set_rejoin(RetryPolicy(max_attempts=2, base_backoff_s=0.001,
+                               seed=3))
+
+    def corrupter():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sup = drv._live_supervisor
+            if sup is not None:
+                try:
+                    if sup.primary().version >= 5:
+                        drv.chaos_corrupt_weights()
+                        return
+                except Exception:
+                    pass
+            time.sleep(0.002)
+
+    t = threading.Thread(target=corrupter, daemon=True)
+    t.start()
+    with pytest.raises(IntegrityError) as ei:
+        drv.optimize_with_history((X, y), w0)
+    t.join(timeout=5)
+    assert ei.value.kind == "poison"
+    assert drv.last_store_snapshot["pushes_poisoned"] >= 8
+
+
+# -- checkpoint content checksum ----------------------------------------------
+
+
+def test_checkpoint_checksum_roundtrip(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep=3)
+    w = np.arange(8, dtype=np.float32)
+    m.save(5, w, 0.25, np.asarray([1.0, 0.5]), "cfg",
+           extras={"ef": np.ones(4, np.float32)})
+    state = m.restore()
+    assert state["iteration"] == 5
+    np.testing.assert_array_equal(state["weights"], w)
+    np.testing.assert_array_equal(state["extras"]["ef"],
+                                  np.ones(4, np.float32))
+
+
+def test_checkpoint_disabled_integrity_omits_checksum(tmp_path):
+    set_integrity(False)
+    try:
+        m = CheckpointManager(str(tmp_path), keep=3)
+        path = m.save(1, np.ones(4, np.float32), 0.0,
+                      np.asarray([1.0]), "")
+        with np.load(path) as z:
+            assert "checksum" not in z.files
+    finally:
+        set_integrity(True)
+    assert m.restore()["iteration"] == 1  # legacy files keep loading
+
+
+def test_corrupt_checkpoint_quarantined_and_falls_back(tmp_path):
+    quarantined = []
+    m = CheckpointManager(str(tmp_path), keep=3,
+                          on_corruption=lambda p, q, e: quarantined
+                          .append((q or p, e)))
+    m.save(5, np.full(8, 5.0, np.float32), 0.0, np.asarray([1.0]), "")
+    path10 = m.save(10, np.full(8, 10.0, np.float32), 0.0,
+                    np.asarray([1.0, 0.5]), "")
+    # silently damage the newest file's weights WITHOUT re-sealing —
+    # exactly what a bit rotting at rest looks like to the reader
+    with np.load(path10) as z:
+        entries = {k: np.array(z[k]) for k in z.files}
+    entries["weights"][0] = 999.0
+    with open(path10, "wb") as f:
+        np.savez(f, **entries)
+
+    with pytest.raises(IntegrityError):
+        m.restore_version(10)  # explicit request: raises, never swaps
+
+    state = m.restore()  # latest-default: quarantine + fall back
+    assert state["iteration"] == 5
+    assert len(quarantined) == 1
+    assert isinstance(quarantined[0][1], IntegrityError)
+    assert m.versions() == [5]  # the bad file left the namespace
+
+
+# -- detectors ----------------------------------------------------------------
+
+
+def _window(index, series):
+    return {"index": index, "t_start": float(index),
+            "t_end": float(index + 1),
+            "series": {k: ({"count": v, "mean": 0.0, "max": None,
+                            "bytes": 0} if isinstance(v, int) else v)
+                       for k, v in series.items()}}
+
+
+def test_integrity_detector_trip_no_trip_and_rearm():
+    from tpu_sgd.obs.detect import DetectorEngine, IntegrityDetector
+
+    alerts = []
+    eng = DetectorEngine(detectors=[IntegrityDetector()],
+                         on_alert=alerts.append)
+    eng.on_window_close(_window(0, {"train.loss": 4}))  # clean: no trip
+    assert alerts == []
+    eng.on_window_close(_window(1, {"integrity.corrupt.io.chunk": 2}))
+    assert len(alerts) == 1
+    assert alerts[0].rule == "integrity"
+    assert alerts[0].series == "integrity.corrupt.io.chunk"
+    assert alerts[0].value == 2.0
+    # stays-tripped = ONE incident
+    eng.on_window_close(_window(2, {"integrity.corrupt.io.chunk": 1}))
+    assert len(alerts) == 1
+    # a clean window re-arms; the next corrupt frame is a new incident
+    eng.on_window_close(_window(3, {}))
+    eng.on_window_close(_window(4, {"integrity.corrupt.io.chunk": 1}))
+    assert len(alerts) == 2
+
+
+def test_heartbeat_stall_detector_membership_and_fleet_silence():
+    from tpu_sgd.obs.detect import DetectorEngine, HeartbeatStallDetector
+
+    alerts = []
+    eng = DetectorEngine(
+        detectors=[HeartbeatStallDetector(stall_windows=2)],
+        on_alert=alerts.append)
+    watch = {"reliability.hb.watch[feed]": 1,
+             "reliability.hb.watch[batcher]": 1}
+    both = {**watch, "reliability.heartbeat[feed]": 3,
+            "reliability.heartbeat[batcher]": 2}
+    eng.on_window_close(_window(0, both))
+    assert alerts == []
+    # batcher goes silent while feed beats: trips after stall_windows
+    one = {"reliability.heartbeat[feed]": 3}
+    eng.on_window_close(_window(1, one))
+    assert alerts == []  # 1 silent window < 2
+    eng.on_window_close(_window(2, one))
+    assert len(alerts) == 1
+    assert "batcher" in alerts[0].series
+    # fleet-wide silence (idle/finished process) never trips
+    alerts.clear()
+    eng2 = DetectorEngine(
+        detectors=[HeartbeatStallDetector(stall_windows=2)],
+        on_alert=alerts.append)
+    eng2.on_window_close(_window(0, both))
+    for i in range(1, 6):
+        eng2.on_window_close(_window(i, {}))
+    assert alerts == []
+    # a retired (unwatched) component cannot trip
+    eng3 = DetectorEngine(
+        detectors=[HeartbeatStallDetector(stall_windows=2)],
+        on_alert=alerts.append)
+    eng3.on_window_close(_window(0, both))
+    eng3.on_window_close(
+        _window(1, {"reliability.hb.unwatch[batcher]": 1,
+                    "reliability.heartbeat[feed]": 1}))
+    for i in range(2, 6):
+        eng3.on_window_close(
+            _window(i, {"reliability.heartbeat[feed]": 1}))
+    assert alerts == []
+
+
+def test_unwatched_heartbeat_never_joins_roster():
+    from tpu_sgd.obs.detect import DetectorEngine, HeartbeatStallDetector
+
+    alerts = []
+    eng = DetectorEngine(
+        detectors=[HeartbeatStallDetector(stall_windows=1)],
+        on_alert=alerts.append)
+    # beats with NO watch declaration: an idle batcher is silent and
+    # healthy — only declared-should-beat components are candidates
+    eng.on_window_close(
+        _window(0, {"reliability.heartbeat[feed]": 2,
+                    "reliability.heartbeat[idle]": 1}))
+    for i in range(1, 5):
+        eng.on_window_close(
+            _window(i, {"reliability.heartbeat[feed]": 2}))
+    assert alerts == []
+
+
+def test_health_monitor_watch_emits_roster_series():
+    from tpu_sgd import obs
+    from tpu_sgd.reliability.health import Heartbeat, HealthMonitor
+
+    class _Sink:
+        def emit(self, kind, payload):
+            pass
+
+    obs.enable(_Sink(), window_s=60.0)
+    try:
+        mon = HealthMonitor()
+        hb = Heartbeat("test-feed")
+        mon.watch_heartbeat(hb)
+        hb.beat()
+        mon.unwatch_heartbeat("test-feed")
+        snap = obs.windows_snapshot()
+    finally:
+        obs.disable()
+    series = {name for w in snap for name in w["series"]}
+    assert "reliability.hb.watch[test-feed]" in series
+    assert "reliability.heartbeat[test-feed]" in series
+    assert "reliability.hb.unwatch[test-feed]" in series
+
+
+# -- the zero-added-runtime pin (PR 8 discipline, checksums ON) ---------------
+
+
+def test_integrity_zero_added_runtime_events():
+    """Checksums are pure host work: the warmed fused driver runs with
+    the SAME dispatch/compile/host-sync counts whether the integrity
+    plane is on (the default this whole suite runs under) or off."""
+    from tpu_sgd.analysis.runtime import count_dispatches, count_host_syncs
+
+    X, y, w0 = _data()
+    opt = _streamed_opt(superstep=4)
+    opt.optimize_with_history((X, y), w0)  # warm every program
+    with count_host_syncs() as s_on, count_dispatches() as d_on:
+        opt.optimize_with_history((X, y), w0)
+    set_integrity(False)
+    try:
+        with count_host_syncs() as s_off, count_dispatches() as d_off:
+            opt.optimize_with_history((X, y), w0)
+    finally:
+        set_integrity(True)
+    assert d_on["n"] == d_off["n"]
+    assert s_on["n"] == s_off["n"]

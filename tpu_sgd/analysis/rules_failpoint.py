@@ -58,15 +58,22 @@ def extract_registry(tree: ast.Module) -> Optional[Dict[str, Tuple[str, int]]]:
     return None
 
 
+#: the hook-entry spellings this rule recognizes: the plain raising
+#: hook and the payload-carrying corrupting hook (ISSUE 15) — both are
+#: HOOK_SITES citizens, so deleting either kind of call fails lint
+HOOK_FUNCS = ("failpoint", "corruptpoint")
+
+
 def failpoint_calls(mod: ModuleFile) -> Iterable[Tuple[str, ast.Call]]:
-    """Literal ``failpoint("name")`` calls in ``mod`` (any dotted
-    spelling whose last segment is ``failpoint``)."""
+    """Literal ``failpoint("name")`` / ``corruptpoint("name", ...)``
+    calls in ``mod`` (any dotted spelling whose last segment is a
+    :data:`HOOK_FUNCS` entry)."""
     if mod.tree is None:
         return
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
-        if last_seg(dotted_name(node.func)) != "failpoint":
+        if last_seg(dotted_name(node.func)) not in HOOK_FUNCS:
             continue
         if node.args and isinstance(node.args[0], ast.Constant) \
                 and isinstance(node.args[0].value, str):

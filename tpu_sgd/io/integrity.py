@@ -1,0 +1,153 @@
+"""End-to-end data integrity: checksummed frames, verified at consume.
+
+Every failure this codebase handled before ISSUE 15 was *loud* — an
+exception, a timeout, a fenced epoch.  A flipped bit in a prefetched
+chunk, a NaN in a compressed push segment, or a truncated delta-log
+record is *silent*: it passes straight into the weights and surfaces,
+if ever, as unexplained loss divergence — and under bounded staleness
+(arXiv:1505.04956) a single poisoned contribution admitted at τ>0
+contaminates every subsequent version with no synchronous barrier to
+catch it.  This module turns corruption into a **detected, typed,
+healed** failure class (ADVICE.md "Corruption is a payload, not an
+exception"):
+
+* :func:`seal` computes a CRC-32 over a frame's host bytes (dtype and
+  shape included, so a truncated segment can never alias a shorter
+  valid one) at the PRODUCE site;
+* :func:`verify` recomputes it at the CONSUME site — after the frame
+  crossed whatever hop the caller distrusts (the corrupting failpoints
+  in ``tpu_sgd/reliability/failpoints.py`` model that hop) — and a
+  mismatch raises the typed :class:`IntegrityError` plus bumps the
+  ``integrity.corrupt`` / ``integrity.corrupt.<site>`` counters the
+  :class:`~tpu_sgd.obs.detect.IntegrityDetector` watches.
+
+:class:`IntegrityError` subclasses ``RuntimeError`` ON PURPOSE: the
+default :class:`~tpu_sgd.reliability.retry.RetryPolicy` retryable set
+includes ``RuntimeError``, so every verified wire heals through the
+retry machinery that already guards it — and because every producer in
+this codebase is deterministic in ``(seed, iteration)``, the healed
+retry reproduces the frame bit-for-bit (the chaos soak's
+healed-run-is-BITWISE invariant, ``scripts/chaos_soak.py`` phase 1g).
+The one consumer that must NOT retry — ``CheckpointManager.restore``'s
+latest-default path — instead quarantines the proven-bad file and
+falls back, composing with the existing corruption/transient
+carve-outs (``tpu_sgd/utils/checkpoint.py``).
+
+Checksums are pure HOST work over bytes the producers already hold, so
+the integrity plane adds ZERO dispatches, compiles, or host syncs on
+the warmed hot paths (the PR 8 pin discipline, re-asserted with
+checksums on in ``tests/test_integrity.py``).  :func:`set_integrity`
+exists for the bench A/B arm (``bench_integrity.py`` measures the
+checksum wall in isolation), not as a production recommendation.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from tpu_sgd.obs.counters import inc
+from tpu_sgd.obs.spans import event
+
+#: graftlint lock-discipline declaration (tpu_sgd/analysis): EMPTY on
+#: purpose.  The only mutable module state is the ``_ENABLED`` bool —
+#: a GIL-atomic reference flip read by hot paths and written only by
+#: test/bench harnesses (the failpoints/obs gate idiom).
+GRAFTLINT_LOCKS: dict = {}
+
+#: fast-path gate: :func:`seal` reads this ONE module global and
+#: returns None when falsy — frames then carry no checksum and
+#: :func:`verify` skips (``expected is None``).  Default ON: the
+#: checksum is host CRC-32 over bytes the producer already assembled.
+_ENABLED = True
+
+
+class IntegrityError(RuntimeError):
+    """A frame failed its integrity check at ``site``.
+
+    ``kind`` names the check that failed (``"checksum"`` today;
+    ``"poison"`` is spelled as a typed ``PushResult.poisoned`` at the
+    store's admission guard instead — a rejected push is a protocol
+    answer, not an unwind).  Subclasses ``RuntimeError`` so the default
+    ``RetryPolicy`` treats it as transient: the producers are
+    deterministic in ``(seed, iteration)``, so the healing retry
+    replays the exact frame and the healed run is bitwise the
+    fault-free one."""
+
+    def __init__(self, site: str, kind: str = "checksum",
+                 detail: str = ""):
+        self.site = site
+        self.kind = kind
+        msg = f"integrity violation at {site!r} ({kind})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def set_integrity(enabled: bool) -> None:
+    """Bench/test switch for the checksummed-wire plane (see module
+    docstring).  The poison-admission guard and the rollback controller
+    are NOT gated here — they live in ``tpu_sgd/replica``."""
+    global _ENABLED
+    _ENABLED = bool(enabled)
+
+
+def integrity_enabled() -> bool:
+    return _ENABLED
+
+
+def checksum_arrays(*arrays) -> int:
+    """CRC-32 over the concatenated ``(dtype, shape, bytes)`` of every
+    array (None leaves hash a sentinel so positional structure is
+    covered too).  Dtype and shape ride the digest ON PURPOSE: a
+    truncated frame must fail even when its surviving bytes are intact,
+    and a bf16 frame must never verify against its f32 twin."""
+    c = 0
+    for a in arrays:
+        if a is None:
+            c = zlib.crc32(b"<none>", c)
+            continue
+        a = np.ascontiguousarray(a)
+        c = zlib.crc32(repr((a.dtype.str, a.shape)).encode(), c)
+        try:
+            c = zlib.crc32(a.data, c)  # zero-copy buffer view
+        except (ValueError, BufferError):
+            # extension dtypes (ml_dtypes bf16) refuse the buffer
+            # protocol: digest their raw bytes instead (one copy)
+            c = zlib.crc32(a.tobytes(), c)
+    return c
+
+
+def seal(*arrays) -> Optional[int]:
+    """Produce-site checksum of a frame, or None when the integrity
+    plane is disabled (the bench A/B arm) — a None seal makes the
+    matching :func:`verify` a no-op, so the two sides always agree on
+    whether the wire is checksummed."""
+    if not _ENABLED:
+        return None
+    return checksum_arrays(*arrays)
+
+
+def verify(site: str, expected: Optional[int], *arrays) -> None:
+    """Consume-site check: recompute the frame's checksum and compare.
+
+    A mismatch is a DETECTED corruption: the ``integrity.corrupt`` /
+    ``integrity.corrupt.<site>`` counters bump (the window series the
+    ``IntegrityDetector`` trips on), one typed ``integrity.corrupt_frame``
+    event lands on the trace, and the typed :class:`IntegrityError`
+    raises for the site's retry machinery to heal.  ``expected=None``
+    (unsealed frame — integrity disabled, or a legacy producer) skips.
+    """
+    if expected is None:
+        return
+    actual = checksum_arrays(*arrays)
+    if actual != expected:
+        inc("integrity.corrupt")
+        inc(f"integrity.corrupt.{site}")
+        event("integrity.corrupt_frame", site=site, kind="checksum")
+        raise IntegrityError(
+            site, "checksum",
+            f"crc {actual:#010x} != sealed {expected:#010x}")
+    inc(f"integrity.verified.{site}")

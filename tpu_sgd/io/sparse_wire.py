@@ -51,8 +51,9 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from tpu_sgd.io.integrity import seal, verify
 from tpu_sgd.obs.counters import record_wire
-from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.reliability.failpoints import corruptpoint, failpoint
 
 
 def parse_wire_compress(spec) -> Optional[float]:
@@ -122,9 +123,12 @@ class ErrorFeedback:
         update; the selected coordinates are zeroed in the accumulator
         (their mass ships), the rest stays.  All host numpy.  Passes the
         ``io.sparse_wire`` failpoint — THE compress/stage fault-injection
-        site, healed by the caller's ingest ``RetryPolicy`` where one is
-        wired (the accumulator mutates only after the failpoint, so a
-        healed retry replays nothing twice)."""
+        site — and ships the segment as a checksummed FRAME through the
+        ``io.segment`` corrupting failpoint, verified here at the
+        extraction boundary (tpu_sgd/io/integrity.py).  Both heal under
+        the caller's retry machinery: NOTHING mutates (accumulator
+        included) until every check passes, so a healed retry replays
+        nothing twice and the reselected segment is bit-identical."""
         failpoint("io.sparse_wire")
         update = np.asarray(update).reshape(-1)
         if update.shape[0] != self.dim:
@@ -132,9 +136,16 @@ class ErrorFeedback:
                 f"update has {update.shape[0]} entries, accumulator has "
                 f"{self.dim}"
             )
-        self.acc += update
-        idx = topk_select(self.acc, self.k)
-        vals = self.acc[idx].copy()
+        # NOT in place (see docstring); the explicit compute-then-cast
+        # matches the old ``acc += update`` bits for any update dtype
+        folded = np.add(self.acc, update).astype(self.acc.dtype,
+                                                 copy=False)
+        idx = topk_select(folded, self.k)
+        vals = folded[idx].copy()
+        ck = seal(idx, vals)
+        idx, vals = corruptpoint("io.segment", (idx, vals))
+        verify("io.segment", ck, idx, vals)
+        self.acc = folded
         self.acc[idx] = 0.0
         record_wire("topk", logical_nbytes=int(update.nbytes),
                     physical_nbytes=int(vals.nbytes + idx.nbytes))

@@ -46,6 +46,21 @@ production set):
   series): a store promotion is ALWAYS an incident worth a typed
   alert + flight-recorder dump, even when the system healed itself —
   a failover nobody noticed is a standby budget silently spent.
+* **integrity** — any ``integrity.corrupt.<site>`` counter moved in
+  the window (a checksum mismatch at a verified wire, a poisoned
+  push): detected-and-HEALED corruption is still an incident — a bit
+  flipping somewhere is a hardware/storage signal, and the one that
+  finally slips through will look exactly like the ones that did not.
+  A clean run never records the series, so the rule has no
+  false-positive surface (ISSUE 15).
+* **heartbeat-stall** — a WATCHED component's heartbeat series went
+  silent for ``stall_windows`` consecutive windows while another
+  watched component kept beating (hang was the one failure mode chaos
+  could not see: a wedged feed raises nothing, it just stops).  The
+  roster is membership-driven like the straggler rule:
+  ``HealthMonitor.watch_heartbeat`` admits, ``unwatch_heartbeat``
+  retires (so a finished run's silence never false-trips the next),
+  and fleet-wide silence — an idle process — trips nothing.
 
 Trip semantics: the engine tracks active ``(rule, series)`` pairs and
 emits one ``obs_alert`` per TRANSITION into the tripped state; a rule
@@ -67,7 +82,8 @@ __all__ = ["Alert", "Detector", "DetectorEngine", "default_detectors",
            "LossDivergenceDetector", "LossPlateauDetector",
            "StalenessCreepDetector", "LaneRejectionDetector",
            "StragglerDetector", "WireRatioDetector",
-           "DispatchRegressionDetector", "FailoverDetector"]
+           "DispatchRegressionDetector", "FailoverDetector",
+           "IntegrityDetector", "HeartbeatStallDetector"]
 
 logger = logging.getLogger("tpu_sgd.obs")
 
@@ -421,11 +437,103 @@ class FailoverDetector(Detector):
             "membership record for old/new primary, epoch, gap)")]
 
 
+class IntegrityDetector(Detector):
+    """Trips when any ``integrity.corrupt.<site>`` counter series moved
+    in the window — one alert per site, value = corrupt frames seen.
+    Detected-and-healed corruption still alerts ON PURPOSE (module
+    docstring): the checksum plane turns silent damage into typed
+    retries, and this rule turns the retries into an incident a human
+    sees.  A clean run never records the series — no false-positive
+    surface, same construction as :class:`FailoverDetector`."""
+
+    rule = "integrity"
+
+    def __init__(self, prefix: str = "integrity.corrupt.",
+                 min_frames: int = 1):
+        self.prefix = prefix
+        self.min_frames = int(min_frames)
+
+    def evaluate(self, window, history):
+        out = []
+        for name in sorted(window["series"]):
+            if not name.startswith(self.prefix):
+                continue
+            n = _count(window, name)
+            if n >= self.min_frames:
+                out.append(self._alert(
+                    window, name, float(n), float(self.min_frames),
+                    f"{n} corrupt frame(s) detected at "
+                    f"{name[len(self.prefix):]!r} this window"))
+        return out
+
+
+class HeartbeatStallDetector(Detector):
+    """Trips when a WATCHED heartbeat is silent ``stall_windows``
+    consecutive windows while at least one other watched heartbeat
+    kept beating — the hang detector (class-level rationale in the
+    module docstring).
+
+    Roster discipline mirrors :class:`StragglerDetector`'s membership
+    rule, with ``HealthMonitor.watch_heartbeat`` /
+    ``unwatch_heartbeat`` as the join/leave events
+    (``reliability.hb.watch[...]`` / ``...unwatch[...]`` series): only
+    DECLARED-should-beat components are candidates (an idle batcher is
+    silent and healthy — first-beat auto-join would false-trip every
+    quiet component), a retire removes the entry so a clean shutdown
+    cannot leave a phantom for the next run sharing this engine, and
+    the any-peer-progressed gate makes fleet-wide silence (an idle or
+    finished process) trip nothing.  Stateful; the engine serializes
+    evaluation under its lock."""
+
+    rule = "heartbeat-stall"
+
+    def __init__(self, prefix: str = "reliability.heartbeat[",
+                 roster_prefix: str = "reliability.hb.",
+                 stall_windows: int = 4):
+        self.prefix = prefix
+        self.roster_prefix = roster_prefix
+        self.stall_windows = int(stall_windows)
+        self._silent: Dict[str, int] = {}  # name -> silent windows
+
+    def _membership(self, window) -> None:
+        rp = self.roster_prefix
+        for name in window["series"]:
+            if name.startswith(rp + "watch[") and name.endswith("]"):
+                self._silent.setdefault(
+                    name[len(rp) + len("watch["):-1], 0)
+            elif name.startswith(rp + "unwatch[") and name.endswith("]"):
+                self._silent.pop(
+                    name[len(rp) + len("unwatch["):-1], None)
+
+    def evaluate(self, window, history):
+        self._membership(window)
+        if not self._silent:
+            return []
+        beats = {name: _count(window, f"{self.prefix}{name}]")
+                 for name in self._silent}
+        if not any(beats.values()):
+            return []  # fleet-wide silence: idle/finished, not a hang
+        out = []
+        for name in sorted(self._silent):
+            if beats[name] > 0:
+                self._silent[name] = 0
+                continue
+            self._silent[name] += 1
+            if self._silent[name] >= self.stall_windows:
+                out.append(self._alert(
+                    window, f"{self.prefix}{name}]",
+                    float(self._silent[name]),
+                    float(self.stall_windows),
+                    f"watched heartbeat {name!r} silent for "
+                    f"{self._silent[name]} windows while peers beat"))
+        return out
+
+
 def default_detectors() -> List[Detector]:
-    """The production rule set (the ISSUE 13 six plus the failover
-    rule).  Thresholds are the wide, low-false-positive defaults a
-    clean seeded run never trips (pinned in tests); harnesses tighten
-    per scenario."""
+    """The production rule set (the ISSUE 13 six, the failover rule,
+    and ISSUE 15's integrity + heartbeat-stall rules).  Thresholds are
+    the wide, low-false-positive defaults a clean seeded run never
+    trips (pinned in tests); harnesses tighten per scenario."""
     return [
         LossDivergenceDetector(),
         StalenessCreepDetector(),
@@ -434,6 +542,8 @@ def default_detectors() -> List[Detector]:
         WireRatioDetector(),
         DispatchRegressionDetector(),
         FailoverDetector(),
+        IntegrityDetector(),
+        HeartbeatStallDetector(),
     ]
 
 

@@ -382,6 +382,42 @@ def observe_step(
     return new_w, reg_val, converged
 
 
+def observed_loop_tail(
+    i, w, new_w, loss_i, new_reg, count, losses, reg_val, cfg, *,
+    listener=None, wall_dt=0.0, save_cb=None, save_every=0,
+    stop_signal=None,
+):
+    """One observed iteration's ENTIRE host tail: the shared
+    :func:`observe_step` bookkeeping plus the cooperative-preemption
+    check (persist the CURRENT iteration through ``save_cb``, then
+    unwind :class:`~tpu_sgd.reliability.supervisor.TrainingPreempted`).
+
+    This is the K=1 observed-loop duplication the PR 9 review flagged
+    between ``optimize/streamed.py`` and ``optimize/streamed_sparse.py``
+    — the same statements, now with one home next to ``observe_step``
+    (both drivers' bitwise pins stay green: extraction moved code, not
+    math).  The caller owns the per-step barrier and the wall-clock
+    timing (they live inside its ``train.step`` span)."""
+    import numpy as np
+
+    w, reg_val, converged = observe_step(
+        i, w, new_w, loss_i, new_reg, count, losses, reg_val, cfg,
+        listener=listener, wall_dt=wall_dt,
+        save_cb=save_cb, save_every=save_every,
+    )
+    if not converged and stop_signal is not None and stop_signal():
+        # cooperative preemption (TrainingSupervisor): persist the
+        # CURRENT iteration — not just the last cadence save — then
+        # unwind cleanly; the save is atomic, so a SIGKILL racing this
+        # still leaves the previous checkpoint intact
+        from tpu_sgd.reliability.supervisor import TrainingPreempted
+
+        if save_cb is not None:
+            save_cb(i, np.asarray(w), reg_val)
+        raise TrainingPreempted(i)
+    return w, reg_val, converged
+
+
 def make_superstep(
     gradient: Gradient,
     updater: Updater,

@@ -196,11 +196,13 @@ def optimize_host_streamed(
 
     from tpu_sgd.io import (Prefetcher, parse_wire_compress,
                             resolve_wire_dtype, wire_cast)
+    from tpu_sgd.io.integrity import seal, verify
     from tpu_sgd.obs.counters import record_wire
     from tpu_sgd.obs.spans import span
     from tpu_sgd.optimize.gradient_descent import (make_compressed_step,
-                                                   make_step, observe_step)
-    from tpu_sgd.reliability.failpoints import failpoint
+                                                   make_step,
+                                                   observed_loop_tail)
+    from tpu_sgd.reliability.failpoints import corruptpoint, failpoint
     from tpu_sgd.utils.events import RunEvent
 
     cfg = config
@@ -403,8 +405,20 @@ def optimize_host_streamed(
     def _put_batch(Xb, yb, valid):
         """The host→device hop of one assembled batch — THE transfer
         fault-injection site (``io.device_put``); retries, when
-        configured, wrap the whole sample via the prefetcher."""
+        configured, wrap the whole sample via the prefetcher.
+
+        The chunk is a checksummed FRAME (tpu_sgd/io/integrity.py):
+        sealed over the assembled host bytes, passed through the
+        ``io.chunk`` corrupting failpoint (the modeled wire/DMA damage
+        window), and verified at this consume boundary — the last host
+        instant before the bytes become a device buffer.  A mismatch
+        raises typed IntegrityError inside the prefetcher's retry
+        scope, and the deterministic (seed, i) reassembly heals it
+        BITWISE."""
         failpoint("io.device_put")
+        ck = seal(Xb, yb, valid)
+        Xb, yb, valid = corruptpoint("io.chunk", (Xb, yb, valid))
+        verify("io.chunk", ck, Xb, yb, valid)
         record_wire(
             _wire_fmt,
             logical_nbytes=int(Xb.size * 4 + yb.nbytes + valid.nbytes),
@@ -497,8 +511,14 @@ def optimize_host_streamed(
         the same ``io.device_put`` failpoint/retry scope as
         ``_put_batch``, with the ``(K, rows, ...)`` shardings from
         ``superchunk_specs`` (row axis sharded on a mesh, step axis
-        replicated)."""
+        replicated).  Same checksummed-frame contract as
+        ``_put_batch`` — one seal/verify per superchunk, so the
+        integrity plane's host cost amortizes with K exactly like the
+        dispatch tax the superstep exists to amortize."""
         failpoint("io.device_put")
+        ck = seal(Xs, Ys, Vs)
+        Xs, Ys, Vs = corruptpoint("io.chunk", (Xs, Ys, Vs))
+        verify("io.chunk", ck, Xs, Ys, Vs)
         record_wire(
             _wire_fmt,
             logical_nbytes=int(Xs.size * 4 + Ys.nbytes + Vs.nbytes),
@@ -968,31 +988,18 @@ def optimize_host_streamed(
                 # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
                 new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
-            # the shared observed-loop bookkeeping (one definition for
-            # this driver, the sparse streamed driver, and the replica
-            # store — see observe_step): barrier above, then each
-            # scalar fetched exactly once
-            w, reg_val, converged = observe_step(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
+            # the shared observed-loop TAIL (one definition for this
+            # driver and the sparse streamed driver — the PR 9 review's
+            # flagged duplication, extracted to the observe_step home):
+            # barrier above, then each scalar fetched exactly once, then
+            # the cooperative-preemption check
+            w, reg_val, converged = observed_loop_tail(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
                 i, w, new_w, loss_i, new_reg, c, losses, reg_val, cfg,
                 listener=listener, wall_dt=dt,
                 save_cb=(_save if checkpoint_manager is not None
                          else None),
-                save_every=checkpoint_every,
+                save_every=checkpoint_every, stop_signal=stop_signal,
             )
-            if (not converged and stop_signal is not None
-                    and stop_signal()):
-                # cooperative preemption (TrainingSupervisor): persist
-                # the CURRENT iteration — not just the last cadence
-                # save — then unwind cleanly; the save is atomic, so a
-                # SIGKILL racing this still leaves the previous
-                # checkpoint intact
-                from tpu_sgd.reliability.supervisor import (
-                    TrainingPreempted,
-                )
-
-                if checkpoint_manager is not None:
-                    _save(i, np.asarray(w), reg_val)  # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
-                raise TrainingPreempted(i)
             i += 1
     finally:
         # convergence exits early: cancel the worker's queued lookahead —

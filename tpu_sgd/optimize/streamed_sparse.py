@@ -168,13 +168,15 @@ def optimize_host_streamed_sparse(
     import time as _time
 
     from tpu_sgd.io import Prefetcher
+    from tpu_sgd.io.integrity import seal, verify
     from tpu_sgd.io.sparse_wire import (bcoo_to_csr_host,
                                         plan_sparse_batches,
                                         stage_sparse_batch)
     from tpu_sgd.obs.counters import record_wire
     from tpu_sgd.obs.spans import span
     from tpu_sgd.optimize.gradient_descent import (_replay_fused_steps,
-                                                   observe_step)
+                                                   observed_loop_tail)
+    from tpu_sgd.reliability.failpoints import corruptpoint
     from tpu_sgd.utils.events import RunEvent
 
     cfg = config
@@ -258,8 +260,17 @@ def optimize_host_streamed_sparse(
 
     def sample(i: int):
         """Stage + transfer — the per-iteration producer (runs on the
-        prefetch worker inside the retry scope)."""
+        prefetch worker inside the retry scope).  The staged components
+        are a checksummed FRAME (tpu_sgd/io/integrity.py): sealed after
+        assembly, passed through the ``io.sparse_chunk`` corrupting
+        failpoint, verified here at the consume boundary — a damaged
+        entry array, label, or mask raises typed IntegrityError inside
+        the retry scope and the deterministic re-stage heals BITWISE."""
         data, idx, yb, valid = stage(i)
+        ck = seal(data, idx, yb, valid)
+        data, idx, yb, valid = corruptpoint(
+            "io.sparse_chunk", (data, idx, yb, valid))
+        verify("io.sparse_chunk", ck, data, idx, yb, valid)
         record_wire(
             "bcoo",
             logical_nbytes=int(cap * d * 4 + yb.nbytes + valid.nbytes),
@@ -280,6 +291,10 @@ def optimize_host_streamed_sparse(
         Vs = np.zeros((K, cap), bool)
         for t in range(steps):
             Ds[t], Is[t], Ys[t], Vs[t] = stage(base + t)
+        ck = seal(Ds, Is, Ys, Vs)
+        Ds, Is, Ys, Vs = corruptpoint(
+            "io.sparse_chunk", (Ds, Is, Ys, Vs))
+        verify("io.sparse_chunk", ck, Ds, Is, Ys, Vs)
         record_wire(
             "bcoo",
             logical_nbytes=int(K * cap * d * 4 + Ys.nbytes + Vs.nbytes),
@@ -447,26 +462,18 @@ def optimize_host_streamed_sparse(
                 # graftlint: disable=host-sync -- observed driver: one barrier per step precedes the scalar reads below
                 new_w = jax.block_until_ready(new_w)
             dt = _time.perf_counter() - t0
-            # the shared observed-loop bookkeeping (one definition for
-            # this driver, the dense streamed driver, and the replica
-            # store — see observe_step): barrier above, then each
-            # scalar fetched exactly once
-            w, reg_val, converged = observe_step(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
+            # the shared observed-loop TAIL (one definition for this
+            # driver and the dense streamed driver — the PR 9 review's
+            # flagged duplication, extracted to the observe_step home):
+            # barrier above, then each scalar fetched exactly once,
+            # then the cooperative-preemption check
+            w, reg_val, converged = observed_loop_tail(  # graftlint: disable=host-sync -- observed driver: the per-step scalar fetches ARE the contract (one barrier above, each scalar fetched once inside the shared helper)
                 i, w, new_w, loss_i, new_reg, c, losses, reg_val, cfg,
                 listener=listener, wall_dt=dt,
                 save_cb=(_save if checkpoint_manager is not None
                          else None),
-                save_every=checkpoint_every,
+                save_every=checkpoint_every, stop_signal=stop_signal,
             )
-            if (not converged and stop_signal is not None
-                    and stop_signal()):
-                from tpu_sgd.reliability.supervisor import (
-                    TrainingPreempted,
-                )
-
-                if checkpoint_manager is not None:
-                    _save(i, np.asarray(w), reg_val)  # graftlint: disable=host-sync -- preemption save: fires once at unwind, not per trip
-                raise TrainingPreempted(i)
             i += 1
     finally:
         if prefetch is not None:

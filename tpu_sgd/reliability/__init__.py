@@ -35,6 +35,9 @@ Quickstart (see ``examples/reliability_quickstart.py``)::
 from tpu_sgd.reliability.failpoints import (
     FailpointSpec,
     FaultInjected,
+    corrupt_nth,
+    corrupt_prob,
+    corruptpoint,
     fail_nth,
     fail_prob,
     failpoint,
@@ -68,6 +71,9 @@ __all__ = [
     "SupervisedResult",
     "TrainingPreempted",
     "TrainingSupervisor",
+    "corrupt_nth",
+    "corrupt_prob",
+    "corruptpoint",
     "fail_nth",
     "fail_prob",
     "failpoint",

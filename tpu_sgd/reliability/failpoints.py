@@ -27,6 +27,24 @@ the health monitor).  The exception class is configurable per spec so a
 site can be made to throw exactly what its caller claims to tolerate
 (``OSError`` for the checkpoint reader, ``TimeoutError`` for a feed…).
 
+**Corrupting mode** (ISSUE 15 — every fault above is an *exception*,
+which no silent data-corruption failure ever is): a payload-carrying
+hook site passes its frame through :func:`corruptpoint`::
+
+    frame = corruptpoint("replica.push.wire", frame)
+
+and a ``corrupt_nth(k, kind=...)`` / ``corrupt_prob(p, seed, kind=...)``
+spec deterministically MUTATES a copy of the payload instead of
+raising — ``kind="bitflip"`` flips one seeded bit of one array leaf's
+host bytes, ``"nan"`` plants a NaN/±Inf in a seeded float entry, and
+``"truncate"`` drops a seeded tail of a leaf's leading axis.  The
+original arrays are never touched (the healing retry re-sends them),
+the mutation draws from the same seeded stream as ``fail_prob``, and
+the checksummed wires (``tpu_sgd/io/integrity.py``) detect the damage
+at their consume-site :func:`~tpu_sgd.io.integrity.verify` — the
+injection half of the end-to-end integrity plane (ADVICE.md
+"Corruption is a payload, not an exception").
+
 Cost when disabled — the only state a production process ever runs in —
 is one module-global load and a falsy branch per hit (measured in
 ``tests/test_reliability.py``); no dict lookup, no lock, no allocation.
@@ -61,24 +79,37 @@ class FailpointSpec:
     * ``prob`` — trigger each hit with probability ``prob`` from a
       private ``random.Random(seed)`` stream (deterministic replay).
 
-    On trigger: sleep ``latency_s`` (if set), then raise ``exc`` — or
-    return normally when ``exc`` is None (latency-only fault).
+    On trigger: sleep ``latency_s`` (if set), then — when ``corrupt``
+    names a mutation kind and the site passed a payload through
+    :func:`corruptpoint` — mutate a COPY of the payload and return it;
+    otherwise raise ``exc``, or return normally when ``exc`` is None
+    (latency-only fault).  A corrupting spec armed at a plain
+    payload-less ``failpoint()`` site triggers but mutates nothing
+    (there is no frame to damage — arm it at a ``corruptpoint`` site).
     """
+
+    CORRUPT_KINDS = ("bitflip", "nan", "truncate")
 
     def __init__(self, *, nth: int = 0, prob: float = 0.0, seed: int = 0,
                  latency_s: float = 0.0,
-                 exc: Optional[Type[BaseException]] = FaultInjected):
+                 exc: Optional[Type[BaseException]] = FaultInjected,
+                 corrupt: Optional[str] = None):
         if nth and prob:
             raise ValueError("pass nth= or prob=, not both")
         if not 0.0 <= prob <= 1.0:
             raise ValueError(f"prob must be in [0, 1], got {prob}")
         if nth < 0 or latency_s < 0:
             raise ValueError("nth and latency_s must be >= 0")
+        if corrupt is not None and corrupt not in self.CORRUPT_KINDS:
+            raise ValueError(
+                f"corrupt kind must be one of {self.CORRUPT_KINDS}, "
+                f"got {corrupt!r}")
         self.nth = int(nth)
         self.prob = float(prob)
         self.seed = int(seed)
         self.latency_s = float(latency_s)
         self.exc = exc
+        self.corrupt = corrupt
         # armed state (reset on every activation)
         self.hits = 0
         self.triggers = 0
@@ -90,7 +121,10 @@ class FailpointSpec:
         self._rng = random.Random(self.seed)
         return self
 
-    def _on_hit(self, name: str) -> None:
+    def _fire(self, name: str) -> bool:
+        """Count the hit, decide whether this one triggers, and record
+        the trace event when it does (shared by the raising and the
+        corrupting paths)."""
         self.hits += 1
         if self.nth:
             fire = self.hits == self.nth
@@ -99,7 +133,7 @@ class FailpointSpec:
         else:
             fire = True  # bare spec: every hit
         if not fire:
-            return
+            return False
         self.triggers += 1
         # an injected fault that the retry layer then heals leaves TWO
         # trace records — this one and the reliability.retry that healed
@@ -108,14 +142,38 @@ class FailpointSpec:
         from tpu_sgd.obs.spans import event as obs_event
 
         obs_event("reliability.failpoint", site=name, hit=self.hits,
-                  latency_s=self.latency_s,
-                  raises=self.exc.__name__ if self.exc else None)
+                  latency_s=self.latency_s, corrupt=self.corrupt,
+                  raises=(self.exc.__name__
+                          if self.exc is not None and self.corrupt is None
+                          else None))
         if self.latency_s:
             time.sleep(self.latency_s)
+        return True
+
+    def _on_hit(self, name: str) -> None:
+        if not self._fire(name):
+            return
+        if self.corrupt is not None:
+            return  # no payload at this site: nothing to damage
         if self.exc is not None:
             raise self.exc(
                 f"failpoint {name!r} triggered (hit {self.hits})"
             )
+
+    def _on_hit_payload(self, name: str, payload):
+        """The :func:`corruptpoint` spelling of :meth:`_on_hit`: a
+        corrupting spec returns a deterministically mutated COPY of the
+        payload; a raising spec behaves exactly as at a plain site (so
+        ``fail_nth``/``fail_prob`` still work at payload hops)."""
+        if not self._fire(name):
+            return payload
+        if self.corrupt is not None:
+            return _corrupt_payload(payload, self.corrupt, self._rng)
+        if self.exc is not None:
+            raise self.exc(
+                f"failpoint {name!r} triggered (hit {self.hits})"
+            )
+        return payload
 
 
 def fail_nth(k: int, exc: Type[BaseException] = FaultInjected,
@@ -139,6 +197,79 @@ def inject_latency(ms: float, *, nth: int = 0, prob: float = 0.0,
     hit sleeps; ``nth``/``prob`` restrict which hits do."""
     return FailpointSpec(nth=nth, prob=prob, seed=seed,
                          latency_s=ms / 1e3, exc=None)
+
+
+def corrupt_nth(k: int, kind: str = "bitflip") -> FailpointSpec:
+    """Corrupt the payload of exactly the k-th hit (1-based) at a
+    :func:`corruptpoint` site, once — the one-shot corruption whose
+    consume-site detection and retry-heal is the behavior under test."""
+    return FailpointSpec(nth=k, corrupt=kind, exc=None)
+
+
+def corrupt_prob(p: float, seed: int = 0,
+                 kind: str = "bitflip") -> FailpointSpec:
+    """Corrupt each payload with probability ``p`` from a ``seed``-keyed
+    private stream — the ``fail_prob`` of silent data damage, replayed
+    bit-identically from its seed (chaos soak phase 1g arms this at
+    every checksummed wire)."""
+    return FailpointSpec(prob=p, seed=seed, corrupt=kind, exc=None)
+
+
+def _corrupt_payload(payload, kind: str, rng: random.Random):
+    """Deterministically damage ONE array leaf of ``payload`` — a
+    (possibly nested) tuple/list structure whose array leaves are host
+    numpy — and rebuild the structure around a mutated COPY.
+
+    The original arrays are never written: the producer's retry
+    re-sends them intact, which is what makes a healed corruption run
+    bitwise the fault-free one.  Non-array leaves (tags, scalars, None)
+    pass through; a payload with no non-empty array leaf returns
+    unchanged (an empty segment has no bytes to damage)."""
+    import numpy as np
+
+    leaves: list = []
+
+    def _walk(obj, path):
+        if isinstance(obj, np.ndarray):
+            if obj.nbytes > 0:
+                leaves.append(path)
+        elif isinstance(obj, (tuple, list)):
+            for j, item in enumerate(obj):
+                _walk(item, path + (j,))
+
+    def _rebuild(obj, path, new_leaf):
+        if not path:
+            return new_leaf
+        items = [(_rebuild(item, path[1:], new_leaf)
+                  if j == path[0] else item)
+                 for j, item in enumerate(obj)]
+        if isinstance(obj, tuple):
+            # NamedTuples (DeltaRecord) rebuild from field args
+            return (type(obj)(*items) if hasattr(obj, "_fields")
+                    else tuple(items))
+        return items
+
+    _walk(payload, ())
+    if not leaves:
+        return payload
+    path = leaves[rng.randrange(len(leaves))]
+    leaf = payload
+    for j in path:
+        leaf = leaf[j]
+    arr = np.array(leaf, copy=True)
+    if kind == "truncate" and arr.ndim >= 1 and arr.shape[0] > 0:
+        keep = rng.randrange(arr.shape[0])  # drop a seeded tail
+        arr = np.ascontiguousarray(arr[:keep])
+    elif kind == "nan" and np.issubdtype(arr.dtype, np.floating):
+        flat = arr.reshape(-1)
+        flat[rng.randrange(flat.size)] = rng.choice(
+            (np.nan, np.inf, -np.inf))
+    else:  # bitflip (and the nan-on-int fallback)
+        buf = bytearray(arr.tobytes())
+        bit = rng.randrange(len(buf) * 8)
+        buf[bit // 8] ^= 1 << (bit % 8)
+        arr = np.frombuffer(bytes(buf), dtype=arr.dtype).reshape(arr.shape)
+    return _rebuild(payload, path, arr)
 
 
 # -- hook-site registry -----------------------------------------------------
@@ -174,6 +305,16 @@ HOOK_SITES = {
     # fires FIRST in submit(), before any queue mutation or admission
     # tally, so a healed admission retry replays nothing twice
     "serve.admit": "tpu_sgd/serve/batcher.py",
+    # -- corrupting sites (ISSUE 15): each passes a host-bytes FRAME
+    # through corruptpoint() between its seal() and its consume-site
+    # verify() (tpu_sgd/io/integrity.py), so an armed corrupt_nth/
+    # corrupt_prob spec models silent wire/DMA/storage damage exactly
+    # where the checksum must catch it
+    "io.chunk": "tpu_sgd/optimize/streamed.py",
+    "io.sparse_chunk": "tpu_sgd/optimize/streamed_sparse.py",
+    "io.segment": "tpu_sgd/io/sparse_wire.py",
+    "replica.push.wire": "tpu_sgd/replica/store.py",
+    "replica.log.record": "tpu_sgd/replica/ha.py",
 }
 
 # -- arming registry --------------------------------------------------------
@@ -197,12 +338,36 @@ def failpoint(name: str) -> None:
     _hit(name)
 
 
+def corruptpoint(name: str, payload):
+    """Payload-carrying hook-site entry: returns ``payload`` untouched
+    unless a spec for ``name`` is armed — a corrupting spec returns a
+    deterministically damaged COPY (the originals stay intact for the
+    healing retry), a raising spec raises like a plain failpoint.
+
+    Sits between a frame's :func:`~tpu_sgd.io.integrity.seal` and its
+    consume-site :func:`~tpu_sgd.io.integrity.verify` on every
+    checksummed wire; same disabled-mode cost contract as
+    :func:`failpoint` — one module-global load and a falsy branch."""
+    if not _ENABLED:
+        return payload
+    return _hit_payload(name, payload)
+
+
 def _hit(name: str) -> None:
     with _LOCK:
         _HITS[name] = _HITS.get(name, 0) + 1
         spec = _SPECS.get(name)
         if spec is not None:
             spec._on_hit(name)
+
+
+def _hit_payload(name: str, payload):
+    with _LOCK:
+        _HITS[name] = _HITS.get(name, 0) + 1
+        spec = _SPECS.get(name)
+        if spec is None:
+            return payload
+        return spec._on_hit_payload(name, payload)
 
 
 def configure(name: str, spec: FailpointSpec) -> None:

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from tpu_sgd.obs.timeseries import observe_scalar
 from tpu_sgd.utils.events import ReliabilityEvent
 
 #: graftlint lock-discipline declaration (tpu_sgd/analysis).  Heartbeat
@@ -63,6 +64,13 @@ class Heartbeat:
         with self._lock:
             self._last = time.monotonic()
             self.count += 1
+        # the live windowed-series feed (tpu_sgd.obs.timeseries; one
+        # module-global load + falsy branch when obs is off) — the
+        # HeartbeatStallDetector compares these per-component beat
+        # series across windows, which is how a HANG becomes a typed
+        # alert instead of the one failure mode chaos cannot see.
+        # Outside the lock: the window store has its own.
+        observe_scalar(f"reliability.heartbeat[{self.name}]", 1.0)
 
     def age_s(self) -> Optional[float]:
         """Seconds since the last beat, or None before the first."""
@@ -98,9 +106,25 @@ class HealthMonitor:
 
     # -- registration ------------------------------------------------------
     def watch_heartbeat(self, heartbeat: Heartbeat) -> Heartbeat:
+        """Watching IS the stall-detector roster (the membership-driven
+        rule, like the straggler detector's join records): the
+        ``reliability.hb.watch[...]`` observation admits this component
+        to the :class:`~tpu_sgd.obs.detect.HeartbeatStallDetector`'s
+        roster — an unwatched heartbeat never trips it, because
+        silence is only a STALL for components someone declared should
+        be beating (an idle batcher is silent and healthy)."""
         with self._lock:
             self._heartbeats[heartbeat.name] = heartbeat
+        observe_scalar(f"reliability.hb.watch[{heartbeat.name}]", 1.0)
         return heartbeat
+
+    def unwatch_heartbeat(self, name: str) -> None:
+        """Retire a component from the roster (a clean shutdown must
+        not leave a phantom whose silence false-trips the next run
+        sharing the detector engine)."""
+        with self._lock:
+            self._heartbeats.pop(name, None)
+        observe_scalar(f"reliability.hb.unwatch[{name}]", 1.0)
 
     def watch_queue(self, name: str, depth_fn: Callable[[], int]) -> None:
         with self._lock:

@@ -119,6 +119,8 @@ class ReplicaDriver:
         self.n_workers = int(n_workers)
         self.staleness = staleness
         self.n_standbys = 0
+        self.poison_guard: object = 10.0
+        self._integrity_rollback = False
         self.wire_compress = None
         self.listener = None
         self.checkpoint_manager = None
@@ -194,6 +196,36 @@ class ReplicaDriver:
         if int(n) < 0:
             raise ValueError(f"n_standbys must be >= 0, got {n}")
         self.n_standbys = int(n)
+        return self
+
+    def set_poison_guard(self, k):
+        """``k`` arms the store's numerical admission gate: a push
+        with non-finite entries — or a batch-mean gradient norm beyond
+        ``k``× the rolling median of recent accepted norms — comes back
+        ``PushResult.poisoned`` and the worker recomputes from ``(seed,
+        version)`` (default ``10.0``).  ``None``/``False`` disables —
+        the configuration whose slipped-through poison
+        :meth:`set_integrity_rollback` exists for."""
+        if k is False:
+            k = None
+        if k is not None and float(k) <= 1.0:
+            raise ValueError(
+                f"poison_guard must be > 1 (a gate at <= 1x the median "
+                f"rejects healthy noise), got {k}")
+        self.poison_guard = None if k is None else float(k)
+        return self
+
+    def set_integrity_rollback(self, enabled: bool = True):
+        """Arm corrupt-state rollback (ISSUE 15): the monitor loop
+        polls the primary's :meth:`ParameterStore.weights_healthy` and,
+        on non-finite weights, drives
+        :class:`~tpu_sgd.replica.ha.RollbackController` — fence the
+        poisoned line, restore the last checksummed-good finite
+        checkpoint with an epoch bump, replay.  Implies the HA
+        supervisor (a rollback IS a failover to your own past), so a
+        run with ``n_standbys=0`` still gets one, with zero standby
+        stores."""
+        self._integrity_rollback = bool(enabled)
         return self
 
     def set_wire_compress(self, spec):
@@ -287,6 +319,29 @@ class ReplicaDriver:
         client.heal(worker_id)
         return True
 
+    def chaos_corrupt_weights(self, index: int = 0) -> bool:
+        """Damage ONE resident weight of the live primary with NaN (the
+        forced weight-corruption chaos cell — models poison past the
+        admission guard).  False when no HA run is live."""
+        sup = self._live_supervisor
+        if sup is None:
+            return False
+        try:
+            sup.settled_primary().corrupt_weights_for_chaos(index)
+            return True
+        except Exception:
+            return False
+
+    def rollback(self, reason: str = "operator rollback") -> bool:
+        """Manually drive the corrupt-state rollback of a live HA run
+        (the automatic spelling is :meth:`set_integrity_rollback`)."""
+        sup = self._live_supervisor
+        if sup is None:
+            return False
+        from tpu_sgd.replica.ha import RollbackController
+
+        return RollbackController(sup).rollback(reason)
+
     def optimize(self, data, initial_weights):
         w, _ = self.optimize_with_history(data, initial_weights)
         return w
@@ -327,7 +382,11 @@ class ReplicaDriver:
                    else list(jax.devices()))
         membership = ReplicaMembership(listener=self.listener)
         supervisor = None
-        if self.n_standbys > 0:
+        # armed integrity rollback implies the HA supervisor even with
+        # zero standbys: a rollback IS a (cold) failover to your own
+        # past, and the epoch fence is what keeps in-flight poisoned
+        # pushes out of the restored line
+        if self.n_standbys > 0 or self._integrity_rollback:
             from tpu_sgd.replica.ha import StoreSupervisor
 
             # ONE error-feedback registry shared by every store in the
@@ -346,6 +405,7 @@ class ReplicaDriver:
                     checkpoint_every=self.checkpoint_every,
                     config_key=config_key, resume_state=resume,
                     epoch=epoch0, ef_registry=shared_ef, name=name,
+                    poison_guard=self.poison_guard,
                 )
 
             def _cold_factory(state, name):
@@ -378,6 +438,7 @@ class ReplicaDriver:
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every,
                 config_key=config_key, resume_state=resume_state,
+                poison_guard=self.poison_guard,
             )
         rejoin = (self.rejoin_policy if self.rejoin_policy is not None
                   else RetryPolicy(max_attempts=5, base_backoff_s=0.01,
@@ -422,6 +483,12 @@ class ReplicaDriver:
         pending_rejoins: dict = {}  # wid -> (shard, due_monotonic)
         self._live_supervisor = supervisor
         self._live_client = store if supervisor is not None else None
+        rollback_ctl = None
+        next_health_check = 0.0
+        if self._integrity_rollback and supervisor is not None:
+            from tpu_sgd.replica.ha import RollbackController
+
+            rollback_ctl = RollbackController(supervisor)
         try:
             for s in range(self.n_workers):
                 _spawn(s)
@@ -455,6 +522,19 @@ class ReplicaDriver:
                 if fatal is not None:
                     break
                 now = time.monotonic()
+                if rollback_ctl is not None and now >= next_health_check:
+                    # the corrupt-state probe rides the monitor loop at
+                    # a 0.1s cadence (a full finite scan per 10ms poll
+                    # would tax wide models for no detection-latency
+                    # win): non-finite primary weights → fence, restore
+                    # the last good checkpoint, epoch-bump, replay
+                    next_health_check = now + 0.1
+                    try:
+                        rollback_ctl.check_and_rollback()
+                    except Exception as e:  # budget exhausted: fatal
+                        fatal = e
+                        store.stop()
+                        break
                 for wid in [w for w, (_, due) in pending_rejoins.items()
                             if due <= now]:
                     s, _ = pending_rejoins.pop(wid)
@@ -481,6 +561,19 @@ class ReplicaDriver:
                 supervisor.snapshot() if supervisor is not None else None)
 
         if fatal is not None:
+            from tpu_sgd.io.integrity import IntegrityError
+            from tpu_sgd.obs.counters import inc
+
+            cause, seen = fatal, set()
+            while cause is not None and id(cause) not in seen:
+                if isinstance(cause, IntegrityError):
+                    # detected corruption that exhausted every healing
+                    # layer: the one number the integrity-zero-unhealed
+                    # SLO gates on (scripts/chaos_soak.py)
+                    inc("integrity.unhealed")
+                    break
+                seen.add(id(cause))
+                cause = cause.__cause__ or cause.__context__
             raise fatal
         if preempted_at is not None:
             store.save_now()

@@ -67,6 +67,16 @@ version line, stale-epoch pushes are fenced (never discounted into the
 new line), and at τ=0 the post-failover trajectory is bitwise the
 fault-free run's (the acceptance pin, soaked in
 ``scripts/chaos_soak.py`` phase 1f).
+
+ISSUE 15 adds the integrity half (ADVICE.md "Corruption is a payload,
+not an exception"): delta-log records carry a checksum sealed at the
+primary's capture and verified at the standby's replay
+(:func:`verified_record` — a damaged hop heals by re-reading the
+intact retained record), and :class:`RollbackController` reuses the
+epoch fencing for **corrupt-state rollback** — poison that reached the
+weights is already replicated to every standby, so the heal is a
+forced COLD promotion from the last checksummed-good, finite-weights
+checkpoint: failover to your own past.
 """
 
 from __future__ import annotations
@@ -77,9 +87,12 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, NamedTuple, Optional
 
+import numpy as np
+
+from tpu_sgd.io.integrity import IntegrityError, verify
 from tpu_sgd.obs.counters import inc
-from tpu_sgd.obs.spans import span
-from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.obs.spans import event, span
+from tpu_sgd.reliability.failpoints import corruptpoint, failpoint
 from tpu_sgd.reliability.health import Heartbeat
 
 logger = logging.getLogger("tpu_sgd.replica.ha")
@@ -137,12 +150,49 @@ class DeltaRecord(NamedTuple):
     """One applied version, as replayable bytes: the round's admitted
     gradient contributions (HOST numpy, shard order) plus the epoch and
     the version the apply produced.  ``kind`` is ``"sums"`` (dense
-    wire) or ``"topk"`` (compressed wire)."""
+    wire) or ``"topk"`` (compressed wire).  ``checksum`` seals the
+    payload bytes at capture (the primary's apply) and is verified at
+    the CONSUME site — the standby's replay — so a record damaged in
+    the log (or on a real network hop) raises typed
+    :class:`~tpu_sgd.io.integrity.IntegrityError` instead of silently
+    forking the standby's bitwise trajectory.  ``None`` = unsealed
+    (integrity disabled)."""
 
     epoch: int
     version: int
     kind: str
     payloads: tuple
+    checksum: Optional[int] = None
+
+
+def record_arrays(record: DeltaRecord) -> list:
+    """The array leaves of one record's payloads, in a canonical order
+    — ONE definition shared by the seal (the primary's capture,
+    ``ParameterStore._apply_payloads_locked``) and the verify (the
+    standby's :func:`verified_record`), so the two sides can never
+    digest different bytes.  Host scalars ride as a packed array so a
+    damaged loss/count is caught too."""
+    out = []
+    for p in record.payloads:
+        if p[0] == "sums":
+            out.extend((np.asarray(p[1]), np.asarray(p[2]),
+                        np.asarray(p[3])))
+        else:  # topk: (tag, idx, vals, loss_sum, count)
+            out.extend((np.asarray(p[1]), np.asarray(p[2]),
+                        np.asarray([p[3], p[4]], np.float64)))
+    return out
+
+
+def verified_record(record: DeltaRecord) -> DeltaRecord:
+    """The delta-log wire's consume-site check: the record passes the
+    ``replica.log.record`` corrupting failpoint (the modeled log/wire
+    damage window — the RETAINED record stays intact, so the healing
+    retry re-reads it clean) and its checksum verifies against the
+    payload bytes about to replay."""
+    record = corruptpoint("replica.log.record", record)
+    verify("replica.log.record", record.checksum,
+           *record_arrays(record))
+    return record
 
 
 class DeltaLog:
@@ -264,11 +314,18 @@ class StandbyReplica:
     """One standby store + the applier thread draining the shared log
     into it (module docstring)."""
 
+    #: consecutive same-record corruption detections before the standby
+    #: gives up (a retained record that NEVER verifies is real storage
+    #: rot, not a transient wire fault — cold-recovery territory)
+    MAX_CORRUPT_RETRIES = 8
+
     def __init__(self, store, log: DeltaLog, name: str = ""):
         self.store = store
         self.log = log
         self.name = name or getattr(store, "name", "standby")
         self.applied = 0
+        self.corrupt_healed = 0
+        self._corrupt_streak = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -281,6 +338,30 @@ class StandbyReplica:
             self._thread.start()
         return self
 
+    def _apply_verified(self, rec: DeltaRecord) -> bool:
+        """Verify + apply one record; a detected corruption is retried
+        by RE-READING the log (the retained record is intact — the
+        damage model is the hop, not the store), bounded by
+        :data:`MAX_CORRUPT_RETRIES` so real storage rot still fails
+        LOUDLY into the standby's cold-recovery path.  Returns False
+        when the caller should re-read the log and try again."""
+        try:
+            self.store.apply_replica_record(verified_record(rec))
+        except IntegrityError:
+            self._corrupt_streak += 1
+            if self._corrupt_streak > self.MAX_CORRUPT_RETRIES:
+                inc("integrity.unhealed")
+                raise StoreFailed(
+                    f"standby {self.name}: record v{rec.version} failed "
+                    f"its checksum {self._corrupt_streak} consecutive "
+                    "times — unhealable corruption") from None
+            return False
+        if self._corrupt_streak:
+            self.corrupt_healed += 1
+        self._corrupt_streak = 0
+        self.applied += 1
+        return True
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -288,8 +369,8 @@ class StandbyReplica:
                                           timeout_s=0.05):
                     if self._stop.is_set():
                         return
-                    self.store.apply_replica_record(rec)
-                    self.applied += 1
+                    if not self._apply_verified(rec):
+                        break  # corrupt copy: re-read the intact record
                     self.log.advance_reader(self.name,
                                             self.store.version)
             except StoreFailed as e:
@@ -342,15 +423,17 @@ class StandbyReplica:
 
     def drain(self) -> int:
         """Apply everything the log still holds beyond this store's
-        version; returns the number of records replayed."""
+        version (same verify-at-consume + bounded corrupt-retry as the
+        live applier — the promotion gap replay must not trust a
+        damaged hop either); returns the number of records replayed."""
         n = 0
         while True:
             recs = self.log.since(self.store.version, timeout_s=0.0)
             if not recs:
                 return n
             for rec in recs:
-                self.store.apply_replica_record(rec)
-                self.applied += 1
+                if not self._apply_verified(rec):
+                    break  # corrupt copy: re-read the intact record
                 n += 1
 
     def lag(self) -> int:
@@ -539,10 +622,16 @@ class StoreSupervisor:
             cold = promoted is None
             if cold:
                 # DOUBLE FAILURE: no live standby — cold recovery from
-                # the last checkpoint (or from scratch).  Loud: this is
-                # a data-loss-adjacent event even though τ=0 stays
-                # bitwise (lost versions recompute from (seed, i)).
-                state = (self._checkpoint_manager.restore()
+                # the last GOOD checkpoint (or from scratch).  Loud:
+                # this is a data-loss-adjacent event even though τ=0
+                # stays bitwise (lost versions recompute from (seed,
+                # i)).  "Good" is two checks deep: the content checksum
+                # (CheckpointManager quarantines a failed verify and
+                # falls back on its own) plus a finite-weights walk —
+                # the rollback path lands here precisely BECAUSE the
+                # live weights went bad, and a cadence save may have
+                # persisted the poison before anyone noticed
+                state = (_restore_good(self._checkpoint_manager)
                          if self._checkpoint_manager is not None else None)
                 logger.warning(
                     "replica HA: primary %s AND every standby are down; "
@@ -594,6 +683,60 @@ class StoreSupervisor:
             self._membership.failover(
                 old.name, promoted.name, new_epoch, gap, cold=cold)
 
+    def rollback(self, error=None) -> bool:
+        """Corrupt-state rollback (driven by
+        :class:`RollbackController`): force a COLD promotion even while
+        standbys are live.  The standbys replayed the same poisoned
+        delta records the primary applied — the standby-bitwise
+        invariant cuts both ways — so every live store is marked failed
+        first and :meth:`_promote_locked` falls through to its
+        cold-recovery branch: fence the old primary, restore the last
+        good checkpoint (:func:`_restore_good`), bump the epoch so
+        in-flight pushes against the poisoned line come back fenced,
+        re-register the roster, replay forward."""
+        with self._lock:
+            if len(self._failovers) >= self.max_failovers:
+                raise StoreFailed(
+                    f"rollback refused: failover budget exhausted "
+                    f"({self.max_failovers}); last error: {error}"
+                ) from error
+            self._promoting = True
+            try:
+                n_live = 0
+                for i, rep in list(self._standbys.items()):
+                    if not (self._stores[i].failed
+                            or self._stores[i].fenced):
+                        n_live += 1
+                    rep.halt()
+                    rep.release()
+                    self._stores[i].mark_failed()
+                self._standbys.clear()
+                self._promote_locked(error)
+                # re-establish the set_standbys(n) redundancy the
+                # caller configured: the poisoned standbys are gone for
+                # good (they replayed the poison), so fresh ones resume
+                # from the SAME restored state the new primary did —
+                # still under this lock, so no push can route (and no
+                # save can land) between the promotion and the rebuild,
+                # which keeps the new standbys version-chained onto the
+                # reset log
+                if n_live and self._store_factory is not None:
+                    state = (_restore_good(self._checkpoint_manager)
+                             if self._checkpoint_manager is not None
+                             else None)
+                    for _ in range(n_live):
+                        s = self._store_factory(
+                            state, f"s{len(self._stores)}")
+                        s.set_epoch(self._epoch)
+                        self._stores.append(s)
+                        idx = len(self._stores) - 1
+                        self._standbys[idx] = StandbyReplica(
+                            s, self._log, name=s.name).start()
+            finally:
+                self._promoting = False
+                self._lock.notify_all()
+            return True
+
     # -- lifecycle -----------------------------------------------------------
     def stop(self) -> None:
         """Settle any in-flight promotion, stop the primary (τ=0
@@ -630,6 +773,86 @@ class StoreSupervisor:
                     for s in self._stores
                 },
             }
+
+
+def _restore_good(manager) -> Optional[dict]:
+    """The rollback/cold-recovery restore: the newest retained
+    checkpoint that passes its content checksum AND carries finite
+    weights.  ``CheckpointManager.restore()`` already quarantines
+    checksum-corrupt files; the finite walk guards the OTHER corruption
+    shape — a cadence save that faithfully persisted already-poisoned
+    weights (checksummed garbage is still garbage)."""
+    state = manager.restore()
+    if state is None or bool(np.isfinite(
+            np.asarray(state["weights"])).all()):
+        return state
+    logger.warning(
+        "rollback restore: checkpoint at iteration %d carries "
+        "non-finite weights (the poison was saved before it was "
+        "detected); walking back through retained versions",
+        state["iteration"])
+    for v in reversed(manager.versions()):
+        try:
+            st = manager.restore_version(v)
+        except Exception:
+            continue  # corrupt/unreadable retained copy: keep walking
+        if bool(np.isfinite(np.asarray(st["weights"])).all()):
+            return st
+    logger.warning(
+        "rollback restore: NO retained checkpoint carries finite "
+        "weights; recovering from initial weights")
+    return None
+
+
+class RollbackController:
+    """Corrupt-state rollback: **failover to your own past** (ISSUE 15;
+    ADVICE.md "Corruption is a payload, not an exception").
+
+    The admission guard (``ParameterStore`` poison gate) rejects the
+    poison it can SEE at push time.  This controller is for the poison
+    that slips through — the guard disabled, or the resident weights
+    themselves damaged — where the corrupt state is already replicated
+    (every standby replayed the same poisoned delta, so promotion
+    cannot help).  :meth:`rollback` reuses PR 14's epoch fencing end to
+    end: fence the whole present (primary and standbys), cold-recover a
+    fresh store from the last checksummed-good, finite-weights
+    checkpoint with an EPOCH BUMP — so in-flight pushes against the
+    poisoned line come back ``fenced`` and are never discounted into
+    the clean one — and let the workers replay forward from ``(seed,
+    version)``.  Runs under ``span("integrity.rollback")`` with a
+    flight-record dump, so the post-mortem starts at the incident.
+
+    :meth:`check_and_rollback` is the polling spelling the
+    ``ReplicaDriver`` monitor loop calls when
+    ``set_integrity_rollback(True)`` arms it."""
+
+    def __init__(self, supervisor: StoreSupervisor):
+        self._sup = supervisor
+
+    def check_and_rollback(self) -> bool:
+        """Roll back iff the current primary's weights went non-finite;
+        returns True when a rollback ran."""
+        try:
+            healthy = self._sup.primary().weights_healthy()
+        except Exception:
+            return False  # mid-promotion churn: the next poll re-checks
+        if healthy:
+            return False
+        return self.rollback("non-finite weights detected")
+
+    def rollback(self, reason: str = "corrupt-state") -> bool:
+        from tpu_sgd.obs import flightrec
+
+        with span("integrity.rollback", reason=reason) as sp:
+            inc("integrity.rollback")
+            event("integrity.rollback", reason=reason)
+            ok = self._sup.rollback(
+                IntegrityError("store.weights", "poison", reason))
+            sp.set(rolled_back=ok, epoch=self._sup.epoch)
+        # dump AFTER the span closes so the incident's own records —
+        # the rollback span included — are in the ring being dumped
+        flightrec.trigger("integrity.rollback", detail=reason)
+        return ok
 
 
 class StoreClient:
@@ -684,17 +907,19 @@ class StoreClient:
         return self._op(worker_id, "pull", worker_id)
 
     def push(self, worker_id: str, basis_version: int, grad_sum,
-             loss_sum, count, *, basis_epoch: Optional[int] = None):
+             loss_sum, count, *, basis_epoch: Optional[int] = None,
+             checksum: Optional[int] = None):
         return self._op(worker_id, "push", worker_id, basis_version,
                         grad_sum, loss_sum, count,
-                        basis_epoch=basis_epoch)
+                        basis_epoch=basis_epoch, checksum=checksum)
 
     def push_compressed(self, worker_id: str, basis_version: int,
                         indices, values, loss_sum: float, count: float,
-                        *, basis_epoch: Optional[int] = None):
+                        *, basis_epoch: Optional[int] = None,
+                        checksum: Optional[int] = None):
         return self._op(worker_id, "push_compressed", worker_id,
                         basis_version, indices, values, loss_sum, count,
-                        basis_epoch=basis_epoch)
+                        basis_epoch=basis_epoch, checksum=checksum)
 
     # -- driver surface (forwarded to the settled primary) -------------------
     def register_worker(self, worker_id: str, shard_index: int) -> None:

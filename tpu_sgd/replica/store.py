@@ -58,6 +58,17 @@ into the new version line), and its late checkpoint saves are refused
 AND epoch-stamped so ``CheckpointManager.restore`` prefers the
 promoted ``(epoch, version)`` line.
 
+Integrity (ISSUE 15; ADVICE.md "Corruption is a payload, not an
+exception"): push payloads arrive as checksummed frames verified at
+THIS consume site (a mismatch raises typed ``IntegrityError`` and the
+worker's retry re-sends the intact bytes); a numerically implausible
+payload — non-finite, or a norm beyond the ``poison_guard`` gate — is
+rejected WHOLE as ``PushResult.poisoned`` exactly like a stale push;
+and poison that slips through anyway (guard off, or the weights
+damaged in place — see :meth:`weights_healthy`) is healed by
+``ha.RollbackController``: fence this line, restore the last good
+checkpoint with an epoch bump, replay.
+
 Lock discipline: ONE condition (``_cond``) guards all mutable state —
 version/weights/inbox/membership mirror/EF registry — because the τ=0
 barrier needs to *wait* on round application, and a second lock would
@@ -77,11 +88,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_sgd.io.integrity import seal, verify
 from tpu_sgd.io.sparse_wire import ErrorFeedback
 from tpu_sgd.obs.counters import inc, record_wire
 from tpu_sgd.obs.spans import event, span
-from tpu_sgd.reliability.failpoints import failpoint
+from tpu_sgd.reliability import failpoints as _fp
+from tpu_sgd.reliability.failpoints import corruptpoint, failpoint
 from tpu_sgd.reliability.health import Heartbeat
+from tpu_sgd.replica import ha as _ha
 from tpu_sgd.replica.ha import DeltaRecord, StoreFailed, StoreFenced
 from tpu_sgd.replica.staleness import StalenessContract
 
@@ -110,6 +124,8 @@ GRAFTLINT_LOCKS = {
         "_stopped": "_cond",
         "_pushes_accepted": "_cond",
         "_pushes_rejected": "_cond",
+        "_pushes_poisoned": "_cond",
+        "_accepted_norms": "_cond",
         "_pulls": "_cond",
         "_max_accepted_staleness": "_cond",
         "_t_last_apply": "_cond",
@@ -147,13 +163,20 @@ class PushResult(NamedTuple):
     recompute — the contract's whole point is that this work is
     discarded, not applied late.  ``fenced=True`` marks the epoch
     spelling of the same verdict: the basis belongs to a superseded
-    primary, so the worker must re-pull from the promoted store."""
+    primary, so the worker must re-pull from the promoted store.
+    ``poisoned=True`` is the INTEGRITY spelling (ISSUE 15): the
+    payload failed the numerical admission guard (non-finite entries,
+    or a gradient norm beyond the k×rolling-median gate) — rejected
+    WHOLE exactly like a stale push, so the worker restores its EF
+    segment, re-pulls, and recomputes the deterministic ``(seed,
+    version)`` contribution; the heal is a replay."""
 
     accepted: bool
     version: int
     staleness: int
     done: bool
     fenced: bool = False
+    poisoned: bool = False
 
 
 class ParameterStore:
@@ -181,6 +204,8 @@ class ParameterStore:
         epoch: int = 0,
         ef_registry: Optional[Dict[str, ErrorFeedback]] = None,
         name: str = "store",
+        poison_guard: Optional[float] = 10.0,
+        poison_warmup: int = 16,
     ):
         self.updater = updater
         self.config = config
@@ -230,8 +255,20 @@ class ParameterStore:
         self._fenced = False
         self._failed = False
         self._replication = None
+        # the poison-admission guard (ISSUE 15): ``poison_guard=k``
+        # rejects a push whose payload carries non-finite entries, or
+        # whose batch-mean gradient norm exceeds k× the rolling median
+        # of the last 64 ACCEPTED norms (after ``poison_warmup``
+        # accepted pushes — early training norms are legitimately
+        # noisy).  ``None`` disables — the configuration whose poison
+        # the RollbackController exists for
+        self._poison_k = (None if poison_guard is None
+                          else float(poison_guard))
+        self._poison_warmup = int(poison_warmup)
+        self._accepted_norms: list = []
         self._pushes_accepted = 0
         self._pushes_rejected = 0
+        self._pushes_poisoned = 0
         self._pushes_fenced = 0
         self._pulls = 0
         self._max_accepted_staleness = 0
@@ -370,15 +407,41 @@ class ParameterStore:
 
     def push(self, worker_id: str, basis_version: int, grad_sum,
              loss_sum, count, *,
-             basis_epoch: Optional[int] = None) -> PushResult:
+             basis_epoch: Optional[int] = None,
+             checksum: Optional[int] = None) -> PushResult:
         """One DENSE gradient-contribution push (the bitwise sync
         wire).  ``grad_sum``/``loss_sum``/``count`` are the worker's
         raw local sums — the store normalizes, exactly like the psum
         path.  Blocks at τ=0 until the round containing this
         contribution applies (or the run ends).  ``basis_epoch``: the
         epoch the basis was pulled at (``None`` = this store's — the
-        single-store spelling)."""
+        single-store spelling).  ``checksum``: the worker's seal over
+        the payload's host bytes, verified HERE — the consume site —
+        after the ``replica.push.wire`` corrupting failpoint (the
+        modeled network hop); a mismatch raises typed IntegrityError,
+        which the worker's RetryPolicy heals by re-sending the intact
+        originals.  The host staging is CPU zero-copy (np.asarray of a
+        device buffer) and the re-put ships byte-identical values, so
+        the τ=0 bitwise contract is untouched."""
         failpoint("replica.push")
+        # host staging is NEEDED by exactly three consumers — the
+        # checksum verify, an armed corruptpoint, and the poison gate —
+        # and is zero-copy on CPU but a real device→host round-trip on
+        # an accelerator backend, so with all three off (checksum-less
+        # push, failpoints disarmed, poison_guard=None) the payload
+        # takes the pre-integrity pure-device wire untouched
+        stage_host = (checksum is not None or self._poison_k is not None
+                      or _fp.is_enabled())
+        poison = None
+        if stage_host:
+            g_h = np.asarray(grad_sum)
+            l_h = np.asarray(loss_sum)
+            c_h = np.asarray(count)
+            g_h, l_h, c_h = corruptpoint("replica.push.wire",
+                                         (g_h, l_h, c_h))
+            verify("replica.push.wire", checksum, g_h, l_h, c_h)
+            poison = self._poison_stats(g_h, l_h, float(c_h))
+            grad_sum, loss_sum, count = g_h, l_h, c_h
         g = jax.device_put(grad_sum, self._device)
         l = jax.device_put(loss_sum, self._device)
         c = jax.device_put(count, self._device)
@@ -386,24 +449,51 @@ class ParameterStore:
                     logical_nbytes=int(g.nbytes + l.nbytes + c.nbytes),
                     physical_nbytes=int(g.nbytes + l.nbytes + c.nbytes))
         return self._admit(worker_id, basis_version, ("sums", g, l, c),
-                           basis_epoch=basis_epoch)
+                           basis_epoch=basis_epoch, poison=poison)
 
     def push_compressed(self, worker_id: str, basis_version: int,
                         indices, values, loss_sum: float,
                         count: float, *,
-                        basis_epoch: Optional[int] = None) -> PushResult:
+                        basis_epoch: Optional[int] = None,
+                        checksum: Optional[int] = None) -> PushResult:
         """One COMPRESSED push: the top-k ``(indices, values)`` segment
         of the worker's EF-folded batch-mean gradient (selected by the
         worker's :class:`ErrorFeedback`, which already counted the wire
         bytes), plus host-scalar loss/count.  Matched-final-loss, not
-        bitwise — the dropped mass ships on later pushes."""
+        bitwise — the dropped mass ships on later pushes.  Same
+        consume-site checksum contract as :meth:`push`; a rejected
+        (stale, fenced, poisoned, OR corrupt-retried) segment's mass is
+        the worker's to restore — reject whole, never leak."""
         failpoint("replica.push")
-        idx = jax.device_put(np.asarray(indices, np.int32), self._device)
-        vals = jax.device_put(np.asarray(values, np.float32),
-                              self._device)
+        idx_h = np.asarray(indices, np.int32)
+        vals_h = np.asarray(values, np.float32)
+        idx_h, vals_h = corruptpoint("replica.push.wire",
+                                     (idx_h, vals_h))
+        verify("replica.push.wire", checksum, idx_h, vals_h)
+        idx = jax.device_put(idx_h, self._device)
+        vals = jax.device_put(vals_h, self._device)
+        poison = self._poison_stats(vals_h, np.asarray(loss_sum),
+                                    None)
         return self._admit(worker_id, basis_version,
                            ("topk", idx, vals, float(loss_sum),
-                            float(count)), basis_epoch=basis_epoch)
+                            float(count)), basis_epoch=basis_epoch,
+                           poison=poison)
+
+    def _poison_stats(self, g_h, l_h, count: Optional[float]):
+        """``(finite, batch_mean_norm)`` of one payload's HOST bytes —
+        computed outside the lock on arrays the push already staged
+        (zero added syncs).  Dense payloads normalize by the count so
+        the gate compares batch-MEAN magnitudes across batch sizes;
+        compressed segments already arrive at mean scale."""
+        if self._poison_k is None:
+            return None
+        finite = bool(np.isfinite(g_h).all()) and bool(
+            np.isfinite(l_h).all()) and (
+            count is None or bool(np.isfinite(count)))
+        norm = float(np.linalg.norm(g_h.astype(np.float64, copy=False)))
+        if count is not None:
+            norm /= max(float(count), 1.0)
+        return (finite, norm)
 
     # -- internals ----------------------------------------------------------
     def _check_live_locked(self, op: str) -> None:
@@ -418,9 +508,26 @@ class ParameterStore:
             raise StoreFailed(f"store {self.name} is failed: {op} must "
                               "re-route to the promoted primary")
 
+    def _poison_verdict_locked(self, poison) -> Optional[str]:
+        """Caller holds ``_cond``.  The numerical admission gate's
+        verdict for one payload's ``(finite, norm)`` stats, or None
+        when the push is clean (or the guard is off)."""
+        if poison is None:
+            return None
+        finite, norm = poison
+        if not finite:
+            return "non-finite payload entries"
+        if len(self._accepted_norms) >= self._poison_warmup:
+            med = float(np.median(self._accepted_norms))
+            if med > 0.0 and norm > self._poison_k * med:
+                return (f"gradient norm {norm:.4g} > {self._poison_k:g}x "
+                        f"rolling median {med:.4g}")
+        return None
+
     def _admit(self, worker_id: str, basis_version: int,
                payload: tuple,
-               basis_epoch: Optional[int] = None) -> PushResult:
+               basis_epoch: Optional[int] = None,
+               poison=None) -> PushResult:
         with self._cond:
             self._check_live_locked("push")
             self.heartbeat.beat()
@@ -473,7 +580,35 @@ class ParameterStore:
                       version=self._version)
                 return PushResult(False, self._version,
                                   decision.staleness, False)
+            # the poison-admission gate (ISSUE 15): a numerically
+            # implausible payload is rejected WHOLE before it can touch
+            # the inbox or the version line — the worker restores its
+            # EF segment and recomputes from (seed, version), so the
+            # heal is a deterministic replay, exactly like a staleness
+            # rejection (ADVICE.md "Corruption is a payload, not an
+            # exception")
+            bad = self._poison_verdict_locked(poison)
+            if bad is not None:
+                self._pushes_poisoned += 1
+                inc("replica.push.poisoned")
+                inc("integrity.corrupt")
+                inc("integrity.corrupt.replica.push.poison")
+                event("replica.push", worker=worker_id,
+                      basis=int(basis_version),
+                      staleness=decision.staleness, accepted=False,
+                      poisoned=True, version=self._version,
+                      detail=bad)
+                return PushResult(False, self._version,
+                                  decision.staleness,
+                                  self._done_locked(), poisoned=True)
             self._pushes_accepted += 1
+            if poison is not None:
+                # the gate's rolling baseline grows from ACCEPTED
+                # norms only (a rejected spike must not legitimize the
+                # next one), bounded to the trailing 64
+                self._accepted_norms.append(poison[1])
+                if len(self._accepted_norms) > 64:
+                    del self._accepted_norms[0]
             if decision.staleness > self._max_accepted_staleness:
                 self._max_accepted_staleness = decision.staleness
             inc("replica.push.accepted")
@@ -583,8 +718,15 @@ class ParameterStore:
         self.heartbeat.beat()
         if ship is not None:
             try:
-                self._replication(DeltaRecord(
-                    self._epoch, i, ship[0][0], tuple(ship)))
+                record = DeltaRecord(self._epoch, i, ship[0][0],
+                                     tuple(ship))
+                # seal the record's payload bytes at capture — the
+                # standby's replay verifies at ITS consume site, so a
+                # record damaged in the log/wire can never silently
+                # fork the standby-bitwise trajectory (ha.py)
+                record = record._replace(
+                    checksum=seal(*_ha.record_arrays(record)))
+                self._replication(record)
                 inc("replica.replicate")
             except StoreFenced:
                 # we were promoted over DURING this apply (the fence
@@ -693,6 +835,33 @@ class ParameterStore:
             self._checkpoint_manager = checkpoint_manager
             self._checkpoint_every = int(checkpoint_every)
             self._listener = listener
+
+    # -- the integrity surface (ISSUE 15; ha.RollbackController) -------------
+    def weights_healthy(self) -> bool:
+        """True iff every resident weight is finite — the cheap
+        (host zero-copy on CPU) corruption probe the rollback
+        controller polls.  A False here means poison already REACHED
+        the version line (guard off, or the weights damaged in place):
+        promotion cannot help — every standby replayed the same delta
+        — so the answer is a rollback, not a failover."""
+        with self._cond:
+            w = self._w
+        return bool(np.isfinite(np.asarray(w)).all())
+
+    def corrupt_weights_for_chaos(self, index: int = 0) -> None:
+        """Chaos/test handle (never called by production code): damage
+        ONE resident weight in place with NaN — the forced
+        weight-corruption cell's injection, modeling poison that
+        slipped past the admission guard into the weights themselves.
+        The fleet then spins on poisoned-rejected pushes (every pulled
+        basis is non-finite) until the RollbackController fences this
+        line and restores the last good checkpoint."""
+        with self._cond:
+            w = np.array(np.asarray(self._w), copy=True)
+            flat = w.reshape(-1)
+            flat[int(index) % flat.size] = np.nan
+            self._w = jax.device_put(w, self._device)
+            self._cond.notify_all()
 
     @property
     def epoch(self) -> int:
@@ -806,6 +975,7 @@ class ParameterStore:
                 "pulls": self._pulls,
                 "pushes_accepted": self._pushes_accepted,
                 "pushes_rejected": self._pushes_rejected,
+                "pushes_poisoned": self._pushes_poisoned,
                 "pushes_fenced": self._pushes_fenced,
                 "max_accepted_staleness": self._max_accepted_staleness,
                 "active_workers": len(self._active),

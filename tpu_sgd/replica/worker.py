@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_sgd.io.integrity import IntegrityError, integrity_enabled, seal
 from tpu_sgd.obs.spans import span
 
 
@@ -80,6 +81,19 @@ class ReplicaWorker:
     """See module docstring.  ``X_shard``/``y_shard`` are the worker's
     HOST rows (staged to ``device`` once here); ``valid`` masks padding
     rows exactly like the meshed path's ``shard_dataset`` mask."""
+
+    #: consecutive poisoned rejections before the worker gives up
+    #: LOUDLY (typed IntegrityError).  A poisoned rejection whose
+    #: recompute is deterministic can only heal if the corruption was
+    #: on the WIRE (the recompute ships clean) or the store's state
+    #: changes under it (a rollback restores finite weights, bumping
+    #: version/epoch and resetting this streak) — a payload that is
+    #: GENUINELY bad, k times in a row against the same basis, would
+    #: otherwise livelock the fleet: the victim spins poison→re-pull→
+    #: identical poison while its τ=0 peers wait in the round barrier.
+    #: Sized well above any rollback's detection latency (the driver's
+    #: 0.1s health poll) at realistic cycle times.
+    POISON_STREAK_LIMIT = 256
 
     def __init__(
         self,
@@ -116,6 +130,9 @@ class ReplicaWorker:
         self.cycles = 0
         self.rejected = 0
         self.fenced = 0
+        self.poisoned = 0
+        self._poison_streak = 0
+        self._poison_basis = None
 
     def _call(self, fn, *args, **kwargs):
         if self.retry_policy is not None:
@@ -170,10 +187,16 @@ class ReplicaWorker:
                     gn = np.asarray(g).reshape(-1) / max(c_host, 1.0)
                     idx, vals = self.ef.compress(gn)
                 try:
+                    # seal the segment's host bytes: the store verifies
+                    # at ITS consume site, after the modeled wire hop
+                    # (tpu_sgd/io/integrity.py) — a corrupt-detected
+                    # push heals inside _call's retry with the intact
+                    # originals, EF mass untouched
                     res = self._call(
                         self.store.push_compressed, self.worker_id,
                         pulled.version, idx, vals, l_host, c_host,
-                        basis_epoch=pulled.epoch)
+                        basis_epoch=pulled.epoch,
+                        checksum=seal(idx, vals))
                 except BaseException:
                     # the push never produced a result (retry budget
                     # exhausted, or a kill): this worker may die and
@@ -188,18 +211,55 @@ class ReplicaWorker:
                     # gradient
                     self.ef.restore_segment(idx, vals)
             else:
-                res = self._call(self.store.push, self.worker_id,
-                                 pulled.version, g, l, c,
-                                 basis_epoch=pulled.epoch)
+                # the dense wire's seal: host views of the local sums
+                # (zero-copy on CPU — the push was about to fetch these
+                # bytes anyway), verified at the store's consume site.
+                # Gated so set_integrity(False) really removes the
+                # device→host staging on backends where it costs
+                ck = (seal(np.asarray(g), np.asarray(l), np.asarray(c))
+                      if integrity_enabled() else None)
+                res = self._call(
+                    self.store.push, self.worker_id,
+                    pulled.version, g, l, c,
+                    basis_epoch=pulled.epoch, checksum=ck)
         self.cycles += 1
         if not res.accepted and not res.done:
             # a fenced push is the failover spelling of a staleness
-            # rejection: the basis belongs to a superseded primary —
-            # re-pull and recompute (EF mass already restored above)
+            # rejection, a poisoned push the integrity spelling: the
+            # work is discarded WHOLE either way — re-pull and
+            # recompute (EF mass already restored above)
             if getattr(res, "fenced", False):
                 self.fenced += 1
+            elif getattr(res, "poisoned", False):
+                self.poisoned += 1
+                # the streak counts SAME-(epoch, basis) rejections: a
+                # rollback moves the store to a restored version line
+                # and the recompute against it is a genuinely new
+                # payload — never charge it with the old line's spins
+                basis = (pulled.epoch, pulled.version)
+                self._poison_streak = (self._poison_streak + 1
+                                       if basis == self._poison_basis
+                                       else 1)
+                self._poison_basis = basis
+                if self._poison_streak >= self.POISON_STREAK_LIMIT:
+                    # the recompute is deterministic: this payload is
+                    # genuinely bad and nothing upstream is changing —
+                    # fail LOUDLY (the driver's rejoin budget absorbs a
+                    # transient; an exhausted budget propagates this
+                    # error, and its IntegrityError class is what the
+                    # integrity.unhealed accounting keys on)
+                    raise IntegrityError(
+                        "replica.push", "poison",
+                        f"worker {self.worker_id!r}: "
+                        f"{self._poison_streak} consecutive poisoned "
+                        f"rejections at basis {pulled.version} — the "
+                        "deterministic recompute cannot heal this "
+                        "(weights corrupted with rollback unarmed, or "
+                        "genuine divergence)")
             else:
                 self.rejected += 1
+        if res.accepted:
+            self._poison_streak = 0
         if self.heartbeat is not None:
             self.heartbeat.beat()
         return not res.done

@@ -13,7 +13,12 @@ One run exercises the whole circulatory system at once:
    STORE is killed mid-round and the supervisor promotes the standby
    under live traffic — the SLO gate requires >= 1 failover, a bounded
    ``replica.failover`` span, the failover detector's typed alert, and
-   (as ever) zero dropped requests.
+   (as ever) zero dropped requests.  The store-kill round is ALSO the
+   corruption round (ISSUE 15): ``corrupt_prob`` damages delta-log
+   records on the replication hop the whole round, so the standby
+   being promoted is one whose replica stream healed through its
+   consume-site checksums — gated by the ``integrity-*`` SLOs
+   (corruption detected, zero unhealed, integrity alert fired).
 2. **Live serving under admission control** — three endpoints serve
    while the fleet retrains underneath them: a hot-reloading dense
    endpoint (interactive + shadow lanes, per-request deadlines), a
@@ -116,6 +121,18 @@ def build_slos(mode: str = "smoke", violate: Optional[str] = None) -> dict:
          "max": (30.0 if mode == "smoke" else 10.0)},
         {"name": "alert-failover", "metric": "alert_count",
          "rule": "failover", "min": 1},
+        # ISSUE 15: the store-kill round runs under active delta-log
+        # corruption — the standby's consume-site checksums must have
+        # DETECTED frames (corruption really injected), every one must
+        # have healed (the unhealed counter stays zero — the retrain
+        # thread's own success is the ground truth), and the integrity
+        # detector must have turned the frames into a typed alert
+        {"name": "integrity-corruption-detected", "metric": "counter",
+         "counter": "integrity.corrupt", "min": 1},
+        {"name": "integrity-zero-unhealed", "metric": "counter",
+         "counter": "integrity.unhealed", "max": 0},
+        {"name": "alert-integrity", "metric": "alert_count",
+         "rule": "integrity", "min": 1},
     ]
     if violate is not None:
         matched = [s for s in slos if s["name"] == violate]
@@ -164,7 +181,9 @@ def run_scenario(
     from tpu_sgd.models import (LinearRegressionModel,
                                 MultinomialLogisticRegressionModel)
     from tpu_sgd.obs import report as obs_report
-    from tpu_sgd.reliability import RetryPolicy, fail_nth, inject_faults
+    from tpu_sgd.reliability import (RetryPolicy, corrupt_prob, fail_nth,
+                                     inject_faults)
+    from tpu_sgd.reliability.failpoints import triggers as fp_triggers
     from tpu_sgd.replica import ReplicaDriver
     from tpu_sgd.scenario.loadgen import (OpenLoopLoadGen, Phase,
                                           TrafficSpec)
@@ -326,10 +345,23 @@ def run_scenario(
                     if r == kill_round:
                         # one-shot kill mid-round: the nth push of this
                         # round dies, the worker deregisters, and the
-                        # driver rejoins it with seeded backoff
-                        with inject_faults({"replica.push": fail_nth(
-                                iters_per_round // 2)}):
+                        # driver rejoins it with seeded backoff.  The
+                        # standby drains this whole round's delta log,
+                        # so the log wire runs under corrupt_prob here
+                        # too (ISSUE 15) — every damaged record is
+                        # detected by the consume-site checksum and
+                        # healed by re-reading the intact retained copy
+                        with inject_faults({
+                                "replica.push": fail_nth(
+                                    iters_per_round // 2),
+                                "replica.log.record": corrupt_prob(
+                                    0.05, seed=seed + 87)}):
                             drv.optimize_with_history(data, w0)
+                            corruptions = fp_triggers(
+                                "replica.log.record")
+                        retrain_result["corruptions_healed"] = \
+                            retrain_result.get("corruptions_healed",
+                                               0) + corruptions
                         members = drv.last_membership_snapshot
                         rejoins += sum(max(0, m["joins"] - 1)
                                        for m in members.values())
@@ -339,7 +371,16 @@ def run_scenario(
                         # per applied version, so the kill lands at a
                         # deterministic version offset regardless of
                         # host load) and the supervisor promotes the
-                        # standby under live serving traffic
+                        # standby under live serving traffic.  The SAME
+                        # round is the CORRUPTION round (ISSUE 15):
+                        # corrupt_prob silently damages delta-log
+                        # records on the replication hop, the standby's
+                        # consume-site checksum detects each one and
+                        # heals by re-reading the intact retained
+                        # record — so the store being promoted under
+                        # traffic is one whose replica stream was under
+                        # active corruption the whole time (gated by
+                        # the integrity-* SLOs)
                         start_v = manager.latest_version() or 0
 
                         class _KillStoreAt:
@@ -357,9 +398,17 @@ def run_scenario(
                                     drv.kill_primary()
 
                         drv.set_listener(_KillStoreAt())
-                        drv.optimize_with_history(data, w0)
+                        with inject_faults({
+                                "replica.log.record": corrupt_prob(
+                                    0.35, seed=seed + 88)}):
+                            drv.optimize_with_history(data, w0)
+                            corruptions = fp_triggers(
+                                "replica.log.record")
                         failovers += drv.last_failover_snapshot[
                             "failovers"]
+                        retrain_result["corruptions_healed"] = \
+                            retrain_result.get("corruptions_healed",
+                                               0) + corruptions
                     else:
                         drv.optimize_with_history(data, w0)
                     # the reload CADENCE: the auto-reload scan catches

@@ -195,6 +195,13 @@ class ModelRegistry:
                         self.breaker.record_failure()
                     continue
                 except Exception as e:
+                    # incl. IntegrityError (ISSUE 15): a checkpoint
+                    # whose content checksum fails at restore is PROVEN
+                    # corrupt — blacklist the version (the breaker
+                    # counts it, repeated corruption stops the disk
+                    # scan) and keep serving the previous good model;
+                    # the ``integrity.corrupt.checkpoint`` counter and
+                    # its detector alert already fired at the verify
                     self.bad_versions[v] = f"{type(e).__name__}: {e}"
                     self.load_failed_count += 1
                     logger.warning(

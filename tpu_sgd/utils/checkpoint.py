@@ -20,9 +20,24 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from tpu_sgd.io.integrity import (IntegrityError, checksum_arrays,
+                                  integrity_enabled)
 from tpu_sgd.reliability.failpoints import FaultInjected, failpoint
 
 logger = logging.getLogger("tpu_sgd.checkpoint")
+
+
+def _content_checksum(entries: dict) -> int:
+    """CRC-32 over every npz entry's name and bytes, in sorted-name
+    order — ONE definition shared by :meth:`CheckpointManager.save`
+    (sealing) and :meth:`CheckpointManager._parse` (verifying), so a
+    flipped bit, a truncated array, or a silently dropped field in ANY
+    entry fails the restore-time check."""
+    leaves = []
+    for k in sorted(entries):
+        leaves.append(np.frombuffer(k.encode(), np.uint8))
+        leaves.append(np.asarray(entries[k]))
+    return checksum_arrays(*leaves)
 
 FORMAT_VERSION = "1.0"
 
@@ -153,19 +168,27 @@ class CheckpointManager:
             # up by latest_path.
             tmp = os.path.join(self.directory,
                                ".tmp_" + os.path.basename(path))
+            entries = {
+                "version": np.asarray(FORMAT_VERSION),
+                "iteration": np.asarray(iteration, np.int64),
+                "epoch": np.asarray(epoch, np.int64),
+                "weights": np.asarray(weights),
+                "reg_val": np.asarray(reg_val, np.float64),
+                "loss_history": np.asarray(loss_history, np.float64),
+                "config_key": np.asarray(config_key),
+                **{f"x_{k}": np.asarray(v)
+                   for k, v in (extras or {}).items()},
+            }
+            if integrity_enabled():
+                # content checksum over every entry (ISSUE 15):
+                # verified at restore, so a bit flipped at rest — in
+                # bytes npz's own zip CRC does not cover end-to-end, or
+                # after a tool rewrote the archive — is a typed,
+                # quarantined corruption instead of poisoned weights
+                entries["checksum"] = np.asarray(
+                    _content_checksum(entries), np.uint32)
             with open(tmp, "wb") as f:
-                np.savez(
-                    f,
-                    version=FORMAT_VERSION,
-                    iteration=np.asarray(iteration, np.int64),
-                    epoch=np.asarray(epoch, np.int64),
-                    weights=np.asarray(weights),
-                    reg_val=np.asarray(reg_val, np.float64),
-                    loss_history=np.asarray(loss_history, np.float64),
-                    config_key=np.asarray(config_key),
-                    **{f"x_{k}": np.asarray(v)
-                       for k, v in (extras or {}).items()},
-                )
+                np.savez(f, **entries)
                 # fsync BEFORE the rename: os.replace is atomic for the
                 # directory entry, but on a writeback mount a power loss
                 # can journal the rename while the data blocks are still
@@ -284,6 +307,34 @@ class CheckpointManager:
                 raise CheckpointVersionError(
                     f"unsupported checkpoint version {z['version']}"
                 )
+            if "checksum" in z.files:
+                # the content-checksum verify (ISSUE 15).  Raising
+                # IntegrityError here composes with restore()'s
+                # existing carve-outs: the latest-default path
+                # QUARANTINES this file and falls back to an older
+                # retained checkpoint (it is proven corrupt, not a
+                # transient hiccup), explicit path/version requests
+                # raise to the caller, and the serve registry marks
+                # the version bad.  Legacy checksum-less files load
+                # as before.
+                expected = int(z["checksum"])
+                actual = _content_checksum(
+                    {k: z[k] for k in z.files if k != "checksum"})
+                if actual != expected:
+                    from tpu_sgd.obs.counters import inc
+                    from tpu_sgd.obs.spans import event
+
+                    inc("integrity.corrupt")
+                    inc("integrity.corrupt.checkpoint")
+                    event("integrity.corrupt_frame", site="checkpoint",
+                          kind="checksum", path=path)
+                    raise IntegrityError(
+                        "checkpoint", "checksum",
+                        f"{path}: crc {actual:#010x} != sealed "
+                        f"{expected:#010x}")
+                from tpu_sgd.obs.counters import inc
+
+                inc("integrity.verified.checkpoint")
             return {
                 "iteration": int(z["iteration"]),
                 "epoch": (int(z["epoch"]) if "epoch" in z.files else 0),
