@@ -33,7 +33,12 @@ BENCH_RESIDENT.json's basis note):
   the fault-free reference (``bitwise_vs_fault_free`` = 1 — gated by
   ``scripts/bench_gate.py``); plus a compressed (τ=1, top-k) failover
   twin asserting the matched-objective bar (EF mass conservation
-  itself is pinned in ``tests/test_replica_ha.py``).
+  itself is pinned in ``tests/test_replica_ha.py``);
+* **store-shard sweep** — the sharded store
+  (``tpu_sgd/replica/shard.py``) at S ∈ {1, 2, 4} apply pipelines:
+  accepted-push counts and per-shard apply totals (deterministic at
+  τ=0 — gated), per-shard wire bytes, bitwise-vs-unsharded asserted,
+  and the accepted-pushes/s rate recorded as secondary wall-clock.
 
 End-to-end walls are SECONDARY on this harness (2 cores share one DRAM
 wall; thread-scheduling noise dominates) — each cell records its wall
@@ -83,7 +88,7 @@ def _objective(X, y, w):
                  + 0.5 * REG * np.sum(np.asarray(w) ** 2))
 
 
-def _driver(tau, workers, wire=None, standbys=0):
+def _driver(tau, workers, wire=None, standbys=0, store_shards=1):
     from tpu_sgd.ops.gradients import LeastSquaresGradient
     from tpu_sgd.ops.updaters import SquaredL2Updater
     from tpu_sgd.replica import ReplicaDriver
@@ -97,6 +102,8 @@ def _driver(tau, workers, wire=None, standbys=0):
         drv.set_wire_compress(wire)
     if standbys:
         drv.set_standbys(standbys)
+    if store_shards > 1:
+        drv.set_store_shards(store_shards)
     return drv
 
 
@@ -109,7 +116,7 @@ class _ListSink:
 
 
 def _run_cell(X, y, w0, tau, workers, wire=None, faults=None,
-              rejoin_seed=None, standbys=0):
+              rejoin_seed=None, standbys=0, store_shards=1):
     """One sweep cell under trace + wire counters; returns the record
     plus the raw counter snapshot."""
     from tpu_sgd.obs import counters as obs_counters
@@ -117,7 +124,8 @@ def _run_cell(X, y, w0, tau, workers, wire=None, faults=None,
     from tpu_sgd.reliability import failpoints as fp
     from tpu_sgd.reliability.retry import RetryPolicy
 
-    drv = _driver(tau, workers, wire, standbys=standbys)
+    drv = _driver(tau, workers, wire, standbys=standbys,
+                  store_shards=store_shards)
     if rejoin_seed is not None:
         drv.set_rejoin(RetryPolicy(max_attempts=5, base_backoff_s=0.005,
                                    seed=rejoin_seed))
@@ -322,6 +330,61 @@ def main() -> int:
                   "dense τ=0 × W=4 sync reference"),
     }
     print(f"compressed failover: ratio={ratio_cf:.4f}")
+
+    # -- store-shard sweep: S apply pipelines behind one contract -----------
+    # (tpu_sgd/replica/shard.py).  τ=0 × 4 workers so every count is
+    # deterministic: pushes_accepted = ITERS * W at every S, each
+    # pipeline applies exactly ITERS combines, and the trajectory is
+    # BITWISE the unsharded one (asserted, and gated).  The per-second
+    # rate is SECONDARY on this harness (2 cores share one DRAM wall)
+    # — counts, per-shard apply totals, and per-shard wire bytes are
+    # the transferable result.
+    shard_sweep = []
+    h_s1 = w_s1 = None
+    for n_shards in (1, 2, 4):
+        rec_s, h_s, w_s, counts_s, drv_s = _run_cell(
+            X, y, w0, 0, 4, store_shards=n_shards)
+        if n_shards == 1:
+            h_s1, w_s1 = h_s, np.asarray(w_s)
+            bitwise_s = 1
+        else:
+            bitwise_s = int(np.array_equal(h_s, h_s1)
+                            and np.array_equal(np.asarray(w_s), w_s1))
+            assert bitwise_s == 1, (
+                f"store_shards={n_shards} diverged from unsharded")
+        snap_s = drv_s.last_store_snapshot
+        shard_wire = {
+            k: v["physical_bytes"]
+            for k, v in wire_ratios(counts_s).items()
+            if k.startswith("replica.wire.dense-f32[")}
+        cell = {
+            "store_shards": n_shards,
+            "pushes_accepted": rec_s["pushes_accepted"],
+            "shard_applies": snap_s.get("shard_applies"),
+            "shard_pushes": snap_s.get("shard_pushes"),
+            "shard_wire_physical_bytes": shard_wire or None,
+            "bitwise_vs_unsharded": bitwise_s,
+            "accepted_pushes_per_s": round(
+                rec_s["pushes_accepted"] / max(rec_s["wall_s"], 1e-9),
+                1),
+            "wall_s": rec_s["wall_s"],
+            "wall_basis": rec_s["wall_basis"],
+        }
+        shard_sweep.append(cell)
+        print(f"store_shards={n_shards}: "
+              f"acc={cell['pushes_accepted']} "
+              f"applies={cell['shard_applies']} "
+              f"bitwise={bitwise_s} "
+              f"rate={cell['accepted_pushes_per_s']}/s")
+    report["store_shard_sweep"] = {
+        "tau": 0, "workers": 4,
+        "cells": shard_sweep,
+        "basis": ("τ=0 × 4 workers, dense pushes: every count is "
+                  "deterministic (ITERS * W accepted pushes, ITERS "
+                  "applies per pipeline) and the sharded trajectory "
+                  "is bitwise the unsharded one; accepted_pushes_per_s "
+                  "is secondary wall-clock on the 2-core harness"),
+    }
 
     with open(OUT, "w") as f:
         json.dump(report, f, indent=2)

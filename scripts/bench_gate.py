@@ -131,6 +131,30 @@ GATES = {
         # and the bench's own <=1.01 assertion stays the hard ceiling
         Gate("failover/compressed/objective_ratio_vs_sync", "lower",
              rel_tol=0.25),
+        # the store-shard sweep (tpu_sgd/replica/shard.py): structural
+        # counts, exact by construction at τ=0 — every S accepts the
+        # same ITERS*W pushes, each pipeline applies exactly ITERS
+        # combines, and the sharded trajectory stays bitwise the
+        # unsharded one (1 = equal; drift = a broken combine, never
+        # noise)
+        Gate("store_shard_sweep/cells[0]/pushes_accepted", "equal",
+             note="S=1 cell: ITERS*W accepted pushes"),
+        Gate("store_shard_sweep/cells[1]/pushes_accepted", "equal",
+             note="S=2 cell accepts the same pushes as unsharded"),
+        Gate("store_shard_sweep/cells[2]/pushes_accepted", "equal",
+             note="S=4 cell accepts the same pushes as unsharded"),
+        Gate("store_shard_sweep/cells[1]/bitwise_vs_unsharded",
+             "equal", note="S=2 τ=0 trajectory must stay bitwise — "
+                           "ADVICE.md 'Shard the apply, not the "
+                           "contract'"),
+        Gate("store_shard_sweep/cells[2]/bitwise_vs_unsharded",
+             "equal", note="S=4 τ=0 trajectory must stay bitwise"),
+        Gate("store_shard_sweep/cells[1]/shard_applies[0]", "equal",
+             note="each pipeline applies exactly ITERS combines"),
+        Gate("store_shard_sweep/cells[2]/shard_applies[3]", "equal",
+             note="the last of 4 pipelines applies exactly ITERS "
+                  "combines — a short list here means a pipeline "
+                  "vanished"),
     ],
     "BENCH_INTEGRITY.json": [
         # the integrity plane's acceptance pin as numbers (ISSUE 15):

@@ -664,11 +664,22 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         # → heal, all while the fleet runs) must complete every version
         # with the staleness bound intact, fenced stale-epoch pushes
         # counted, and a matched objective — the partition is just a
-        # longer rejection, zero EF mass lost
+        # longer rejection, zero EF mass lost.  Both cells run the
+        # SHARDED store (2 apply pipelines): the bitwise and SLO pins
+        # must survive per-shard delta-log payload groups too
         from tpu_sgd.replica import StoreFailed
 
+        def _store_totals(snap):
+            """``(fenced, replayed)`` for a possibly-sharded store
+            snapshot: replay work is counted PER SHARD in the sharded
+            spelling (``shard_replays`` — sum the list), while fencing
+            happens at admission BEFORE shard routing, so
+            ``pushes_fenced`` is a global scalar in both spellings."""
+            replays = sum(snap.get("shard_replays", [0]))
+            return int(snap["pushes_fenced"]), int(replays)
+
         deadline = Deadline(300.0)
-        ha_drv = _make_replica(0).set_standbys(1)
+        ha_drv = _make_replica(0).set_standbys(1).set_store_shards(2)
         # ~8 store accesses per τ=0 version (4 pulls + 4 pushes): the
         # one-shot kill at 4*rep_iters lands mid-run (~version N/2)
         with inject_faults({"replica.store_fail": fp.fail_nth(
@@ -683,8 +694,17 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         np.testing.assert_array_equal(
             h_ha, h_rep_ref,
             err_msg="τ=0 loss history diverged across the store failover")
-        summary["store_failover"] = ha_snap["records"][0]
-        say(f"store failover at τ=0 BITWISE: {ha_snap['records'][0]}")
+        ha_store_snap = ha_drv.last_store_snapshot
+        assert ha_store_snap["store_shards"] == 2, ha_store_snap
+        _, ha_replayed = _store_totals(ha_store_snap)
+        assert ha_replayed >= 1, (
+            "the promoted sharded store never replayed a per-shard "
+            f"payload group: {ha_store_snap}")
+        summary["store_failover"] = dict(
+            ha_snap["records"][0],
+            shard_replays=ha_store_snap["shard_replays"])
+        say(f"store failover at τ=0 BITWISE (sharded store): "
+            f"{summary['store_failover']}")
 
         # (b) partition one worker THROUGH the failover
         deadline = Deadline(300.0)
@@ -693,7 +713,8 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             2, retry=RetryPolicy(max_attempts=400, base_backoff_s=0.01,
                                  max_backoff_s=0.05, seed=seed + 70),
             iters=part_iters)
-            .set_standbys(1).set_wire_compress("topk:0.25"))
+            .set_standbys(1).set_wire_compress("topk:0.25")
+            .set_store_shards(2))
         import threading as _threading
 
         timers = [
@@ -714,15 +735,20 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
             part_drv.last_failover_snapshot)
         assert pt_snap["version"] == part_iters, pt_snap
         assert pt_snap["max_accepted_staleness"] <= 2, pt_snap
-        assert pt_snap["pushes_fenced"] >= 1, (
+        pt_fenced, pt_replayed = _store_totals(pt_snap)
+        assert pt_fenced >= 1, (
             "no push was ever epoch-fenced across the failover")
+        assert pt_replayed >= 1, (
+            "the promoted sharded store replayed no compressed "
+            f"per-shard payload group: {pt_snap}")
         obj_pt = _objective(w_pt)
         assert obj_pt <= _objective(w_rep_ref) * 1.01, (
             f"partitioned-through-failover objective {obj_pt}")
         summary["store_partition"] = {
             "failovers": part_drv.last_failover_snapshot["failovers"],
-            "pushes_fenced": pt_snap["pushes_fenced"],
+            "pushes_fenced": pt_fenced,
             "pushes_rejected": pt_snap["pushes_rejected"],
+            "shard_replays": pt_snap["shard_replays"],
             "objective_ratio_vs_sync": obj_pt / _objective(w_rep_ref),
         }
         say(f"partition through failover survived: "
@@ -806,10 +832,15 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         # (d) POISON ADMISSION: checksums off — NaN corruption now
         # reaches the store's numerical gate, which rejects the pushes
         # WHOLE (typed poisoned); the workers recompute from (seed,
-        # version) and the run lands at the matched objective
+        # version) and the run lands at the matched objective.  The
+        # store is SHARDED: the gate runs at the push consume site,
+        # before shard routing, so a poisoned push never reaches any
+        # pipeline — the per-shard push counts stay equal (dense
+        # pushes touch every shard) even while poison rejects
         set_integrity(False)
         try:
             poison_drv = _make_replica(2, iters=2 * rep_iters)
+            poison_drv.set_store_shards(2)
             with inject_faults({"replica.push.wire": fp.corrupt_prob(
                     0.08, seed=seed + 87, kind="nan")}):
                 w_po, _ = poison_drv.optimize_with_history((X, y), w0)
@@ -818,6 +849,9 @@ def soak(seed: int = 0, iters: int = 40, verbose: bool = True,
         po_snap = poison_drv.last_store_snapshot
         assert po_snap["pushes_poisoned"] >= 1, po_snap
         assert po_snap["version"] == 2 * rep_iters, po_snap
+        assert po_snap["store_shards"] == 2, po_snap
+        assert len(set(po_snap["shard_pushes"])) == 1, (
+            f"poison admission skewed the shard routing: {po_snap}")
         obj_po = _objective(w_po)
         assert obj_po <= _objective(w_rep_ref) * 1.01, obj_po
 

@@ -1,0 +1,481 @@
+"""Sharded parameter store + SparCML tree-merged compressed pushes
+(``tpu_sgd/replica/shard.py``, ``io/sparse_wire.py`` merge,
+``plan.choose_store_shards``).
+
+The load-bearing pins:
+
+* τ=0 through a sharded store is BITWISE the synchronous meshed
+  trajectory at every S — sharding splits the COMBINE (contiguous
+  f32 slice accumulation commutes with concatenation bitwise), never
+  the updater's whole-vector apply (ADVICE.md "Shard the apply, not
+  the contract");
+* the HA composition survives sharding: a standby replaying per-shard
+  delta-log payload groups is bitwise the primary at every version,
+  and a mid-run primary kill at τ=0 stays bitwise the fault-free run;
+* a compressed workload confined to one shard replays ONLY that
+  shard's pipeline through a failover — replication bytes and replay
+  work scale with the touched coordinate range;
+* a rejected sharded compressed push restores its EF mass shard by
+  shard with nothing leaked;
+* the lock discipline (store ``_cond`` → pipeline ``_cond``, depth-1
+  per shard) holds on a LIVE sharded store, validated dynamically
+  against the same GRAFTLINT_LOCKS literals the lexical rule reads.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_sgd.config import SGDConfig
+from tpu_sgd.optimize.gradient_descent import GradientDescent
+from tpu_sgd.parallel.mesh import DATA_AXIS
+from tpu_sgd.io.sparse_wire import ErrorFeedback, merge_sparse_segments
+from tpu_sgd.ops.gradients import LeastSquaresGradient
+from tpu_sgd.ops.updaters import SquaredL2Updater
+from tpu_sgd.replica import (ReplicaDriver, ReplicaWorker,
+                             ShardedParameterStore, StoreFailed,
+                             StoreSupervisor, shard_offsets, shard_rows)
+from tpu_sgd.reliability import failpoints as fp
+from tpu_sgd.reliability.retry import RetryPolicy
+from tpu_sgd.utils.events import CollectingListener
+
+
+def _data(n=128, d=12, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (X @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    return X, y, np.zeros(d, np.float32)
+
+
+def _mesh(n_shards):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n_shards]), (DATA_AXIS,))
+
+
+def _driver(*, iters=12, frac=0.5, step=0.3, reg=0.1, workers=4, tau=0,
+            store_shards=1, standbys=0):
+    drv = (ReplicaDriver(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(step).set_num_iterations(iters)
+           .set_mini_batch_fraction(frac).set_convergence_tol(0.0)
+           .set_reg_param(reg).set_workers(workers).set_staleness(tau))
+    if store_shards > 1:
+        drv.set_store_shards(store_shards)
+    if standbys:
+        drv.set_standbys(standbys)
+    return drv
+
+
+def _sync_reference(X, y, w0, *, iters=12, frac=0.5, step=0.3, reg=0.1,
+                    workers=4):
+    opt = (GradientDescent(LeastSquaresGradient(), SquaredL2Updater())
+           .set_step_size(step).set_num_iterations(iters)
+           .set_mini_batch_fraction(frac).set_convergence_tol(0.0)
+           .set_reg_param(reg).set_mesh(_mesh(workers))
+           .set_listener(CollectingListener()))
+    w, h = opt.optimize_with_history((X, y), w0)
+    return np.asarray(w), np.asarray(h)
+
+
+def _cfg(**kw):
+    base = dict(step_size=0.2, num_iterations=20,
+                mini_batch_fraction=1.0, convergence_tol=0.0,
+                reg_param=0.01)
+    base.update(kw)
+    return SGDConfig(**base)
+
+
+def _sharded_pair(cfg, w0, *, n_shards=2, tau=0, primary_listener=None,
+                  standby_listener=None, **sup_kw):
+    """A sharded primary + sharded standby under a supervisor — the HA
+    composition unit (same shard count group-wide, like the driver)."""
+    ef = {}
+    primary = ShardedParameterStore(
+        SquaredL2Updater(), cfg, w0, n_shards=n_shards, staleness=tau,
+        listener=primary_listener, ef_registry=ef, name="s0")
+    standby = ShardedParameterStore(
+        SquaredL2Updater(), cfg, w0, n_shards=n_shards, staleness=tau,
+        listener=standby_listener, ef_registry=ef, name="s1")
+    sup = StoreSupervisor([primary, standby], **sup_kw)
+    return primary, standby, sup
+
+
+# -- shard layout -------------------------------------------------------------
+
+
+def test_shard_offsets_contiguous_balanced():
+    assert shard_offsets(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert shard_offsets(12, 4) == [(0, 3), (3, 6), (6, 9), (9, 12)]
+    # more shards than coordinates clamps to unit shards
+    assert shard_offsets(4, 8) == [(0, 1), (1, 2), (2, 3), (3, 4)]
+    # contiguity + full cover, arbitrary split
+    offs = shard_offsets(17, 5)
+    assert offs[0][0] == 0 and offs[-1][1] == 17
+    assert all(a[1] == b[0] for a, b in zip(offs, offs[1:]))
+
+
+# -- the SparCML merge --------------------------------------------------------
+
+
+def test_merge_sparse_segments_matches_dense_reference():
+    """The tree merge is an exact sparse sum at EVERY density
+    crossover — the crossover changes where the densification
+    happens, never the result (within f32 re-association tolerance
+    of the float64 reference)."""
+    rng = np.random.default_rng(0)
+    dim = 200
+    segs = []
+    for _ in range(7):
+        k = int(rng.integers(1, 40))
+        idx = rng.choice(dim, size=k, replace=False).astype(np.int32)
+        vals = rng.normal(size=k).astype(np.float32)
+        segs.append((idx, vals))
+    ref = np.zeros(dim, np.float64)
+    for i, v in segs:
+        np.add.at(ref, i, v.astype(np.float64))
+    for crossover in (0.0, 0.05, 0.25, 1.0):
+        out = merge_sparse_segments(segs, dim, crossover)
+        assert out.dtype == np.float32 and out.shape == (dim,)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_merge_sparse_segments_dedups_and_handles_empties():
+    # duplicate coordinates within and across segments sum
+    out = merge_sparse_segments(
+        [(np.asarray([3, 3, 1], np.int32),
+          np.asarray([1.0, 2.0, 4.0], np.float32)),
+         (np.asarray([3], np.int32), np.asarray([8.0], np.float32))],
+        dim=5, density_crossover=0.25)
+    np.testing.assert_array_equal(
+        out, np.asarray([0, 4, 0, 11, 0], np.float32))
+    # no contributions at all → zeros
+    np.testing.assert_array_equal(
+        merge_sparse_segments([], dim=3, density_crossover=0.25),
+        np.zeros(3, np.float32))
+    # empty segments drop, not crash
+    out = merge_sparse_segments(
+        [(np.asarray([], np.int32), np.asarray([], np.float32)),
+         (np.asarray([2], np.int32), np.asarray([5.0], np.float32))],
+        dim=3, density_crossover=1.0)
+    np.testing.assert_array_equal(
+        out, np.asarray([0, 0, 5.0], np.float32))
+
+
+# -- τ=0 bitwise vs sync, per shard count -------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_tau0_bitwise_vs_sync_per_shard_count(n_shards):
+    """THE acceptance pin: τ=0 through S apply pipelines is BITWISE
+    the synchronous meshed trajectory — weights AND loss history —
+    because per-shard slice accumulation in payload order is the same
+    f32 add chain as the sequential combine, and the whole-vector
+    jitted apply is untouched."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _sync_reference(X, y, w0)
+    drv = _driver(store_shards=n_shards)
+    w, h = drv.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w), w_ref)
+    np.testing.assert_array_equal(np.asarray(h), h_ref)
+    snap = drv.last_store_snapshot
+    if n_shards > 1:
+        assert snap["store_shards"] == n_shards
+        # dense pushes touch every shard: 12 versions × 4 workers
+        assert snap["shard_pushes"] == [48] * n_shards
+        assert snap["shard_applies"] == [12] * n_shards
+
+
+# -- HA composition -----------------------------------------------------------
+
+
+def test_sharded_standby_bitwise_at_every_version():
+    """Per-shard delta-log payload groups replay, they do not
+    approximate: the sharded standby's per-version loss and
+    weight-delta and its final weights are bitwise the sharded
+    primary's, and every dense record replays through every
+    pipeline."""
+    X, y, w0 = _data(n=128, d=8, seed=3)
+    cfg = _cfg(num_iterations=16, mini_batch_fraction=0.5,
+               step_size=0.3)
+    p_lis, s_lis = CollectingListener(), CollectingListener()
+    primary, standby, sup = _sharded_pair(
+        cfg, w0, n_shards=2, tau=0, primary_listener=p_lis,
+        standby_listener=s_lis)
+    client = sup.client()
+    shards = shard_rows(X, y, 2)
+    workers = [ReplicaWorker(f"w{s}", s, client, LeastSquaresGradient(),
+                             cfg, *shards[s]) for s in range(2)]
+    for s in range(2):
+        client.register_worker(f"w{s}", s)
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    sup.stop()  # drains the standby to the log head
+    np.testing.assert_array_equal(standby.loss_history(),
+                                  primary.loss_history())
+    np.testing.assert_array_equal(np.asarray(standby.weights),
+                                  np.asarray(primary.weights))
+    assert len(p_lis.iterations) == len(s_lis.iterations) == 16
+    for pe, se in zip(p_lis.iterations, s_lis.iterations):
+        assert (pe.iteration, pe.loss, pe.weight_delta_norm) == (
+            se.iteration, se.loss, se.weight_delta_norm)
+    # dense ssums groups touch both shards on every replayed record
+    s_snap = standby.snapshot()
+    assert s_snap["shard_replays"] == [16, 16]
+
+
+def test_tau0_kill_primary_sharded_bitwise_across_failover():
+    """The HA pin composed with sharding: τ=0 + sharded store + a
+    primary kill mid-run is STILL bitwise the fault-free UNSHARDED
+    run — failover replays the per-shard payload groups, the promoted
+    pipelines pick up where the log ends."""
+    X, y, w0 = _data()
+    w_ref, h_ref = _driver().optimize_with_history((X, y), w0)
+    drv = (_driver(store_shards=2, standbys=1)
+           .set_retry(RetryPolicy(max_attempts=400, base_backoff_s=0.01,
+                                  max_backoff_s=0.05, seed=7)))
+    with fp.inject_faults({"replica.store_fail":
+                           fp.fail_nth(48, exc=StoreFailed)}):
+        w_k, h_k = drv.optimize_with_history((X, y), w0)
+    assert drv.last_failover_snapshot["failovers"] == 1
+    np.testing.assert_array_equal(np.asarray(w_k), np.asarray(w_ref))
+    np.testing.assert_array_equal(h_k, h_ref)
+    snap = drv.last_store_snapshot
+    assert snap["store_shards"] == 2
+    # the promoted store replayed records into BOTH pipelines (dense
+    # groups) before taking over live pushes
+    assert all(r > 0 for r in snap["shard_replays"])
+
+
+def test_single_shard_failover_replays_only_its_gap():
+    """Compressed pushes confined to shard 0's coordinate range
+    produce ``stopk`` records whose shard-1 group is ``None`` —
+    through live replication AND the failover gap replay, pipeline 1
+    never replays and never counts a push.  Replay work scales with
+    the touched range, which is the point of the per-shard payload
+    groups."""
+    d = 16
+    cfg = _cfg(num_iterations=10, step_size=0.1)
+    w0 = np.zeros(d, np.float32)
+    primary, standby, sup = _sharded_pair(cfg, w0, n_shards=2, tau=2)
+    assert primary.shard_layout() == [(0, 8), (8, 16)]
+    client = sup.client()
+    for s in range(2):
+        client.register_worker(f"w{s}", s)
+    rng = np.random.default_rng(5)
+
+    def push_lower(wid):
+        pulled = client.pull(wid)
+        idx = np.asarray([0, 2, 5], np.int32)  # shard 0 only
+        vals = rng.normal(size=3).astype(np.float32)
+        r = client.push_compressed(wid, pulled.version, idx, vals,
+                                   0.5, 64.0)
+        assert r.accepted
+
+    for _ in range(3):
+        push_lower("w0")
+        push_lower("w1")
+    assert sup.kill_primary()
+    for _ in range(2):
+        push_lower("w0")
+        push_lower("w1")
+    sup.stop()
+    promoted = sup.primary()
+    assert promoted is standby
+    snap = promoted.snapshot()
+    assert snap["version"] == 10
+    assert snap["shard_replays"][0] >= 1   # the touched shard replays
+    assert snap["shard_replays"][1] == 0   # the untouched one NEVER
+    assert snap["shard_pushes"][1] == 0    # live pushes skip it too
+    rec = sup.snapshot()["records"][0]
+    assert rec["new_primary"] == "s1" and not rec["cold_recovery"]
+
+
+# -- EF mass conservation, per shard ------------------------------------------
+
+
+def test_rejected_sharded_compressed_push_restores_ef_per_shard():
+    """A stale compressed push rejected by a sharded store restores
+    its EF segment shard by shard with ZERO leaked mass — each
+    shard's restore lands exactly its own coordinate range."""
+    d = 16
+    cfg = _cfg(num_iterations=10, step_size=0.1)
+    store = ShardedParameterStore(
+        SquaredL2Updater(), cfg, np.zeros(d, np.float32), n_shards=2,
+        staleness=1)
+    try:
+        store.register_worker("w0", 0)
+        store.register_worker("w1", 1)
+        ef = store.error_feedback("w0", 0.5)
+        rng = np.random.default_rng(9)
+        update = rng.normal(size=d).astype(np.float32)
+        idx, vals = ef.compress(update.copy())
+        # advance the store 2 versions past w0's basis (tau=1)
+        g = rng.normal(size=d).astype(np.float32)
+        assert store.push("w1", 0, g, 0.5, 8.0).accepted
+        assert store.push("w0", 0, g, 0.5, 8.0).accepted
+        res = store.push_compressed("w0", 0, idx, vals, 0.5, 8.0)
+        assert not res.accepted and res.staleness > 1
+        # the worker-side heal, split exactly as the wire was: restore
+        # shard 0's segment → ONLY coords [0, 8) are whole again
+        (a0, b0), (a1, b1) = store.shard_layout()
+        m0 = (idx >= a0) & (idx < b0)
+        ef.restore_segment(idx[m0], vals[m0])
+        np.testing.assert_allclose(ef.acc[a0:b0], update[a0:b0],
+                                   rtol=1e-5)
+        if np.any(~m0):  # mass extracted from shard 1 still missing
+            assert not np.allclose(ef.acc[a1:b1], update[a1:b1])
+        ef.restore_segment(idx[~m0], vals[~m0])
+        np.testing.assert_allclose(ef.acc, update, rtol=1e-5)
+    finally:
+        store.stop()
+
+
+# -- lock discipline ----------------------------------------------------------
+
+
+def test_sharded_store_lock_discipline_validated_at_runtime():
+    """GRAFTLINT_LOCKS for the store AND every pipeline, validated
+    dynamically on a live sharded run — the runtime twin of the
+    lexical rule, proving the two-level discipline (store ``_cond`` →
+    pipeline ``_cond``, never the reverse) holds under real worker
+    concurrency."""
+    from tpu_sgd.analysis.runtime import instrument_object
+    from tpu_sgd.replica import shard as shard_mod
+    from tpu_sgd.replica import store as store_mod
+
+    X, y, w0 = _data(n=64, d=6)
+    cfg = _cfg(num_iterations=20, step_size=0.2,
+               mini_batch_fraction=0.5)
+    store = ShardedParameterStore(
+        SquaredL2Updater(), cfg, w0, n_shards=2, staleness=1)
+    recorders = [instrument_object(
+        store, store_mod.GRAFTLINT_LOCKS["ParameterStore"])]
+    recorders += [
+        instrument_object(p, shard_mod.GRAFTLINT_LOCKS["ShardPipeline"])
+        for p in store._pipes]
+    shards = shard_rows(X, y, 2)
+    workers = [ReplicaWorker(f"w{s}", s, store, LeastSquaresGradient(),
+                             cfg, *shards[s]) for s in range(2)]
+    for s in range(2):
+        store.register_worker(f"w{s}", s)
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    store.stop()
+    assert store.version == 20
+    assert sum(r.checked_accesses for r in recorders) > 0
+    for r in recorders:
+        assert r.violations == []
+
+
+# -- the planner --------------------------------------------------------------
+
+
+def test_choose_store_shards_small_model_stays_unsharded():
+    from tpu_sgd.plan import choose_store_shards
+
+    # a 12-wide update's wire never dominates one dispatch
+    assert choose_store_shards(256, 12, n_devices=8) == 1
+    # one device cannot place pipelines worth having
+    assert choose_store_shards(2000, 20_000_000, n_devices=1) == 1
+
+
+def test_choose_store_shards_wide_model_shards_and_clamps():
+    from tpu_sgd.plan import choose_store_shards
+
+    s8 = choose_store_shards(2_000_000, 20_000_000, n_devices=8)
+    assert 1 < s8 <= 8
+    # dispatch dominance: each pipeline's wire share must still beat
+    # one dispatch, so S stops growing when the share shrinks under it
+    s4 = choose_store_shards(2_000_000, 20_000_000, n_devices=4)
+    assert 1 < s4 <= 4 and s4 <= s8
+
+
+def test_choose_replicas_grows_with_store_shards():
+    from tpu_sgd.plan import choose_replicas
+
+    # store-bound regime: the wide update wire throttles the fleet a
+    # single-pipeline store can feed; sharding the combine relieves it
+    w1 = choose_replicas(2000, 20_000_000, n_devices=8)
+    w4 = choose_replicas(2000, 20_000_000, n_devices=8, store_shards=4)
+    assert w4 > w1 >= 2
+
+
+def test_plan_exposes_store_shards():
+    from tpu_sgd.plan import DEFAULT_COST_MODEL, plan
+
+    assert DEFAULT_COST_MODEL.sparse_merge_density == 0.25
+    small = plan(256, 12, n_devices=8)
+    assert small.store_shards == 1
+    wide = plan(2_000_000, 20_000_000, n_devices=8)
+    assert wide.store_shards > 1
+    assert wide.estimates["store_shards"] == wide.store_shards
+
+
+# -- the obs surface ----------------------------------------------------------
+
+
+def test_record_wire_shard_tag_fans_out_counter_series():
+    from tpu_sgd.obs import counters as obs_counters
+
+    obs_counters.enable()
+    obs_counters.reset()
+    try:
+        obs_counters.record_wire("dense-f32", 128, 128, tag="s0")
+        obs_counters.record_wire("dense-f32", 128, 64, tag="s1")
+        snap = obs_counters.snapshot()
+    finally:
+        obs_counters.disable()
+    tagged = {n for n in snap
+              if ".wire.dense-f32[" in n and not n.endswith(".logical")}
+    assert len(tagged) == 2
+    ratios = obs_counters.wire_ratios(snap)
+    by_tag = {n[n.index("["):]: r for n, r in ratios.items()
+              if "[" in n}
+    assert by_tag["[s0]"]["physical_bytes"] == 128
+    assert by_tag["[s1]"]["physical_bytes"] == 64
+    assert by_tag["[s1]"]["logical_bytes"] == 128
+
+
+def test_shard_imbalance_detector_trips_on_lagging_shard_only():
+    from tpu_sgd.obs.detect import (DetectorEngine,
+                                    ShardImbalanceDetector,
+                                    default_detectors)
+
+    # an operator opt-in fixture, NOT in the defaults (the
+    # LossPlateauDetector precedent)
+    assert "shard-imbalance" not in {d.rule for d in default_detectors()}
+
+    def _win(idx, series):
+        return {"index": idx, "t_start": float(idx),
+                "t_end": float(idx) + 1.0, "series": series}
+
+    def _cnt(n):
+        return {"count": n, "sum": 0.0, "mean": 0.0, "max": None,
+                "bytes": 0}
+
+    eng = DetectorEngine([ShardImbalanceDetector()])
+    # balanced: no trip
+    eng.on_window_close(_win(0, {"replica.shard.push[s0]": _cnt(20),
+                                 "replica.shard.push[s1]": _cnt(18)}))
+    assert eng.trip_counts() == {}
+    # one shard lags below half the busiest: trips
+    eng.on_window_close(_win(1, {"replica.shard.push[s0]": _cnt(20),
+                                 "replica.shard.push[s1]": _cnt(2)}))
+    assert eng.trip_counts() == {"shard-imbalance": 1}
+    # a quiet window (busiest under the floor) cannot trip on noise
+    eng2 = DetectorEngine([ShardImbalanceDetector()])
+    eng2.on_window_close(_win(0, {"replica.shard.push[s0]": _cnt(4),
+                                  "replica.shard.push[s1]": _cnt(0)}))
+    assert eng2.trip_counts() == {}
+    # a single series (unsharded store) never trips
+    eng2.on_window_close(_win(1, {"replica.shard.push[s0]": _cnt(50)}))
+    assert eng2.trip_counts() == {}

@@ -184,6 +184,80 @@ class ErrorFeedback:
         self.acc = acc.astype(self.acc.dtype, copy=True)
 
 
+# -- SparCML stream aggregation (arXiv:1802.08021) ---------------------------
+
+
+def _merge_pair(a, b):
+    """Merge two sparse ``(indices, values)`` segments into one
+    deduplicated segment: concatenate, stable-sort by index, and
+    reduce runs of equal indices with ``np.add.reduceat`` — within a
+    run the summation order is the concatenation order (stable sort),
+    so the merge is a deterministic function of its inputs."""
+    idx = np.concatenate([a[0], b[0]])
+    vals = np.concatenate([a[1], b[1]])
+    order = np.argsort(idx, kind="stable")
+    idx = idx[order]
+    vals = vals[order]
+    starts = np.flatnonzero(np.r_[True, idx[1:] != idx[:-1]])
+    return idx[starts], np.add.reduceat(vals, starts)
+
+
+def merge_sparse_segments(segments, dim: int,
+                          density_crossover: float = 0.25) -> np.ndarray:
+    """SparCML stream aggregation (arXiv:1802.08021) of top-k
+    ``(indices, values)`` contributions: merge segments PAIRWISE up a
+    tree — each round halves the segment count while the merged
+    segments stay sparse — and switch to a DENSE accumulator the
+    moment any merged segment's density (nnz / dim) crosses
+    ``density_crossover``, scatter-adding the remaining segments into
+    it.  The crossover is the paper's representation switch: a sparse
+    merge costs O(nnz log nnz) per pair and re-pays only while the
+    union stays sparse; once contributions overlap enough that the
+    union approaches dense, the O(dim) dense add is strictly cheaper.
+    The threshold is a cost-model knob
+    (``plan.CostModel.sparse_merge_density``) plumbed next to
+    ``wire_compress_frac``.
+
+    Returns the DENSE f32 sum vector of shape ``(dim,)`` — the sharded
+    store's apply consumes a dense accumulator either way
+    (``tpu_sgd/replica/shard.py``).  Deterministic given the segment
+    ORDER (the caller passes payloads in shard order), which is what
+    keeps a primary and its standby bitwise against each other: both
+    replay the identical segment list through this identical tree.
+    Segments may be empty; duplicate indices WITHIN a segment are
+    summed (scatter-add semantics, matching the dense scatter the flat
+    gather used)."""
+    dim = int(dim)
+    segs = []
+    for si, sv in segments:
+        si = np.asarray(si, np.int64).reshape(-1)
+        sv = np.asarray(sv, np.float32).reshape(-1)
+        if si.size:
+            segs.append((si, sv))
+    if not segs:
+        return np.zeros((dim,), np.float32)
+    nnz_cap = max(1, int(np.ceil(float(density_crossover) * dim)))
+    while len(segs) > 1:
+        merged = []
+        for j in range(0, len(segs) - 1, 2):
+            merged.append(_merge_pair(segs[j], segs[j + 1]))
+        if len(segs) % 2:
+            merged.append(segs[-1])
+        segs = merged
+        if any(si.size > nnz_cap for si, _ in segs):
+            # density crossover: the unions stopped being sparse —
+            # finish with one dense accumulator, remaining segments
+            # scatter-added in list order (still deterministic)
+            out = np.zeros((dim,), np.float32)
+            for si, sv in segs:
+                np.add.at(out, si, sv)
+            return out
+    out = np.zeros((dim,), np.float32)
+    si, sv = segs[0]
+    np.add.at(out, si, sv)
+    return out
+
+
 # -- fixed-nse sparse chunk planning / staging -------------------------------
 
 

@@ -128,7 +128,8 @@ def inc(name: str, n: int = 1, nbytes: int = 0) -> None:
     _GLOBAL.inc(name, n, nbytes)
 
 
-def record_wire(fmt: str, logical_nbytes: int, physical_nbytes: int) -> None:
+def record_wire(fmt: str, logical_nbytes: int, physical_nbytes: int,
+                tag: Optional[str] = None) -> None:
     """Tag one wire transfer by FORMAT (``dense-f32`` / ``bf16`` /
     ``bcoo`` / ``topk``): ``physical`` is what actually crosses the
     link, ``logical`` the dense-f32-equivalent payload it represents —
@@ -137,11 +138,18 @@ def record_wire(fmt: str, logical_nbytes: int, physical_nbytes: int) -> None:
     :func:`wire_ratios` computes it).  Counter names:
     ``<subsystem>.wire.<fmt>`` carries the physical bytes,
     ``<subsystem>.wire.<fmt>.logical`` the logical bytes, both with one
-    ``n`` per transfer.  Same disabled-mode cost contract as
+    ``n`` per transfer.  ``tag`` fans the format out per-instance with
+    the SAME bracket syntax the span/event fan-outs use
+    (``<subsystem>.wire.<fmt>[<tag>]`` — e.g. the sharded store's
+    per-shard wires tag ``s0..s{S-1}``); consumers that key on the
+    format (the wire-ratio detector's exempt list) strip the bracket
+    suffix before comparing.  Same disabled-mode cost contract as
     :func:`inc` — one global load + falsy branch."""
     if not _ENABLED:
         return
     base = f"{_tagged('wire')}.{fmt}"
+    if tag is not None:
+        base = f"{base}[{tag}]"
     _GLOBAL.inc(base, nbytes=int(physical_nbytes))
     _GLOBAL.inc(base + ".logical", nbytes=int(logical_nbytes))
 

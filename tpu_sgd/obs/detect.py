@@ -367,6 +367,11 @@ class WireRatioDetector(Detector):
             if ".wire." not in name or name.endswith(".logical"):
                 continue
             fmt = name.rsplit(".", 1)[1]
+            # per-instance fan-out (record_wire's tag arg — e.g. the
+            # sharded store's ``...wire.dense-f32[s0]``): the exempt
+            # list keys on the FORMAT, so strip the bracket suffix
+            if fmt.endswith("]") and "[" in fmt:
+                fmt = fmt[:fmt.index("[")]
             if fmt in self.EXEMPT:
                 continue
             phys = s["bytes"]
@@ -384,6 +389,46 @@ class WireRatioDetector(Detector):
                 out.append(self._alert(
                     window, name, ratio, self.min_ratio,
                     f"{phys} physical vs {logical} logical bytes"))
+        return out
+
+
+class ShardImbalanceDetector(Detector):
+    """Sharded-store balance sensor (NOT in the defaults — the
+    ``LossPlateauDetector`` precedent: an operator opt-in, not an
+    anomaly by default).  Contiguous equal-width ranges make DENSE
+    push routing balanced by construction; on a COMPRESSED workload
+    the per-shard ``replica.shard.push[sK]`` counts follow where the
+    top-k mass concentrates, and a shard going quiet means one
+    pipeline does most of the combine work — the sharding stopped
+    paying.  Trips per lagging shard when its window count falls below
+    ``min_frac`` of the busiest shard's (floor ``min_count`` on the
+    busiest, so idle windows cannot trip on noise)."""
+
+    rule = "shard-imbalance"
+
+    def __init__(self, prefix: str = "replica.shard.push",
+                 min_frac: float = 0.5, min_count: int = 8):
+        self.prefix = prefix
+        self.min_frac = float(min_frac)
+        self.min_count = int(min_count)
+
+    def evaluate(self, window, history):
+        counts = {}
+        for name, s in window["series"].items():
+            if (name.startswith(self.prefix + "[")
+                    and name.endswith("]")):
+                counts[name] = int(s["count"])
+        if len(counts) < 2:
+            return []
+        busiest = max(counts.values())
+        if busiest < self.min_count:
+            return []
+        out = []
+        for name, c in sorted(counts.items()):
+            if c < self.min_frac * busiest:
+                out.append(self._alert(
+                    window, name, float(c), self.min_frac * busiest,
+                    f"{c} shard pushes vs busiest shard's {busiest}"))
         return out
 
 

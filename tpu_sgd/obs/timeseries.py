@@ -129,6 +129,11 @@ EVENT_FANOUT: Dict[str, str] = {
     # fleet-wide stall must not read as one worker lagging), the
     # fanned ``replica.failover[s1]`` series names the promoted store
     "replica.failover": "new_primary",
+    # the sharded store's per-shard push routing
+    # (tpu_sgd/replica/shard.py): one event per touched shard per
+    # push, fanned by shard id into ``replica.shard.push[s0]``-style
+    # count series — the shard-imbalance detector's feed
+    "replica.shard.push": "shard",
 }
 
 #: fast-path gate (the failpoints discipline): every hook reads this

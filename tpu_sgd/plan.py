@@ -141,6 +141,13 @@ class CostModel:
     #: of coordinates = ~50x fewer physical bytes (value + int32 index
     #: per entry), the SparCML operating point
     wire_compress_frac: float = 0.01
+    #: density (nnz / dim) at which the sharded store's SparCML
+    #: pairwise segment merge switches to a dense accumulator
+    #: (``io.sparse_wire.merge_sparse_segments``; arXiv:1802.08021's
+    #: representation crossover): a sparse merge costs O(nnz log nnz)
+    #: per pair and only re-pays while the union stays sparse — past
+    #: this density the O(dim) dense scatter-add is strictly cheaper
+    sparse_merge_density: float = 0.25
     #: set by :meth:`calibrate` — raw probe readings plus which probes
     #: were rejected and fell back to the persisted defaults; excluded
     #: from equality/repr (two models with the same rates ARE the same
@@ -343,6 +350,14 @@ class Plan:
     #: the USER's call; this field is the sizing advice they read when
     #: they make it
     replicas: int = 0
+    #: store-shard count for the async store's apply plane
+    #: (``tpu_sgd/replica/shard.py``; ``choose_store_shards``): how
+    #: many per-shard apply pipelines the cost model says pay at this
+    #: width (1 = unsharded).  Sizing advice with the same contract as
+    #: :attr:`replicas` — the driver only shards when the user asks
+    #: (``ReplicaDriver.set_store_shards``); also in
+    #: ``estimates["store_shards"]``
+    store_shards: int = 1
     estimates: dict = dataclasses.field(default_factory=dict)
 
     def describe(self) -> str:
@@ -697,7 +712,7 @@ def choose_replicas(n: int, d: int, itemsize: int = 4,
                     n_devices: int = 1,
                     mini_batch_fraction: float = 1.0,
                     cost_model: CostModel = DEFAULT_COST_MODEL,
-                    cap: int = 8) -> int:
+                    cap: int = 8, store_shards: int = 1) -> int:
     """Replica-worker count W for the async bounded-staleness driver
     (``tpu_sgd/replica``), from the existing cost model.
 
@@ -719,10 +734,20 @@ def choose_replicas(n: int, d: int, itemsize: int = 4,
     Like :data:`Plan.replicas`, this is SIZING advice, not a schedule
     decision: ``tau > 0`` changes the update rule (matched final loss,
     not matched trajectory), so the async switch itself is always the
-    user's."""
+    user's.
+
+    ``store_shards``: the store's apply-pipeline count
+    (:func:`choose_store_shards`; ``tpu_sgd/replica/shard.py``).  A
+    sharded store splits the per-push COMBINE across S pipelines, so
+    only the update wire scales down by S — the one whole-vector apply
+    dispatch stays serialized (the updater is not per-coordinate
+    separable; ADVICE.md "Shard the apply, not the contract").  The
+    pre-shard model charged the full wire to every push, silently
+    understating the fleet a sharded store can feed."""
     cm = cost_model
     store_s = (cm.dispatch_overhead_s
-               + 2.0 * d * 4.0 / (cm.allreduce_gb_s * 1e9))
+               + 2.0 * d * 4.0
+               / (max(1, int(store_shards)) * cm.allreduce_gb_s * 1e9))
     best = 0
     # an empty range when fewer than 2 devices: a single device cannot
     # place a fleet, whatever the cost model says
@@ -732,6 +757,42 @@ def choose_replicas(n: int, d: int, itemsize: int = 4,
                      * itemsize / (cm.hbm_gb_s * 1e9))
         if w * store_s <= REPLICA_STORE_HEADROOM * compute_s:
             best = w
+    return best
+
+
+def choose_store_shards(n: int, d: int, itemsize: int = 4,
+                        n_devices: int = 1,
+                        workers: int = 2,
+                        mini_batch_fraction: float = 1.0,
+                        cost_model: CostModel = DEFAULT_COST_MODEL,
+                        cap: int = 8) -> int:
+    """Store-shard count S for the sharded parameter store
+    (``tpu_sgd/replica/shard.py``): the largest S — clamped by the
+    device count and ``cap`` — whose per-shard pipeline keeps
+    :data:`REPLICA_STORE_HEADROOM` headroom under a ``workers``-strong
+    fleet's push arrival rate, subject to DISPATCH DOMINANCE: each
+    added pipeline replicates the fixed apply-dispatch tax
+    (``dispatch_overhead_s``), so splitting only pays while the
+    per-shard share of the update wire (``2 * d * 4 / S`` bytes at
+    ``allreduce_gb_s``) still dominates one dispatch.  Small models
+    return 1 (unsharded — the wire never dominated); wide models
+    return the largest S the clamps allow.  Sizing advice with the
+    same contract as :func:`choose_replicas`: the driver only shards
+    when the user asks (``ReplicaDriver.set_store_shards``)."""
+    cm = cost_model
+    w = max(2, int(workers))
+    transfer_s = 2.0 * d * 4.0 / (cm.allreduce_gb_s * 1e9)
+    rows_local = max(1.0, float(n) / w)
+    compute_s = (2.0 * rows_local * mini_batch_fraction * d
+                 * itemsize / (cm.hbm_gb_s * 1e9))
+    best = 1
+    for s in range(2, min(int(n_devices), int(cap)) + 1):
+        if transfer_s / s < cm.dispatch_overhead_s:
+            break  # dispatch dominance: the (s-1)-way split already
+            # shrank the wire below one dispatch tax
+        if (w * (cm.dispatch_overhead_s + transfer_s / s)
+                <= REPLICA_STORE_HEADROOM * compute_s):
+            best = s
     return best
 
 
@@ -1073,7 +1134,19 @@ def plan(
     # just what the cost model says a fleet could be if they make it
     replicas = choose_replicas(n, d, itemsize, n_devices,
                                mini_batch_fraction=frac, cost_model=cm)
+    # two-pass sizing: the single-apply fleet estimate feeds the shard
+    # choice, then the replica advice is re-derived against the sharded
+    # store (the fix for the stale single-apply model)
+    store_shards = choose_store_shards(
+        n, d, itemsize, n_devices, workers=max(2, replicas),
+        mini_batch_fraction=frac, cost_model=cm)
+    if store_shards > 1:
+        replicas = choose_replicas(n, d, itemsize, n_devices,
+                                   mini_batch_fraction=frac,
+                                   cost_model=cm,
+                                   store_shards=store_shards)
     est["replicas"] = replicas
+    est["store_shards"] = store_shards
 
     if not host_resident_ok and chosen.schedule in (
             "partial_residency", "host_streamed", "streamed_virtual_gram"):
@@ -1108,8 +1181,10 @@ def plan(
                 f"fit the budget (sampling={sampling!r}, frac={frac}, "
                 f"n_devices={n_devices})"
             )
-        return dataclasses.replace(forced, replicas=replicas)
-    return dataclasses.replace(chosen, replicas=replicas)
+        return dataclasses.replace(forced, replicas=replicas,
+                                   store_shards=store_shards)
+    return dataclasses.replace(chosen, replicas=replicas,
+                               store_shards=store_shards)
 
 
 def _forced_plan(force, chosen, est, *, fits, free_hbm, data_bytes_local,

@@ -12,6 +12,9 @@ Layers, bottom-up:
   store: lock-disciplined delta inbox, jitted donated apply, τ=0
   barrier-and-combine (bitwise the synchronous data-parallel
   trajectory), checkpointing with per-worker EF extras;
+* ``shard``      — the sharded store (ROADMAP item 3): S per-shard
+  apply pipelines behind the same contract, SparCML tree-merged
+  compressed pushes, per-shard delta-log payload groups;
 * ``worker``     — one replica: pull → local shard gradient (the
   shared ``_make_local_sums`` sampling recipe, shard index folded) →
   push, under failpoint/retry healing;
@@ -31,6 +34,8 @@ from tpu_sgd.replica.ha import (DeltaLog, DeltaRecord, StandbyReplica,
                                 StoreClient, StoreFailed, StoreFenced,
                                 StoreSupervisor, StoreUnreachable)
 from tpu_sgd.replica.membership import ReplicaMembership, WorkerRecord
+from tpu_sgd.replica.shard import (ShardedParameterStore, ShardPipeline,
+                                   shard_offsets)
 from tpu_sgd.replica.staleness import PushDecision, StalenessContract
 from tpu_sgd.replica.store import ParameterStore, PulledState, PushResult
 from tpu_sgd.replica.worker import ReplicaWorker, make_shard_local_sums
@@ -40,6 +45,9 @@ __all__ = [
     "ReplicaMembership",
     "ReplicaWorker",
     "ParameterStore",
+    "ShardedParameterStore",
+    "ShardPipeline",
+    "shard_offsets",
     "PulledState",
     "PushResult",
     "PushDecision",

@@ -119,6 +119,7 @@ class ReplicaDriver:
         self.n_workers = int(n_workers)
         self.staleness = staleness
         self.n_standbys = 0
+        self.store_shards = 1
         self.poison_guard: object = 10.0
         self._integrity_rollback = False
         self.wire_compress = None
@@ -196,6 +197,19 @@ class ReplicaDriver:
         if int(n) < 0:
             raise ValueError(f"n_standbys must be >= 0, got {n}")
         self.n_standbys = int(n)
+        return self
+
+    def set_store_shards(self, n: int):
+        """``n >= 2`` shards the parameter store's apply plane: each
+        push's coordinates split across ``n`` per-shard pipelines that
+        combine in parallel before the ONE whole-vector apply
+        (``tpu_sgd/replica/shard.py``; README "Sharded store").  Every
+        store contract — τ=0 bitwise, the delta log, failover — is
+        preserved at any ``n``; ``plan.choose_store_shards`` is the
+        sizing advice.  ``1`` (default) keeps the unsharded store."""
+        if int(n) < 1:
+            raise ValueError(f"store_shards must be >= 1, got {n}")
+        self.store_shards = int(n)
         return self
 
     def set_poison_guard(self, k):
@@ -381,6 +395,16 @@ class ReplicaDriver:
         devices = (self.devices if self.devices is not None
                    else list(jax.devices()))
         membership = ReplicaMembership(listener=self.listener)
+        # store_shards > 1 swaps in the sharded store; at 1 the plain
+        # store is constructed — the single-pipeline path stays
+        # code-identical to before (tpu_sgd/replica/shard.py)
+        if self.store_shards > 1:
+            from tpu_sgd.replica.shard import ShardedParameterStore
+            _store_cls = ShardedParameterStore
+            _shard_kw: dict = {"n_shards": self.store_shards}
+        else:
+            _store_cls = ParameterStore
+            _shard_kw = {}
         supervisor = None
         # armed integrity rollback implies the HA supervisor even with
         # zero standbys: a rollback IS a (cold) failover to your own
@@ -398,11 +422,15 @@ class ReplicaDriver:
 
             def _mk_store(name, *, listener=None, manager=None,
                           resume=resume_state, weights=w0):
-                return ParameterStore(
+                # every store in the group gets the SAME shard count:
+                # a standby's replay of a per-shard payload group must
+                # route identically to the primary's combine
+                return _store_cls(
                     self.updater, cfg, weights,
                     staleness=self.staleness, device=devices[0],
                     listener=listener, checkpoint_manager=manager,
                     checkpoint_every=self.checkpoint_every,
+                    **_shard_kw,
                     config_key=config_key, resume_state=resume,
                     epoch=epoch0, ef_registry=shared_ef, name=name,
                     poison_guard=self.poison_guard,
@@ -431,7 +459,7 @@ class ReplicaDriver:
             )
             store = supervisor.client()
         else:
-            store = ParameterStore(
+            store = _store_cls(
                 self.updater, cfg, w0,
                 staleness=self.staleness, device=devices[0],
                 listener=self.listener,
@@ -439,6 +467,7 @@ class ReplicaDriver:
                 checkpoint_every=self.checkpoint_every,
                 config_key=config_key, resume_state=resume_state,
                 poison_guard=self.poison_guard,
+                **_shard_kw,
             )
         rejoin = (self.rejoin_policy if self.rejoin_policy is not None
                   else RetryPolicy(max_attempts=5, base_backoff_s=0.01,

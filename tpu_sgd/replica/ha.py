@@ -150,7 +150,11 @@ class DeltaRecord(NamedTuple):
     """One applied version, as replayable bytes: the round's admitted
     gradient contributions (HOST numpy, shard order) plus the epoch and
     the version the apply produced.  ``kind`` is ``"sums"`` (dense
-    wire) or ``"topk"`` (compressed wire).  ``checksum`` seals the
+    wire), ``"topk"`` (compressed wire), or their sharded-store
+    spellings ``"ssums"`` / ``"stopk"`` whose payloads carry per-shard
+    groups — ``None`` for an untouched shard, so replication bytes
+    scale with the touched coordinate range
+    (``tpu_sgd/replica/shard.py``).  ``checksum`` seals the
     payload bytes at capture (the primary's apply) and is verified at
     the CONSUME site — the standby's replay — so a record damaged in
     the log (or on a real network hop) raises typed
@@ -177,6 +181,23 @@ def record_arrays(record: DeltaRecord) -> list:
         if p[0] == "sums":
             out.extend((np.asarray(p[1]), np.asarray(p[2]),
                         np.asarray(p[3])))
+        elif p[0] == "ssums":
+            # sharded dense (tpu_sgd/replica/shard.py): the per-shard
+            # slices in shard order, then the scalar pair
+            out.extend(np.asarray(s) for s in p[1])
+            out.extend((np.asarray(p[2]), np.asarray(p[3])))
+        elif p[0] == "stopk":
+            # sharded compressed: a shard-presence mask FIRST (None
+            # groups carry no arrays, so without it a damaged mask —
+            # a segment silently dropped or misrouted in the log —
+            # would digest identically), then each touched shard's
+            # (local idx, vals), then the packed scalars
+            out.append(np.asarray(
+                [0 if s is None else 1 for s in p[1]], np.int64))
+            for s in p[1]:
+                if s is not None:
+                    out.extend((np.asarray(s[0]), np.asarray(s[1])))
+            out.append(np.asarray([p[2], p[3]], np.float64))
         else:  # topk: (tag, idx, vals, loss_sum, count)
             out.extend((np.asarray(p[1]), np.asarray(p[2]),
                         np.asarray([p[3], p[4]], np.float64)))
@@ -916,10 +937,26 @@ class StoreClient:
     def push_compressed(self, worker_id: str, basis_version: int,
                         indices, values, loss_sum: float, count: float,
                         *, basis_epoch: Optional[int] = None,
-                        checksum: Optional[int] = None):
+                        checksum: Optional[int] = None,
+                        shard_seals=None):
+        if shard_seals is None:
+            # a plain (unsharded) store's signature has no shard_seals
+            # kwarg — forward only what the callee accepts
+            return self._op(worker_id, "push_compressed", worker_id,
+                            basis_version, indices, values, loss_sum,
+                            count, basis_epoch=basis_epoch,
+                            checksum=checksum)
         return self._op(worker_id, "push_compressed", worker_id,
                         basis_version, indices, values, loss_sum, count,
-                        basis_epoch=basis_epoch, checksum=checksum)
+                        basis_epoch=basis_epoch, checksum=checksum,
+                        shard_seals=shard_seals)
+
+    def shard_layout(self):
+        """The settled primary's per-shard coordinate ranges (or
+        ``None`` — unsharded).  Every store in a supervised group is
+        built with the SAME shard count (the driver's ``_mk_store``),
+        so the layout is failover-stable and workers may cache it."""
+        return self._sup.settled_primary().shard_layout()
 
     # -- driver surface (forwarded to the settled primary) -------------------
     def register_worker(self, worker_id: str, shard_index: int) -> None:

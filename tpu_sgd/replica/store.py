@@ -69,6 +69,16 @@ damaged in place — see :meth:`weights_healthy`) is healed by
 ``ha.RollbackController``: fence this line, restore the last good
 checkpoint with an epoch bump, replay.
 
+Sharding (ROADMAP item 3; ``tpu_sgd/replica/shard.py``; README
+"Sharded store"; ADVICE.md "Shard the apply, not the contract"): the
+combine — NOT the updater — is where per-push work is separable, so
+:class:`~tpu_sgd.replica.shard.ShardedParameterStore` overrides the
+``_combine_*_locked`` hooks below to accumulate disjoint contiguous
+coordinate ranges on S parallel per-shard pipelines (disjoint ranges
+commute — arXiv:1505.04956) and reassembles before the ONE whole-vector
+apply, keeping every contract on this page — τ=0 bitwise, the delta
+log, the epoch fence — intact.
+
 Lock discipline: ONE condition (``_cond``) guards all mutable state —
 version/weights/inbox/membership mirror/EF registry — because the τ=0
 barrier needs to *wait* on round application, and a second lock would
@@ -648,6 +658,42 @@ class ParameterStore:
             return PushResult(True, self._version, decision.staleness,
                               self._done_locked())
 
+    def _combine_sums_locked(self, payloads):
+        """Combine admitted DENSE payloads (payload order = shard order
+        for a τ=0 round) into device ``(grad_sum, loss_sum, count)`` —
+        the psum re-association the τ=0 bitwise contract pins.  The
+        sharded store (``tpu_sgd/replica/shard.py``) overrides this to
+        run the same coordinate-wise add chain per shard in parallel;
+        the apply itself stays whole-vector either way."""
+        _, g, l, c = payloads[0]
+        for _, gi, li, ci in payloads[1:]:
+            g, l, c = self._acc3(g, l, c, gi, li, ci)
+        return g, l, c
+
+    def _combine_topk_locked(self, payloads):
+        """Combine admitted COMPRESSED payloads into a dense device
+        accumulator plus host ``(loss_sum, count)`` scalars — the flat
+        sequential scatter; the sharded store overrides this with the
+        SparCML per-shard tree merge
+        (:func:`~tpu_sgd.io.sparse_wire.merge_sparse_segments`)."""
+        g = jax.device_put(np.zeros((self._dim,), np.float32),
+                           self._device)
+        l_host = 0.0
+        c_host = 0.0
+        for _, idx, vals, li, ci in payloads:
+            g = self._scatter(g, idx, vals)
+            l_host += li
+            c_host += ci
+        return g, l_host, c_host
+
+    def shard_layout(self):
+        """Per-shard ``(start, stop)`` coordinate ranges of a SHARDED
+        store (``tpu_sgd/replica/shard.py``), or ``None``: this store
+        applies the whole vector through one pipeline.  Workers probe
+        this once to decide whether to seal compressed segments
+        per-shard."""
+        return None
+
     def _round_complete_locked(self) -> bool:
         return bool(self._active) and set(self._active) <= set(self._inbox)
 
@@ -677,22 +723,13 @@ class ParameterStore:
         ship = (None if self._replication is None
                 else [self._host_payload(p) for p in payloads])
         with span("replica.apply", version=i, n_payloads=len(payloads)):
-            if payloads[0][0] == "sums":
-                _, g, l, c = payloads[0]
-                for _, gi, li, ci in payloads[1:]:
-                    g, l, c = self._acc3(g, l, c, gi, li, ci)
+            if payloads[0][0] in ("sums", "ssums"):
+                g, l, c = self._combine_sums_locked(payloads)
                 new_w, loss_i, new_reg = self._apply_sums(
                     self._w, g, l, c, i_dev, rv_dev)
                 count = c
             else:
-                g = jax.device_put(np.zeros((self._dim,), np.float32),
-                                   self._device)
-                l_host = 0.0
-                c_host = 0.0
-                for _, idx, vals, li, ci in payloads:
-                    g = self._scatter(g, idx, vals)
-                    l_host += li
-                    c_host += ci
+                g, l_host, c_host = self._combine_topk_locked(payloads)
                 new_w, loss_i, new_reg = self._apply_mean(
                     self._w, g, jnp.asarray(len(payloads), jnp.float32),
                     jnp.asarray(l_host, jnp.float32),

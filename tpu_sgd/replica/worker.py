@@ -127,6 +127,13 @@ class ReplicaWorker:
             with_valid=self._valid is not None)
         self.ef = (None if wire_frac is None
                    else store.error_feedback(worker_id, wire_frac))
+        # the store's per-shard coordinate layout (None = unsharded):
+        # probed ONCE — a supervised group keeps one layout across
+        # failovers (ha.StoreClient.shard_layout), so compressed pushes
+        # can seal their per-shard splits at the producer
+        self._shard_layout = (store.shard_layout()
+                              if hasattr(store, "shard_layout")
+                              else None)
         self.cycles = 0
         self.rejected = 0
         self.fenced = 0
@@ -191,12 +198,24 @@ class ReplicaWorker:
                     # at ITS consume site, after the modeled wire hop
                     # (tpu_sgd/io/integrity.py) — a corrupt-detected
                     # push heals inside _call's retry with the intact
-                    # originals, EF mass untouched
+                    # originals, EF mass untouched.  Against a SHARDED
+                    # store the seals additionally ride per-shard: the
+                    # producer splits exactly as the store will
+                    # (shard_layout) and seals each split, so a
+                    # misrouted/damaged shard segment is caught at the
+                    # store's per-shard consume site
+                    push_kw = {}
+                    if self._shard_layout is not None:
+                        push_kw["shard_seals"] = tuple(
+                            seal((idx[(idx >= a) & (idx < b)]
+                                  - a).astype(np.int32),
+                                 vals[(idx >= a) & (idx < b)])
+                            for a, b in self._shard_layout)
                     res = self._call(
                         self.store.push_compressed, self.worker_id,
                         pulled.version, idx, vals, l_host, c_host,
                         basis_epoch=pulled.epoch,
-                        checksum=seal(idx, vals))
+                        checksum=seal(idx, vals), **push_kw)
                 except BaseException:
                     # the push never produced a result (retry budget
                     # exhausted, or a kill): this worker may die and
