@@ -24,11 +24,22 @@ scheduling noise — basis strings say what was measured).
 Writes ``BENCH_SERVE.json`` and prints ONE JSON line on stdout;
 diagnostics go to stderr.
 
+A third section (``tenant_sweep``, ISSUE 18) drives the multi-tenant
+slab plane: Zipf-distributed mixed-tenant batches through
+``tpu_sgd.tenant`` at M ∈ {16, 256, 2048} tenants over ONE fixed
+capacity-256 slab.  Headlines per the 2-core policy: dispatches per
+mixed batch (must be flat across M — the shape-trap contract), compiles
+after warm-up (must be 0), the slab hit rate under the Zipf head, and
+the burst-admission lock-round ledger (one lock round per burst vs one
+per request).  ``scripts/bench_gate.py`` gates all four.
+
 Env knobs: BENCH_SERVE_DIM (default 64), BENCH_SERVE_SECONDS per level
 (default 2.0), BENCH_SERVE_LOADS (comma rps list, default
 "500,2500,10000,40000" — the last level is deliberately far beyond
 capacity so overload actually engages), BENCH_SERVE_MAX_BATCH (default
-32), BENCH_SERVE_DEADLINE (interactive budget, default 0.02).
+32), BENCH_SERVE_DEADLINE (interactive budget, default 0.02),
+BENCH_SERVE_TENANTS (comma tenant counts, default "16,256,2048"),
+BENCH_SERVE_TENANT_BATCHES (measured batches per cell, default 100).
 """
 
 from __future__ import annotations
@@ -57,6 +68,12 @@ MAX_QUEUE = int(os.environ.get("BENCH_SERVE_MAX_QUEUE", "4096"))
 # queue is where the shed_off arm's latency balloon lives
 MAX_BATCH = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
 DEADLINE_S = float(os.environ.get("BENCH_SERVE_DEADLINE", "0.02"))
+TENANT_COUNTS = [
+    int(v) for v in os.environ.get(
+        "BENCH_SERVE_TENANTS", "16,256,2048").split(",")
+]
+TENANT_CAPACITY = int(os.environ.get("BENCH_SERVE_TENANT_CAPACITY", "256"))
+TENANT_BATCHES = int(os.environ.get("BENCH_SERVE_TENANT_BATCHES", "100"))
 
 #: the two arms: (lane, weight, deadline_s) mixes + shed config
 ARMS = {
@@ -236,12 +253,148 @@ def run_arm(name: str, arm: dict, rng) -> list:
                                            "shed_count", "reject_count")}
 
 
+def run_tenant_sweep(rng) -> dict:
+    """The multi-tenant slab sweep (ISSUE 18): Zipf mixed-tenant batches
+    through ``tpu_sgd.tenant`` at several tenant counts over ONE fixed
+    slab capacity, plus the burst-admission lock-round cell.  Counts and
+    ratios only — the gated headlines are structural."""
+    import tempfile
+
+    from tpu_sgd.analysis.runtime import count_dispatches
+    from tpu_sgd.serve import MicroBatcher
+    from tpu_sgd.serve.metrics import nearest_rank
+    from tpu_sgd.tenant import TenantModelStore, TenantPredictEngine
+
+    cells = []
+    for m in TENANT_COUNTS:
+        tmp = tempfile.TemporaryDirectory()
+        store = TenantModelStore(tmp.name, capacity=TENANT_CAPACITY, d=DIM)
+        weights = rng.normal(size=(m, DIM)).astype(np.float32)
+        for t in range(m):
+            store.publish(t, weights[t], intercept=0.01 * (t % 5))
+        # Zipf(1.2)-shaped tenant popularity over [0, m): the hot head
+        # fits the slab, the cold tail forces admission-on-miss
+        ranks = np.arange(1, m + 1, dtype=np.float64)
+        p = ranks ** -1.2
+        p /= p.sum()
+        tids_all = rng.choice(m, size=TENANT_BATCHES * MAX_BATCH, p=p)
+        rows = rng.normal(size=(MAX_BATCH, DIM)).astype(np.float32)
+
+        engine = TenantPredictEngine(store)
+        # warm: the Zipf head resident, both compiled paths built
+        store.slots_for(
+            np.unique(tids_all[:4 * TENANT_CAPACITY])[:TENANT_CAPACITY])
+        warm_ids = np.unique(tids_all[:64])[:8]
+        engine.predict_batch(np.resize(warm_ids, MAX_BATCH), rows)
+        engine.predict_batch(np.full(MAX_BATCH, int(warm_ids[0])), rows)
+        compiles_warm = engine.compile_count
+        led0 = store.slab.ledger_snapshot()
+
+        n_disp = 0
+        walls = []
+        t0 = time.perf_counter()
+        for bi in range(TENANT_BATCHES):
+            tb = tids_all[bi * MAX_BATCH:(bi + 1) * MAX_BATCH]
+            # residency resolves first (cold tenants restore from disk
+            # and pay a row-set dispatch — the slab-churn cost the hit
+            # rate prices), then the SCORING dispatch count is measured
+            # alone: the number that must stay flat across M
+            store.slots_for(tb)
+            t1 = time.perf_counter()
+            with count_dispatches() as dc:
+                engine.predict_batch(tb, rows)
+            walls.append(time.perf_counter() - t1)
+            n_disp += dc["n"]
+        elapsed = time.perf_counter() - t0
+        led = store.slab.ledger_snapshot()
+        hits = led["hits"] - led0["hits"]
+        misses = led["misses"] - led0["misses"]
+        cell = {
+            "tenants": m,
+            "dispatches_per_batch": round(n_disp / TENANT_BATCHES, 4),
+            "compiles_after_warm": engine.compile_count - compiles_warm,
+            "slab_hit_rate": round(hits / max(1, hits + misses), 4),
+            "evictions": led["evicted"] - led0["evicted"],
+            "rows_per_s": round(TENANT_BATCHES * MAX_BATCH / elapsed, 1),
+            "p99_batch_ms": round(
+                nearest_rank(sorted(walls), 99) * 1e3, 3),
+        }
+        cells.append(cell)
+        log(f"[tenant] M={m}: {cell['dispatches_per_batch']} dispatches/"
+            f"batch, {cell['compiles_after_warm']} compiles after warm, "
+            f"hit rate {cell['slab_hit_rate']}, "
+            f"{cell['rows_per_s']} rows/s")
+        tmp.cleanup()
+
+    # -- the burst-admission lock-round cell (satellite: vectorized
+    # admission prices a whole burst under ONE lock round) --------------
+    n_burst = 1024
+    xs = list(rng.normal(size=(n_burst, DIM)).astype(np.float32))
+
+    def _zero(X):
+        return np.zeros(len(X), np.float32)
+
+    b_burst = MicroBatcher(_zero, max_batch=MAX_BATCH,
+                           max_queue=2 * n_burst, shed_utilization={})
+    t0 = time.perf_counter()
+    b_burst.submit_burst(xs)
+    wall_burst = time.perf_counter() - t0
+    snap_burst = b_burst.admission_snapshot()
+    b_burst.stop()
+
+    b_seq = MicroBatcher(_zero, max_batch=MAX_BATCH,
+                         max_queue=2 * n_burst, shed_utilization={})
+    t0 = time.perf_counter()
+    for x in xs:
+        b_seq.submit(x)
+    wall_seq = time.perf_counter() - t0
+    snap_seq = b_seq.admission_snapshot()
+    b_seq.stop()
+
+    burst_admission = {
+        "rows": n_burst,
+        "burst": {**snap_burst,
+                  "rounds_per_row": round(
+                      snap_burst["lock_rounds"] / snap_burst["priced"], 6),
+                  "admit_wall_ms": round(wall_burst * 1e3, 3)},
+        "per_request": {**snap_seq,
+                        "rounds_per_row": round(
+                            snap_seq["lock_rounds"] / snap_seq["priced"],
+                            6),
+                        "admit_wall_ms": round(wall_seq * 1e3, 3)},
+    }
+    log(f"[tenant] burst admission: {snap_burst['lock_rounds']} lock "
+        f"round for {snap_burst['priced']} rows "
+        f"({burst_admission['burst']['admit_wall_ms']} ms) vs "
+        f"{snap_seq['lock_rounds']} rounds per-request "
+        f"({burst_admission['per_request']['admit_wall_ms']} ms)")
+    return {
+        "capacity": TENANT_CAPACITY,
+        "batch_rows": MAX_BATCH,
+        "batches_per_cell": TENANT_BATCHES,
+        "zipf_a": 1.2,
+        "basis": (
+            "mixed-tenant Zipf(1.2) batches over one capacity-"
+            f"{TENANT_CAPACITY} slab; dispatches_per_batch counts XLA "
+            "launches of the SCORING dispatch only (residency resolves "
+            "first; cold admissions pay their own row-set dispatch, "
+            "priced by slab_hit_rate/evictions); compiles_after_warm "
+            "and the burst lock-round ledger are exact; rows_per_s and "
+            "p99_batch_ms run under the dispatch-counting hook on a "
+            "2-core host — context, not gates"
+        ),
+        "cells": cells,
+        "burst_admission": burst_admission,
+    }
+
+
 def main() -> int:
     rng = np.random.default_rng(0)
     arms = {}
     for name, arm in ARMS.items():
         levels, counts = run_arm(name, arm, rng)
         arms[name] = {"levels": levels, "admission_counts": counts}
+    tenant_sweep = run_tenant_sweep(rng)
 
     sat = LOADS[-1]
 
@@ -266,6 +419,10 @@ def main() -> int:
             "shed": counts_on["shed_count"],
             "rejected_total": counts_on["reject_count"],
         },
+        "tenant_dispatches_per_batch": [
+            c["dispatches_per_batch"] for c in tenant_sweep["cells"]],
+        "tenant_burst_rounds_per_row": (
+            tenant_sweep["burst_admission"]["burst"]["rounds_per_row"]),
         "note": (
             "every rejection is a typed Overloaded answer; the shed_on "
             "p99 tail is requests admitted just before a scheduling "
@@ -290,6 +447,7 @@ def main() -> int:
             "interactive deadline budget, default shed thresholds"
         ),
         "arms": arms,
+        "tenant_sweep": tenant_sweep,
         "parsed": parsed,
     }
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

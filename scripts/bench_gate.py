@@ -103,6 +103,40 @@ GATES = {
         # coalescing at saturation: mean rows per flushed batch
         Gate("arms/shed_off/levels[3]/mean_batch_size", "higher",
              rel_tol=0.3, note="batcher stopped coalescing"),
+        # -- the multi-tenant slab sweep (ISSUE 18): structural, exact --
+        # scoring dispatches per mixed-tenant batch must be FLAT across
+        # tenant counts (the shape-trap contract: tenant identity is a
+        # traced index vector, never a program key) — 1.0 at every M
+        Gate("tenant_sweep/cells[0]/dispatches_per_batch", "equal",
+             note="M=16 mixed batch must stay one dispatch"),
+        Gate("tenant_sweep/cells[1]/dispatches_per_batch", "equal",
+             note="M=256 mixed batch must stay one dispatch"),
+        Gate("tenant_sweep/cells[2]/dispatches_per_batch", "equal",
+             note="M=2048 mixed batch must stay one dispatch — "
+                  "dispatch count independent of tenant count"),
+        # zero compiles after warm-up at ANY tenant count: a nonzero
+        # delta means a shape escaped the slab's program-key discipline
+        Gate("tenant_sweep/cells[0]/compiles_after_warm", "lower"),
+        Gate("tenant_sweep/cells[2]/compiles_after_warm", "lower",
+             note="tenant churn must never reach the XLA compiler"),
+        # the Zipf head must keep hitting the slab: M=16 fits entirely
+        # (rate ~1.0); M=2048 serves mostly from the resident head
+        Gate("tenant_sweep/cells[0]/slab_hit_rate", "higher",
+             rel_tol=0.02),
+        Gate("tenant_sweep/cells[2]/slab_hit_rate", "higher",
+             rel_tol=0.25,
+             note="Zipf head stopped fitting the slab — LRU or "
+                  "admission regression"),
+        # burst admission must keep amortizing the lock: ~1/rows rounds
+        # per row for a whole burst, exactly 1.0 per-request
+        Gate("tenant_sweep/burst_admission/burst/rounds_per_row",
+             "lower", rel_tol=0.5,
+             note="vectorized burst admission stopped amortizing the "
+                  "admission lock"),
+        Gate("tenant_sweep/burst_admission/per_request/rounds_per_row",
+             "equal",
+             note="per-request admission is the 1.0 basis the burst "
+                  "ratio is read against"),
     ],
     "BENCH_SPARSE_WIRE.json": [
         Gate("sparse_feed/wire_bytes/ratio", "higher", rel_tol=0.10,

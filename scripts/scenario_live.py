@@ -52,16 +52,23 @@ def main(argv=None) -> int:
     ap.add_argument("--violate", metavar="SLO_NAME", default=None,
                     help="deliberately break one named SLO bound; the "
                          "run must then exit 1")
+    ap.add_argument("--tenant", action="store_true",
+                    help="run the multi-tenant slab stress round "
+                         "instead (ISSUE 18): Zipf traffic over "
+                         "thousands of tenants, a per-tenant retrain "
+                         "trickle, eviction + reload-storm chaos — "
+                         "same SLO-gate contract")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
     # registry/driver warnings are expected noise under live reload
     logging.basicConfig(level=logging.ERROR)
 
-    from tpu_sgd.scenario import run_scenario
+    from tpu_sgd.scenario import run_scenario, run_tenant_scenario
 
-    return run_scenario(seed=args.seed, smoke=args.smoke,
-                        out_dir=args.out, violate=args.violate,
-                        verbose=not args.quiet)
+    run = run_tenant_scenario if args.tenant else run_scenario
+    return run(seed=args.seed, smoke=args.smoke,
+               out_dir=args.out, violate=args.violate,
+               verbose=not args.quiet)
 
 
 if __name__ == "__main__":

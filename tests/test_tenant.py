@@ -1,0 +1,496 @@
+"""Multi-tenant model store + serving plane (tpu_sgd/tenant, ISSUE 18).
+
+The acceptance pins: an M=1 slab predict is BITWISE the existing
+single-model ``PredictEngine`` path; dispatch/compile counts for a
+mixed-tenant batch are independent of tenant count; LRU
+admission/eviction keeps an exact ledger; a hot reload of tenant i
+leaves tenant j's row bitwise unchanged; per-tenant obs series and the
+opt-in SlabThrashDetector work; the vectorized burst admission shows
+fewer lock rounds per burst in the counted ledger; slab state rides
+CRC-sealed checkpoint frames.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_sgd.models import LinearRegressionModel
+from tpu_sgd.serve import MicroBatcher, Overloaded, PredictEngine
+from tpu_sgd.tenant import (SlabFullError, TenantMissingError,
+                            TenantModelStore, TenantPredictEngine,
+                            TenantServer, WeightSlab)
+from tpu_sgd.utils import CheckpointManager
+
+D = 12
+
+
+def _store(tmp_path, rng, n_tenants=8, capacity=4, d=D, **kw):
+    store = TenantModelStore(str(tmp_path / "tenants"), capacity=capacity,
+                             d=d, **kw)
+    weights = rng.normal(size=(n_tenants, d)).astype(np.float32)
+    for t in range(n_tenants):
+        store.publish(t, weights[t], intercept=0.125 * t)
+    return store, weights
+
+
+# -- (a) the bitwise M=1 pin ------------------------------------------------
+def test_m1_slab_predict_bitwise_matches_predict_engine(tmp_path, rng):
+    """An M=1 (and any UNIFORM-tenant) slab batch routes through the
+    canonical ``bucketed_matvec`` program on the gathered host row —
+    literally the same compiled program the single-model
+    ``PredictEngine`` runs, hence bitwise-identical output."""
+    store, weights = _store(tmp_path, rng, n_tenants=1, capacity=1)
+    tengine = TenantPredictEngine(store)
+    sengine = PredictEngine()
+    model = LinearRegressionModel(weights[0], 0.0)
+    for n in (1, 3, 8, 17):
+        X = rng.normal(size=(n, D)).astype(np.float32)
+        got = tengine.predict_batch(np.zeros(n, np.int64), X)
+        want = sengine.predict_batch(model, X)
+        np.testing.assert_array_equal(got, want)  # bitwise, not close
+
+
+def test_uniform_batch_of_many_tenant_slab_is_still_bitwise(tmp_path, rng):
+    """The pin holds per tenant on a PACKED slab too: a uniform batch
+    for tenant t matches the single-model path for t's weights even
+    with neighbors resident."""
+    store, weights = _store(tmp_path, rng, n_tenants=6, capacity=6)
+    tengine = TenantPredictEngine(store)
+    sengine = PredictEngine()
+    X = rng.normal(size=(5, D)).astype(np.float32)
+    for t in (0, 3, 5):
+        got = tengine.predict_batch(np.full(5, t), X)
+        want = sengine.predict_batch(
+            LinearRegressionModel(weights[t], 0.125 * t), X)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_mixed_batch_matches_reference_to_tolerance(tmp_path, rng):
+    """A MIXED batch runs the gathered einsum program — same math as
+    the per-row matvec, a different XLA reduction, so tight tolerance
+    (the docstring contract), and every row scores under ITS tenant."""
+    store, weights = _store(tmp_path, rng, n_tenants=6, capacity=6)
+    tengine = TenantPredictEngine(store)
+    tids = np.array([0, 3, 5, 3, 1])
+    X = rng.normal(size=(5, D)).astype(np.float32)
+    got = tengine.predict_batch(tids, X)
+    want = np.array([X[i] @ weights[t] + 0.125 * t
+                     for i, t in enumerate(tids)], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# -- (b) LRU admission/eviction ledger --------------------------------------
+def test_lru_ledger_exactness(rng):
+    slab = WeightSlab(2, D)
+    w = rng.normal(size=(4, D)).astype(np.float32)
+    assert slab.put(10, w[0]) == (0, None, "admitted")
+    assert slab.put(11, w[1]) == (1, None, "admitted")
+    # hot reload of a resident tenant: swap in place, nobody evicted
+    slot, evicted, kind = slab.put(10, w[2])
+    assert (slot, evicted, kind) == (0, None, "swapped")
+    # 11 is now LRU (10's swap refreshed it): admitting 12 evicts 11
+    assert slab.put(12, w[3]) == (1, 11, "admitted")
+    assert slab.resident() == (10, 12)
+    assert slab.evictions == [(11, 1, 12)]
+    # serving touches refresh recency: touch 10, then 13 evicts 12
+    slab.snapshot_for([10])
+    assert slab.put(13, w[0]) == (1, 12, "admitted")
+    assert slab.ledger_snapshot() == {
+        "admitted": 4, "evicted": 2, "swapped": 1,
+        "hits": 1, "misses": 0, "resident": 2, "capacity": 2}
+    assert slab.staleness_s(12) == float("inf")  # evicted = not resident
+    assert slab.staleness_s(10) < 60.0
+
+
+def test_store_admission_on_miss_and_thrash_guard(tmp_path, rng):
+    store, _ = _store(tmp_path, rng, n_tenants=8, capacity=4)
+    # 8 published, none resident yet beyond publish (publish only swaps
+    # residents); first resolve admits from disk
+    slots, W, b = store.slots_for([0, 1, 2])
+    assert sorted(store.slab.resident()) == [0, 1, 2]
+    # a batch whose DISTINCT tenants exceed capacity cannot be scored:
+    # typed SlabFullError, not an admission livelock
+    with pytest.raises(SlabFullError):
+        store.slots_for(np.arange(8))
+    with pytest.raises(TenantMissingError):
+        store.load(99)  # never published
+
+
+# -- (c) hot reload row isolation -------------------------------------------
+def test_hot_reload_leaves_neighbor_rows_bitwise_unchanged(tmp_path, rng):
+    store, weights = _store(tmp_path, rng, n_tenants=4, capacity=4)
+    store.slots_for([0, 1, 2, 3])
+    tengine = TenantPredictEngine(store)
+    X = rng.normal(size=(6, D)).astype(np.float32)
+    before = {t: tengine.predict_batch(np.full(6, t), X) for t in range(4)}
+    ledger0 = store.slab.ledger_snapshot()
+    # hot reload tenant 2 (a publish to a RESIDENT tenant swaps in place)
+    w_new = rng.normal(size=D).astype(np.float32)
+    store.publish(2, w_new, intercept=-1.0)
+    ledger1 = store.slab.ledger_snapshot()
+    # one swap, zero admissions/evictions — neighbors untouched
+    assert ledger1["swapped"] == ledger0["swapped"] + 1
+    assert ledger1["admitted"] == ledger0["admitted"]
+    assert ledger1["evicted"] == ledger0["evicted"]
+    for t in (0, 1, 3):
+        np.testing.assert_array_equal(
+            tengine.predict_batch(np.full(6, t), X), before[t])
+        w_t, _ = store.slab.host_row(t)
+        np.testing.assert_array_equal(w_t, weights[t])  # row bytes too
+    got2 = tengine.predict_batch(np.full(6, 2), X)
+    np.testing.assert_array_equal(
+        got2, PredictEngine().predict_batch(
+            LinearRegressionModel(w_new, -1.0), X))
+
+
+# -- (d) dispatch/compile counts independent of tenant count ----------------
+def test_dispatch_count_flat_across_tenant_counts(tmp_path, rng):
+    """THE shape-trap acceptance pin: a 32-row mixed batch costs the
+    same dispatches (one) and zero fresh compiles whether the batch
+    mixes 1, 16, or 256 tenants — tenant identity is a traced index
+    vector, never a program key."""
+    from tpu_sgd.analysis import assert_compile_count
+    from tpu_sgd.analysis.runtime import count_dispatches
+
+    store, _ = _store(tmp_path, rng, n_tenants=256, capacity=256)
+    store.slots_for(np.arange(256))
+    tengine = TenantPredictEngine(store)
+    X = rng.normal(size=(32, D)).astype(np.float32)
+    # warm both programs (uniform-path matvec + mixed-path gather)
+    tengine.predict_batch(np.zeros(32, np.int64), X)
+    tengine.predict_batch(np.arange(32) % 16, X)
+
+    dispatches = {}
+    with assert_compile_count(0, of=lambda: tengine.compile_count):
+        for m in (1, 16, 256):
+            tids = (np.arange(32) * 31) % m
+            with count_dispatches() as dc:
+                tengine.predict_batch(tids, X)
+            dispatches[m] = dc["n"]
+    assert dispatches[16] == dispatches[256], dispatches
+    assert dispatches[1] == dispatches[16], dispatches
+    assert dispatches[256] == 1, dispatches  # ONE gathered program
+
+
+def test_hot_reload_never_recompiles(tmp_path, rng):
+    from tpu_sgd.analysis import assert_compile_count
+
+    store, _ = _store(tmp_path, rng, n_tenants=8, capacity=4)
+    store.slots_for([0, 1, 2, 3])  # warms the row-set program
+    tengine = TenantPredictEngine(store)
+    X = rng.normal(size=(8, D)).astype(np.float32)
+    tengine.predict_batch(np.array([0, 1, 2, 3] * 2), X)
+    with assert_compile_count(0, of=lambda: tengine.compile_count):
+        for i in range(8):
+            store.publish(i % 4, rng.normal(size=D).astype(np.float32))
+            tengine.predict_batch(np.array([0, 1, 2, 3] * 2), X)
+
+
+# -- (e) multi-model (shadow/canary) batch ----------------------------------
+def test_multi_version_batch_single_dispatch(tmp_path, rng):
+    """Old ROADMAP item 1 as the M=versions special case: several
+    checkpoint versions of ONE model scored per dispatch."""
+    from tpu_sgd.analysis.runtime import count_dispatches
+
+    mgr = CheckpointManager(str(tmp_path / "versions"), keep=8)
+    ws = rng.normal(size=(3, D)).astype(np.float32)
+    for v in (1, 2, 3):
+        mgr.save(v, ws[v - 1], 0.0, [],
+                 extras={"intercept": np.float32(0.5 * v)})
+    store = TenantModelStore(str(tmp_path / "slab"), capacity=4, d=D)
+    assert store.admit_versions(mgr) == (1, 2, 3)
+    tengine = TenantPredictEngine(store)
+    X = rng.normal(size=(5, D)).astype(np.float32)
+    scores, ids = tengine.predict_all(X)  # warm
+    with count_dispatches() as dc:
+        scores, ids = tengine.predict_all(X)
+    assert dc["n"] == 1  # every version in ONE dispatch
+    assert scores.shape == (5, 3) and list(ids) == [1, 2, 3]
+    for j, v in enumerate(ids):
+        np.testing.assert_allclose(
+            scores[:, j], X @ ws[v - 1] + 0.5 * v, rtol=1e-5, atol=1e-5)
+
+
+# -- (f) slab state on CRC-sealed frames ------------------------------------
+def test_slab_state_roundtrip_and_tamper_detection(tmp_path, rng):
+    from tpu_sgd.io.integrity import IntegrityError
+
+    store, weights = _store(tmp_path, rng, n_tenants=6, capacity=4)
+    store.slots_for([5, 1, 4])
+    store.publish(1, weights[0])  # a swap, so versions differ per tenant
+    mgr = CheckpointManager(str(tmp_path / "slab_state"), keep=8)
+    v = store.save_state(mgr)
+
+    other = TenantModelStore(str(tmp_path / "other"), capacity=4, d=D)
+    assert other.restore_state(mgr) == v
+    assert other.slab.resident() == store.slab.resident()
+    np.testing.assert_array_equal(other.slab.state()["weights"],
+                                  store.slab.state()["weights"])
+    assert other.slab.version_of(1) == store.slab.version_of(1)
+
+    # tamper: re-save mutated slab bytes under the ORIGINAL seal — the
+    # io-plane verify must refuse the restore with a typed error
+    ck = mgr.restore_version(v)
+    bad_w = np.asarray(ck["weights"]).copy()
+    bad_w[0, 0] += 1.0
+    mgr.save(v + 1, bad_w, 0.0, [], config_key="tenant-slab",
+             extras=dict(ck["extras"]))
+    with pytest.raises(IntegrityError, match="tenant.slab"):
+        other.restore_state(mgr)
+
+
+# -- (g) per-tenant obs series + detector fixtures --------------------------
+class _Sink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, payload):
+        self.records.append((kind, dict(payload)))
+
+
+def test_per_tenant_obs_series(tmp_path, rng):
+    """Residency transitions and predict staleness land as PER-TENANT
+    series through the declared fanouts: ``tenant.admit[7]``-style
+    count series and the ``tenant.predict.staleness_s`` value series."""
+    from tpu_sgd import obs
+
+    store, _ = _store(tmp_path, rng, n_tenants=6, capacity=2)
+    tengine = TenantPredictEngine(store)
+    X = rng.normal(size=(4, D)).astype(np.float32)
+    sink = _Sink()
+    obs.enable(sink, window_s=60.0)
+    try:
+        tengine.predict_batch(np.array([0, 1, 0, 1]), X)  # admits 0, 1
+        tengine.predict_batch(np.full(4, 2), X)           # evicts one
+        store.publish(2, rng.normal(size=D).astype(np.float32))  # swap
+        wins = obs.windows_snapshot()
+    finally:
+        obs.disable()
+    names = {n for w in wins for n in w["series"]}
+    assert {"tenant.admit", "tenant.admit[0]", "tenant.admit[1]",
+            "tenant.evict", "tenant.swap[2]",
+            "tenant.predict[2]", "tenant.predict.staleness_s",
+            "tenant.batch"} <= names, names
+
+
+def _window(series: dict) -> dict:
+    return {"index": 0, "t_start": 0.0, "t_end": 1.0,
+            "series": {k: {"count": v} for k, v in series.items()}}
+
+
+def test_slab_thrash_detector_fixtures():
+    from tpu_sgd.obs.detect import SlabThrashDetector
+
+    det = SlabThrashDetector(max_evict_frac=0.5, min_admits=16)
+    # healthy: admissions mostly stick
+    assert det.evaluate(_window({"tenant.admit": 40,
+                                 "tenant.evict": 10}), []) == []
+    # cold-start fill: all admits, zero evicts — never an alert
+    assert det.evaluate(_window({"tenant.admit": 64}), []) == []
+    # idle/low-volume windows cannot trip on noise
+    assert det.evaluate(_window({"tenant.admit": 8,
+                                 "tenant.evict": 8}), []) == []
+    # thrash: every admission churned somebody out
+    alerts = det.evaluate(_window({"tenant.admit": 32,
+                                   "tenant.evict": 30}), [])
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.rule == "slab-thrash" and a.series == "tenant.evict"
+    assert a.value == 30.0 and a.bound == 16.0
+
+
+def test_slab_thrash_detector_is_opt_in():
+    from tpu_sgd.obs.detect import default_detectors
+
+    assert "slab-thrash" not in {d.rule for d in default_detectors()}
+
+
+# -- (h) vectorized burst admission (satellite) -----------------------------
+def test_submit_burst_one_lock_round_counted(rng):
+    """The satellite's acceptance ledger: a 50-request burst takes ONE
+    admission lock round where 50 per-request submits take 50 — counted
+    by the batcher itself, gated by the bench."""
+    b = MicroBatcher(lambda X: np.zeros(len(X), np.float32),
+                     max_batch=8, max_queue=256, shed_utilization={})
+    X = rng.normal(size=(50, 4)).astype(np.float32)
+    futs = b.submit_burst(list(X))
+    assert len(futs) == 50
+    snap1 = b.admission_snapshot()
+    assert snap1 == {"lock_rounds": 1, "priced": 50}
+    for i in range(50):
+        b.submit(X[i])
+    snap2 = b.admission_snapshot()
+    assert snap2 == {"lock_rounds": 51, "priced": 100}
+    b.stop()  # drains synchronously (never started)
+    assert all(f.result(timeout=5) == 0.0 for f in futs)
+
+
+def test_submit_burst_decision_equivalent_to_sequential(rng):
+    """Same arrivals, same outcomes: the one-pass burst admission must
+    split/label exactly like a per-request submit loop (shed boundary,
+    queue_full, displacement), so the two paths are interchangeable."""
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+
+    def mk():
+        return MicroBatcher(lambda Z: np.zeros(len(Z), np.float32),
+                            max_batch=8, max_queue=16,
+                            shed_utilization={"batch": 0.5})
+
+    # per-request loop
+    seq = mk()
+    seq_out = []
+    for i in range(20):
+        try:
+            seq.submit(X[i], lane="batch")
+            seq_out.append("admitted")
+        except Overloaded as e:
+            seq_out.append(e.reason)
+    # one burst
+    bur = mk()
+    futs = bur.submit_burst(list(X[:20]), lane="batch")
+    bur_out = []
+    for f in futs:
+        err = f.exception() if f.done() else None
+        bur_out.append("admitted" if err is None else err.reason)
+    assert bur_out == seq_out  # 8 admitted (0.5 * 16), then shed
+    assert bur.lane_snapshot() == seq.lane_snapshot()
+
+    # displacement folds into the burst: interactive arrivals over a
+    # FULL queue evict queued batch-lane requests, batched
+    full = MicroBatcher(lambda Z: np.zeros(len(Z), np.float32),
+                        max_batch=8, max_queue=4, shed_utilization={})
+    low = [full.submit(X[i], lane="batch") for i in range(4)]
+    futs = full.submit_burst(list(X[:3]), lane="interactive")
+    assert sum(1 for f in low if f.done()
+               and isinstance(f.exception(), Overloaded)
+               and f.exception().reason == "displaced") == 3
+    assert all(not f.done() for f in futs)  # all admitted, queued
+    assert full.lane_snapshot()["batch"]["displaced"] == 3
+
+
+def test_submit_burst_deadline_priced_in_one_pass(rng):
+    """The rolling-p99 deadline rule applies positionally across the
+    burst: early rows clear their budget, rows queued behind more than
+    a batch of work do not."""
+    b = MicroBatcher(lambda Z: np.zeros(len(Z), np.float32),
+                     max_batch=4, max_queue=64, shed_utilization={})
+    with b._cond:
+        b._p99_wall = 0.1  # as if 100ms batch walls were observed
+    X = rng.normal(size=(10, 4)).astype(np.float32)
+    # budget covers 2 batches ahead: positions 0..7 predict <= 0.2,
+    # position 8 predicts 0.3 > budget
+    futs = b.submit_burst(list(X), deadline_s=0.25)
+    outcomes = ["admitted" if not f.done() else f.exception().reason
+                for f in futs]
+    assert outcomes == ["admitted"] * 8 + ["deadline"] * 2
+
+
+# -- (i) shed thresholds from config (satellite) ----------------------------
+def test_shed_thresholds_from_config_and_runtime_actuation():
+    from tpu_sgd.config import (ServingConfig, serving_config,
+                                set_serving_config)
+
+    # default config carries the historical constants
+    assert serving_config().shed_utilization == {"batch": 0.75,
+                                                 "shadow": 0.50}
+    prev = set_serving_config(
+        ServingConfig(shed_utilization={"batch": 0.25}))
+    try:
+        b = MicroBatcher(lambda Z: np.zeros(len(Z), np.float32),
+                         max_batch=4, max_queue=8)  # shed_utilization=None
+        assert b.shed_utilization == {"batch": 0.25}
+        # 0.25 * 8 = depth 2: third batch-lane submit sheds
+        b.submit(np.zeros(4, np.float32), lane="batch")
+        b.submit(np.zeros(4, np.float32), lane="batch")
+        with pytest.raises(Overloaded, match="shed"):
+            b.submit(np.zeros(4, np.float32), lane="batch")
+        # runtime actuation on the RUNNING batcher: loosen, admit again
+        b.set_shed_utilization({"batch": 0.75})
+        b.submit(np.zeros(4, np.float32), lane="batch")
+        with pytest.raises(ValueError):
+            b.set_shed_utilization({"nope": 0.5})
+        with pytest.raises(ValueError):
+            b.set_shed_utilization({"batch": 1.5})
+    finally:
+        set_serving_config(prev)
+    with pytest.raises(ValueError):
+        ServingConfig(shed_utilization={"batch": 0.0})
+
+
+# -- (j) the tenant server end to end ---------------------------------------
+def test_tenant_server_routes_rows_to_their_tenants(tmp_path, rng):
+    store, weights = _store(tmp_path, rng, n_tenants=6, capacity=6)
+    with TenantServer(store, max_batch=16, max_latency_s=0.003) as srv:
+        tids = [0, 5, 2, 5, 1, 0]
+        futs = [srv.submit(t, rng.normal(size=D).astype(np.float32))
+                for t in tids]
+        # burst spelling over the same server
+        Xb = rng.normal(size=(4, D)).astype(np.float32)
+        bfuts = srv.submit_burst([3, 4, 3, 4], Xb)
+        for f in futs + bfuts:
+            f.result(timeout=10)
+        hz = srv.healthz()
+    assert hz["slab"]["resident"] == 6
+    assert hz["engine"]["dispatches"] >= 1
+    assert hz["admission"]["priced"] == 10
+    assert hz["admission"]["lock_rounds"] == 7  # 6 submits + 1 burst
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        srv.submit(1 << 24, np.zeros(D, np.float32))
+
+
+# -- (k) capacity sizing advice ---------------------------------------------
+def test_choose_slab_capacity():
+    from tpu_sgd.plan import choose_slab_capacity
+
+    # the Zipf head, rounded up to a power of two
+    assert choose_slab_capacity(10000, 64, free_hbm=16e9) == 1024
+    assert choose_slab_capacity(10000, 64, free_hbm=16e9,
+                                working_set=300) == 512
+    assert choose_slab_capacity(8, 4, free_hbm=16e9) == 1
+    # HBM clamp: wide rows shrink capacity by halving
+    assert choose_slab_capacity(1 << 20, 1 << 20, free_hbm=16e9) == 2048
+    # cap backstop
+    assert choose_slab_capacity(10 ** 9, 4, free_hbm=1e12,
+                                cap=4096) == 4096
+
+
+# -- (l) the tenant stress scenario (smoke), once per module ----------------
+@pytest.fixture(scope="module")
+def tenant_scenario_run(tmp_path_factory):
+    from tpu_sgd.scenario import run_tenant_scenario
+
+    out = tmp_path_factory.mktemp("tenant_scenario")
+    rc = run_tenant_scenario(seed=0, smoke=True, out_dir=str(out),
+                             verbose=False)
+    return rc, out
+
+
+def test_tenant_scenario_slo_gate_passes(tenant_scenario_run):
+    rc, out = tenant_scenario_run
+    assert rc == 0, "the tenant smoke scenario's SLO gate must pass"
+    summary = json.loads((out / "tenant_summary.json").read_text())
+    t = summary["totals"]
+    assert t["dropped"] == 0 and t["errored"] == 0
+    assert t["submitted"] == (t["answered"] + t["rejected"]
+                              + t["displaced"] + t["errored"]
+                              + t["dropped"])
+    # the chaos really happened: LRU churn past capacity AND hot swaps
+    # under live traffic, with ZERO serving compiles after warm-up
+    assert summary["slab"]["evicted"] >= 10
+    assert summary["slab"]["swapped"] >= 5
+
+
+def test_tenant_scenario_violated_slo_fails_the_gate(tenant_scenario_run,
+                                                     tmp_path):
+    from tpu_sgd.obs import report as obs_report
+    from tpu_sgd.scenario import build_tenant_slos
+
+    rc, out = tenant_scenario_run
+    bad = tmp_path / "bad_slo.json"
+    bad.write_text(json.dumps(build_tenant_slos(
+        "smoke", violate="eviction-storm-churned")))
+    assert obs_report.main([str(out / "tenant_trace.jsonl"),
+                            "--slo", str(bad)]) == 1
+    with pytest.raises(ValueError, match="no such SLO"):
+        build_tenant_slos("smoke", violate="not-an-slo")

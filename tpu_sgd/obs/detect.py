@@ -83,7 +83,8 @@ __all__ = ["Alert", "Detector", "DetectorEngine", "default_detectors",
            "StalenessCreepDetector", "LaneRejectionDetector",
            "StragglerDetector", "WireRatioDetector",
            "DispatchRegressionDetector", "FailoverDetector",
-           "IntegrityDetector", "HeartbeatStallDetector"]
+           "IntegrityDetector", "HeartbeatStallDetector",
+           "ShardImbalanceDetector", "SlabThrashDetector"]
 
 logger = logging.getLogger("tpu_sgd.obs")
 
@@ -430,6 +431,39 @@ class ShardImbalanceDetector(Detector):
                     window, name, float(c), self.min_frac * busiest,
                     f"{c} shard pushes vs busiest shard's {busiest}"))
         return out
+
+
+class SlabThrashDetector(Detector):
+    """Tenant-slab churn sensor (NOT in the defaults — the
+    ``ShardImbalanceDetector`` precedent: an operator opt-in for
+    deployments running ``tpu_sgd/tenant``).  A healthy slab admits a
+    tenant once and serves it many times; when the working set exceeds
+    capacity, every admission evicts a tenant the NEXT batch re-admits
+    — each predict pays a disk restore plus a row-set dispatch, the
+    latency cliff ``plan.choose_slab_capacity`` exists to prevent.
+    Trips when the window's ``tenant.evict`` count exceeds
+    ``max_evict_frac`` of its ``tenant.admit`` count (floor
+    ``min_admits`` on admissions, so a cold-start fill — all admits,
+    no evicts — and idle windows cannot trip)."""
+
+    rule = "slab-thrash"
+
+    def __init__(self, max_evict_frac: float = 0.5, min_admits: int = 16):
+        self.max_evict_frac = float(max_evict_frac)
+        self.min_admits = int(min_admits)
+
+    def evaluate(self, window, history):
+        admits = _count(window, "tenant.admit")
+        if admits < self.min_admits:
+            return []
+        evicts = _count(window, "tenant.evict")
+        bound = self.max_evict_frac * admits
+        if evicts > bound:
+            return [self._alert(
+                window, "tenant.evict", float(evicts), bound,
+                f"{evicts} evictions vs {admits} admissions — working "
+                "set exceeds slab capacity")]
+        return []
 
 
 class DispatchRegressionDetector(Detector):

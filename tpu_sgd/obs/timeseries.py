@@ -109,6 +109,10 @@ SPAN_FANOUT: Dict[str, str] = {
 #: (rejected pushes are excluded: their gap was refused, not served).
 EVENT_VALUES: Dict[str, tuple] = {
     "replica.push": (("staleness", "accepted"),),
+    # each tenant touched by a predict batch reports how stale its slab
+    # row is — the per-tenant freshness SLO feed (tenant.predict.
+    # staleness_s value series; tpu_sgd/tenant/engine.py emits it)
+    "tenant.predict": (("staleness_s", None),),
 }
 
 #: instant events fanned into per-actor count series by an attribute
@@ -134,6 +138,16 @@ EVENT_FANOUT: Dict[str, str] = {
     # push, fanned by shard id into ``replica.shard.push[s0]``-style
     # count series — the shard-imbalance detector's feed
     "replica.shard.push": "shard",
+    # the tenant slab's residency transitions (tpu_sgd/tenant/store.py),
+    # fanned by tenant id: ``tenant.admit[7]`` / ``tenant.evict[7]`` /
+    # ``tenant.swap[7]`` count series are the per-tenant SLO surface,
+    # and the unfanned totals feed the opt-in SlabThrashDetector;
+    # ``tenant.predict`` fans each batch's touched tenants into
+    # per-tenant serve-rate series next to them
+    "tenant.admit": "tenant",
+    "tenant.evict": "tenant",
+    "tenant.swap": "tenant",
+    "tenant.predict": "tenant",
 }
 
 #: fast-path gate (the failpoints discipline): every hook reads this

@@ -35,15 +35,30 @@ DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
 #: (rows, d, x-dtype, w-ndim, w-cols, w-dtype, activation)
 _MATVEC_PROGRAMS: dict = {}
 
-#: memo-key contract (graftlint memo-key rule): the factory receives
+#: compiled multi-tenant slab programs (tpu_sgd/tenant), keyed by
+#: (mode, rows, d, capacity, x-dtype, slab-dtype, activation) — the
+#: slab CAPACITY is a key root (it is the traced weight array's static
+#: shape), the number of tenants RESIDENT is deliberately not: a slab
+#: serves 1 or 10k tenants through the same executable, which is what
+#: makes tenant-mixed dispatch counts independent of tenant count
+_SLAB_PROGRAMS: dict = {}
+
+#: memo-key contract (graftlint memo-key rule): each factory receives
 #: the fully-formed key tuple — callers build it from the shape/dtype/
 #: activation roots documented above, and the factory's only program-
-#: affecting read (the activation tag) comes out of the key itself
-GRAFTLINT_MEMO = {"_MATVEC_PROGRAMS": ("key",)}
+#: affecting reads (the mode/activation tags) come out of the key itself
+GRAFTLINT_MEMO = {
+    "_MATVEC_PROGRAMS": ("key",),
+    "_SLAB_PROGRAMS": ("key",),
+}
 
 
 def program_cache_size() -> int:
     return len(_MATVEC_PROGRAMS)
+
+
+def slab_program_cache_size() -> int:
+    return len(_SLAB_PROGRAMS)
 
 
 def bucket_for(n: int, buckets: Tuple[int, ...] = DEFAULT_BUCKETS) -> int:
@@ -118,4 +133,119 @@ def bucketed_matvec(X, w, intercept=0.0,
         activation,
     )
     fn = _matvec_program(key)
-    return np.asarray(fn(Xp, w, jnp.asarray(intercept, jnp.float32)))[:n]
+    # the intercept stays a HOST numpy scalar: jnp.asarray on a python
+    # scalar is an eager convert_element_type — a whole extra device
+    # dispatch per predict — while device_put of a 0-d ndarray is free
+    return np.asarray(fn(Xp, w, np.asarray(intercept, np.float32)))[:n]
+
+
+def _slab_program(key):
+    fn = _SLAB_PROGRAMS.get(key)
+    if fn is None:
+        mode, act = key[0], key[-1]
+        if mode == "gather":
+            # per-row gathered dot: row r scores against slab row
+            # slots[r].  The gather indices are a TRACED int32 argument
+            # — tenant identity never reaches the compiler, so one
+            # program serves every tenant mix of this shape
+            def score(X, slots, W, b):
+                out = jnp.einsum("rd,rd->r", X, W[slots]) + b[slots]
+                if act == "sigmoid":
+                    out = jax.nn.sigmoid(out)
+                return out
+        else:  # "all": every row against EVERY slab row (shadow/canary)
+            def score(X, W, b):
+                out = X @ W.T + b
+                if act == "sigmoid":
+                    out = jax.nn.sigmoid(out)
+                return out
+        fn = jax.jit(score)
+        _SLAB_PROGRAMS[key] = fn
+    return fn
+
+
+def bucketed_gather_matvec(X, slots, slab, intercepts,
+                           buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+                           activation: Optional[str] = None):
+    """Mixed-tenant margin: row ``r`` of ``X`` scores against slab row
+    ``slots[r]`` — ``einsum('rd,rd->r', X, slab[slots]) + b[slots]`` with
+    the row count padded to a bucket, one cached jit program per
+    (bucket, d, slab capacity), and a HOST numpy result sliced back to
+    ``len(X)``.  The slab and the slot vector are traced arguments, so
+    neither a tenant hot-swap nor a novel tenant mix ever recompiles;
+    only a new (bucket, width, capacity) shape does.
+
+    NOT bitwise-comparable to :func:`bucketed_matvec` on a uniform
+    batch: the per-row dot is a different XLA reduction than the
+    matvec's, so the two programs may disagree at ~1 ulp.  A caller that
+    needs the single-model bitwise contract (tpu_sgd/tenant: the M=1 /
+    uniform-tenant case) must route those batches through
+    :func:`bucketed_matvec` on the gathered weight row instead."""
+    Xh = np.asarray(X)
+    sh = np.asarray(slots, np.int32)
+    slab = jnp.asarray(slab)
+    intercepts = jnp.asarray(intercepts)
+    n = int(Xh.shape[0])
+
+    def _eager(Xe, se):
+        out = (jnp.einsum("rd,rd->r", jnp.asarray(Xe), slab[jnp.asarray(se)])
+               + intercepts[jnp.asarray(se)])
+        if activation == "sigmoid":
+            out = jax.nn.sigmoid(out)
+        return np.asarray(out)
+
+    if n == 0 or Xh.ndim != 2:
+        return _eager(Xh, sh)
+    if n > buckets[-1]:
+        # training-scale scoring: one eager pass at the natural shape,
+        # same contract as bucketed_matvec's oversized path
+        return _eager(Xh, sh)
+    rows = bucket_for(n, buckets)
+    if rows != n:
+        # host-side padding on purpose (see bucketed_matvec); pad slots
+        # with 0 — slot 0 always exists (capacity >= 1) and the padded
+        # rows are all-zero features sliced away below
+        Xp = np.concatenate(
+            [Xh, np.zeros((rows - n, Xh.shape[1]), Xh.dtype)], axis=0)
+        sp = np.concatenate([sh, np.zeros(rows - n, np.int32)])
+    else:
+        Xp, sp = Xh, sh
+    key = ("gather", rows, int(Xh.shape[1]), int(slab.shape[0]),
+           str(Xp.dtype), str(slab.dtype), activation)
+    fn = _slab_program(key)
+    return np.asarray(fn(Xp, jnp.asarray(sp), slab, intercepts))[:n]
+
+
+def bucketed_multi_matvec(X, slab, intercepts,
+                          buckets: Tuple[int, ...] = DEFAULT_BUCKETS,
+                          activation: Optional[str] = None):
+    """Multi-model batch: every row of ``X`` scores against EVERY slab
+    row in one dispatch — ``X @ slab.T + b`` returning a host
+    ``(len(X), capacity)`` score matrix.  The shadow/canary special case
+    of the tenant slab (M = registry versions): several model versions
+    scored per dispatch, columns selected host-side by the caller."""
+    Xh = np.asarray(X)
+    slab = jnp.asarray(slab)
+    intercepts = jnp.asarray(intercepts)
+    n = int(Xh.shape[0])
+
+    def _eager(Xe):
+        out = jnp.asarray(Xe) @ slab.T + intercepts
+        if activation == "sigmoid":
+            out = jax.nn.sigmoid(out)
+        return np.asarray(out)
+
+    if n == 0 or Xh.ndim != 2:
+        return _eager(Xh)
+    if n > buckets[-1]:
+        return _eager(Xh)
+    rows = bucket_for(n, buckets)
+    if rows != n:
+        Xp = np.concatenate(
+            [Xh, np.zeros((rows - n, Xh.shape[1]), Xh.dtype)], axis=0)
+    else:
+        Xp = Xh
+    key = ("all", rows, int(Xh.shape[1]), int(slab.shape[0]),
+           str(Xp.dtype), str(slab.dtype), activation)
+    fn = _slab_program(key)
+    return np.asarray(fn(Xp, slab, intercepts))[:n]

@@ -842,6 +842,57 @@ def choose_residency(k: int, checkpoint_every: int = 10,
     return int(c) if c >= 2 else 0
 
 
+def choose_slab_capacity(n_tenants: int, d: int, itemsize: int = 4,
+                         free_hbm: Optional[float] = None,
+                         working_set: Optional[int] = None,
+                         hot_frac: float = 0.1,
+                         cost_model: CostModel = DEFAULT_COST_MODEL,
+                         cap: int = 65536) -> int:
+    """Slab capacity C (resident tenant rows) for the multi-tenant
+    model store (``tpu_sgd/tenant``): the smallest power of two holding
+    the HOT working set, clamped to what HBM can carry.
+
+    The decision axes, in order:
+
+    * **working set, not tenant count** — a Zipf-shaped tenant
+      population serves most traffic from a small head, and every
+      resident row costs HBM whether or not it is ever gathered, so C
+      targets ``working_set`` (explicit, from the operator's traffic
+      knowledge) or ``hot_frac * n_tenants`` (the default 10% head)
+      rather than all ``n_tenants``.  Misses are not failures — the
+      store re-admits from checkpoint at disk latency — but each one
+      evicts a neighbor, so an undersized slab thrashes (the opt-in
+      ``SlabThrashDetector`` watches the evict/admit ratio live).
+    * **power-of-two rounding (up)** — the slab's capacity is a
+      compiled-program shape root (``ops/bucketed.py``'s slab-program
+      keys): every distinct capacity is a fresh compile of the gather,
+      multi-model, and row-set programs, so quantizing keeps a fleet
+      of stores on a handful of executables.
+    * **HBM clamp** — ``C * (d + 1) * itemsize`` (rows + intercepts)
+      must fit the measured free budget under the cost model's
+      ``hbm_safety`` fraction (``free_hbm=None`` probes
+      :func:`device_budget`), leaving the rest for serving batches and
+      any co-resident training run.  ``cap`` backstops the search.
+
+    Same contract as :func:`choose_replicas`: sizing ADVICE, not a
+    schedule decision — the caller constructs the store with the
+    returned capacity (or their own number) explicitly."""
+    m = max(1, int(n_tenants))
+    target = (max(1, int(working_set)) if working_set is not None
+              else max(1, int(round(hot_frac * m))))
+    target = min(target, m)
+    c = 1
+    while c < target:
+        c *= 2
+    if free_hbm is None:
+        free_hbm, _ = device_budget(cost_model=cost_model)
+    row_bytes = (int(d) + 1) * int(itemsize)
+    budget = cost_model.hbm_safety * float(free_hbm)
+    while c > 1 and c * row_bytes > budget:
+        c //= 2
+    return int(min(c, int(cap)))
+
+
 def _fmt_gb(b: float) -> str:
     return f"{b / 1e9:.2f} GB"
 

@@ -22,11 +22,15 @@ from __future__ import annotations
 
 from tpu_sgd.scenario.harness import build_slos, run_scenario
 from tpu_sgd.scenario.loadgen import OpenLoopLoadGen, Phase, TrafficSpec
+from tpu_sgd.scenario.tenant_stress import (build_tenant_slos,
+                                            run_tenant_scenario)
 
 __all__ = [
     "OpenLoopLoadGen",
     "Phase",
     "TrafficSpec",
     "build_slos",
+    "build_tenant_slos",
     "run_scenario",
+    "run_tenant_scenario",
 ]

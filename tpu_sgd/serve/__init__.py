@@ -201,6 +201,10 @@ class Server:
             "shed_count": sum(s["shed"] + s["displaced"]
                               for s in lanes.values()),
             "p99_batch_wall_s": self.batcher.p99_batch_wall_s(),
+            # admission lock amortization (ISSUE 18): lock rounds taken
+            # vs requests priced — rounds << priced means the burst
+            # path is doing its job
+            "admission": self.batcher.admission_snapshot(),
             # the live windowed time-series for the serve subsystem
             # (ISSUE 13): per-window span/counter aggregates from the
             # bounded obs.timeseries ring, or None when the layer is

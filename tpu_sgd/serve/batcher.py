@@ -58,6 +58,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from tpu_sgd.obs.counters import inc as obs_inc
 from tpu_sgd.obs.spans import span
 from tpu_sgd.reliability.failpoints import failpoint
@@ -80,6 +82,9 @@ GRAFTLINT_LOCKS = {
         "_flush_walls": "_cond",
         "_p99_wall": "_cond",
         "lane_counts": "_cond",
+        "shed_utilization": "_cond",
+        "admission_lock_rounds": "_cond",
+        "admission_priced": "_cond",
     },
 }
 
@@ -89,10 +94,21 @@ LANES = ("interactive", "batch", "shadow")
 
 _LANE_PRIORITY = {lane: i for i, lane in enumerate(LANES)}
 
-#: default utilization thresholds at which NEW arrivals to a lane are
-#: shed (fraction of ``max_queue`` occupied, any lane).  ``interactive``
-#: is deliberately absent: it sheds only at queue-full-with-no-victim,
-#: the last line, so the premium lane degrades last.
+def _default_shed_utilization() -> Dict[str, float]:
+    """Per-lane shed thresholds from the process :class:`ServingConfig`
+    (``tpu_sgd.config.serving_config``) — the control plane actuates
+    these through config, never by monkey-patching a module constant.
+    ``interactive`` is absent by default: it sheds only at
+    queue-full-with-no-victim, the last line, so the premium lane
+    degrades last."""
+    from tpu_sgd.config import serving_config
+
+    return dict(serving_config().shed_utilization)
+
+
+#: legacy alias — the historical constants now live in
+#: ``ServingConfig``'s defaults; kept so pre-config callers (and tests
+#: pinning the defaults) keep reading the same numbers
 DEFAULT_SHED_UTILIZATION = {"batch": 0.75, "shadow": 0.50}
 
 
@@ -183,7 +199,7 @@ class MicroBatcher:
         self.metrics = metrics
         self.padded_size_fn = padded_size_fn or (lambda n: n)
         self.shed_utilization = dict(
-            DEFAULT_SHED_UTILIZATION if shed_utilization is None
+            _default_shed_utilization() if shed_utilization is None
             else shed_utilization)
         unknown = set(self.shed_utilization) - set(LANES)
         if unknown:
@@ -201,6 +217,15 @@ class MicroBatcher:
         self._p99_wall = 0.0
         self.reject_count = 0
         self.batch_count = 0
+        #: admission-cost ledger: ``admission_lock_rounds`` counts
+        #: acquisitions of ``_cond`` for admission (one per
+        #: :meth:`submit`, one per WHOLE :meth:`submit_burst`),
+        #: ``admission_priced`` counts requests priced under them — the
+        #: rounds/priced ratio is the per-request lock amortization the
+        #: vectorized burst path exists to buy (BENCH_SERVE.json gates
+        #: it)
+        self.admission_lock_rounds = 0
+        self.admission_priced = 0
         #: per-lane admission tallies: admitted / rejected (queue_full +
         #: deadline) / shed (threshold sheds, never admitted) /
         #: displaced (admitted, then evicted) — the healthz scrape
@@ -241,6 +266,8 @@ class MicroBatcher:
         with self._cond:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
+            self.admission_lock_rounds += 1
+            self.admission_priced += 1
             depth = sum(len(q) for q in self._lanes.values())
             thr = self.shed_utilization.get(lane)
             if thr is not None and depth >= thr * self.max_queue:
@@ -296,6 +323,155 @@ class MicroBatcher:
         if victim is not None:
             self._answer_displaced(victim)
         return req.future
+
+    def submit_burst(self, xs, lane: str = "interactive",
+                     deadline_s: Optional[float] = None) -> List[Future]:
+        """Admit a whole arrival burst under ONE lock round: the shed,
+        deadline, and capacity rules are priced for every position of
+        the burst in one numpy pass, then the queue mutates once —
+        instead of ``len(xs)`` per-request lock round-trips through
+        :meth:`submit` (the GIL-stall tail BENCH_SERVE.json's basis
+        names; the ``admission_lock_rounds`` / ``admission_priced``
+        ledger counts the difference and the bench gates it).
+
+        Decision-equivalent to submitting the rows one by one: each
+        admission rule's predicate is monotone in the number of earlier
+        admissions from the same burst, so the burst splits into an
+        admitted prefix and a rejected tail labeled by whichever rule
+        fires first at the boundary.  Displacement is folded in the same
+        way — every victim a full queue owes the burst is popped under
+        the one lock and answered afterwards, batched.
+
+        Returns one :class:`~concurrent.futures.Future` per row, in
+        order.  Rejected rows get a future with the typed
+        :class:`Overloaded` already set (never a raise — a burst is not
+        all-or-nothing), so callers handle both outcomes through the
+        same future interface.
+        """
+        if lane not in _LANE_PRIORITY:
+            raise ValueError(f"unknown lane {lane!r}; expected one of {LANES}")
+        n = len(xs)
+        if n == 0:
+            return []
+        failpoint("serve.admit")
+        failpoint("serve.batcher.enqueue")
+        victims: List[_Request] = []
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("batcher is stopped")
+            self.admission_lock_rounds += 1
+            self.admission_priced += n
+            depth = sum(len(q) for q in self._lanes.values())
+            # -- one numpy pass: admissible prefix length per rule ------
+            # each predicate is monotone in the count of earlier burst
+            # admissions (depth and depth_ahead only grow), so "first
+            # failing position" fully determines the split
+            a_shed = n
+            thr = self.shed_utilization.get(lane)
+            if thr is not None:
+                # position a is shed when depth + a >= thr * max_queue
+                a_shed = int(np.clip(
+                    np.ceil(thr * self.max_queue - depth), 0, n))
+            a_deadline = n
+            if deadline_s is not None and self._p99_wall > 0.0:
+                depth_ahead = sum(
+                    len(self._lanes[ln]) for ln in LANES
+                    if _LANE_PRIORITY[ln] <= _LANE_PRIORITY[lane])
+                predicted = self._p99_wall * (
+                    1 + (depth_ahead + np.arange(n)) // self.max_batch)
+                ok = deadline_s >= predicted
+                a_deadline = n if bool(ok.all()) else int(np.argmin(ok))
+            admit = min(a_shed, a_deadline)
+            # -- capacity: pop every owed victim under this same lock ---
+            free = self.max_queue - depth
+            need_victims = max(0, admit - max(0, free))
+            for _ in range(need_victims):
+                v = self._pop_victim_locked(lane)
+                if v is None:
+                    break
+                victims.append(v)
+            admit = min(admit, max(0, free) + len(victims))
+            # -- mutate the queue once ----------------------------------
+            reqs = [_Request(x, lane=lane, enqueue_depth=depth,
+                             deadline_s=deadline_s) for x in xs]
+            if admit:
+                self._lanes[lane].extend(reqs[:admit])
+                self.lane_counts[lane]["admitted"] += admit
+                obs_inc(f"serve.admitted.{lane}", admit)
+            # -- batched tallies for the rejected tail ------------------
+            rejected = n - admit
+            if rejected:
+                if admit < min(a_shed, a_deadline):
+                    reason = "queue_full"
+                    detail = (f"{self.max_queue} pending, no lower-"
+                              "priority victim")
+                elif a_shed <= a_deadline:
+                    reason = "shed"
+                    detail = (f"utilization >= {thr:.0%} of "
+                              f"{self.max_queue}")
+                else:
+                    reason = "deadline"
+                    detail = (f"budget {deadline_s * 1e3:.1f}ms < "
+                              "predicted wait")
+                bucket = "shed" if reason == "shed" else "rejected"
+                self.lane_counts[lane][bucket] += rejected
+                self.reject_count += rejected
+                obs_inc("serve.reject", rejected)
+                obs_inc(f"serve.{bucket}.{lane}", rejected)
+            if victims:
+                for v in victims:
+                    self.lane_counts[v.lane]["displaced"] += 1
+                    obs_inc(f"serve.displaced.{v.lane}")
+                self.reject_count += len(victims)
+                obs_inc("serve.reject", len(victims))
+            if admit:
+                self._cond.notify_all()
+        # answer victims and settle rejected futures OUTSIDE the lock
+        # (future callbacks run synchronously in this thread)
+        for v in victims:
+            self._answer_displaced(v)
+            if self.metrics is not None:
+                try:
+                    self.metrics.record_reject(lane=v.lane,
+                                               reason="displaced")
+                except Exception:
+                    logging.getLogger("tpu_sgd.serve.batcher").warning(
+                        "serving metrics raised on displace; dropped",
+                        exc_info=True)
+        for r in reqs[admit:]:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(Overloaded(reason, lane, detail))
+            if self.metrics is not None:
+                try:
+                    self.metrics.record_reject(lane=lane, reason=reason)
+                except Exception:
+                    logging.getLogger("tpu_sgd.serve.batcher").warning(
+                        "serving metrics raised on reject; dropped",
+                        exc_info=True)
+        return [r.future for r in reqs]
+
+    def set_shed_utilization(self, thresholds: Dict[str, float]) -> None:
+        """Actuate the per-lane shed thresholds on a RUNNING batcher —
+        the control-plane hook (ROADMAP item 1).  Validates like the
+        constructor; replaces the whole mapping atomically under the
+        admission lock, so no submit ever sees a half-updated policy."""
+        unknown = set(thresholds) - set(LANES)
+        if unknown:
+            raise ValueError(f"unknown shed_utilization lanes: {unknown}")
+        for ln, thr in thresholds.items():
+            if not (0.0 < float(thr) <= 1.0):
+                raise ValueError(
+                    f"shed_utilization[{ln!r}] must be in (0, 1], got {thr}")
+        with self._cond:
+            self.shed_utilization = dict(thresholds)
+
+    def admission_snapshot(self) -> dict:
+        """The admission-cost ledger: lock rounds taken vs requests
+        priced under them (rounds == priced means pure per-request
+        admission; rounds << priced means bursts are amortizing)."""
+        with self._cond:
+            return {"lock_rounds": self.admission_lock_rounds,
+                    "priced": self.admission_priced}
 
     def _reject_locked(self, reason: str, lane: str,
                        detail: str) -> Overloaded:
@@ -490,8 +666,18 @@ class MicroBatcher:
             batch = []
             for lane in LANES:  # priority drain order
                 q = self._lanes[lane]
-                while q and len(batch) < self.max_batch:
-                    batch.append(q.popleft())
+                room = self.max_batch - len(batch)
+                if room <= 0:
+                    break
+                if len(q) <= room:
+                    # batched drain: take the whole lane in one extend +
+                    # clear instead of a per-item popleft loop — the
+                    # common saturated case moves max_batch requests with
+                    # O(lanes) python-level operations under the lock
+                    batch.extend(q)
+                    q.clear()
+                else:
+                    batch.extend(q.popleft() for _ in range(room))
             # claim each future NOW (running state): a client cancel() from
             # here on fails instead of racing set_result into an
             # InvalidStateError that would kill the flush thread; already-

@@ -1,0 +1,39 @@
+"""Multi-tenant model store and serving plane (ROADMAP item 4).
+
+Thousands of tenants, each owning a small GLM, served from ONE
+device-resident ``(capacity, d)`` weight slab:
+
+* :class:`WeightSlab` — LRU-admitted rows, in-place hot reload, exact
+  admission/eviction ledger (``tenant/slab.py``);
+* :class:`TenantModelStore` — per-tenant ``CheckpointManager``
+  durability, admission-on-miss, CRC-sealed whole-slab checkpoints,
+  and the shadow/canary multi-version special case
+  (``tenant/store.py``);
+* :class:`TenantPredictEngine` — mixed-tenant batches scored by ONE
+  gathered-matvec dispatch; uniform batches take the canonical
+  single-model program for the bitwise contract (``tenant/engine.py``);
+* :class:`TenantServer` — the lanes/admission micro-batcher fronting
+  it, tenant id riding each row as a float32 column
+  (``tenant/serve.py``).
+
+The organizing rule (ADVICE.md): pack tenants into one slab; gather,
+don't recompile — dispatch and compile counts are independent of
+tenant count by construction, because tenant identity only ever enters
+compiled programs as a traced index vector.
+"""
+
+from tpu_sgd.tenant.engine import TenantPredictEngine
+from tpu_sgd.tenant.serve import TenantServer
+from tpu_sgd.tenant.slab import (SlabFullError, WeightSlab,
+                                 row_set_program_cache_size)
+from tpu_sgd.tenant.store import TenantMissingError, TenantModelStore
+
+__all__ = [
+    "SlabFullError",
+    "TenantMissingError",
+    "TenantModelStore",
+    "TenantPredictEngine",
+    "TenantServer",
+    "WeightSlab",
+    "row_set_program_cache_size",
+]
