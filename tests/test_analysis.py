@@ -15,18 +15,23 @@ import time
 import numpy as np
 import pytest
 
+from tpu_sgd.analysis import GRAFTLINT_LOCK_ORDER
 from tpu_sgd.analysis.core import (Config, Finding, KNOWN_RULES, ModuleFile,
-                                   run_lint)
+                                   load_config, load_modules, run_lint)
 from tpu_sgd.analysis.rules_callback import CallbackDisciplineRule
 from tpu_sgd.analysis.rules_carry import CarryStabilityRule
+from tpu_sgd.analysis.rules_cond import CondDisciplineRule
+from tpu_sgd.analysis.rules_contract import ContractDriftRule
 from tpu_sgd.analysis.rules_donation import DonationSafetyRule
 from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
 from tpu_sgd.analysis.rules_lock import LockDisciplineRule
 from tpu_sgd.analysis.rules_memo import MemoKeyRule
+from tpu_sgd.analysis.rules_order import LockOrderRule
 from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
 from tpu_sgd.analysis.rules_sync import HostSyncRule, ObsDisciplineRule
 from tpu_sgd.analysis.runtime import (CompileCountError, InstrumentedLock,
-                                      LocksetRecorder, assert_compile_count,
+                                      LockOrderError, LocksetRecorder,
+                                      assert_compile_count, assert_lock_order,
                                       instrument_object)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -477,7 +482,7 @@ def test_mutation_deleted_lock_block_fails_lint():
 
 def test_every_rule_fires_on_its_seeded_violation():
     """One seeded violation per rule, one combined sweep: each of the
-    ten rules must report exactly its own planted bug."""
+    thirteen rules must report exactly its own planted bug."""
     registry = {"io.feed": "seeded.py"}
     seeded = mod("""
         import threading
@@ -489,7 +494,33 @@ def test_every_rule_fires_on_its_seeded_violation():
         from functools import partial
         from tpu_sgd.obs.spans import event
 
-        GRAFTLINT_LOCKS = {"S": {"_q": "_lock"}}
+        GRAFTLINT_LOCKS = {
+            "S": {"_q": "_lock"},
+            "Cyc": {"_xa": "_l1", "_xb": "_l2"},
+            "W": {"_q2": "_cv"},
+        }
+
+        class Cyc:  # lock-order: the two methods nest opposite ways
+            def ab(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+
+            def ba(self):
+                with self._l2:
+                    with self._l1:
+                        pass
+
+        class W:  # cond-discipline: a wait with no while around it
+            def bad_wait(self):
+                with self._cv:
+                    self._cv.wait()
+
+        # contract-drift: an SLO gate over a counter nothing emits
+        SEEDED_SLOS = [
+            {"metric": "counter", "name": "seeded",
+             "counter": "no.such.counter", "max": 1},
+        ]
 
         HIST = []
         _PROGRAMS = {}
@@ -1584,3 +1615,625 @@ def test_assert_bounded_callback_buffer():
     capped = [1, 2]
     with assert_bounded_callback_buffer(capped, max_len=4):
         capped.append(3)
+
+
+# -- lock-order (fixtures) ---------------------------------------------------
+
+def test_lock_order_cycle_is_a_deadlock_finding():
+    """Opposite nestings of two declared locks form a cycle: a deadlock
+    finding even with no GRAFTLINT_LOCK_ORDER declared anywhere."""
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"C": {"_xa": "_l1", "_xb": "_l2"}}
+
+        class C:
+            def ab(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+
+            def ba(self):
+                with self._l2:
+                    with self._l1:
+                        pass
+    """), [LockOrderRule()])
+    found = by_rule(res, "lock-order")
+    assert len(found) == 1
+    assert "CYCLE" in found[0].message and "deadlock" in found[0].message
+    assert "C._l1" in found[0].message and "C._l2" in found[0].message
+
+
+def test_lock_order_without_declaration_checks_cycles_only():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"C": {"_xa": "_l1", "_xb": "_l2"}}
+
+        class C:
+            def ab(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+    """), [LockOrderRule()])
+    assert by_rule(res, "lock-order") == []
+
+
+def test_lock_order_inverted_edge_names_both_paths():
+    """An acquisition path that inverts a declared pair fails lint, and
+    the finding carries the full call-resolved path (the nesting goes
+    through a typed ``self._a`` receiver, not a lexical ``with``)."""
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {
+            "A": {"_xa": "_la"},
+            "B": {"_xb": "_lb"},
+        }
+
+        GRAFTLINT_LOCK_ORDER = (("A._la", "B._lb"),)
+
+        class A:
+            def hold(self):
+                with self._la:
+                    pass
+
+        class B:
+            def __init__(self, a):
+                self._a: "A" = a
+
+            def inverted(self):
+                with self._lb:
+                    self._a.hold()
+    """), [LockOrderRule()])
+    found = by_rule(res, "lock-order")
+    inv = [f for f in found if "INVERTS the declared order" in f.message]
+    assert len(inv) == 1
+    msg = inv[0].message
+    assert "lock nesting B._lb -> A._la" in msg
+    assert "B.inverted" in msg and "A.hold" in msg  # the proving path
+    assert "declared-direction path" in msg
+
+
+def test_lock_order_undeclared_edge_and_stale_pair_both_fail():
+    """Drift fails in both directions: a discovered nesting missing
+    from the declaration, and a declared pair the graph cannot find."""
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {
+            "C": {"_xa": "_l1", "_xb": "_l2", "_xc": "_l3"},
+        }
+
+        GRAFTLINT_LOCK_ORDER = (("C._l1", "C._l3"),)
+
+        class C:
+            def ab(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+    """), [LockOrderRule()])
+    found = by_rule(res, "lock-order")
+    assert any("is not in GRAFTLINT_LOCK_ORDER" in f.message
+               and '("C._l1", "C._l2")' in f.message for f in found)
+    assert any("matches no nesting" in f.message
+               and "C._l1 -> C._l3" in f.message for f in found)
+    assert len(found) == 2
+
+
+def test_lock_order_declaration_matching_graph_is_clean():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"C": {"_xa": "_l1", "_xb": "_l2"}}
+
+        GRAFTLINT_LOCK_ORDER = (("C._l1", "C._l2"),)
+
+        class C:
+            def ab(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+    """), [LockOrderRule()])
+    assert by_rule(res, "lock-order") == []
+
+
+def test_lock_order_rejects_malformed_declaration():
+    res = lint(mod("""
+        GRAFTLINT_LOCK_ORDER = ("oops",)
+    """), [LockOrderRule()])
+    found = by_rule(res, "lock-order")
+    assert len(found) == 1
+    assert "literal sequence" in found[0].message
+
+
+def test_committed_lock_order_is_acyclic_and_covers_the_repo():
+    """The committed declaration itself: acyclic (a cyclic declaration
+    would sanction a deadlock), and exactly the graph — which the
+    repo-clean sweep enforces; here we pin the structural property."""
+    adj = {}
+    for a, b in GRAFTLINT_LOCK_ORDER:
+        adj.setdefault(a, set()).add(b)
+    seen, done = set(), set()
+
+    def dfs(u):
+        seen.add(u)
+        for v in adj.get(u, ()):
+            assert v not in seen or v in done, (
+                f"committed GRAFTLINT_LOCK_ORDER has a cycle through {v}")
+            if v not in done:
+                dfs(v)
+        done.add(u)
+
+    for node in list(adj):
+        if node not in done:
+            dfs(node)
+    # every node is a Class.lockattr pair
+    for a, b in GRAFTLINT_LOCK_ORDER:
+        assert "." in a and "." in b
+
+
+# -- cond-discipline (fixtures) ----------------------------------------------
+
+def test_cond_wait_not_in_while_fires_wait_for_and_while_exempt():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"C": {"_q": "_cv"}}
+
+        class C:
+            def bad(self):
+                with self._cv:
+                    if not self._q:
+                        self._cv.wait()
+
+            def good(self):
+                with self._cv:
+                    while not self._q:
+                        self._cv.wait()
+
+            def also_good(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._q, timeout=1.0)
+    """), [CondDisciplineRule()])
+    found = by_rule(res, "cond-discipline")
+    assert len(found) == 1
+    assert "not re-checked in a `while`" in found[0].message
+
+
+def test_cond_notify_outside_lock_fires_helper_proof_holds():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"C": {"_q": "_cv"}}
+
+        class C:
+            def bad(self):
+                self._cv.notify_all()
+
+            def good(self):
+                with self._cv:
+                    self._cv.notify()
+
+            def _helper(self):
+                self._cv.notify_all()  # every caller holds the cv
+
+            def caller(self):
+                with self._cv:
+                    self._helper()
+    """), [CondDisciplineRule()])
+    found = by_rule(res, "cond-discipline")
+    assert len(found) == 1
+    assert "notify without the owning lock" in found[0].message
+    assert found[0].line is not None
+
+
+def test_cond_untimed_wait_on_stop_path_fires_stop_flag_exempts():
+    res = lint(mod("""
+        import threading
+
+        GRAFTLINT_LOCKS = {"Bad": {"_q": "_cv"}, "Good": {"_q": "_cv"}}
+
+        class Bad:
+            def close(self):
+                self.drain()
+
+            def drain(self):
+                with self._cv:
+                    while not self._done:
+                        self._cv.wait()
+
+        class Good:
+            def close(self):
+                with self._cv:
+                    self._stopped = True
+                    self._cv.notify_all()
+                self.drain()
+
+            def drain(self):
+                with self._cv:
+                    while not self._done and not self._stopped:
+                        self._cv.wait()
+    """), [CondDisciplineRule()])
+    found = by_rule(res, "cond-discipline")
+    assert len(found) == 1
+    assert "reachable from Bad.close()" in found[0].message
+    assert "hang" in found[0].message
+
+
+def test_cond_unjoined_daemon_thread_fires_join_anywhere_exempts():
+    res = lint(mod("""
+        import threading
+
+        class Leaky:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+        class Owned:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+    """), [CondDisciplineRule()])
+    found = by_rule(res, "cond-discipline")
+    assert len(found) == 1
+    assert "Leaky" in found[0].message
+    assert "daemon is a backstop" in found[0].message
+
+
+def test_cond_unobserved_future_exception_cross_module():
+    setter = mod("""
+        def fail(fut, e):
+            fut.set_exception(e)
+    """, relpath="setter.py")
+    res = lint([setter], [CondDisciplineRule()])
+    found = by_rule(res, "cond-discipline")
+    assert len(found) == 1
+    assert ".result()/.exception()" in found[0].message
+
+    observer = mod("""
+        def harvest(fut):
+            return fut.result(timeout=1.0)
+    """, relpath="observer.py")
+    res = lint([setter, observer], [CondDisciplineRule()])
+    assert by_rule(res, "cond-discipline") == []
+
+
+# -- contract-drift (fixtures) -----------------------------------------------
+
+def test_contract_slo_counter_and_rule_typos_fire():
+    """The deliberate-rename fixture: one resolving SLO entry, one
+    counter typo, one unknown detector rule — only the renames fail."""
+    res = lint(mod("""
+        from tpu_sgd.obs.counters import inc
+
+        def emit():
+            inc("scenario.answered")
+
+        class D:
+            rule = "shed-rate"
+
+        SLOS = [
+            {"metric": "counter", "name": "ok",
+             "counter": "scenario.answered", "max": 1},
+            {"metric": "counter", "name": "typo",
+             "counter": "scenario.answred", "max": 1},
+            {"metric": "detector", "name": "r", "rule": "no-such-rule"},
+        ]
+    """), [ContractDriftRule()])
+    found = by_rule(res, "contract-drift")
+    assert len(found) == 2
+    assert any("'scenario.answred'" in f.message
+               and "0 of nothing passes" in f.message for f in found)
+    assert any("'no-such-rule'" in f.message for f in found)
+
+
+def test_contract_detector_default_series_must_resolve():
+    res = lint(mod("""
+        from tpu_sgd.obs.spans import event
+
+        def emit():
+            event("train.tick", n=1)
+
+        class Silent:
+            rule = "silent"
+
+            def __init__(self, series="train.renamed"):
+                self.series = series
+
+        class Wired:
+            rule = "wired"
+
+            def __init__(self, series="train.tick", prefix="train."):
+                self.series = series
+                self.prefix = prefix
+    """), [ContractDriftRule()])
+    found = by_rule(res, "contract-drift")
+    assert len(found) == 1
+    assert "series='train.renamed'" in found[0].message
+    assert "permanently silent" in found[0].message
+
+
+def test_contract_fanout_tables_and_tagged_emits_resolve():
+    """EVENT_FANOUT keys emit ``name[actor]`` (+ the ``.error[`` twin),
+    and ``inc(_tagged("x"))`` emits the ``.x`` suffix under any
+    subsystem — consumers over those shapes resolve."""
+    res = lint(mod("""
+        from tpu_sgd.obs.counters import inc, _tagged
+
+        EVENT_FANOUT = {"tenant.swap": ("tenant", None)}
+
+        def emit():
+            inc(_tagged("dispatch"))
+
+        class D:
+            rule = "fanout"
+
+            def __init__(self, prefix="tenant.swap[",
+                         series="train.dispatch"):
+                self.prefix = prefix
+                self.series = series
+    """), [ContractDriftRule()])
+    assert by_rule(res, "contract-drift") == []
+
+
+def test_contract_gate_paths_validate_against_committed_baselines():
+    """Gate JSON paths resolve against the real BENCH_*.json files at
+    the project root: a dangling segment and a missing baseline each
+    fail; the intact path is silent."""
+    res = lint(mod("""
+        GATES = {
+            "BENCH_OBS.json": [
+                Gate("headline/superstep_count_deltas", "lower"),
+                Gate("headline/superstep_count_deltas/no_such_key",
+                     "lower"),
+            ],
+            "BENCH_MISSING.json": [Gate("x", "lower")],
+        }
+    """), [ContractDriftRule()], root=REPO)
+    found = by_rule(res, "contract-drift")
+    assert len(found) == 2
+    assert any("dangles" in f.message
+               and "'no_such_key'" in f.message for f in found)
+    assert any("missing or unreadable" in f.message
+               and "BENCH_MISSING.json" in f.message for f in found)
+
+
+# -- mutation: inverted acquisition in the real replica store ----------------
+
+_STORE_REL = "tpu_sgd/replica/store.py"
+_PULL_ANCHOR = '    def pull(self, worker_id: str = "") -> PulledState:'
+_INVERSION = (
+    "    def _mutant_hold_and_poke(self, sup):\n"
+    '        self._mutant_sup: "StoreSupervisor" = sup\n'
+    "        with self._cond:\n"
+    "            return self._mutant_sup.primary()\n\n"
+)
+
+
+def test_mutation_inverted_acquisition_fails_lock_order_lint():
+    """Seed a method into the real ParameterStore that acquires the
+    supervisor's lock while holding the store condition — the inverse
+    of the committed (StoreSupervisor._lock, ParameterStore._cond)
+    pair.  The lock-order rule must name the inversion AND the cycle it
+    forms with the declared-direction path."""
+    cfg = load_config(REPO)
+    mods = load_modules(cfg, None)
+    mutated = _real_module(
+        _STORE_REL,
+        lambda s: s.replace(_PULL_ANCHOR, _INVERSION + _PULL_ANCHOR, 1))
+    mods = [mutated if m.relpath == _STORE_REL else m for m in mods]
+    res = run_lint(config=cfg, rules=[LockOrderRule()], modules=mods)
+    found = by_rule(res, "lock-order")
+    inv = [f for f in found if "INVERTS the declared order" in f.message]
+    assert len(inv) == 1, found
+    msg = inv[0].message
+    assert "ParameterStore._cond -> StoreSupervisor._lock" in msg
+    assert "_mutant_hold_and_poke" in msg  # this path
+    assert "declared-direction path" in msg
+    # both directions now discovered: the deadlock cycle is named too
+    assert any("CYCLE" in f.message for f in found)
+
+
+def test_mutation_inverted_acquisition_fails_runtime_replay():
+    """The runtime twin of the same mutation: the declared-direction
+    acquisition passes replay, the inverted one raises."""
+    rec = LocksetRecorder()
+    sup_lk = InstrumentedLock(threading.Lock(),
+                              name="StoreSupervisor._lock", recorder=rec)
+    store_cv = InstrumentedLock(threading.Condition(),
+                                name="ParameterStore._cond", recorder=rec)
+    with sup_lk:
+        with store_cv:
+            pass
+    assert_lock_order(rec)  # declared direction: clean
+
+    rec2 = LocksetRecorder()
+    sup_lk2 = InstrumentedLock(threading.Lock(),
+                               name="StoreSupervisor._lock", recorder=rec2)
+    store_cv2 = InstrumentedLock(threading.Condition(),
+                                 name="ParameterStore._cond", recorder=rec2)
+    with store_cv2:
+        with sup_lk2:
+            pass
+    with pytest.raises(LockOrderError, match="INVERTS the committed"):
+        assert_lock_order(rec2)
+
+
+def test_lock_order_replay_uses_transitive_closure():
+    """A -> B -> C declared; observing C-then-A is an inversion even
+    though no single declared pair relates them directly."""
+    rec = LocksetRecorder()
+    a = InstrumentedLock(threading.Lock(), name="A.l", recorder=rec)
+    c = InstrumentedLock(threading.Lock(), name="C.l", recorder=rec)
+    with c:
+        with a:
+            pass
+    order = (("A.l", "B.l"), ("B.l", "C.l"))
+    with pytest.raises(LockOrderError):
+        assert_lock_order(rec, order=order)
+    # unrelated pairs pass: the declaration does not order D against A
+    rec2 = LocksetRecorder()
+    d = InstrumentedLock(threading.Lock(), name="D.l", recorder=rec2)
+    a2 = InstrumentedLock(threading.Lock(), name="A.l", recorder=rec2)
+    with d:
+        with a2:
+            pass
+    assert_lock_order(rec2, order=order)
+
+
+# -- mutation: unlocked write into the real WeightSlab -----------------------
+
+def test_mutation_unlocked_slab_access_fails_lint():
+    intact = _real_module("tpu_sgd/tenant/slab.py")
+    res = lint([intact], [LockDisciplineRule()])
+    assert by_rule(res, "lock-discipline") == []
+
+    mutated = _real_module(
+        "tpu_sgd/tenant/slab.py",
+        lambda s: s.replace("with self._lock:", "if True:", 1))
+    res = lint([mutated], [LockDisciplineRule()])
+    found = by_rule(res, "lock-discipline")
+    assert len(found) >= 1
+    assert all("outside `with self._lock:`" in f.message for f in found)
+
+
+def test_mutation_unlocked_slab_write_flagged_by_eraser():
+    """The runtime twin: a live two-thread workload where one thread
+    writes a guarded slab attribute without the lock — the Eraser
+    lockset intersection must produce a race report naming both
+    threads' sites."""
+    from tpu_sgd.tenant.slab import GRAFTLINT_LOCKS as SLAB_LOCKS
+    from tpu_sgd.tenant.slab import WeightSlab
+
+    slab = WeightSlab(4, 3)
+    rec = instrument_object(slab, SLAB_LOCKS["WeightSlab"])
+
+    def locked_writer():
+        with slab._lock:
+            slab._published_at = dict(slab._published_at)
+
+    def unlocked_writer():  # the seeded race
+        slab._published_at = {}
+
+    t1 = threading.Thread(target=locked_writer, name="locked")
+    t1.start(); t1.join()
+    t2 = threading.Thread(target=unlocked_writer, name="racy")
+    t2.start(); t2.join()
+
+    races = rec.races()
+    hit = [r for r in races
+           if r.cls_name == "WeightSlab" and r.attr == "_published_at"]
+    assert len(hit) == 1, races
+    assert {"locked", "racy"} <= hit[0].threads
+    assert any(op == "write" for _, op, _, _ in hit[0].sites)
+
+
+def test_eraser_clean_on_consistently_locked_slab_workload():
+    """Contrast case: the same slab driven correctly from two threads —
+    every access under the lock — reports no races and no violations,
+    and the observed acquisition order replays clean."""
+    from tpu_sgd.tenant.slab import GRAFTLINT_LOCKS as SLAB_LOCKS
+    from tpu_sgd.tenant.slab import WeightSlab
+
+    slab = WeightSlab(4, 3)
+    rec = instrument_object(slab, SLAB_LOCKS["WeightSlab"])
+
+    def worker(base):
+        for i in range(8):
+            slab.put(base + i % 3, np.ones(3, np.float32), 0.5, version=i)
+            slab.version_of(base + i % 3)
+
+    threads = [threading.Thread(target=worker, args=(b,), name=f"w{b}")
+               for b in (0, 100)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.violations == []
+    assert rec.races() == []
+    assert_lock_order(rec)
+    assert rec.checked_accesses > 20
+
+
+# -- runtime: the fixed racing schedules, pinned -----------------------------
+
+def test_flightrec_concurrent_triggers_rate_limited_once(tmp_path):
+    """The flightrec fix pinned: the min-interval check and the clock
+    update are one atomic region, so N concurrent debounced triggers
+    produce exactly ONE dump — and the instrumented run shows every
+    ``_last_dump_t`` access under the declared lock."""
+    from tpu_sgd.obs.flightrec import FlightRecorder, GRAFTLINT_LOCKS
+
+    fr = FlightRecorder(str(tmp_path / "fr.jsonl"), capacity=8)
+    fr.record("probe", {"i": 0})
+    rec = instrument_object(fr, GRAFTLINT_LOCKS["FlightRecorder"])
+
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(
+            fr.trigger("race", min_interval_s=60.0)))
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fr.dumps == 1  # one winner; the rest saw the fresh clock
+    assert sum(r is not None for r in results) == 1
+    assert rec.violations == []
+    assert rec.races() == []
+
+
+def test_batcher_concurrent_start_spawns_one_worker_and_restarts():
+    """The batcher start/stop fix pinned: racing ``start()`` calls
+    create exactly one worker thread, ``stop()`` resets the handle
+    under the condition so a later ``start()`` really restarts."""
+    from tpu_sgd.serve.batcher import MicroBatcher
+
+    b = MicroBatcher(lambda X: np.asarray(X).sum(axis=1),
+                     max_batch=4, max_latency_s=0.002)
+    starters = [threading.Thread(target=b.start) for _ in range(4)]
+    for t in starters:
+        t.start()
+    for t in starters:
+        t.join()
+    workers = [t for t in threading.enumerate()
+               if t.name == "tpu-sgd-serve-batcher"]
+    assert len(workers) == 1
+
+    futs = [b.submit(np.ones(3, np.float32)) for _ in range(5)]
+    assert [float(f.result(timeout=10)) for f in futs] == [3.0] * 5
+    b.stop()
+    assert not workers[0].is_alive()
+
+    b.start()  # the reset handle admits a true restart
+    assert float(b.submit(np.ones(3, np.float32)).result(timeout=10)) == 3.0
+    b.stop()
+
+
+def test_batcher_burst_eraser_clean_and_counters_consistent():
+    """The batcher burst path under full instrumentation: no lockset
+    violations, no Eraser races (the sanctioned racy reader
+    ``queue_depth`` is simply not exercised), the acquisition order
+    replays against the committed declaration, and the counter pair
+    moved under ``_cond`` adds up."""
+    from tpu_sgd.serve.batcher import GRAFTLINT_LOCKS, MicroBatcher
+
+    b = MicroBatcher(lambda X: np.asarray(X).sum(axis=1),
+                     max_batch=4, max_latency_s=0.002)
+    rec = instrument_object(b, GRAFTLINT_LOCKS["MicroBatcher"])
+    with b:
+        futs = []
+        for wave in range(3):
+            futs += [b.submit(np.ones(3, np.float32)) for _ in range(8)]
+            got = [f.result(timeout=10) for f in futs[-8:]]
+            assert [float(g) for g in got] == [3.0] * 8
+    allowed = {"_flush"}  # the metrics sample reads qd outside the cv
+    assert rec.violating_functions() <= allowed, rec.violations
+    assert rec.races() == []
+    assert_lock_order(rec)
+    with b._cond:
+        assert b.batch_count >= 6  # 24 requests / max_batch 4
+        assert b.reject_count == 0

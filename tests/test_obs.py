@@ -789,6 +789,27 @@ def test_window_store_flush_and_late_records():
     assert [w["index"] for w in store.snapshot()] == [5, 6]
 
 
+def test_window_store_concurrent_close_joins_dispatch_thread():
+    """The racing schedule the ISSUE 19 fix pins: ``close()`` snapshots
+    the dispatch-thread handle UNDER ``_dispatch_cv`` (an unlocked read
+    raced ``add_close_listener``'s lazy spawn and could miss the thread
+    entirely), then joins OUTSIDE the cv — so N concurrent closers all
+    return with the dispatch thread really dead, never deadlocked on
+    the loop's finally-block."""
+    store, _ = _mk_store()
+    store.add_close_listener(lambda snap: None)
+    t = store._dispatch_thread
+    assert t is not None and t.is_alive()
+    closers = [threading.Thread(target=store.close, name=f"close{i}")
+               for i in range(3)]
+    for c in closers:
+        c.start()
+    for c in closers:
+        c.join(timeout=30)
+    assert not any(c.is_alive() for c in closers)  # no deadlock
+    assert not t.is_alive()  # really joined, not leaked as a daemon
+
+
 # -- detectors: trip / no-trip fixtures per rule -----------------------------
 
 def _run_detector(detector, feeds, width=1.0):

@@ -263,6 +263,75 @@ def test_resurrected_primary_delta_records_refused_by_log():
         primary.apply_replica_record(stale)
 
 
+def test_failover_lock_discipline_and_order_validated_at_runtime():
+    """A kill-primary failover under FULL lock instrumentation
+    (supervisor + both stores + delta log + client, one shared
+    recorder): no unguarded access, no Eraser race, and the observed
+    acquisition order — including the ``set_replication(log.append)``
+    callback edge the static lock-order graph cannot resolve — replays
+    clean against the committed GRAFTLINT_LOCK_ORDER."""
+    import jax.numpy as jnp
+
+    from tpu_sgd.analysis.runtime import (LocksetRecorder, assert_lock_order,
+                                          instrument_object)
+    from tpu_sgd.replica import ha as ha_mod
+    from tpu_sgd.replica import store as store_mod
+
+    _, _, w0 = _data(n=32, d=8)
+    primary, standby, sup = _store_pair(_cfg(num_iterations=200), w0, tau=2)
+    # quiesce the standby applier while the locks are swapped for
+    # instrumented twins — it polls DeltaLog.since() from its own
+    # thread, and a swap mid-wait would look like an unguarded read
+    sup._standbys[1].halt()
+    rec = LocksetRecorder()
+    instrument_object(sup._log, ha_mod.GRAFTLINT_LOCKS["DeltaLog"], rec)
+    for st in (primary, standby):
+        instrument_object(
+            st, store_mod.GRAFTLINT_LOCKS["ParameterStore"], rec,
+            owner="ParameterStore")
+    sup._standbys[1].start()
+    # instrument the supervisor LAST: the restart above reads
+    # sup._standbys from the test thread, which is outside the lock
+    instrument_object(sup, ha_mod.GRAFTLINT_LOCKS["StoreSupervisor"], rec)
+    client = sup.client()
+    instrument_object(client, ha_mod.GRAFTLINT_LOCKS["StoreClient"], rec)
+    client.register_worker("w0", 0)
+    client.register_worker("w1", 1)
+
+    ok = [0, 0]
+
+    def pusher(i):
+        for _ in range(30):
+            try:
+                pulled = client.pull(f"w{i}")
+                res = client.push(
+                    f"w{i}", pulled.version,
+                    jnp.asarray(np.ones(8, np.float32)),
+                    jnp.asarray(1.0), jnp.asarray(8.0),
+                    basis_epoch=pulled.epoch)
+                ok[i] += bool(res.accepted)
+            except Exception:
+                pass  # transient mid-promotion refusals are protocol
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=pusher, args=(i,), name=f"push{i}")
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    assert sup.kill_primary()  # the failover, mid-traffic
+    for t in threads:
+        t.join(timeout=60)
+    assert sup.epoch == 1
+    assert sum(ok) > 0  # traffic really flowed across the promotion
+    assert rec.checked_accesses > 0
+    assert rec.violations == []
+    assert rec.races() == []
+    # the statically-invisible callback edge WAS observed and is legal
+    assert ("ParameterStore._cond", "DeltaLog._cond") in rec.order_pairs
+    assert_lock_order(rec)
+
+
 def test_fenced_old_primary_late_save_never_shadows(tmp_path):
     """The satellite-1 pin: restore() prefers the highest
     ``(epoch, version)`` — a fenced old primary's LATE save with a

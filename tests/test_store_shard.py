@@ -345,7 +345,8 @@ def test_sharded_store_lock_discipline_validated_at_runtime():
     lexical rule, proving the two-level discipline (store ``_cond`` →
     pipeline ``_cond``, never the reverse) holds under real worker
     concurrency."""
-    from tpu_sgd.analysis.runtime import instrument_object
+    from tpu_sgd.analysis.runtime import (LocksetRecorder, assert_lock_order,
+                                          instrument_object)
     from tpu_sgd.replica import shard as shard_mod
     from tpu_sgd.replica import store as store_mod
 
@@ -354,11 +355,15 @@ def test_sharded_store_lock_discipline_validated_at_runtime():
                mini_batch_fraction=0.5)
     store = ShardedParameterStore(
         SquaredL2Updater(), cfg, w0, n_shards=2, staleness=1)
-    recorders = [instrument_object(
-        store, store_mod.GRAFTLINT_LOCKS["ParameterStore"])]
-    recorders += [
-        instrument_object(p, shard_mod.GRAFTLINT_LOCKS["ShardPipeline"])
-        for p in store._pipes]
+    # ONE recorder across store + pipelines so cross-object acquisition
+    # ORDER pairs are observed, then replayed against the committed
+    # GRAFTLINT_LOCK_ORDER (the Eraser + lock-order runtime twins)
+    rec = LocksetRecorder()
+    instrument_object(store, store_mod.GRAFTLINT_LOCKS["ParameterStore"],
+                      rec, owner="ParameterStore")
+    for p in store._pipes:
+        instrument_object(p, shard_mod.GRAFTLINT_LOCKS["ShardPipeline"],
+                          rec, owner="ShardPipeline")
     shards = shard_rows(X, y, 2)
     workers = [ReplicaWorker(f"w{s}", s, store, LeastSquaresGradient(),
                              cfg, *shards[s]) for s in range(2)]
@@ -371,9 +376,37 @@ def test_sharded_store_lock_discipline_validated_at_runtime():
         t.join(timeout=60)
     store.stop()
     assert store.version == 20
-    assert sum(r.checked_accesses for r in recorders) > 0
-    for r in recorders:
-        assert r.violations == []
+    assert rec.checked_accesses > 0
+    assert rec.violations == []
+    assert rec.races() == []
+    assert ("ParameterStore._cond",
+            "ShardPipeline._cond") in rec.order_pairs
+    assert_lock_order(rec)  # the observed nesting matches the committed order
+
+
+def test_shard_pipeline_concurrent_shutdown_and_post_shutdown_submit():
+    """The ISSUE 19 shard fix pinned: ``shutdown()`` swaps the thread
+    handle to None UNDER the condition, so racing shutdowns join the
+    worker exactly once, the handle cannot be re-read mid-swap, and a
+    submit after shutdown fails typed instead of posting into a dead
+    pipeline."""
+    from tpu_sgd.replica.shard import ShardPipeline
+
+    p = ShardPipeline(0, 0, 4)
+    p.submit(lambda: 41 + 1)  # lazily spawns the worker under _cond
+    assert p.collect() == 42
+    worker = p._thread
+    assert worker is not None and worker.is_alive()
+
+    closers = [threading.Thread(target=p.shutdown) for _ in range(4)]
+    for t in closers:
+        t.start()
+    for t in closers:
+        t.join(timeout=10)
+    assert not worker.is_alive()
+    assert p._thread is None  # the swapped handle, observed post-join
+    with pytest.raises(RuntimeError, match="shut down"):
+        p.submit(lambda: 0)
 
 
 # -- the planner --------------------------------------------------------------

@@ -494,3 +494,52 @@ def test_tenant_scenario_violated_slo_fails_the_gate(tenant_scenario_run,
                             "--slo", str(bad)]) == 1
     with pytest.raises(ValueError, match="no such SLO"):
         build_tenant_slos("smoke", violate="not-an-slo")
+
+
+# -- (i) publish-storm lock discipline (graftlint v3 runtime twin) ----------
+def test_publish_storm_lock_discipline_validated_at_runtime(tmp_path, rng):
+    """A publish/load storm on ONE tenant under full lock
+    instrumentation (store + slab, one shared recorder): every guarded
+    access holds its declared lock, the Eraser lockset detector finds
+    no race, the observed acquisition order replays clean against the
+    committed GRAFTLINT_LOCK_ORDER — and the per-tenant publish lock
+    keeps the slab row and the checkpoint manager's latest version in
+    lockstep (the pre-fix race could restore a STALE checkpoint over a
+    newer slab row)."""
+    import threading
+
+    from tpu_sgd.analysis.runtime import (LocksetRecorder, assert_lock_order,
+                                          instrument_object)
+    from tpu_sgd.tenant import slab as slab_mod
+    from tpu_sgd.tenant import store as tenant_store_mod
+
+    store = TenantModelStore(str(tmp_path / "storm"), capacity=4, d=D)
+    w = rng.normal(size=(24, D)).astype(np.float32)
+    store.publish(7, w[0], intercept=0.5)
+
+    rec = LocksetRecorder()
+    instrument_object(
+        store, tenant_store_mod.GRAFTLINT_LOCKS["TenantModelStore"], rec)
+    instrument_object(
+        store.slab, slab_mod.GRAFTLINT_LOCKS["WeightSlab"], rec)
+
+    def publisher():
+        for i in range(1, 20):
+            store.publish(7, w[i], intercept=0.5 * i)
+
+    def loader():
+        for _ in range(20):
+            store.load(7)
+
+    threads = [threading.Thread(target=publisher, name="publish"),
+               threading.Thread(target=loader, name="load")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert rec.checked_accesses > 0
+    assert rec.violations == []
+    assert rec.races() == []
+    assert_lock_order(rec)
+    # the lockstep pin: the resident row is the latest published version
+    assert store.slab.version_of(7) == store._manager(7).latest_version()

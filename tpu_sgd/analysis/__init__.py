@@ -16,20 +16,48 @@ from tpu_sgd.analysis.core import (Finding, KNOWN_RULES, LintResult,
 from tpu_sgd.analysis.runtime import (CallbackBufferError,
                                       CompileCountError, DispatchCountError,
                                       HostSyncError, InstrumentedLock,
-                                      LocksetRecorder,
+                                      LockOrderError, LocksetRecorder,
                                       assert_bounded_callback_buffer,
                                       assert_compile_count,
                                       assert_dispatch_count,
+                                      assert_lock_order,
                                       assert_no_host_sync,
                                       count_dispatches, count_host_syncs,
                                       instrument_object)
 
+#: THE project lock order — every (outer, inner) acquisition nesting the
+#: static lock-order graph (``rules_order.py``) discovers, committed.
+#: Nodes are ``DeclaringClass.lockattr`` per the ``GRAFTLINT_LOCKS``
+#: grammar.  The rule fails lint when the graph and this declaration
+#: drift in EITHER direction: a new nesting must be added here (after
+#: checking it does not invert an existing pair), an inverted nesting
+#: names both acquisition paths, and a pair the graph no longer finds
+#: must be deleted.  ``runtime.assert_lock_order`` replays recorded
+#: acquisition sequences from live tests against the same pairs
+#: (transitively closed), covering callback-routed acquisitions the
+#: static graph cannot see (the HA ``set_replication(log.append)``
+#: hook).  Current topology, tallest first: StoreSupervisor._lock sits
+#: above the whole replica plane; ParameterStore._cond above the shard
+#: pipelines and the obs counters; WindowStore._lock -> _dispatch_cv is
+#: the PR 13 ordering fix, now machine-checked.
+GRAFTLINT_LOCK_ORDER = (
+    ("MicroBatcher._cond", "RuntimeCounters._lock"),
+    ("ParameterStore._cond", "Heartbeat._lock"),
+    ("ParameterStore._cond", "RuntimeCounters._lock"),
+    ("ParameterStore._cond", "ShardPipeline._cond"),
+    ("StoreSupervisor._lock", "DeltaLog._cond"),
+    ("StoreSupervisor._lock", "ParameterStore._cond"),
+    ("StoreSupervisor._lock", "RuntimeCounters._lock"),
+    ("WindowStore._lock", "WindowStore._dispatch_cv"),
+)
+
 __all__ = [
-    "Finding", "KNOWN_RULES", "LintResult", "ModuleFile", "Rule",
-    "load_config", "run_lint",
+    "Finding", "GRAFTLINT_LOCK_ORDER", "KNOWN_RULES", "LintResult",
+    "ModuleFile", "Rule", "load_config", "run_lint",
     "CallbackBufferError", "CompileCountError", "DispatchCountError",
-    "HostSyncError", "InstrumentedLock", "LocksetRecorder",
-    "assert_bounded_callback_buffer", "assert_compile_count",
-    "assert_dispatch_count", "assert_no_host_sync", "count_dispatches",
-    "count_host_syncs", "instrument_object",
+    "HostSyncError", "InstrumentedLock", "LockOrderError",
+    "LocksetRecorder", "assert_bounded_callback_buffer",
+    "assert_compile_count", "assert_dispatch_count", "assert_lock_order",
+    "assert_no_host_sync", "count_dispatches", "count_host_syncs",
+    "instrument_object",
 ]

@@ -49,6 +49,9 @@ KNOWN_RULES = (
     "carry-stability",
     "memo-key",
     "obs-discipline",
+    "lock-order",
+    "cond-discipline",
+    "contract-drift",
 )
 
 #: core policy checks (not AST rules; emitted by the runner itself)
@@ -290,17 +293,21 @@ def default_rules() -> List[Rule]:
     # for the rule modules that import it
     from tpu_sgd.analysis.rules_callback import CallbackDisciplineRule
     from tpu_sgd.analysis.rules_carry import CarryStabilityRule
+    from tpu_sgd.analysis.rules_cond import CondDisciplineRule
+    from tpu_sgd.analysis.rules_contract import ContractDriftRule
     from tpu_sgd.analysis.rules_donation import DonationSafetyRule
     from tpu_sgd.analysis.rules_failpoint import FailpointCoverageRule
     from tpu_sgd.analysis.rules_lock import LockDisciplineRule
     from tpu_sgd.analysis.rules_memo import MemoKeyRule
+    from tpu_sgd.analysis.rules_order import LockOrderRule
     from tpu_sgd.analysis.rules_shape import EagerInLoopRule, ShapeTrapRule
     from tpu_sgd.analysis.rules_sync import HostSyncRule, ObsDisciplineRule
 
     return [ShapeTrapRule(), LockDisciplineRule(), DonationSafetyRule(),
             FailpointCoverageRule(), EagerInLoopRule(), HostSyncRule(),
             CallbackDisciplineRule(), CarryStabilityRule(), MemoKeyRule(),
-            ObsDisciplineRule()]
+            ObsDisciplineRule(), LockOrderRule(), CondDisciplineRule(),
+            ContractDriftRule()]
 
 
 def _policy_findings(modules: Sequence[ModuleFile],

@@ -53,6 +53,11 @@ GRAFTLINT_LOCKS = {
     "FlightRecorder": {
         "_ring": "_lock",
         "_dumps": "_lock",
+        # the rate-limit clock: an undeclared read-modify-write lets
+        # two concurrent triggers both pass the min-interval check and
+        # dump twice (declared since ISSUE 19; accesses were already
+        # locked, the declaration makes drift fail lint)
+        "_last_dump_t": "_lock",
     },
 }
 

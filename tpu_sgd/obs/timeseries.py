@@ -91,6 +91,10 @@ GRAFTLINT_LOCKS = {
         "_pending": "_dispatch_cv",
         "_dispatch_busy": "_dispatch_cv",
         "_dispatch_stop": "_dispatch_cv",
+        # lazily spawned by add_close_listener(), snapshotted by
+        # close() — both under the cv since ISSUE 19 (the unlocked
+        # close-side read raced the first-listener spawn)
+        "_dispatch_thread": "_dispatch_cv",
     },
 }
 
@@ -328,7 +332,13 @@ class WindowStore:
         with self._dispatch_cv:
             self._dispatch_stop = True
             self._dispatch_cv.notify_all()
-        t = self._dispatch_thread
+            # snapshot under the cv — add_close_listener() lazily
+            # spawns the thread under it, and an unlocked read here
+            # races that spawn; the join stays OUTSIDE the cv
+            # (ADVICE.md "A lock order is a declaration, not a
+            # convention": joining under the cv the dispatch loop's
+            # finally-block needs would deadlock the close)
+            t = self._dispatch_thread
         if t is not None:
             t.join(timeout=5.0)
 

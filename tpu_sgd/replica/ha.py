@@ -85,7 +85,11 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, NamedTuple, Optional
+from typing import (TYPE_CHECKING, Callable, Dict, List, NamedTuple,
+                    Optional)
+
+if TYPE_CHECKING:  # the lock-order graph reads this annotation too
+    from tpu_sgd.replica.store import ParameterStore
 
 import numpy as np
 
@@ -486,7 +490,12 @@ class StoreSupervisor:
         if not stores:
             raise ValueError("StoreSupervisor needs at least one store")
         self._lock = threading.Condition()
-        self._stores = list(stores)
+        #: the element annotation is load-bearing: the static lock-order
+        #: graph (analysis/rules_order.py) types `self._stores[i]` /
+        #: `for s in self._stores` receivers from it, which is how the
+        #: StoreSupervisor._lock -> ParameterStore._cond nesting in
+        #: _promote_locked is proven rather than taken on faith
+        self._stores: "List[ParameterStore]" = list(stores)
         self._primary_index = 0
         self._epoch = int(stores[0].epoch)
         self._membership = membership

@@ -90,6 +90,10 @@ GRAFTLINT_LOCKS = {
         "_pushes": "_cond",
         "_applies": "_cond",
         "_replays": "_cond",
+        # lazily spawned by the first submit(), swapped out by
+        # shutdown() — both under _cond since ISSUE 19 (the unlocked
+        # shutdown swap raced the first-submit spawn)
+        "_thread": "_cond",
     },
 }
 
@@ -228,7 +232,13 @@ class ShardPipeline:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
-        t, self._thread = self._thread, None
+            # swap the handle under the lock — submit() lazily writes
+            # it under _cond, and an unlocked swap here races that
+            # first-submit spawn (Eraser-confirmed, ISSUE 19); the join
+            # itself happens OUTSIDE the lock (ADVICE.md "A lock order
+            # is a declaration, not a convention": joining under _cond
+            # would deadlock against the worker's final acquisition)
+            t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=10.0)
 

@@ -85,6 +85,9 @@ GRAFTLINT_LOCKS = {
         "shed_utilization": "_cond",
         "admission_lock_rounds": "_cond",
         "admission_priced": "_cond",
+        "batch_count": "_cond",
+        "reject_count": "_cond",
+        "_thread": "_cond",
     },
 }
 
@@ -555,17 +558,20 @@ class MicroBatcher:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
-        if self._thread is not None:
-            return self
         with self._cond:
-            # under the lock: a submit() racing this restart must see
-            # either the stopped batcher or the restarted one, never a
-            # torn flag (found by graftlint's lock-discipline rule)
+            # the whole check-then-spawn under the lock: two concurrent
+            # start() calls must never each see _thread None and spawn
+            # two flush threads over one queue, and a submit() racing a
+            # restart must see either the stopped batcher or the
+            # restarted one, never a torn flag
+            if self._thread is not None:
+                return self
             self._stopped = False
-        self._thread = threading.Thread(
-            target=self._run, name="tpu-sgd-serve-batcher", daemon=True
-        )
-        self._thread.start()
+            t = self._thread = threading.Thread(
+                target=self._run, name="tpu-sgd-serve-batcher",
+                daemon=True
+            )
+        t.start()
         return self
 
     def stop(self, drain: bool = True):
@@ -580,7 +586,7 @@ class MicroBatcher:
                     while q:
                         q.popleft().future.cancel()
             self._cond.notify_all()
-        t = self._thread
+            t = self._thread  # snapshot under the lock; join OUTSIDE it
         if t is not None:
             t.join(timeout=10.0)
             if t.is_alive():
@@ -592,7 +598,8 @@ class MicroBatcher:
                     "flush thread did not stop within 10s (a batch is "
                     "still in flight); call stop() again to re-join"
                 )
-            self._thread = None
+            with self._cond:
+                self._thread = None
         elif drain:
             # never started: no flush thread exists to honor the drain
             # promise, so drain synchronously here — a waiter blocked on
@@ -709,7 +716,11 @@ class MicroBatcher:
             if len(self._flush_walls) >= 8:
                 self._p99_wall = nearest_rank(
                     sorted(self._flush_walls), 99)
-        self.batch_count += 1
+            self.batch_count += 1
+            # snapshot for the metrics record below: the tally is read
+            # outside the lock, and an unlocked read races every
+            # admission-path increment (Eraser-confirmed, ISSUE 19)
+            reject_count = self.reject_count
         self.heartbeat.beat()
         for i, r in enumerate(batch):
             r.future.set_result(out[i])
@@ -728,7 +739,7 @@ class MicroBatcher:
                     batch_size=len(batch),
                     padded_size=self.padded_size_fn(len(batch)),
                     latencies=[t_done - r.t_enqueue for r in batch],
-                    reject_count=self.reject_count,
+                    reject_count=reject_count,
                     enqueue_depth=batch[0].enqueue_depth,
                     deadline_slack_s=deadline_slack_s,
                     lanes=lanes,

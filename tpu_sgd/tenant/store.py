@@ -140,31 +140,39 @@ class TenantModelStore:
         (incl. ``IntegrityError``) — residency never swallows it."""
         tid = int(tenant_id)
         m = self._manager(tid)
-        last_err: Optional[BaseException] = None
-        for _ in range(3):
-            latest = m.latest_version()
-            if latest is None:
-                raise TenantMissingError(
-                    f"tenant {tid}: no published checkpoint under "
-                    f"{self.directory!r}")
-            try:
-                ck = m.restore_version(latest)
-                break
-            except Exception as e:
-                # a concurrent publish can prune `latest` between the
-                # version scan and the read (keep=N retention); re-scan
-                # and retry — a persistent failure (e.g. a corrupt
-                # newest checkpoint) still raises after the bounded
-                # retries, never silently served
-                last_err = e
-        else:
-            raise last_err
-        _, evicted, kind = self.slab.put(
-            tid, ck["weights"],
-            float(ck["extras"].get("intercept", 0.0)), version=latest)
-        self._emit("swap" if kind == "swapped" else "admit", tid)
-        if evicted is not None:
-            self._emit("evict", evicted)
+        # the whole scan-restore-put under the tenant's publish lock: a
+        # concurrent publish(tid) bumps the checkpoint AND the slab row
+        # between an unserialized load's restore and its put, and the
+        # load would then overwrite the newer slab row with the older
+        # checkpoint — a silent version regression served until the
+        # next swap (Eraser-confirmed on the publish-storm workload,
+        # ISSUE 19).  Loads of DIFFERENT tenants stay fully concurrent.
+        with self._publish_lock(tid):
+            last_err: Optional[BaseException] = None
+            for _ in range(3):
+                latest = m.latest_version()
+                if latest is None:
+                    raise TenantMissingError(
+                        f"tenant {tid}: no published checkpoint under "
+                        f"{self.directory!r}")
+                try:
+                    ck = m.restore_version(latest)
+                    break
+                except Exception as e:
+                    # a concurrent publish can prune `latest` between
+                    # the version scan and the read (keep=N retention);
+                    # re-scan and retry — a persistent failure (e.g. a
+                    # corrupt newest checkpoint) still raises after the
+                    # bounded retries, never silently served
+                    last_err = e
+            else:
+                raise last_err
+            _, evicted, kind = self.slab.put(
+                tid, ck["weights"],
+                float(ck["extras"].get("intercept", 0.0)), version=latest)
+            self._emit("swap" if kind == "swapped" else "admit", tid)
+            if evicted is not None:
+                self._emit("evict", evicted)
         return latest
 
     # alias: the hot-reload spelling (reload tenant i; neighbors untouched)
