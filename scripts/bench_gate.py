@@ -138,6 +138,42 @@ GATES = {
              note="per-request admission is the 1.0 basis the burst "
                   "ratio is read against"),
     ],
+    "BENCH_RESIDENT.json": [
+        # the one-fused-dispatch contract (ISSUE 20): however many
+        # iterations the run covers, the resident driver launches ONE
+        # program — exact by construction, any extra launch is a
+        # regression with no noise excuse
+        Gate("counts/resident/optimize.streamed.step", "lower",
+             note="the full resident run must stay ONE fused dispatch"),
+        Gate("counts/dispatch_reduction_vs_superstep_x", "higher",
+             rel_tol=0.05),
+        Gate("counts/round_trip_reduction_vs_superstep_x", "higher",
+             rel_tol=0.05),
+        Gate("counts/h2d_bytes_reduction_vs_k1_x", "higher",
+             rel_tol=0.05),
+        # resident + EF (ISSUE 20): the error-feedback accumulator is a
+        # while_loop carry leaf, so the composed run keeps the dense
+        # cell's shape — one dispatch, >= 10x fewer than the compressed
+        # superstep twin, bitwise trajectory
+        Gate("ef_cell/resident/optimize.streamed.step", "lower",
+             note="EF carry must keep the one-dispatch contract"),
+        Gate("ef_cell/dispatch_reduction_vs_superstep_x", "higher",
+             rel_tol=0.05,
+             note="the ISSUE 20 >= 10x acceptance number"),
+        Gate("ef_cell/bitwise_vs_compressed_superstep", "equal",
+             note="resident+EF must replay the compressed superstep "
+                  "trajectory bitwise — drift means the carried EF "
+                  "diverged from the host accumulator"),
+        # resident + sparse (ISSUE 20): the fixed-nse BCOO feed variant
+        # of the same driver — runtime-twin dispatch counts, small band
+        # for staging-op drift on a deliberate driver change
+        Gate("sparse_cell/dispatches/resident", "lower", rel_tol=0.10),
+        Gate("sparse_cell/dispatch_reduction_vs_superstep_x", "higher",
+             rel_tol=0.10),
+        Gate("sparse_cell/bitwise_vs_sparse_superstep", "equal",
+             note="the sparse slab feed must stay bitwise its "
+                  "superstep twin"),
+    ],
     "BENCH_SPARSE_WIRE.json": [
         Gate("sparse_feed/wire_bytes/ratio", "higher", rel_tol=0.10,
              note="BCOO feed physical-vs-dense-f32 compression"),

@@ -311,21 +311,34 @@ def test_resume_without_ef_state_warns():
             o2.optimize_with_history((X, y), w0)
 
 
-def test_wire_compress_falls_back_with_residency_and_partial_residency():
+def test_wire_compress_composes_with_residency_partial_slab_falls_back():
+    """ISSUE 20 lifted the PR 9 deviation: ``set_residency`` +
+    ``wire_compress`` now composes — the EF accumulator rides the
+    resident while-loop ring and the run is BITWISE its compressed
+    superstep twin, with zero fallback warnings.  Only the
+    partially-resident slab (no EF carry in the window step) still
+    falls back to the dense wire, loudly."""
+    import warnings
+
     X, y = _dense_reg(seed=4, n=128, d=8)
     w0 = np.zeros(8, np.float32)
-    # whole-run resident driver: warned fallback to the superstep driver
+    # whole-run resident driver: composes, bitwise vs compressed superstep
+    o_sup = _streamed_opt(iters=8, k=4, frac=1.0)
+    o_sup.set_ingest_options(wire_compress="topk:0.25")
+    w_sup, h_sup = o_sup.optimize_with_history((X, y), w0)
     o = _streamed_opt(iters=8, k=4, frac=1.0)
     o.set_residency(2).set_ingest_options(wire_compress="topk:0.25")
-    with pytest.warns(RuntimeWarning, match="superstep driver"):
-        _, h = o.optimize_with_history((X, y), w0)
-    assert len(h) == 8
-    # partial residency: warned fallback to the dense wire
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        w_res, h_res = o.optimize_with_history((X, y), w0)
+    np.testing.assert_array_equal(np.asarray(w_res), np.asarray(w_sup))
+    np.testing.assert_array_equal(h_res, h_sup)
+    # partially-resident slab: warned fallback to the dense wire
     o2 = _streamed_opt(iters=8, sampling="sliced")
     o2.host_streaming = True
     o2.streaming_resident_rows = 100
     o2.set_ingest_options(wire_compress="topk:0.25")
-    with pytest.warns(RuntimeWarning, match="partial residency"):
+    with pytest.warns(RuntimeWarning, match="partially-resident"):
         o2.optimize_with_history((X, y), w0)
 
 

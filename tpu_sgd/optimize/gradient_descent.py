@@ -1339,15 +1339,6 @@ class GradientDescent(Optimizer):
                         "(shard the resident BCOO path with set_mesh "
                         "instead)"
                     )
-                if self.resident_cadence >= 2:
-                    import warnings
-
-                    warnings.warn(
-                        "set_residency applies to the dense "
-                        "device-resident-data feeds; the host-streamed "
-                        "sparse driver runs per-superstep dispatch",
-                        RuntimeWarning, stacklevel=2,
-                    )
                 if self.ingest_wire_dtype is not None:
                     import warnings
 
@@ -1370,6 +1361,7 @@ class GradientDescent(Optimizer):
                     retry_policy=self.ingest_retry_policy,
                     stop_signal=self._stop_signal,
                     superstep_k=self.superstep,
+                    resident_cadence=self.resident_cadence,
                     wire_compress=(self.ingest_wire_compress
                                    if self.ingest_pipeline else None),
                 )

@@ -23,7 +23,15 @@ the host.  This module moves the *run loop itself* onto the device:
   with a bounded ring buffer of per-step ys, which replays through the
   existing :func:`_replay_fused_steps` — the loss history, the detected
   convergence iteration, listener events, and the checkpoint cadence
-  are byte-for-byte the superstep driver's.
+  are byte-for-byte the superstep driver's, and
+* feature state as CARRY state (``with_extra``): the compressed wire's
+  error-feedback accumulator rides the while-loop carry next to the
+  weights with its per-step history on a seventh ring leaf, so
+  ``set_residency`` + ``wire_compress`` composes in ONE program (the
+  lifted PR 9 DEVIATION; ADVICE.md "One driver, many carries") —
+  every feed is a ``step_fn`` + ``*data`` variant of this one driver
+  (dense full-batch, fully-resident slab, fixed-nse BCOO in
+  ``optimize/streamed_sparse.py``), never a second loop.
 
 Why a bounded RING, not whole-run ys: a while_loop cannot return
 per-trip stacked outputs (its carry is fixed-shape), and even if it
@@ -108,7 +116,8 @@ class ResidentBookkeeper:
                  losses: list, reg_val: float, start_iter: int,
                  listener=None, save_cb: Optional[Callable] = None,
                  save_every: int = 0, stop_signal=None,
-                 retry_policy=None, check_numerics: bool = False):
+                 retry_policy=None, check_numerics: bool = False,
+                 extras_cb: Optional[Callable] = None):
         self.cfg = config
         self.k = int(k)
         self.cadence = int(cadence)
@@ -120,6 +129,11 @@ class ResidentBookkeeper:
         self.stop_signal = stop_signal
         self.retry_policy = retry_policy
         self.check_numerics = bool(check_numerics)
+        #: installed by callers whose step carries extra optimizer state
+        #: (the EF accumulator): called as ``extras_cb(i0w, extras_ring)``
+        #: BEFORE each window replay so a checkpoint save fired inside
+        #: the replay reads the iteration-exact post-update extras
+        self.extras_cb = extras_cb
         #: last iteration whose bookkeeping has been replayed (the
         #: preemption boundary and the resume point after a false
         #: device-convergence)
@@ -128,6 +142,10 @@ class ResidentBookkeeper:
         #: ring ys — the truncation-safe final state when a run ends
         #: mid-superstep, exactly like the superstep drivers')
         self.last_w: Optional[np.ndarray] = None
+        #: host copy of the extras leaf AT ``replayed_through`` (set only
+        #: when the loop carries extras) — the resume state for a false
+        #: device-convergence re-dispatch, like ``last_w``
+        self.last_extra: Optional[np.ndarray] = None
         self.host_converged = False
         self.stop_requested = False
         self.error: Optional[BaseException] = None
@@ -197,10 +215,22 @@ class ResidentBookkeeper:
         checkpoint cadence).  Overshoot steps past ``num_iterations``
         (the while body's scan never branches on the budget) are bounded
         out here, exactly as the superstep drivers truncate their tails.
+
+        A 7-leaf ``rings`` carries per-step EXTRAS (the EF accumulator
+        ring of the compressed carry) as its last leaf: ``extras_cb``
+        fires first with the whole window so a mid-window checkpoint
+        save reads the iteration-exact post-update state, and
+        ``last_extra`` tracks the replayed boundary like ``last_w``.
         """
         from tpu_sgd.optimize.gradient_descent import _replay_fused_steps
 
         K, cfg = self.k, self.cfg
+        exs = None
+        if len(rings) == 7:
+            exs = rings[6]
+            rings = rings[:6]
+            if self.extras_cb is not None:
+                self.extras_cb(i0w, exs)
         ws, ls, rs, cs, dns, wns = rings
         now = time.perf_counter()
         n_steps = max(1, n_supersteps * K)
@@ -222,6 +252,8 @@ class ResidentBookkeeper:
             )
             self.replayed_through = base + t_last
             self.last_w = np.asarray(ws[lo + t_last])
+            if exs is not None:
+                self.last_extra = np.asarray(exs[lo + t_last])
             if conv:
                 self.host_converged = True
                 break
@@ -238,6 +270,17 @@ class ResidentLoop:
     as buffers, not baked constants).  ``k`` steps fuse per superstep
     (the scan), ``cadence`` supersteps per host window (the ring).
 
+    ``with_extra=True`` is the SAME driver with one more carry leaf —
+    feature state (the compressed wire's EF accumulator) rides the
+    while-loop carry next to the weights and its per-step post-update
+    values ride a seventh ring leaf, mirroring how
+    :func:`make_compressed_superstep` carries EF in the scan.  The
+    step contract becomes ``step_fn(w, extra, i, reg_val, *data) ->
+    (new_w, new_extra, loss_i, new_reg, count)`` and ``run()`` takes
+    ``extra0`` (see ADVICE.md "One driver, many carries": feature
+    state must be carry state of the one driver, never per-driver
+    bookkeeping — this is what lifted the PR 9 DEVIATION).
+
     One instance = one jitted program; ``run()`` may be called
     repeatedly (the stepwise driver memoizes instances per
     ``(gradient, updater, config, K, C)``) — a whole run, including
@@ -246,7 +289,7 @@ class ResidentLoop:
     """
 
     def __init__(self, step_fn: Callable, config: SGDConfig, k: int,
-                 cadence: int):
+                 cadence: int, *, with_extra: bool = False):
         if int(cadence) < 1:
             raise ValueError(f"cadence must be >= 1, got {cadence}")
         if int(k) < 1:
@@ -254,6 +297,7 @@ class ResidentLoop:
         self.config = config
         self.k = int(k)
         self.cadence = int(cadence)
+        self.with_extra = bool(with_extra)
         self._step_fn = step_fn
         # Installed by run() immediately before each dispatch and read
         # by the io_callback (which may execute on the runtime's
@@ -360,7 +404,89 @@ class ResidentLoop:
                     jnp.asarray(False))
             return jax.lax.while_loop(cond, superstep, init)
 
-        return loop
+        def loop_extra(w0, e0, rv0, i0, *data):
+            # the extras-carrying twin of `loop`: identical structure
+            # with ONE more carry leaf (the extras state, e.g. the EF
+            # accumulator) and one more ring leaf (its per-step
+            # post-update history).  Kept as a separate trace so the
+            # legacy carry layout — and every bitwise pin on it —
+            # is untouched when no extras ride.
+            from jax.experimental import io_callback
+
+            from tpu_sgd.optimize.gradient_descent import pack_step_ys
+
+            rings0 = (
+                jnp.zeros((CK,) + w0.shape, w0.dtype),
+                jnp.zeros((CK,), jnp.float32),  # loss
+                jnp.zeros((CK,), jnp.float32),  # reg value
+                jnp.zeros((CK,), jnp.float32),  # realized batch count
+                jnp.zeros((CK,), jnp.float32),  # ||w_t - w_{t-1}||
+                jnp.zeros((CK,), jnp.float32),  # ||w_t||
+                jnp.zeros((CK,) + e0.shape, e0.dtype),  # extras (EF)
+            )
+
+            def superstep(carry):
+                (i, w, e, rv, rws, rls, rrs, rcs, rdns, rwns, res,
+                 slot, conv, stop) = carry
+                idx = i + jnp.arange(K, dtype=jnp.int32)
+
+                def body(c, ii):
+                    cw, ce, crv = c
+                    new_w, new_e, loss_i, new_rv, cnt = step_fn(
+                        cw, ce, ii, crv, *data)
+                    # extras ride the ys like the compressed superstep's
+                    # seventh leaf: mid-window checkpoints need
+                    # iteration-exact extras just as they need
+                    # iteration-exact weights
+                    return (new_w, new_e, new_rv), pack_step_ys(
+                        cw, new_w, loss_i, new_rv, cnt, f32=True
+                    ) + (new_e,)
+
+                (w, e, rv), ys = jax.lax.scan(body, (w, e, rv), idx)
+                base = slot * K
+                rws = jax.lax.dynamic_update_slice_in_dim(
+                    rws, ys[0], base, 0)
+                rls = jax.lax.dynamic_update_slice_in_dim(
+                    rls, ys[1], base, 0)
+                rrs = jax.lax.dynamic_update_slice_in_dim(
+                    rrs, ys[2], base, 0)
+                rcs = jax.lax.dynamic_update_slice_in_dim(
+                    rcs, ys[3], base, 0)
+                rdns = jax.lax.dynamic_update_slice_in_dim(
+                    rdns, ys[4], base, 0)
+                rwns = jax.lax.dynamic_update_slice_in_dim(
+                    rwns, ys[5], base, 0)
+                res = jax.lax.dynamic_update_slice_in_dim(
+                    res, ys[6], base, 0)
+                if tol > 0.0:
+                    conv_t = ((ys[3] > 0) & (idx > 1)
+                              & (ys[4] < tol * jnp.maximum(ys[5], 1.0)))
+                    conv = jnp.any(conv_t)
+                slot = slot + 1
+                fire = (slot == C) & jnp.logical_not(conv)
+                win_start = i - (C - 1) * K
+                stop = jax.lax.cond(
+                    fire,
+                    lambda a: io_callback(fire_cb, _BOOL, *a,
+                                          ordered=True),
+                    lambda a: stop,
+                    (win_start, rws, rls, rrs, rcs, rdns, rwns, res))
+                slot = jnp.where(fire, 0, slot)
+                return (i + K, w, e, rv, rws, rls, rrs, rcs, rdns,
+                        rwns, res, slot, conv, stop)
+
+            def cond(carry):
+                i, conv, stop = carry[0], carry[12], carry[13]
+                return ((i <= N) & jnp.logical_not(conv)
+                        & jnp.logical_not(stop))
+
+            init = (jnp.asarray(i0, jnp.int32), w0, e0,
+                    jnp.asarray(rv0, jnp.float32), *rings0,
+                    jnp.asarray(0, jnp.int32), jnp.asarray(False),
+                    jnp.asarray(False))
+            return jax.lax.while_loop(cond, superstep, init)
+
+        return loop_extra if self.with_extra else loop
 
     def compile_cache_size(self) -> int:
         """Compiled-program count of the underlying jitted loop (for
@@ -369,7 +495,7 @@ class ResidentLoop:
 
     # -- run-time ------------------------------------------------------------
     def run(self, w0, reg_val: float, start_iter: int, data: tuple,
-            hooks: ResidentBookkeeper):
+            hooks: ResidentBookkeeper, *, extra0=None):
         """Dispatch the whole-run program and finalize through ``hooks``.
 
         Returns ``(weights_np, converged)`` with every side effect (loss
@@ -379,12 +505,22 @@ class ResidentLoop:
         stop signal fired.  Normally ONE dispatch; a false f32
         device-convergence (see module docstring) re-dispatches from the
         exact replayed state — bitwise-stable, never a drift.
+
+        ``extra0`` seeds the extras carry leaf of a ``with_extra`` loop
+        (e.g. the restored-or-zero EF accumulator); its boundary state
+        surfaces through ``hooks.last_extra`` / ``hooks.extras_cb``.
         """
         from tpu_sgd.reliability.supervisor import TrainingPreempted
 
         cfg = self.config
         K = self.k
+        WE = self.with_extra
+        if WE and extra0 is None:
+            raise ValueError(
+                "this loop carries extras (with_extra=True); pass "
+                "extra0 — the initial extras state")
         w_dev = w0
+        e_dev = extra0
         rv = float(reg_val)
         i0 = int(start_iter)
         while True:
@@ -398,28 +534,34 @@ class ResidentLoop:
                 with self._run_lock:
                     self._hooks = hooks
                     try:
-                        carry = self._fn(w_dev, rv, i0, *data)
+                        carry = (self._fn(w_dev, e_dev, rv, i0, *data)
+                                 if WE else
+                                 self._fn(w_dev, rv, i0, *data))
                         # dispatch is async: block on the carry BEFORE
                         # clearing the hook — no callback outlives its
-                        # dispatch only once the program has completed
-                        # graftlint: disable=host-sync -- whole-run dispatch barrier: this 'loop' trips once per run (re-trips only on a false f32 device-convergence), and the callback hook must not be cleared before the program completes
+                        # dispatch only once the program has completed.
+                        # This barrier is the whole-run dispatch's own
+                        # contract (one trip per run; re-trips only on
+                        # a false f32 device-convergence)
                         jax.block_until_ready(carry)
                     finally:
                         self._hooks = None
-                # graftlint: disable=host-sync -- boundary fetch: three scalars once per RUN (the while re-trips only on false device-convergence), not per iteration
+                # boundary fetch: three scalars once per RUN, not per
+                # iteration
                 i_f = int(carry[0])
-                # graftlint: disable=host-sync -- boundary fetch, see line above
-                slot_f = int(carry[9])
-                # graftlint: disable=host-sync -- boundary fetch, see line above
-                conv_f = bool(carry[10])
+                slot_f = int(carry[11 if WE else 9])
+                conv_f = bool(carry[12 if WE else 10])
                 if hooks.error is None and slot_f:
                     # tail window: the un-replayed supersteps since the
                     # last fired window sit in ring rows
                     # [0, slot_f * K) — the rings are fetched to host
                     # ONLY here (a completed or stopped run with
                     # slot_f == 0 never pays the (C*K, d) device->host
-                    # copy)
-                    rings = tuple(np.asarray(r) for r in carry[3:9])
+                    # copy).  An extras carry shifts the ring block by
+                    # one (the extras leaf sits at carry[2]) and adds
+                    # its ring as the seventh leaf.
+                    rings = tuple(np.asarray(r) for r in
+                                  (carry[4:11] if WE else carry[3:9]))
                     hooks.replay(i_f - slot_f * K, rings, slot_f)
             if hooks.error is not None:
                 raise hooks.error
@@ -440,4 +582,7 @@ class ResidentLoop:
             # continue from the exact replayed state (one extra launch)
             i0 = hooks.replayed_through + 1
             w_dev = jnp.asarray(hooks.last_w).astype(w0.dtype)
+            if WE:
+                e_dev = jnp.asarray(hooks.last_extra).astype(
+                    extra0.dtype)
             rv = hooks.reg_val

@@ -73,11 +73,12 @@ _RESIDENT_LOOPS_MAX = 8
 #: key must be built from exactly these roots, and every program-
 #: affecting value the stored loop derives from must be covered by them
 GRAFTLINT_MEMO = {
-    # the loop key's locals (K, C, m_fixed, shared_full_batch) decompose
-    # to these roots: the optimizer plugins, the config, the superstep /
-    # cadence knobs, and the feed geometry through X
+    # the loop key's locals (K, C, comp_frac, m_fixed,
+    # shared_full_batch) decompose to these roots: the optimizer
+    # plugins, the config, the superstep / cadence / wire knobs, and
+    # the feed geometry through X
     "_RESIDENT_LOOPS": ("gradient", "updater", "config", "superstep_k",
-                        "resident_cadence", "X"),
+                        "resident_cadence", "wire_compress", "X"),
 }
 
 
@@ -187,10 +188,15 @@ def optimize_host_streamed(
     is checkpointed (``extras={"ef": ...}``) at every save — cadence,
     convergence, and preemption — and restores on resume, so an
     interrupted+resumed compressed run is bitwise equal to its
-    uninterrupted twin.  Composes with ``superstep_k``; partial
-    residency and the whole-run resident driver fall back to the dense
-    wire / superstep driver with a warning (the resident ring does not
-    yet carry EF state).
+    uninterrupted twin.  Composes with ``superstep_k`` AND with the
+    whole-run resident driver (``resident_cadence >= 2`` on the
+    full-batch or fully-resident-slab feed): the EF accumulator rides
+    the while-loop carry with its per-step history on a ring leaf, so
+    a compressed resident run is ONE dispatch per run like the dense
+    one (tests/test_composition.py).  Only PARTIAL residency falls
+    back to the dense wire with a warning (the mixed
+    resident/transferred window step carries no EF state — the grid's
+    recorded fallback cell).
     """
     import time as _time
 
@@ -214,17 +220,6 @@ def optimize_host_streamed(
         return w, np.zeros((0,), np.float32)
     wd = resolve_wire_dtype(wire_dtype, X.dtype)
     comp_frac = parse_wire_compress(wire_compress)
-    if comp_frac is not None and resident_rows:
-        import warnings
-
-        warnings.warn(
-            "wire_compress does not compose with partial residency "
-            "(the resident-window step has no EF carry); running the "
-            "dense gradient wire",
-            RuntimeWarning, stacklevel=3,
-        )
-        comp_frac = None
-
     # frac applied host-side; the device step consumes the whole batch.
     step_cfg = cfg.replace(mini_batch_fraction=1.0)
     frac = cfg.mini_batch_fraction
@@ -272,26 +267,32 @@ def optimize_host_streamed(
         warnings.warn(
             "device residency applies to the single-device full-batch "
             "and fully-resident-slab feeds (a host-sampled feed's host "
-            "hop IS the data feed); running the fused superstep driver",
+            "hop IS the data feed); running the fused superstep driver "
+            "— the recorded composition-grid cell for this feed "
+            "(tests/test_composition.py, feed=host-sampled x resident)",
             RuntimeWarning, stacklevel=3,
         )
         C = 0
-    if C >= 2 and comp_frac is not None:
+    if comp_frac is not None and R and not (fully_resident and C >= 2):
         import warnings
 
-        # DEVIATION, recorded loudly: the resident while-loop's ring
-        # carries (w, loss, reg, count, norms) but not yet the EF
-        # accumulator, and a cadence checkpoint without iteration-exact
-        # EF state would break the bitwise-resume contract — so the
-        # compressed wire runs the fused superstep driver (same compiled
-        # scan body, one dispatch per superstep instead of per run)
+        # a PARTIALLY-resident window feed mixes on-device and
+        # transferred windows through steps that carry no EF state
+        # (make_resident_window_superstep / resident_step) — the dense
+        # wire runs instead, per the recorded composition-grid cell
+        # (tests/test_composition.py, feed=slab-partial x compressed).
+        # A FULLY-resident slab with resident_cadence >= 2 composes:
+        # the EF accumulator rides the while-loop carry (the lifted
+        # PR 9 DEVIATION — see resident_driver.ResidentLoop).
         warnings.warn(
-            "wire_compress composes with the fused superstep driver; "
-            "the whole-run resident loop does not yet carry EF state "
-            "in its ring — running the superstep driver",
+            "wire_compress with a partially-resident window feed runs "
+            "the dense gradient wire (the resident-window step has no "
+            "EF carry; composition grid cell feed=slab-partial x "
+            "compressed) — a fully resident slab with "
+            "resident_cadence >= 2 carries EF in the while-loop ring",
             RuntimeWarning, stacklevel=3,
         )
-        C = 0
+        comp_frac = None
     if mesh is None:
         if device is None:
             device = jax.devices()[0]
@@ -702,11 +703,24 @@ def optimize_host_streamed(
             )
 
             if start_iter <= cfg.num_iterations:
+                # compressed wire on the resident driver: the EF
+                # accumulator is a CARRY LEAF of the same while-loop
+                # (with_extra) and its per-step history rides the ring,
+                # exactly as make_compressed_superstep carries it in
+                # the scan — one driver, many carries (ADVICE.md)
+                comp_step = (make_compressed_step(
+                    gradient, updater, step_cfg, comp_frac)
+                    if comp_frac is not None else None)
                 if shared_full_batch:
                     res_data = _full_batch_transfer()
 
-                    def _res_step(w_, i_, rv_, Xr, yr, vr):
-                        return base_step(w_, Xr, yr, i_, rv_, vr)
+                    if comp_frac is not None:
+                        def _res_step(w_, e_, i_, rv_, Xr, yr, vr):
+                            return comp_step(w_, e_, Xr, yr, i_, rv_,
+                                             vr)
+                    else:
+                        def _res_step(w_, i_, rv_, Xr, yr, vr):
+                            return base_step(w_, Xr, yr, i_, rv_, vr)
                 else:
                     # fully-resident sliced slab: the window sequence
                     # is deterministic in (seed, i) — replay THE host
@@ -724,27 +738,47 @@ def optimize_host_streamed(
                     starts_d = jax.device_put(starts_np, device)
                     res_data = (Xres, yres, starts_d)
 
-                    def _res_step(w_, i_, rv_, Xr, yr, st):
-                        s0 = st[i_ - 1]
-                        Xb = jax.lax.dynamic_slice_in_dim(
-                            Xr, s0, m_fixed, 0)
-                        yb = jax.lax.dynamic_slice_in_dim(
-                            yr, s0, m_fixed, 0)
-                        return base_step(w_, Xb, yb, i_, rv_,
-                                         ones_mask)
+                    if comp_frac is not None:
+                        def _res_step(w_, e_, i_, rv_, Xr, yr, st):
+                            s0 = st[i_ - 1]
+                            Xb = jax.lax.dynamic_slice_in_dim(
+                                Xr, s0, m_fixed, 0)
+                            yb = jax.lax.dynamic_slice_in_dim(
+                                yr, s0, m_fixed, 0)
+                            return comp_step(w_, e_, Xb, yb, i_, rv_,
+                                             ones_mask)
+                    else:
+                        def _res_step(w_, i_, rv_, Xr, yr, st):
+                            s0 = st[i_ - 1]
+                            Xb = jax.lax.dynamic_slice_in_dim(
+                                Xr, s0, m_fixed, 0)
+                            yb = jax.lax.dynamic_slice_in_dim(
+                                yr, s0, m_fixed, 0)
+                            return base_step(w_, Xb, yb, i_, rv_,
+                                             ones_mask)
 
                 # the loop's program depends only on (step math, cfg,
-                # K, C) and the feed shape family — memo hit = zero
-                # re-trace on resume/replay with the same optimizer
-                loop_key = (gradient, updater, cfg, K, C,
+                # K, C, wire) and the feed shape family — memo hit =
+                # zero re-trace on resume/replay with the same
+                # optimizer
+                loop_key = (gradient, updater, cfg, K, C, comp_frac,
                             ("full",) if shared_full_batch
                             else ("slab", m_fixed))
                 loop = _RESIDENT_LOOPS.get(loop_key)
                 if loop is None:
-                    loop = ResidentLoop(_res_step, cfg, K, C)
+                    loop = ResidentLoop(
+                        _res_step, cfg, K, C,
+                        with_extra=comp_frac is not None)
                     _RESIDENT_LOOPS[loop_key] = loop
                     while len(_RESIDENT_LOOPS) > _RESIDENT_LOOPS_MAX:
                         _RESIDENT_LOOPS.popitem(last=False)
+
+                def _install_ef_window(i0w, exs):
+                    # iteration-exact EF for checkpoint saves fired
+                    # inside this window's replay (_save reads it)
+                    _ef_window["efs"] = exs
+                    _ef_window["i0"] = int(i0w)
+
                 hooks = ResidentBookkeeper(
                     cfg, K, C, losses=losses, reg_val=reg_val,
                     start_iter=start_iter, listener=listener,
@@ -752,12 +786,19 @@ def optimize_host_streamed(
                              else None),
                     save_every=checkpoint_every,
                     stop_signal=stop_signal,
-                    retry_policy=retry_policy)
+                    retry_policy=retry_policy,
+                    extras_cb=(_install_ef_window
+                               if comp_frac is not None else None))
                 # the iteration-body failpoint fires once per DISPATCH,
                 # as on every other driver — one hit per resident run
                 failpoint("optimize.streamed.step")
-                w_np, converged = loop.run(w, reg_val, start_iter,
-                                           res_data, hooks)
+                if comp_frac is not None:
+                    w_np, converged = loop.run(w, reg_val, start_iter,
+                                               res_data, hooks,
+                                               extra0=ef)
+                else:
+                    w_np, converged = loop.run(w, reg_val, start_iter,
+                                               res_data, hooks)
                 w = jax.device_put(jnp.asarray(w_np), w_sharding)
                 reg_val = hooks.reg_val
             if listener is not None:

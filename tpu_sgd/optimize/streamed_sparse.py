@@ -33,7 +33,11 @@ superchunk, per-step ys replayed through the shared
 ``_replay_fused_steps`` — tail supersteps pad with all-False valid
 rows); checkpoint/resume and cooperative preemption at superstep
 boundaries, bitwise vs uninterrupted.  Full-batch feeds transfer the
-components ONCE and scan over them.
+components ONCE and scan over them; ``resident_cadence >= 2`` on that
+feed escalates to the shared whole-run resident driver
+(``optimize/resident_driver.py``) — the fixed-nse BCOO body becomes a
+``step_fn`` feed variant of the ONE ``lax.while_loop`` program, one
+dispatch per run instead of one per superstep.
 """
 
 from __future__ import annotations
@@ -65,7 +69,7 @@ _SPARSE_PROGRAMS_MAX = 8
 #: sampling parameters)
 GRAFTLINT_MEMO = {
     "_SPARSE_PROGRAMS": ("gradient", "updater", "config", "superstep_k",
-                         "X", "n", "d"),
+                         "resident_cadence", "X", "n", "d"),
 }
 
 
@@ -114,6 +118,24 @@ def _sparse_superstep_fn(gradient, updater, step_cfg, rows: int, d: int):
     return fn
 
 
+def _sparse_resident_step_fn(gradient, updater, step_cfg, rows: int,
+                             d: int):
+    """Per-iteration unit for the whole-run resident driver over the
+    ONE shared sparse batch: the fixed-nse BCOO reassembles from its
+    once-transferred components inside the while-loop body — the
+    sparse feed is just another ``step_fn`` variant of the single
+    driver (``resident_driver.ResidentLoop``), not a second loop.
+    UNJITTED: the loop owns the jit."""
+    from tpu_sgd.optimize.gradient_descent import make_step
+
+    base = make_step(gradient, updater, step_cfg)
+
+    def fn(w, i, rv, data, idx, yb, valid):
+        return base(w, _bcoo(data, idx, rows, d), yb, i, rv, valid)
+
+    return fn
+
+
 def _sparse_shared_superstep_fn(gradient, updater, step_cfg, rows: int,
                                 d: int, k: int):
     """Jitted K-fused superstep over ONE shared sparse batch (the
@@ -155,6 +177,7 @@ def optimize_host_streamed_sparse(
     retry_policy=None,
     stop_signal=None,
     superstep_k: int = 1,
+    resident_cadence: int = 0,
     wire_compress=None,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Run mini-batch SGD with the SPARSE dataset resident on the host.
@@ -164,7 +187,19 @@ def optimize_host_streamed_sparse(
     loss_history)`` with the dense streamed driver's exact bookkeeping
     semantics (loss history includes the previous iteration's reg
     value, convergence tolerance early exit, checkpoint cadence,
-    boundary preemption)."""
+    boundary preemption).
+
+    ``resident_cadence >= 2`` (with ``superstep_k >= 2``) on the
+    FULL-BATCH feed moves the whole run loop on device: the fixed-nse
+    BCOO components transfer once and the run is ONE
+    ``lax.while_loop`` dispatch of the same resident driver the dense
+    feeds use (``optimize/resident_driver.py``) — the sparse slab is a
+    ``step_fn`` feed variant of that one program, with the cadence
+    ``io_callback`` ring replaying through the shared
+    ``_replay_fused_steps``.  Host-sampled (bernoulli) sparse
+    streaming keeps the superstep driver with a warning (the per-batch
+    host hop IS the data feed — the composition grid's recorded
+    fallback cell)."""
     import time as _time
 
     from tpu_sgd.io import Prefetcher
@@ -242,6 +277,30 @@ def optimize_host_streamed_sparse(
                                       cfg.num_iterations, cap)
 
     K = max(1, int(superstep_k))
+    C = max(0, int(resident_cadence))
+    if C >= 2 and K <= 1:
+        import warnings
+
+        warnings.warn(
+            "device residency rides the fused superstep executor; pass "
+            "superstep_k >= 2 (or let the planner pick K) to engage it",
+            RuntimeWarning, stacklevel=3,
+        )
+        C = 0
+    if C >= 2 and not full_batch:
+        import warnings
+
+        warnings.warn(
+            "device residency applies to the full-batch sparse feed "
+            "(components transfer once); a bernoulli-sampled sparse "
+            "stream's per-batch host hop IS the data feed, so the "
+            "fused superstep driver runs — the recorded "
+            "composition-grid cell for this feed "
+            "(tests/test_composition.py, feed=sparse-bernoulli x "
+            "resident)",
+            RuntimeWarning, stacklevel=3,
+        )
+        C = 0
 
     _, reg_val = updater.compute(
         w, jnp.zeros_like(w), 0.0, jnp.asarray(1, jnp.int32),
@@ -304,11 +363,17 @@ def optimize_host_streamed_sparse(
                 jax.device_put(Ys, device), jax.device_put(Vs, device))
 
     # -- compiled programs (memoized; see GRAFTLINT_MEMO) -------------------
-    if K > 1:
-        kind = "shared_super" if full_batch else "super"
+    # kind stays at key index 4 (pinned in tests); the resident kind
+    # appends its cadence, which the other kinds don't key on
+    if K > 1 and C >= 2:
+        kind = "resident"
+        prog_key = (gradient, updater, cfg, K, kind, cap, nse_cap, d, C)
     else:
-        kind = "step"
-    prog_key = (gradient, updater, cfg, K, kind, cap, nse_cap, d)
+        if K > 1:
+            kind = "shared_super" if full_batch else "super"
+        else:
+            kind = "step"
+        prog_key = (gradient, updater, cfg, K, kind, cap, nse_cap, d)
     prog = _SPARSE_PROGRAMS.get(prog_key)
     if prog is None:
         if kind == "step":
@@ -316,6 +381,16 @@ def optimize_host_streamed_sparse(
         elif kind == "super":
             prog = jax.jit(_sparse_superstep_fn(
                 gradient, updater, step_cfg, cap, d))
+        elif kind == "resident":
+            # the ONE whole-run driver (optimize/resident_driver.py):
+            # the sparse shared batch is a step_fn feed variant of the
+            # same while-loop program the dense feeds dispatch
+            from tpu_sgd.optimize.resident_driver import ResidentLoop
+
+            prog = ResidentLoop(
+                _sparse_resident_step_fn(gradient, updater, step_cfg,
+                                         cap, d),
+                cfg, K, C)
         else:
             prog = jax.jit(_sparse_shared_superstep_fn(
                 gradient, updater, step_cfg, cap, d, K))
@@ -361,6 +436,38 @@ def optimize_host_streamed_sparse(
                 converged_early=converged,
                 wall_time_s=_time.perf_counter() - t_run,
             ))
+
+    if K > 1 and C >= 2:
+        # Whole-run resident sparse driver: the shared fixed-nse BCOO
+        # components transfer ONCE (inside the ingest retry scope,
+        # like the dense full-batch transfer) and the entire
+        # converged-or-budget-exhausted run is one dispatch of the
+        # shared while-loop program; window rings replay through the
+        # same ResidentBookkeeper/_replay_fused_steps bookkeeping as
+        # every resident feed, so history, events, convergence, and
+        # checkpoint bytes are exactly the superstep driver's.
+        from tpu_sgd.optimize.resident_driver import ResidentBookkeeper
+
+        if start_iter <= cfg.num_iterations:
+            def _t0():
+                return sample(start_iter)
+
+            shared = (retry_policy.call(_t0)
+                      if retry_policy is not None else _t0())
+            hooks = ResidentBookkeeper(
+                cfg, K, C, losses=losses, reg_val=reg_val,
+                start_iter=start_iter, listener=listener,
+                save_cb=(_save if checkpoint_manager is not None
+                         else None),
+                save_every=checkpoint_every,
+                stop_signal=stop_signal,
+                retry_policy=retry_policy)
+            w_np, converged = prog.run(w, reg_val, start_iter, shared,
+                                       hooks)
+            w = jax.device_put(jnp.asarray(w_np), device)
+            reg_val = hooks.reg_val
+        _end()
+        return w, np.asarray(losses, np.float32)
 
     if K > 1:
         from tpu_sgd.reliability.supervisor import TrainingPreempted

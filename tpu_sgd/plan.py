@@ -667,8 +667,8 @@ def choose_superstep(window_rows: int, d: int, itemsize: int,
 
 
 def choose_wire_compress(dim: int, n_devices: int,
-                         cost_model: CostModel = DEFAULT_COST_MODEL
-                         ) -> Optional[str]:
+                         cost_model: CostModel = DEFAULT_COST_MODEL,
+                         resident_cadence: int = 0) -> Optional[str]:
     """Compressed-wire decision for the per-step gradient all-reduce
     (README "Compressed wire"): compression pays ONLY when the
     predicted wire bytes dominate the compress/decompress cost.
@@ -679,20 +679,41 @@ def choose_wire_compress(dim: int, n_devices: int,
     (each surviving entry carries an int32 index beside its f32 value)
     at a fixed ``compress_overhead_s`` per step (host/device top-k
     selection + the segment scatter-add).  Returns ``"topk:<frac>"``
-    when the byte-time saving exceeds the overhead, else None.  Two
-    structural gates: a single device has no all-reduce wire (``None``
-    — the single-device EF rule stays a user opt-in for A/B runs), and
-    the kept segment must hold at least one entry.
+    when the byte-time saving exceeds the overhead, else None.
+
+    ``resident_cadence`` lifts the old single-device gate (ISSUE 20):
+    a lone device has no all-reduce wire, so the EF rule used to be
+    strictly a user opt-in for A/B runs — and the resident driver
+    REFUSED it anyway (the PR 9 deviation).  With EF carried in the
+    resident while-loop ring, a plan may propose residency AND the
+    compressed update together: under ``resident_cadence >= 2`` the
+    top-k select runs in-trace inside the one fused body (no
+    ``compress_overhead_s`` host hop — only one extra ``(dim,)`` pass
+    at ``hbm_gb_s``), so the single-device proposal costs what that
+    pass costs and buys scale-out-ready EF state: the run trains the
+    exact update rule its meshed or replica twin ships, with the wire
+    already matched-loss-validated.  The proposal still requires the
+    kept segment to hold at least one entry (``frac * dim >= 1``) and
+    the in-trace pass to fit the same ``compress_overhead_s`` budget
+    the meshed rule charges.
 
     Deliberately conservative: the compressed wire CHANGES the update
     rule (top-k + error feedback — matched final loss, not matched
     trajectory), so the planner proposes it only where the cost model
-    says the wire genuinely dominates; borderline cases keep the dense
-    wire and its bitwise contracts."""
+    says the wire genuinely dominates (or, resident, where it rides
+    free); borderline cases keep the dense wire and its bitwise
+    contracts."""
     cm = cost_model
-    if int(n_devices) <= 1 or int(dim) < 2:
+    if int(dim) < 2:
         return None
     frac = float(cm.wire_compress_frac)
+    if int(n_devices) <= 1:
+        if int(resident_cadence) < 2 or frac * dim < 1.0:
+            return None
+        select_s = dim * 4.0 / (cm.hbm_gb_s * 1e9)
+        if select_s > cm.compress_overhead_s:
+            return None
+        return f"topk:{frac:g}"
     dense_s = dim * 4.0 / (cm.allreduce_gb_s * 1e9)
     saved_s = dense_s * (1.0 - 2.0 * frac)
     if saved_s <= cm.compress_overhead_s:
@@ -1147,12 +1168,16 @@ def plan(
                     K = K_res
                     est["superstep"] = K
             est["residency"] = Cres
-            # compressed gradient wire: only where a real multi-shard
+            # compressed gradient wire: where a real multi-shard
             # all-reduce exists and its bytes dominate the compress
-            # cost (choose_wire_compress — the compressed update rule
-            # is matched-loss, not matched-trajectory, so the proposal
-            # is loud in the reason string)
-            wc = choose_wire_compress(d, n_devices, cost_model=cm)
+            # cost — or, single-device, where the EF select rides the
+            # RESIDENT body in-trace (ISSUE 20 lifted the PR 9 mutual
+            # exclusion, so a plan may propose residency and the
+            # compressed update together).  Matched-loss, not
+            # matched-trajectory either way, so the proposal is loud
+            # in the reason string
+            wc = choose_wire_compress(d, n_devices, cost_model=cm,
+                                      resident_cadence=Cres)
             est["wire_compress"] = wc
             fused_note = (
                 f"; K={K} fused steps per dispatch amortize the "
@@ -1162,12 +1187,20 @@ def plan(
                 fused_note += (
                     f"; device-resident run loop (cadence {Cres} "
                     "supersteps/host hop — one dispatch per run)")
-            if wc:
+            if wc and n_devices > 1:
                 fused_note += (
                     f"; compressed gradient wire ({wc}: top-k + error "
                     "feedback — matched final loss, NOT a bitwise "
                     "trajectory; pass wire_compress=False to keep the "
                     "dense all-reduce)")
+            elif wc:
+                fused_note += (
+                    f"; compressed gradient wire ({wc}) riding the "
+                    "resident body — the EF top-k selects in-trace "
+                    "inside the one while-loop dispatch (ISSUE 20), "
+                    "matched final loss, NOT a bitwise trajectory; "
+                    "pass wire_compress=False to keep the dense "
+                    "update")
             chosen = Plan(
                 "host_streamed",
                 f"data ({_fmt_gb(data_bytes_local)}) exceeds HBM "

@@ -123,6 +123,7 @@ class ReplicaDriver:
         self.poison_guard: object = 10.0
         self._integrity_rollback = False
         self.wire_compress = None
+        self.resident_rounds = 0
         self.listener = None
         self.checkpoint_manager = None
         self.checkpoint_every = 10
@@ -252,6 +253,25 @@ class ReplicaDriver:
         self.wire_compress = spec
         return self
 
+    def set_resident_rounds(self, k):
+        """``k >= 1`` runs every worker in RESIDENT mode (ISSUE 20):
+        the pull → local-sums → push cycle becomes ``k`` supersteps of
+        the shared fused body inside ONE ``lax.while_loop`` dispatch
+        per worker, push/pull staged at the cadence ``io_callback``.
+        ``k=1`` is per-push bitwise with the threaded loop (τ=0 keeps
+        the sync pin); ``k >= 2`` folds ``k`` sampled batches into one
+        contribution per protocol round — matched loss, not bitwise.
+        Needs one device per worker (a resident worker holds its
+        device for the whole-run dispatch); a shared-device fleet
+        falls back LOUDLY to the per-cycle loop.
+        ``0``/``None``/``False`` (default) keeps the per-cycle loop."""
+        if k is None or k is False:
+            k = 0
+        if int(k) < 0:
+            raise ValueError(f"resident_rounds must be >= 0, got {k}")
+        self.resident_rounds = int(k)
+        return self
+
     def set_retry(self, policy):
         """Per-worker ``RetryPolicy`` healing transient pull/push
         faults (the ``replica.pull``/``replica.push`` failpoints) in
@@ -375,6 +395,7 @@ class ReplicaDriver:
             "replica", type(self.gradient).__name__,
             type(self.updater).__name__, cfg, self.n_workers,
             StalenessContract(self.staleness).tau, self.wire_compress,
+            self.resident_rounds,
         ))
 
         resume_state = None
@@ -394,6 +415,27 @@ class ReplicaDriver:
 
         devices = (self.devices if self.devices is not None
                    else list(jax.devices()))
+        resident_rounds = self.resident_rounds
+        if resident_rounds >= 1 and self.n_workers > len(devices):
+            # a resident worker OWNS its device for the whole-run
+            # while_loop dispatch; two resident programs sharing one
+            # device serialize, and at τ=0 the in-callback round
+            # barrier then deadlocks (worker A's push waits for worker
+            # B, whose queued dispatch waits for the device).  Loud
+            # fallback, never silent
+            import warnings as _warnings
+
+            _warnings.warn(
+                f"resident replica mode needs one device per worker "
+                f"({self.n_workers} workers, {len(devices)} devices): "
+                "a resident worker holds its device for the whole-run "
+                "while_loop, so co-scheduled fleets serialize (and "
+                "deadlock on the τ=0 round barrier) — falling back to "
+                "the per-cycle threaded loop (the recorded "
+                "composition-grid cell: tests/test_composition.py, "
+                "replica x resident, shared device)",
+                RuntimeWarning, stacklevel=2)
+            resident_rounds = 0
         membership = ReplicaMembership(listener=self.listener)
         # store_shards > 1 swaps in the sharded store; at 1 the plain
         # store is constructed — the single-pipeline path stays
@@ -489,6 +531,7 @@ class ReplicaDriver:
                 device=devices[s % len(devices)],
                 retry_policy=self.retry_policy,
                 heartbeat=rec.heartbeat, wire_frac=frac,
+                resident_rounds=resident_rounds,
             )
 
             def _main():

@@ -39,6 +39,21 @@ mass is not).  A worker that cannot reach ANY store sees
 partition is just a longer rejection, healed by retry or by
 death-and-rejoin — zero gradient mass lost either way.
 
+Resident mode (``resident_rounds >= 1``, ISSUE 20): the per-cycle
+Python loop is replaced by ONE ``lax.while_loop`` dispatch whose carry
+is the protocol state ``(weights, version, done)`` — the same
+one-driver shape as ``optimize/resident_driver.py``.  Each loop
+iteration runs ``resident_rounds`` supersteps of the shared local-sums
+body against the pulled basis (sampled at ``version + 1 + t`` — the
+K-fold batch union) and stages push → pull through ONE ordered
+``io_callback`` per cadence window.  ``resident_rounds=1`` keeps the
+per-push math identical to the per-cycle loop (τ=0 stays bitwise vs
+the synchronous meshed path); ``resident_rounds >= 2`` folds K
+sampled batches into one contribution — a matched-loss, NOT bitwise,
+trajectory (the composition grid records the cell).  Both wires ride
+it unchanged: :meth:`ReplicaWorker._push_contribution` is host code
+shared verbatim with :meth:`ReplicaWorker.run_once`.
+
 Compressed wire (``topk:<frac>``): the worker normalizes its
 contribution to a batch-mean gradient, folds it through its persistent
 per-worker :class:`~tpu_sgd.io.sparse_wire.ErrorFeedback` accumulator
@@ -110,6 +125,7 @@ class ReplicaWorker:
         retry_policy=None,
         heartbeat=None,
         wire_frac: Optional[float] = None,
+        resident_rounds: int = 0,
     ):
         self.worker_id = worker_id
         self.shard_index = int(shard_index)
@@ -134,6 +150,11 @@ class ReplicaWorker:
         self._shard_layout = (store.shard_layout()
                               if hasattr(store, "shard_layout")
                               else None)
+        self.resident_rounds = max(0, int(resident_rounds))
+        self._resident_fn = None  # built lazily on the first resident run
+        self._res_epoch = None
+        self._res_w = None
+        self._res_exc: dict = {"exc": None}
         self.cycles = 0
         self.rejected = 0
         self.fenced = 0
@@ -145,6 +166,129 @@ class ReplicaWorker:
         if self.retry_policy is not None:
             return self.retry_policy.call(fn, *args, **kwargs)
         return fn(*args, **kwargs)
+
+    def _push_contribution(self, version: int, epoch, g, l, c):
+        """Ship ONE ``(grad_sum, loss_sum, count)`` contribution computed
+        at basis ``version`` over the configured wire — the dense sealed
+        push, or the compressed top-k wire with its error-feedback
+        restore-on-rejection discipline.  Shared verbatim by the
+        per-cycle loop (:meth:`run_once`) and the resident loop's
+        cadence callback, so the wire semantics cannot drift between
+        the two drivers."""
+        if self.ef is not None:
+            # compressed wire: batch-mean normalize HOST-side (EF
+            # state must accumulate at one scale), fold + select
+            # top-k.  This is the wire boundary: the segment
+            # selection runs in host numpy (the shape-trap rule),
+            # so the contribution comes home here — one bulk fetch
+            # plus its two scalars
+            c_host = float(c)
+            l_host = float(l)
+            if c_host <= 0.0:
+                # empty sampled batch: the store's apply is a no-op
+                # (has_batch gates the update), so folding the EF
+                # accumulator here would extract mass an ACCEPTED
+                # push then silently discards — ship an empty
+                # segment instead (the push still advances the
+                # protocol; the accumulator is untouched)
+                idx = np.zeros((0,), np.int32)
+                vals = np.zeros((0,), np.float32)
+            else:
+                gn = np.asarray(g).reshape(-1) / max(c_host, 1.0)
+                idx, vals = self.ef.compress(gn)
+            try:
+                # seal the segment's host bytes: the store verifies
+                # at ITS consume site, after the modeled wire hop
+                # (tpu_sgd/io/integrity.py) — a corrupt-detected
+                # push heals inside _call's retry with the intact
+                # originals, EF mass untouched.  Against a SHARDED
+                # store the seals additionally ride per-shard: the
+                # producer splits exactly as the store will
+                # (shard_layout) and seals each split, so a
+                # misrouted/damaged shard segment is caught at the
+                # store's per-shard consume site
+                push_kw = {}
+                if self._shard_layout is not None:
+                    push_kw["shard_seals"] = tuple(
+                        seal((idx[(idx >= a) & (idx < b)]
+                              - a).astype(np.int32),
+                             vals[(idx >= a) & (idx < b)])
+                        for a, b in self._shard_layout)
+                res = self._call(
+                    self.store.push_compressed, self.worker_id,
+                    version, idx, vals, l_host, c_host,
+                    basis_epoch=epoch,
+                    checksum=seal(idx, vals), **push_kw)
+            except BaseException:
+                # the push never produced a result (retry budget
+                # exhausted, or a kill): this worker may die and
+                # REJOIN re-attached to the same accumulator — the
+                # extracted mass must go back first, or every such
+                # death leaks gradient
+                self.ef.restore_segment(idx, vals)
+                raise
+            if not res.accepted and not res.done:
+                # stale push: the extracted mass must go back into
+                # the accumulator or the rejection silently drops
+                # gradient
+                self.ef.restore_segment(idx, vals)
+            return res
+        # the dense wire's seal: host views of the local sums
+        # (zero-copy on CPU — the push was about to fetch these
+        # bytes anyway), verified at the store's consume site.
+        # Gated so set_integrity(False) really removes the
+        # device→host staging on backends where it costs
+        ck = (seal(np.asarray(g), np.asarray(l), np.asarray(c))
+              if integrity_enabled() else None)
+        return self._call(
+            self.store.push, self.worker_id,
+            version, g, l, c,
+            basis_epoch=epoch, checksum=ck)
+
+    def _account(self, res, version: int, epoch) -> None:
+        """Post-push bookkeeping shared by both drivers: the cycle /
+        rejection / fenced / poisoned counters, the poison-streak
+        limit, and the heartbeat tick."""
+        self.cycles += 1
+        if not res.accepted and not res.done:
+            # a fenced push is the failover spelling of a staleness
+            # rejection, a poisoned push the integrity spelling: the
+            # work is discarded WHOLE either way — re-pull and
+            # recompute (EF mass already restored above)
+            if getattr(res, "fenced", False):
+                self.fenced += 1
+            elif getattr(res, "poisoned", False):
+                self.poisoned += 1
+                # the streak counts SAME-(epoch, basis) rejections: a
+                # rollback moves the store to a restored version line
+                # and the recompute against it is a genuinely new
+                # payload — never charge it with the old line's spins
+                basis = (epoch, version)
+                self._poison_streak = (self._poison_streak + 1
+                                       if basis == self._poison_basis
+                                       else 1)
+                self._poison_basis = basis
+                if self._poison_streak >= self.POISON_STREAK_LIMIT:
+                    # the recompute is deterministic: this payload is
+                    # genuinely bad and nothing upstream is changing —
+                    # fail LOUDLY (the driver's rejoin budget absorbs a
+                    # transient; an exhausted budget propagates this
+                    # error, and its IntegrityError class is what the
+                    # integrity.unhealed accounting keys on)
+                    raise IntegrityError(
+                        "replica.push", "poison",
+                        f"worker {self.worker_id!r}: "
+                        f"{self._poison_streak} consecutive poisoned "
+                        f"rejections at basis {version} — the "
+                        "deterministic recompute cannot heal this "
+                        "(weights corrupted with rollback unarmed, or "
+                        "genuine divergence)")
+            else:
+                self.rejected += 1
+        if res.accepted:
+            self._poison_streak = 0
+        if self.heartbeat is not None:
+            self.heartbeat.beat()
 
     def run_once(self) -> bool:
         """One pull → compute → push cycle; False when the run is done
@@ -172,118 +316,131 @@ class ReplicaWorker:
             else:
                 g, l, c = self._local_sums(
                     w, self._X, self._y, jnp.asarray(i, jnp.int32))
-            if self.ef is not None:
-                # compressed wire: batch-mean normalize HOST-side (EF
-                # state must accumulate at one scale), fold + select
-                # top-k.  This is the wire boundary: the segment
-                # selection runs in host numpy (the shape-trap rule),
-                # so the contribution comes home here — one bulk fetch
-                # plus its two scalars
-                c_host = float(c)
-                l_host = float(l)
-                if c_host <= 0.0:
-                    # empty sampled batch: the store's apply is a no-op
-                    # (has_batch gates the update), so folding the EF
-                    # accumulator here would extract mass an ACCEPTED
-                    # push then silently discards — ship an empty
-                    # segment instead (the push still advances the
-                    # protocol; the accumulator is untouched)
-                    idx = np.zeros((0,), np.int32)
-                    vals = np.zeros((0,), np.float32)
-                else:
-                    gn = np.asarray(g).reshape(-1) / max(c_host, 1.0)
-                    idx, vals = self.ef.compress(gn)
-                try:
-                    # seal the segment's host bytes: the store verifies
-                    # at ITS consume site, after the modeled wire hop
-                    # (tpu_sgd/io/integrity.py) — a corrupt-detected
-                    # push heals inside _call's retry with the intact
-                    # originals, EF mass untouched.  Against a SHARDED
-                    # store the seals additionally ride per-shard: the
-                    # producer splits exactly as the store will
-                    # (shard_layout) and seals each split, so a
-                    # misrouted/damaged shard segment is caught at the
-                    # store's per-shard consume site
-                    push_kw = {}
-                    if self._shard_layout is not None:
-                        push_kw["shard_seals"] = tuple(
-                            seal((idx[(idx >= a) & (idx < b)]
-                                  - a).astype(np.int32),
-                                 vals[(idx >= a) & (idx < b)])
-                            for a, b in self._shard_layout)
-                    res = self._call(
-                        self.store.push_compressed, self.worker_id,
-                        pulled.version, idx, vals, l_host, c_host,
-                        basis_epoch=pulled.epoch,
-                        checksum=seal(idx, vals), **push_kw)
-                except BaseException:
-                    # the push never produced a result (retry budget
-                    # exhausted, or a kill): this worker may die and
-                    # REJOIN re-attached to the same accumulator — the
-                    # extracted mass must go back first, or every such
-                    # death leaks gradient
-                    self.ef.restore_segment(idx, vals)
-                    raise
-                if not res.accepted and not res.done:
-                    # stale push: the extracted mass must go back into
-                    # the accumulator or the rejection silently drops
-                    # gradient
-                    self.ef.restore_segment(idx, vals)
-            else:
-                # the dense wire's seal: host views of the local sums
-                # (zero-copy on CPU — the push was about to fetch these
-                # bytes anyway), verified at the store's consume site.
-                # Gated so set_integrity(False) really removes the
-                # device→host staging on backends where it costs
-                ck = (seal(np.asarray(g), np.asarray(l), np.asarray(c))
-                      if integrity_enabled() else None)
-                res = self._call(
-                    self.store.push, self.worker_id,
-                    pulled.version, g, l, c,
-                    basis_epoch=pulled.epoch, checksum=ck)
-        self.cycles += 1
-        if not res.accepted and not res.done:
-            # a fenced push is the failover spelling of a staleness
-            # rejection, a poisoned push the integrity spelling: the
-            # work is discarded WHOLE either way — re-pull and
-            # recompute (EF mass already restored above)
-            if getattr(res, "fenced", False):
-                self.fenced += 1
-            elif getattr(res, "poisoned", False):
-                self.poisoned += 1
-                # the streak counts SAME-(epoch, basis) rejections: a
-                # rollback moves the store to a restored version line
-                # and the recompute against it is a genuinely new
-                # payload — never charge it with the old line's spins
-                basis = (pulled.epoch, pulled.version)
-                self._poison_streak = (self._poison_streak + 1
-                                       if basis == self._poison_basis
-                                       else 1)
-                self._poison_basis = basis
-                if self._poison_streak >= self.POISON_STREAK_LIMIT:
-                    # the recompute is deterministic: this payload is
-                    # genuinely bad and nothing upstream is changing —
-                    # fail LOUDLY (the driver's rejoin budget absorbs a
-                    # transient; an exhausted budget propagates this
-                    # error, and its IntegrityError class is what the
-                    # integrity.unhealed accounting keys on)
-                    raise IntegrityError(
-                        "replica.push", "poison",
-                        f"worker {self.worker_id!r}: "
-                        f"{self._poison_streak} consecutive poisoned "
-                        f"rejections at basis {pulled.version} — the "
-                        "deterministic recompute cannot heal this "
-                        "(weights corrupted with rollback unarmed, or "
-                        "genuine divergence)")
-            else:
-                self.rejected += 1
-        if res.accepted:
-            self._poison_streak = 0
-        if self.heartbeat is not None:
-            self.heartbeat.beat()
+            res = self._push_contribution(
+                pulled.version, pulled.epoch, g, l, c)
+        self._account(res, pulled.version, pulled.epoch)
         return not res.done
 
+    # -- resident mode (ISSUE 20: one while_loop per worker) ---------------
+
+    def _resident_round_cb(self, ver, G, L, C):
+        """The resident loop's ONE host hop per cadence window, run on
+        the runtime's ordered-``io_callback`` thread: push the folded
+        K-superstep contribution at basis ``ver``, then pull the next
+        basis.  Exceptions must not cross the FFI boundary (the same
+        stash-flag-reraise containment as
+        ``optimize/resident_driver.py``): the callback stashes, halts
+        the device loop via the done flag, and :meth:`_run_resident`
+        re-raises after the dispatch completes."""
+        try:
+            ver_i = int(ver)
+            with span("replica.round", worker=self.worker_id,
+                      basis=ver_i, k=self.resident_rounds):
+                res = self._push_contribution(
+                    ver_i, self._res_epoch, np.asarray(G),
+                    float(L), float(C))
+                self._account(res, ver_i, self._res_epoch)
+                if res.done:
+                    return (self._res_w, np.int32(ver_i), np.bool_(True))
+                pulled = self._call(self.store.pull, self.worker_id)
+                if pulled.done:
+                    return (self._res_w, np.int32(ver_i), np.bool_(True))
+                self._res_epoch = pulled.epoch
+                self._res_w = np.asarray(
+                    pulled.weights, dtype=self._res_w.dtype)
+                return (self._res_w, np.int32(pulled.version),
+                        np.bool_(False))
+        except BaseException as e:
+            self._res_exc["exc"] = e
+            return (self._res_w, np.int32(int(ver)), np.bool_(True))
+
+    def _build_resident(self):
+        """Trace the resident worker program: ONE ``lax.while_loop``
+        whose carry is ``(weights, version, done)`` — the replica
+        protocol state as first-class carry of the fused driver shape.
+        Each loop iteration runs ``resident_rounds`` supersteps of the
+        SAME shared ``_make_local_sums`` body (sampled at ``version + 1
+        + t``, all against the pulled basis — the K-fold batch union),
+        folds the sums on device, and stages push → pull through the
+        cadence ``io_callback``.  Dense and compressed wires both ride
+        it: the wire code is host-side and shared via
+        :meth:`_push_contribution` (ADVICE.md "One driver, many
+        carries")."""
+        K = self.resident_rounds
+        local = self._local_sums
+        has_valid = self._valid is not None
+        round_cb = self._resident_round_cb
+
+        def loop(w0, ver0, X, y, valid):
+            from jax.experimental import io_callback
+
+            res_shapes = (jax.ShapeDtypeStruct(w0.shape, w0.dtype),
+                          jax.ShapeDtypeStruct((), jnp.int32),
+                          jax.ShapeDtypeStruct((), jnp.bool_))
+
+            def body(carry):
+                w, ver, _done = carry
+
+                def one(t, acc):
+                    gacc, lacc, cacc = acc
+                    i = (ver + 1 + t).astype(jnp.int32)
+                    if has_valid:
+                        g, l, c = local(w, X, y, i, valid)
+                    else:
+                        g, l, c = local(w, X, y, i)
+                    return (gacc + g.astype(w.dtype),
+                            lacc + l.astype(jnp.float32),
+                            cacc + c.astype(jnp.float32))
+
+                G, L, C = jax.lax.fori_loop(
+                    0, K, one,
+                    (jnp.zeros_like(w), jnp.float32(0.0),
+                     jnp.float32(0.0)))
+                # ordered: the round protocol is sequenced host state
+                # (push t must precede pull t must precede push t+1)
+                new_w, new_ver, new_done = io_callback(
+                    round_cb, res_shapes, ver, G, L, C, ordered=True)
+                return (new_w, new_ver, new_done)
+
+            def cond(carry):
+                return jnp.logical_not(carry[2])
+
+            return jax.lax.while_loop(
+                cond, body, (w0, ver0, jnp.bool_(False)))
+
+        return jax.jit(loop)
+
+    def _run_resident(self) -> None:
+        """The resident main loop: one pull to seed the carry, ONE
+        dispatch for the whole run (vs. one per cycle in
+        :meth:`run_once`'s loop — the dispatch-count headline in
+        BENCH_RESIDENT.json)."""
+        pulled = self._call(self.store.pull, self.worker_id)
+        if pulled.done:
+            return
+        self._res_epoch = pulled.epoch
+        self._res_w = np.asarray(pulled.weights, np.float32)
+        self._res_exc["exc"] = None
+        if self._resident_fn is None:
+            self._resident_fn = self._build_resident()
+        w_dev = jax.device_put(jnp.asarray(self._res_w), self.device)
+        valid = (self._valid if self._valid is not None
+                 else jnp.zeros((0,), jnp.float32))
+        carry = self._resident_fn(
+            w_dev, jnp.asarray(pulled.version, jnp.int32),
+            self._X, self._y, valid)
+        jax.block_until_ready(carry[0])
+        exc = self._res_exc["exc"]
+        if exc is not None:
+            self._res_exc["exc"] = None
+            raise exc
+
     def run(self) -> None:
-        """The worker main loop (the driver runs this on a thread)."""
+        """The worker main loop (the driver runs this on a thread).
+        ``resident_rounds >= 1`` swaps the per-cycle pull → compute →
+        push loop for the resident ``while_loop`` driver."""
+        if self.resident_rounds >= 1:
+            self._run_resident()
+            return
         while self.run_once():
             pass
